@@ -1,0 +1,70 @@
+"""Benchmark driver: prints ONE JSON line with the headline metric.
+
+Current flagship: LeNet-MNIST training throughput on one TPU chip
+(imgs/sec). Baseline for vs_baseline: the reference's best published
+ResNet-class CPU number is not comparable to LeNet; we use the reference's
+SmallNet (CIFAR-quick) 10.463 ms/batch @ bs64 on K40m
+(reference: benchmark/README.md:54) as the nearest small-convnet
+train-step baseline => 6116 imgs/sec. Will switch to ResNet-50 when the
+model zoo lands.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    from paddle_tpu import models, optim
+    from paddle_tpu.nn.module import ShapeSpec
+    from paddle_tpu.ops import losses
+    from paddle_tpu.train.state import TrainState
+    from paddle_tpu.train.trainer import make_train_step
+
+    batch = 256
+    model = models.lenet.lenet(10, with_bn=True)
+    rng = jax.random.key(0)
+    params, mstate = model.init(rng, ShapeSpec((batch, 28, 28, 1)))
+    opt = optim.momentum(0.01, mu=0.9)
+    state = TrainState.create(params, mstate, opt)
+
+    def loss_fn(logits, labels):
+        return jnp.mean(losses.softmax_cross_entropy(logits, labels))
+
+    step = make_train_step(model, loss_fn, opt, donate=True)
+
+    x = jnp.asarray(np.random.RandomState(0).rand(batch, 28, 28, 1), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 10, batch))
+
+    # warmup / compile
+    state, loss, _ = step(state, rng, (x,), (y,))
+    jax.block_until_ready(state.params)
+
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss, _ = step(state, rng, (x,), (y,))
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * iters / dt
+    baseline = 64 / 0.010463  # SmallNet bs64 @ 10.463 ms/batch on K40m
+    print(
+        json.dumps(
+            {
+                "metric": "lenet_mnist_train_imgs_per_sec",
+                "value": round(imgs_per_sec, 1),
+                "unit": "imgs/sec",
+                "vs_baseline": round(imgs_per_sec / baseline, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Core runtime: dtype policy, mesh/sharding helpers, RNG, error checking.
+
+Maps the reference's device-abstraction layers (paddle/cuda C ABI,
+paddle/platform DeviceContext/Place, paddle/memory) onto the JAX/XLA
+runtime: devices come from PJRT, memory from XLA's arena allocator, and
+"kernels" are traced+compiled programs, so the explicit per-device
+stream/handle machinery (reference: platform/device_context.h:38) is
+structurally unnecessary and is replaced by thin helpers here.
+"""
+
+from paddle_tpu.core.dtypes import (
+    Policy,
+    default_policy,
+    set_default_policy,
+    canonical_dtype,
+)
+from paddle_tpu.core.errors import (
+    PaddleTpuError,
+    enforce,
+    enforce_eq,
+    enforce_shape,
+    enforce_rank,
+)
+from paddle_tpu.core.mesh import (
+    MeshConfig,
+    build_mesh,
+    local_mesh,
+    axis_size,
+    with_sharding,
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+)
+from paddle_tpu.core.rng import RngSeq, split_key
+from paddle_tpu.core.pytree import (
+    tree_size,
+    tree_bytes,
+    named_leaves,
+    tree_map_with_name,
+    global_norm,
+)

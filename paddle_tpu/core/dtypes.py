@@ -1,0 +1,66 @@
+"""Dtype policy: parameters, compute, and output dtypes.
+
+The reference keeps everything float32 (real_t, paddle/utils/Common.h) with
+optional float16 storage in GpuMatrix. On TPU the idiomatic split is
+float32 parameters with bfloat16 compute feeding the MXU; this module makes
+that a single global (or per-call) policy object instead of a compile-time
+typedef.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Dtype policy applied by layers.
+
+    param_dtype:   dtype parameters are stored in (master weights).
+    compute_dtype: dtype inputs/weights are cast to before matmul/conv so
+                   the MXU runs in bf16 while accumulation stays f32.
+    accum_dtype:   preferred_element_type for dot/conv accumulation.
+    """
+
+    param_dtype: jnp.dtype = jnp.float32
+    compute_dtype: jnp.dtype = jnp.float32
+    accum_dtype: jnp.dtype = jnp.float32
+
+    def cast_to_compute(self, *xs):
+        out = tuple(
+            x.astype(self.compute_dtype) if hasattr(x, "astype") else x for x in xs
+        )
+        return out if len(out) != 1 else out[0]
+
+
+_DEFAULT = Policy()
+
+
+def default_policy() -> Policy:
+    return _DEFAULT
+
+
+def set_default_policy(policy: Policy) -> None:
+    global _DEFAULT
+    _DEFAULT = policy
+
+
+def bf16_compute_policy() -> Policy:
+    """The standard TPU training policy: f32 params, bf16 MXU compute."""
+    return Policy(
+        param_dtype=jnp.float32,
+        compute_dtype=jnp.bfloat16,
+        accum_dtype=jnp.float32,
+    )
+
+
+def canonical_dtype(dtype) -> jnp.dtype:
+    return jnp.dtype(dtype)
+
+
+def at_least_f32(x):
+    """Upcast to float32 for stable reductions, but keep float64 intact
+    (numeric gradient checks run the whole graph in double)."""
+    return x.astype(jnp.promote_types(x.dtype, jnp.float32))

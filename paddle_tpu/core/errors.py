@@ -1,0 +1,38 @@
+"""Error checking helpers.
+
+Replaces the reference's PADDLE_ENFORCE / PADDLE_THROW machinery
+(reference: paddle/platform/enforce.h) and the Error monad
+(reference: paddle/utils/Error.h) with plain Python exceptions raised at
+trace time — shape/type errors on TPU are trace-time errors by design.
+"""
+
+from __future__ import annotations
+
+
+class PaddleTpuError(RuntimeError):
+    """Base error for the framework."""
+
+
+def enforce(cond: bool, msg: str = "", *args) -> None:
+    if not cond:
+        raise PaddleTpuError(msg % args if args else (msg or "enforce failed"))
+
+
+def enforce_eq(a, b, msg: str = "") -> None:
+    if a != b:
+        raise PaddleTpuError(f"enforce_eq failed: {a!r} != {b!r}. {msg}")
+
+
+def enforce_rank(x, rank: int, name: str = "tensor") -> None:
+    if x.ndim != rank:
+        raise PaddleTpuError(
+            f"{name} expected rank {rank}, got rank {x.ndim} (shape {x.shape})"
+        )
+
+
+def enforce_shape(x, shape, name: str = "tensor") -> None:
+    """Check shape; None entries in `shape` are wildcards."""
+    if len(x.shape) != len(shape) or any(
+        s is not None and s != xs for s, xs in zip(shape, x.shape)
+    ):
+        raise PaddleTpuError(f"{name} expected shape {shape}, got {x.shape}")

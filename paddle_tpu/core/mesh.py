@@ -1,0 +1,87 @@
+"""Device mesh and sharding helpers.
+
+This is the TPU-native replacement for the reference's three parallel
+execution stacks (reference: gserver/gradientmachines/MultiGradientMachine.h:44
+thread-per-GPU data parallelism; pserver/ParameterServer2.h:73 block-sharded
+parameter server; operators/nccl_op.cu.cc:41 NCCL collective ops). On TPU a
+single ``jax.sharding.Mesh`` with named axes covers all of them: XLA inserts
+all-reduce / all-gather / reduce-scatter over ICI (within slice) and DCN
+(across slices) from sharding annotations.
+
+Canonical axis names:
+  data  — batch-sharded data parallelism (MultiGradientMachine equivalent)
+  model — tensor/weight sharding (ParallelNeuralNetwork / pserver block shard)
+  seq   — optional sequence/context parallelism axis (no reference
+          counterpart; forward-looking for ring attention)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape; -1 in `data` means "all remaining devices"."""
+
+    data: int = -1
+    model: int = 1
+    seq: int = 1
+
+    def resolve(self, n_devices: int) -> tuple:
+        model, seq = self.model, self.seq
+        data = self.data
+        if data == -1:
+            if n_devices % (model * seq) != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by model*seq={model * seq}"
+                )
+            data = n_devices // (model * seq)
+        if data * model * seq != n_devices:
+            raise ValueError(
+                f"mesh {data}x{model}x{seq} != {n_devices} devices"
+            )
+        return (data, model, seq)
+
+
+def build_mesh(
+    config: MeshConfig = MeshConfig(),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named Mesh over the given (default: all) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    data, model, seq = config.resolve(len(devices))
+    arr = np.array(devices).reshape(data, model, seq)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+
+
+def local_mesh() -> Mesh:
+    """Mesh over all visible devices, pure data parallel."""
+    return build_mesh(MeshConfig())
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def with_sharding(x, mesh: Mesh, spec: P):
+    """Annotate intermediate values with a sharding constraint."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis sharding over the data axis (per-batch tensors)."""
+    return NamedSharding(mesh, P(DATA_AXIS))

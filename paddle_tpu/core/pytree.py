@@ -1,0 +1,69 @@
+"""Pytree utilities for parameter trees.
+
+Parameters in this framework are nested dicts of jax arrays (a pytree),
+replacing the reference's named Parameter objects with typed buffer sets
+(reference: paddle/parameter/Parameter.h:60). Utilities here provide the
+name-addressed views the reference APIs offered (Parameters.__getitem__,
+reference: python/paddle/v2/parameters.py:44).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def tree_size(tree) -> int:
+    """Total number of scalar elements."""
+    return sum(x.size for x in jax.tree.leaves(tree))
+
+
+def tree_bytes(tree) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(tree))
+
+
+def named_leaves(tree, sep: str = "/") -> Iterator[Tuple[str, Any]]:
+    """Yield (path-string, leaf) pairs, e.g. ('conv1/kernel', array)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        yield _path_str(path, sep), leaf
+
+
+def _path_str(path, sep: str = "/") -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return sep.join(parts)
+
+
+def tree_map_with_name(fn: Callable[[str, Any], Any], tree, sep: str = "/"):
+    """Map over leaves with their path names: fn(name, leaf) -> new leaf."""
+
+    def _fn(path, leaf):
+        return fn(_path_str(path, sep), leaf)
+
+    return jax.tree_util.tree_map_with_path(_fn, tree)
+
+
+def global_norm(tree) -> jax.Array:
+    """L2 norm over all leaves (for clipping / stats)."""
+    leaves = [jnp.sum(jnp.square(x.astype(jnp.float32))) for x in jax.tree.leaves(tree)]
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(sum(leaves))
+
+
+def get_by_name(tree: Dict, name: str, sep: str = "/"):
+    node = tree
+    for part in name.split(sep):
+        node = node[part]
+    return node

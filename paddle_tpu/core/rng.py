@@ -1,0 +1,34 @@
+"""RNG key management.
+
+The reference seeds global generators per thread (reference:
+paddle/utils/Util.h ThreadLocalRand, paddle/math/Matrix.cpp randomizeUniform).
+JAX is functional: explicit keys, split on use. RngSeq is a tiny convenience
+for imperative-style call sites (trainer loops, layer init).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def split_key(key, n: int = 2):
+    return jax.random.split(key, n)
+
+
+class RngSeq:
+    """A stateful stream of PRNG keys (host-side convenience only).
+
+    Never use inside jitted code — pass explicit keys there.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+
+    def next(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def next_n(self, n: int):
+        keys = jax.random.split(self._key, n + 1)
+        self._key = keys[0]
+        return list(keys[1:])

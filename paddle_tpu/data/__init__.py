@@ -1,0 +1,14 @@
+"""Data pipeline: readers, batching, datasets, device feeding."""
+
+from paddle_tpu.data import reader
+from paddle_tpu.data import batch
+from paddle_tpu.data import datasets
+from paddle_tpu.data.batch import (
+    batch as batch_reader,
+    SequenceBatch,
+    pack_sequences,
+    pad_sequences,
+    bucket_by_length,
+    stack_columns,
+)
+from paddle_tpu.data.feeder import DataFeeder

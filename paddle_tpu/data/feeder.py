@@ -1,0 +1,68 @@
+"""Host→device feeding with async prefetch.
+
+Replaces the reference's DataFeeder/dataprovider_converter (reference:
+paddle/py_paddle/dataprovider_converter.py) and the DoubleBuffer prefetch
+thread (reference: gserver/dataproviders/DataProvider.h:249): batches are
+converted to stacked numpy columns on a worker thread while the device
+computes, then transferred with jax.device_put (optionally sharded over the
+mesh's data axis).
+"""
+
+from __future__ import annotations
+
+import queue as queue_mod
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import numpy as np
+
+from paddle_tpu.data.batch import stack_columns
+
+
+class DataFeeder:
+    """Iterate device-ready batches from a batch-reader.
+
+    convert_fn: list-of-samples -> pytree of np arrays (default: stack
+    tuple columns). sharding: optional jax.sharding.Sharding applied on
+    device_put (the data-parallel split, replacing MultiGradientMachine's
+    per-thread batch slicing, reference: MultiGradientMachine.h:73).
+    """
+
+    def __init__(
+        self,
+        convert_fn: Optional[Callable] = None,
+        sharding=None,
+        prefetch: int = 2,
+    ):
+        self.convert_fn = convert_fn or stack_columns
+        self.sharding = sharding
+        self.prefetch = prefetch
+
+    def __call__(self, batch_reader) -> Iterator[Any]:
+        end = object()
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=self.prefetch)
+        errors = []
+
+        def worker():
+            try:
+                for raw in batch_reader():
+                    q.put(self.convert_fn(raw))
+            except BaseException as e:
+                errors.append(e)
+            finally:
+                q.put(end)
+
+        threading.Thread(target=worker, daemon=True).start()
+        while True:
+            host_batch = q.get()
+            if host_batch is end:
+                if errors:
+                    raise errors[0]
+                return
+            if self.sharding is not None:
+                yield jax.tree.map(
+                    lambda x: jax.device_put(x, self.sharding), host_batch
+                )
+            else:
+                yield jax.tree.map(jax.device_put, host_batch)

@@ -1,0 +1,8 @@
+"""Model zoo: the reference's demo/benchmark configs rebuilt TPU-native.
+
+Reference model configs: v1_api_demo/mnist/{light_mnist,vgg_16_mnist}.py,
+benchmark/paddle/image/{alexnet,vgg,resnet,googlenet}.py,
+benchmark/paddle/rnn/rnn.py, v1_api_demo/sequence_tagging/rnn_crf.py.
+"""
+
+from paddle_tpu.models import lenet

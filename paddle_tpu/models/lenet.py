@@ -1,0 +1,44 @@
+"""LeNet-style MNIST convnet.
+
+Parity target: the reference's MNIST demo (reference:
+v1_api_demo/mnist/light_mnist.py — conv/pool x2 + fc, and
+python/paddle/trainer_config_helpers/networks.py:144 simple_img_conv_pool).
+NHWC layout; BN variant matches light_mnist's conv_bn blocks.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import nn
+
+
+def lenet(num_classes: int = 10, *, with_bn: bool = False) -> nn.Sequential:
+    def block(features, name):
+        layers = [
+            nn.Conv2D(features, 5, padding="SAME", activation=None if with_bn else "relu",
+                      name=f"{name}_conv"),
+        ]
+        if with_bn:
+            layers.append(nn.BatchNorm(activation="relu", name=f"{name}_bn"))
+        layers.append(nn.MaxPool2D(2, name=f"{name}_pool"))
+        return layers
+
+    return nn.Sequential(
+        block(20, "b1")
+        + block(50, "b2")
+        + [
+            nn.Flatten(name="flatten"),
+            nn.Dense(500, activation="relu", name="fc1"),
+            nn.Dense(num_classes, name="logits"),
+        ],
+        name="lenet",
+    )
+
+
+def mlp(num_classes: int = 10, hidden=(128, 64)) -> nn.Sequential:
+    """The fluid book's recognize_digits_mlp equivalent (reference:
+    python/paddle/v2/fluid/tests/book/test_recognize_digits_mlp.py)."""
+    layers = [nn.Flatten(name="flatten")]
+    for i, h in enumerate(hidden):
+        layers.append(nn.Dense(h, activation="relu", name=f"fc{i + 1}"))
+    layers.append(nn.Dense(num_classes, name="logits"))
+    return nn.Sequential(layers, name="mlp")

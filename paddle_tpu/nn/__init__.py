@@ -1,0 +1,18 @@
+"""Neural-network layer library (module system + layers)."""
+
+from paddle_tpu.nn.module import Layer, Sequential, ShapeSpec, spec_of, merge_state
+from paddle_tpu.nn import initializers
+from paddle_tpu.nn.layers import (
+    Dense,
+    Conv2D,
+    MaxPool2D,
+    AvgPool2D,
+    GlobalAvgPool2D,
+    BatchNorm,
+    LayerNorm,
+    Dropout,
+    Embedding,
+    Flatten,
+    Activation,
+    Lambda,
+)

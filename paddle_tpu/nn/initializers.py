@@ -1,0 +1,112 @@
+"""Weight initializers.
+
+Parity with the reference's parameter init schemes (reference:
+paddle/parameter/Parameter.cpp randomize — uniform with
+initial_strategy/initial_smart std 1/sqrt(dim), normal, constant; and Fluid
+python/paddle/v2/fluid/initializer.py Constant/Uniform/Normal/Xavier/MSRA).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def constant(value: float = 0.0):
+    def init(rng, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+
+    return init
+
+
+zeros = constant(0.0)
+ones = constant(1.0)
+
+
+def uniform(scale: float = 1.0):
+    def init(rng, shape, dtype=jnp.float32):
+        return jax.random.uniform(rng, shape, dtype, -scale, scale)
+
+    return init
+
+
+def normal(std: float = 0.01, mean: float = 0.0):
+    def init(rng, shape, dtype=jnp.float32):
+        return mean + std * jax.random.normal(rng, shape, dtype)
+
+    return init
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels [kh, kw, in, out]
+    receptive = math.prod(shape[:-2])
+    return shape[-2] * receptive, shape[-1] * receptive
+
+
+def xavier_uniform():
+    """Glorot uniform (reference: fluid/initializer.py XavierInitializer)."""
+
+    def init(rng, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        limit = math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+    return init
+
+
+def xavier_normal():
+    def init(rng, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        std = math.sqrt(2.0 / (fan_in + fan_out))
+        return std * jax.random.normal(rng, shape, dtype)
+
+    return init
+
+
+def msra():
+    """He/Kaiming init (reference: fluid/initializer.py MSRAInitializer)."""
+
+    def init(rng, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        std = math.sqrt(2.0 / fan_in)
+        return std * jax.random.normal(rng, shape, dtype)
+
+    return init
+
+
+def smart_uniform():
+    """The reference's 'initial_smart': uniform(±1/sqrt(fan_in))
+    (reference: python/paddle/trainer/config_parser.py Parameter smart init).
+    """
+
+    def init(rng, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        limit = 1.0 / math.sqrt(fan_in)
+        return jax.random.uniform(rng, shape, dtype, -limit, limit)
+
+    return init
+
+
+def get(name_or_fn):
+    if callable(name_or_fn):
+        return name_or_fn
+    table = {
+        "zeros": zeros,
+        "ones": ones,
+        "xavier": xavier_uniform(),
+        "xavier_normal": xavier_normal(),
+        "msra": msra(),
+        "smart": smart_uniform(),
+        "normal": normal(),
+        "uniform": uniform(),
+    }
+    try:
+        return table[name_or_fn]
+    except KeyError:
+        raise ValueError(f"unknown initializer {name_or_fn!r}") from None

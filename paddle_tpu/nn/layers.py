@@ -1,0 +1,348 @@
+"""Core layers: Dense, Conv2D, pooling, BatchNorm, Dropout, Embedding, etc.
+
+Each class mirrors one (or a family) of the reference's ~115 registered
+layer types (reference: gserver/layers/*, REGISTER_LAYER sites) as a
+config object with pure init/apply — see nn.module for the contract.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import Policy, default_policy
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.nn import initializers
+from paddle_tpu.nn.module import Layer, ShapeSpec, Sequential
+from paddle_tpu.ops import activations as A
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import linalg
+from paddle_tpu.ops import norm as norm_ops
+
+
+class Dense(Layer):
+    """Fully-connected layer (reference: gserver/layers/FullyConnectedLayer.cpp,
+    operators/mul_op.cc + fc in fluid/layers.py)."""
+
+    def __init__(
+        self,
+        features: int,
+        *,
+        activation=None,
+        use_bias: bool = True,
+        kernel_init="smart",
+        bias_init="zeros",
+        name: Optional[str] = None,
+        policy: Optional[Policy] = None,
+    ):
+        self.features = features
+        self.activation = A.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = initializers.get(kernel_init)
+        self.bias_init = initializers.get(bias_init)
+        self.name = name
+        self.policy = policy
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        in_f = spec.shape[-1]
+        out_spec = ShapeSpec(spec.shape[:-1] + (self.features,), spec.dtype)
+        if _abstract:
+            return {}, {}, out_spec
+        kr, br = jax.random.split(rng)
+        params = {"kernel": self.kernel_init(kr, (in_f, self.features))}
+        if self.use_bias:
+            params["bias"] = self.bias_init(br, (self.features,))
+        return params, {}, out_spec
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        y = linalg.dense(
+            x, params["kernel"], params.get("bias"), policy=self.policy or default_policy()
+        )
+        return self.activation(y), {}
+
+
+class Conv2D(Layer):
+    """2-D conv layer, NHWC (reference: gserver/layers/ExpandConvLayer.cpp,
+    CudnnConvLayer.cpp; operators/conv_op.cc)."""
+
+    def __init__(
+        self,
+        features: int,
+        kernel_size: Union[int, Tuple[int, int]] = 3,
+        *,
+        stride: Union[int, Tuple[int, int]] = 1,
+        padding="SAME",
+        dilation: Union[int, Tuple[int, int]] = 1,
+        groups: int = 1,
+        activation=None,
+        use_bias: bool = True,
+        kernel_init="msra",
+        bias_init="zeros",
+        name: Optional[str] = None,
+        policy: Optional[Policy] = None,
+    ):
+        self.features = features
+        self.kernel_size = conv_ops._pair(kernel_size)
+        self.stride = conv_ops._pair(stride)
+        self.padding = padding
+        self.dilation = conv_ops._pair(dilation)
+        self.groups = groups
+        self.activation = A.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = initializers.get(kernel_init)
+        self.bias_init = initializers.get(bias_init)
+        self.name = name
+        self.policy = policy
+
+    def _out_hw(self, h, w):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        dh, dw = self.dilation
+        ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+        if self.padding == "SAME":
+            return -(-h // sh), -(-w // sw)
+        if self.padding == "VALID":
+            return (h - ekh) // sh + 1, (w - ekw) // sw + 1
+        ph, pw = conv_ops._pair(self.padding)
+        return (h + 2 * ph - ekh) // sh + 1, (w + 2 * pw - ekw) // sw + 1
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        n, h, w, c = spec.shape
+        enforce(c % self.groups == 0, "channels not divisible by groups")
+        oh, ow = self._out_hw(h, w)
+        out_spec = ShapeSpec((n, oh, ow, self.features), spec.dtype)
+        if _abstract:
+            return {}, {}, out_spec
+        kr, br = jax.random.split(rng)
+        kh, kw = self.kernel_size
+        params = {
+            "kernel": self.kernel_init(kr, (kh, kw, c // self.groups, self.features))
+        }
+        if self.use_bias:
+            params["bias"] = self.bias_init(br, (self.features,))
+        return params, {}, out_spec
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        y = conv_ops.conv2d(
+            x,
+            params["kernel"],
+            stride=self.stride,
+            padding=self.padding,
+            dilation=self.dilation,
+            groups=self.groups,
+            bias=params.get("bias"),
+            policy=self.policy or default_policy(),
+        )
+        return self.activation(y), {}
+
+
+class MaxPool2D(Layer):
+    def __init__(self, window=2, *, stride=None, padding="VALID", name=None):
+        self.window = conv_ops._pair(window)
+        self.stride = conv_ops._pair(stride if stride is not None else window)
+        self.padding = padding
+        self.name = name
+
+    def _out_hw(self, h, w):
+        wh, ww = self.window
+        sh, sw = self.stride
+        if self.padding == "SAME":
+            return -(-h // sh), -(-w // sw)
+        if self.padding == "VALID":
+            return (h - wh) // sh + 1, (w - ww) // sw + 1
+        ph, pw = conv_ops._pair(self.padding)
+        return (h + 2 * ph - wh) // sh + 1, (w + 2 * pw - ww) // sw + 1
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        n, h, w, c = spec.shape
+        oh, ow = self._out_hw(h, w)
+        return {}, {}, ShapeSpec((n, oh, ow, c), spec.dtype)
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return (
+            conv_ops.max_pool2d(x, self.window, stride=self.stride, padding=self.padding),
+            {},
+        )
+
+
+class AvgPool2D(MaxPool2D):
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return (
+            conv_ops.avg_pool2d(x, self.window, stride=self.stride, padding=self.padding),
+            {},
+        )
+
+
+class GlobalAvgPool2D(Layer):
+    def __init__(self, name=None):
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        n, h, w, c = spec.shape
+        return {}, {}, ShapeSpec((n, c), spec.dtype)
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return conv_ops.global_avg_pool2d(x), {}
+
+
+class BatchNorm(Layer):
+    """Batch normalization with running stats as explicit state
+    (reference: gserver/layers/BatchNormalizationLayer.cpp,
+    operators/batch_norm_op.cc)."""
+
+    def __init__(
+        self,
+        *,
+        momentum: float = 0.9,
+        epsilon: float = 1e-5,
+        activation=None,
+        name: Optional[str] = None,
+    ):
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.activation = A.get(activation)
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        c = spec.shape[-1]
+        if _abstract:
+            return {}, {}, spec
+        params = {
+            "scale": jnp.ones((c,), jnp.float32),
+            "offset": jnp.zeros((c,), jnp.float32),
+        }
+        state = {
+            "mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32),
+        }
+        return params, state, spec
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        y, new_mean, new_var = norm_ops.batch_norm(
+            x,
+            params["scale"],
+            params["offset"],
+            state["mean"],
+            state["var"],
+            training=training,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+        )
+        return self.activation(y), {"mean": new_mean, "var": new_var}
+
+
+class LayerNorm(Layer):
+    def __init__(self, *, epsilon: float = 1e-5, name: Optional[str] = None):
+        self.epsilon = epsilon
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        c = spec.shape[-1]
+        if _abstract:
+            return {}, {}, spec
+        return (
+            {"scale": jnp.ones((c,), jnp.float32), "offset": jnp.zeros((c,), jnp.float32)},
+            {},
+            spec,
+        )
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return norm_ops.layer_norm(x, params["scale"], params["offset"], epsilon=self.epsilon), {}
+
+
+class Dropout(Layer):
+    """Dropout (reference: Layer.h dropout hookup + operators/dropout_op.cc).
+
+    Inverted dropout: scales by 1/keep at train time; identity at eval.
+    """
+
+    def __init__(self, rate: float = 0.5, name: Optional[str] = None):
+        enforce(0.0 <= rate < 1.0, "dropout rate must be in [0,1)")
+        self.rate = rate
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        return {}, {}, spec
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        if not training or self.rate == 0.0:
+            return x, {}
+        enforce(rng is not None, "Dropout needs an rng in training mode")
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype), {}
+
+
+class Embedding(Layer):
+    """Embedding lookup table (reference: gserver/layers/TableProjection +
+    operators/lookup_table_op.cc)."""
+
+    def __init__(
+        self,
+        vocab_size: int,
+        features: int,
+        *,
+        embedding_init="normal",
+        name: Optional[str] = None,
+    ):
+        self.vocab_size = vocab_size
+        self.features = features
+        self.embedding_init = initializers.get(embedding_init)
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        out_spec = ShapeSpec(spec.shape + (self.features,), jnp.float32)
+        if _abstract:
+            return {}, {}, out_spec
+        return (
+            {"table": self.embedding_init(rng, (self.vocab_size, self.features))},
+            {},
+            out_spec,
+        )
+
+    def _apply(self, params, state, ids, *, training: bool, rng):
+        return jnp.take(params["table"], ids, axis=0), {}
+
+
+class Flatten(Layer):
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        import math
+
+        flat = math.prod(spec.shape[1:])
+        return {}, {}, ShapeSpec((spec.shape[0], flat), spec.dtype)
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return x.reshape(x.shape[0], -1), {}
+
+
+class Activation(Layer):
+    def __init__(self, fn, name: Optional[str] = None):
+        self.fn = A.get(fn)
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        return {}, {}, spec
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return self.fn(x), {}
+
+
+class Lambda(Layer):
+    """Wrap an arbitrary pure function as a layer."""
+
+    def __init__(self, fn: Callable, out_spec_fn=None, name: Optional[str] = None):
+        self.fn = fn
+        self.out_spec_fn = out_spec_fn
+        self.name = name
+
+    def _init(self, rng, *specs, _abstract: bool = False):
+        out = self.out_spec_fn(*specs) if self.out_spec_fn else specs[0]
+        return {}, {}, out
+
+    def _apply(self, params, state, *inputs, training: bool, rng):
+        return self.fn(*inputs), {}

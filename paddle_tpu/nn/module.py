@@ -1,0 +1,155 @@
+"""Light module system: layers as config objects + pure init/apply.
+
+Design: a Layer is an immutable configuration object with two methods —
+``init(rng, *specs) -> (params, state)`` and
+``apply(params, state, *inputs, training=..., rng=...) -> (out, new_state)``.
+Parameters and mutable statistics (e.g. batch-norm running stats) are plain
+nested-dict pytrees the caller owns; apply is a pure function, so the whole
+model jits/vmaps/pjits and autodiff "just works".
+
+This replaces the reference's virtual-dispatch Layer graph (reference:
+gserver/layers/Layer.h:62 forward/backward + REGISTER_LAYER at Layer.h:31)
+and its separate config→parameter creation pass (reference:
+python/paddle/trainer/config_parser.py:4289): on TPU the model must be a
+traced pure function, so "layer" becomes a parameter factory + function,
+and the topological executor (reference:
+gserver/gradientmachines/NeuralNetwork.cpp:247) becomes ordinary Python
+composition traced once by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.errors import enforce
+
+Params = Dict[str, Any]
+State = Dict[str, Any]
+
+
+class ShapeSpec:
+    """Shape+dtype spec used for shape inference during init."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype=jnp.float32):
+        self.shape = tuple(shape)
+        self.dtype = jnp.dtype(dtype)
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def __repr__(self):
+        return f"ShapeSpec({self.shape}, {self.dtype})"
+
+
+def spec_of(x) -> ShapeSpec:
+    if isinstance(x, ShapeSpec):
+        return x
+    return ShapeSpec(x.shape, x.dtype)
+
+
+class Layer:
+    """Base class: stateless config; params/state live outside.
+
+    Subclasses implement:
+      _init(rng, *specs) -> (params, state, out_specs)
+      _apply(params, state, *inputs, training, rng) -> (out, new_state)
+    """
+
+    name: Optional[str] = None
+
+    # ---- public API -------------------------------------------------
+    def init(self, rng, *specs) -> Tuple[Params, State]:
+        specs = tuple(spec_of(s) for s in specs)
+        params, state, _ = self._init(rng, *specs)
+        return params, state
+
+    def out_spec(self, *specs):
+        """Shape inference without allocating parameters."""
+        specs = tuple(spec_of(s) for s in specs)
+        _, _, out = self._init(_DUMMY_RNG, *specs, _abstract=True)
+        return out
+
+    def apply(self, params, state, *inputs, training: bool = False, rng=None):
+        return self._apply(params, state, *inputs, training=training, rng=rng)
+
+    def __call__(self, params, state, *inputs, training: bool = False, rng=None):
+        return self.apply(params, state, *inputs, training=training, rng=rng)
+
+    # ---- to implement ----------------------------------------------
+    def _init(self, rng, *specs, _abstract: bool = False):
+        raise NotImplementedError
+
+    def _apply(self, params, state, *inputs, training: bool, rng):
+        raise NotImplementedError
+
+
+_DUMMY_RNG = None  # abstract init must not draw randomness
+
+
+class Sequential(Layer):
+    """Compose layers in order (the `NeuralNetwork` forward-in-config-order
+    equivalent, reference: gserver/gradientmachines/NeuralNetwork.cpp:247).
+    """
+
+    def __init__(self, layers: Sequence[Layer], name: Optional[str] = None):
+        self.layers = list(layers)
+        self.name = name
+
+    def _init(self, rng, *specs, _abstract: bool = False):
+        params: Params = {}
+        state: State = {}
+        cur = specs
+        for i, layer in enumerate(self.layers):
+            key = layer.name or f"layer{i}"
+            enforce(key not in params, f"duplicate layer name {key}")
+            if _abstract:
+                sub_p, sub_s, cur = layer._init(None, *cur, _abstract=True)
+            else:
+                rng, sub = jax.random.split(rng)
+                sub_p, sub_s, cur = layer._init(sub, *cur)
+            if sub_p:
+                params[key] = sub_p
+            if sub_s:
+                state[key] = sub_s
+            if not isinstance(cur, tuple):
+                cur = (cur,)
+        out = cur if len(cur) != 1 else cur[0]
+        return params, state, out
+
+    def _apply(self, params, state, *inputs, training: bool, rng):
+        cur = inputs
+        new_state: State = {}
+        for i, layer in enumerate(self.layers):
+            key = layer.name or f"layer{i}"
+            sub_rng = None
+            if rng is not None:
+                rng, sub_rng = jax.random.split(rng)
+            out, sub_state = layer._apply(
+                params.get(key, {}),
+                state.get(key, {}),
+                *cur,
+                training=training,
+                rng=sub_rng,
+            )
+            if sub_state:
+                new_state[key] = sub_state
+            cur = out if isinstance(out, tuple) else (out,)
+        out = cur if len(cur) != 1 else cur[0]
+        return out, new_state
+
+
+def merge_state(old: State, new: State) -> State:
+    """Overlay updated sub-states onto the full state tree."""
+    merged = dict(old)
+    for k, v in new.items():
+        if isinstance(v, dict) and isinstance(merged.get(k), dict):
+            merged[k] = merge_state(merged[k], v)
+        else:
+            merged[k] = v
+    return merged

@@ -1,0 +1,16 @@
+"""Pure-function op library.
+
+The TPU-native analogue of the reference's three op stacks in one place:
+paddle/math element-wise ops (reference: math/BaseMatrix.h:74),
+paddle/function device functors (reference: function/Function.h:31) and
+the Fluid operator library (reference: paddle/operators/). Everything is a
+pure jax function — autodiff comes from jax.grad, not hand-written
+backward kernels; fusion comes from XLA, not expression templates.
+"""
+
+from paddle_tpu.ops import activations
+from paddle_tpu.ops import conv
+from paddle_tpu.ops import linalg
+from paddle_tpu.ops import losses
+from paddle_tpu.ops import norm
+from paddle_tpu.ops import metrics

@@ -1,0 +1,173 @@
+"""Activation functions.
+
+Parity with the reference's activation registry (reference:
+gserver/activations/ActivationFunction.cpp — identity/sigmoid/softmax/tanh/
+stanh/relu/brelu/softrelu/abs/square/exponential/log/sequence_softmax) and
+the Fluid activation ops (reference: paddle/operators/activation_op.cc).
+All are jax-differentiable; sequence_softmax lives in ops.sequence (it
+needs segment ids).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def identity(x):
+    return x
+
+
+linear = identity
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def stanh(x, scale_a: float = 0.67, scale_b: float = 1.7159):
+    """Scaled tanh: b * tanh(a * x) (reference: STanhActivation)."""
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def brelu(x, t_min: float = 0.0, t_max: float = 24.0):
+    """Bounded relu (reference: BReluActivation clips to [0, 24])."""
+    return jnp.clip(x, t_min, t_max)
+
+
+def relu6(x):
+    return jnp.clip(x, 0.0, 6.0)
+
+
+def leaky_relu(x, alpha: float = 0.01):
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha)
+
+
+def gelu(x):
+    return jax.nn.gelu(x)
+
+
+def softrelu(x, threshold: float = 40.0):
+    """log(1 + exp(x)), input clipped to [-t, t] (reference: SoftReluActivation)."""
+    return jnp.log1p(jnp.exp(jnp.clip(x, -threshold, threshold)))
+
+
+softplus = jax.nn.softplus
+
+
+def softsign(x):
+    return x / (1.0 + jnp.abs(x))
+
+
+def abs_act(x):
+    return jnp.abs(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def exponential(x):
+    return jnp.exp(x)
+
+
+def log_act(x):
+    return jnp.log(x)
+
+
+def sqrt_act(x):
+    return jnp.sqrt(x)
+
+
+def reciprocal(x):
+    return 1.0 / x
+
+
+def softmax(x, axis: int = -1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def swish(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def hard_sigmoid(x, slope: float = 0.2, offset: float = 0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def hard_shrink(x, threshold: float = 0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def soft_shrink(x, lambda_: float = 0.5):
+    return jnp.sign(x) * jnp.maximum(jnp.abs(x) - lambda_, 0.0)
+
+
+def thresholded_relu(x, threshold: float = 1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def pow_act(x, factor: float = 1.0):
+    return jnp.power(x, factor)
+
+
+_REGISTRY = {
+    "identity": identity,
+    "linear": identity,
+    "sigmoid": sigmoid,
+    "tanh": tanh,
+    "stanh": stanh,
+    "relu": relu,
+    "brelu": brelu,
+    "relu6": relu6,
+    "leaky_relu": leaky_relu,
+    "elu": elu,
+    "gelu": gelu,
+    "softrelu": softrelu,
+    "softplus": softplus,
+    "softsign": softsign,
+    "abs": abs_act,
+    "square": square,
+    "exponential": exponential,
+    "exp": exponential,
+    "log": log_act,
+    "sqrt": sqrt_act,
+    "reciprocal": reciprocal,
+    "softmax": softmax,
+    "log_softmax": log_softmax,
+    "swish": swish,
+    "hard_sigmoid": hard_sigmoid,
+    "hard_shrink": hard_shrink,
+    "soft_shrink": soft_shrink,
+    "thresholded_relu": thresholded_relu,
+}
+
+
+def get(name):
+    """Look up an activation by name (reference: ActivationFunction::create)."""
+    if callable(name):
+        return name
+    if name is None:
+        return identity
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown activation {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None

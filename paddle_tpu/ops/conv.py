@@ -1,0 +1,257 @@
+"""Convolution and pooling ops (NHWC, TPU-native layout).
+
+Replaces the reference's conv stack — im2col+GEMM (reference:
+paddle/function/GemmConvOp.cpp, function/Im2ColOp.cpp), cuDNN layers
+(reference: gserver/layers/CudnnConvLayer.cpp) and Fluid conv ops
+(reference: paddle/operators/conv_op.cc) — with
+jax.lax.conv_general_dilated, which XLA lowers directly onto the MXU.
+Layout is NHWC/HWIO (TPU-preferred), not the reference's NCHW.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from paddle_tpu.core.dtypes import Policy, default_policy
+
+IntOr2 = Union[int, Tuple[int, int], Sequence[int]]
+
+
+def _pair(v: IntOr2) -> Tuple[int, int]:
+    if isinstance(v, int):
+        return (v, v)
+    a, b = v
+    return (int(a), int(b))
+
+
+def _padding(padding, kernel: Tuple[int, int]):
+    if isinstance(padding, str):
+        return padding  # 'SAME' / 'VALID'
+    ph, pw = _pair(padding)
+    return ((ph, ph), (pw, pw))
+
+
+def conv2d(
+    x,
+    kernel,
+    *,
+    stride: IntOr2 = 1,
+    padding="SAME",
+    dilation: IntOr2 = 1,
+    groups: int = 1,
+    bias=None,
+    policy: Optional[Policy] = None,
+):
+    """2-D convolution. x: [N,H,W,C], kernel: [kh,kw,Cin/groups,Cout]."""
+    policy = policy or default_policy()
+    x = x.astype(policy.compute_dtype)
+    kernel = kernel.astype(policy.compute_dtype)
+    kh, kw = kernel.shape[0], kernel.shape[1]
+    y = lax.conv_general_dilated(
+        x,
+        kernel,
+        window_strides=_pair(stride),
+        padding=_padding(padding, (kh, kw)),
+        rhs_dilation=_pair(dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+        preferred_element_type=policy.accum_dtype,
+    )
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def conv2d_transpose(
+    x,
+    kernel,
+    *,
+    stride: IntOr2 = 1,
+    padding="SAME",
+    bias=None,
+    policy: Optional[Policy] = None,
+):
+    """Transposed conv (reference: gserver/layers/ConvTransLayer.cpp,
+    paddle/operators/conv_transpose_op.cc). kernel: [kh,kw,Cin,Cout]."""
+    policy = policy or default_policy()
+    x = x.astype(policy.compute_dtype)
+    kernel = kernel.astype(policy.compute_dtype)
+    y = lax.conv_transpose(
+        x,
+        kernel,
+        strides=_pair(stride),
+        padding=padding if isinstance(padding, str) else _padding(padding, kernel.shape[:2]),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=policy.accum_dtype,
+    )
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def depthwise_conv2d(
+    x,
+    kernel,
+    *,
+    stride: IntOr2 = 1,
+    padding="SAME",
+    bias=None,
+    policy: Optional[Policy] = None,
+):
+    """Depthwise conv (reference: function/DepthwiseConvOp.cpp).
+
+    kernel: [kh, kw, 1, C*multiplier]; groups = C.
+    """
+    channels = x.shape[-1]
+    return conv2d(
+        x,
+        kernel,
+        stride=stride,
+        padding=padding,
+        groups=channels,
+        bias=bias,
+        policy=policy,
+    )
+
+
+def max_pool2d(x, window: IntOr2 = 2, *, stride: Optional[IntOr2] = None, padding="VALID"):
+    """Max pooling (reference: gserver/layers/PoolLayer.cpp MaxPooling,
+    paddle/operators/pool_op.cc)."""
+    wh, ww = _pair(window)
+    sh, sw = _pair(stride if stride is not None else window)
+    pad = padding if isinstance(padding, str) else (
+        (0, 0),
+        (_pair(padding)[0],) * 2,
+        (_pair(padding)[1],) * 2,
+        (0, 0),
+    )
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x, init, lax.max, (1, wh, ww, 1), (1, sh, sw, 1), pad
+    )
+
+
+def avg_pool2d(
+    x,
+    window: IntOr2 = 2,
+    *,
+    stride: Optional[IntOr2] = None,
+    padding="VALID",
+    count_include_pad: bool = True,
+):
+    """Average pooling (reference: AvgPooling in gserver/layers/PoolLayer.cpp)."""
+    wh, ww = _pair(window)
+    sh, sw = _pair(stride if stride is not None else window)
+    pad = padding if isinstance(padding, str) else (
+        (0, 0),
+        (_pair(padding)[0],) * 2,
+        (_pair(padding)[1],) * 2,
+        (0, 0),
+    )
+    summed = lax.reduce_window(
+        x, 0.0, lax.add, (1, wh, ww, 1), (1, sh, sw, 1), pad
+    )
+    if count_include_pad or (isinstance(pad, str) and pad == "VALID"):
+        return summed / (wh * ww)
+    ones = jnp.ones_like(x)
+    counts = lax.reduce_window(
+        ones, 0.0, lax.add, (1, wh, ww, 1), (1, sh, sw, 1), pad
+    )
+    return summed / counts
+
+
+def global_avg_pool2d(x):
+    return jnp.mean(x, axis=(1, 2))
+
+
+def spp(x, pyramid_height: int = 3, pool_type: str = "max"):
+    """Spatial pyramid pooling (reference: gserver/layers/SpatialPyramidPoolLayer.cpp).
+
+    Returns [N, sum_l 4^l * C] features over a pyramid of bin grids.
+    """
+    n, h, w, c = x.shape
+    outs = []
+    for level in range(pyramid_height):
+        bins = 2**level
+        # Split H and W into `bins` near-equal windows via resize-free pooling.
+        ys = jnp.linspace(0, h, bins + 1).astype(jnp.int32)
+        xs = jnp.linspace(0, w, bins + 1).astype(jnp.int32)
+        for i in range(bins):
+            for j in range(bins):
+                patch = x[:, ys[i] : max(int(ys[i + 1]), int(ys[i]) + 1),
+                          xs[j] : max(int(xs[j + 1]), int(xs[j]) + 1), :]
+                if pool_type == "max":
+                    outs.append(jnp.max(patch, axis=(1, 2)))
+                else:
+                    outs.append(jnp.mean(patch, axis=(1, 2)))
+    return jnp.concatenate(outs, axis=-1)
+
+
+def pad(x, paddings, value: float = 0.0):
+    """Pad op (reference: function/PadOp.cpp, operators/pad_op.cc)."""
+    return jnp.pad(x, paddings, constant_values=value)
+
+
+def crop(x, offsets, shape):
+    """Crop op (reference: function/CropOp.cpp, operators/crop_op.cc)."""
+    return lax.dynamic_slice(x, offsets, shape)
+
+
+def im2col(x, window: IntOr2, *, stride: IntOr2 = 1, padding="VALID"):
+    """Extract patches: [N,H,W,C] -> [N,Ho,Wo,kh*kw*C].
+
+    Reference: function/Im2ColOp.cpp / gserver BlockExpandLayer. On TPU you
+    rarely want this (XLA handles conv directly); provided for block_expand
+    parity.
+    """
+    kh, kw = _pair(window)
+    patches = lax.conv_general_dilated_patches(
+        x.transpose(0, 3, 1, 2),
+        (kh, kw),
+        _pair(stride),
+        padding if isinstance(padding, str) else _padding(padding, (kh, kw)),
+    )
+    # patches: [N, C*kh*kw, Ho, Wo] -> [N, Ho, Wo, C*kh*kw]
+    return patches.transpose(0, 2, 3, 1)
+
+
+def roi_pool(x, rois, output_size: Tuple[int, int], spatial_scale: float = 1.0):
+    """ROI max pooling (reference: gserver/layers/ROIPoolLayer.cpp).
+
+    x: [N,H,W,C]; rois: [R,5] = (batch_idx, x1, y1, x2, y2) in input scale.
+    Returns [R, oh, ow, C]. Static-shape implementation via per-bin masking.
+    """
+    n, h, w, c = x.shape
+    oh, ow = output_size
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        x1, y1, x2, y2 = roi[1] * spatial_scale, roi[2] * spatial_scale, roi[3] * spatial_scale, roi[4] * spatial_scale
+        roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        bin_h = roi_h / oh
+        bin_w = roi_w / ow
+        img = x[b]  # [H,W,C]
+
+        def one_bin(i, j):
+            y_lo = y1 + i * bin_h
+            y_hi = y1 + (i + 1) * bin_h
+            x_lo = x1 + j * bin_w
+            x_hi = x1 + (j + 1) * bin_w
+            ymask = (ys >= jnp.floor(y_lo)) & (ys < jnp.ceil(y_hi))
+            xmask = (xs >= jnp.floor(x_lo)) & (xs < jnp.ceil(x_hi))
+            mask = ymask[:, None] & xmask[None, :]
+            masked = jnp.where(mask[:, :, None], img, -jnp.inf)
+            val = jnp.max(masked, axis=(0, 1))
+            return jnp.where(jnp.isfinite(val), val, 0.0)
+
+        rows = [jnp.stack([one_bin(i, j) for j in range(ow)]) for i in range(oh)]
+        return jnp.stack(rows)
+
+    return jax.vmap(one_roi)(rois.astype(jnp.float32))

@@ -1,0 +1,34 @@
+"""Dense linear algebra with dtype policy.
+
+Replaces the reference's GEMM plumbing (reference: paddle/math/Matrix.cpp
+CpuMatrix::mul / GpuMatrix::mul over cuBLAS, paddle/operators/math/
+math_function.cc) with jnp.dot + preferred_element_type so the MXU runs
+bf16 with f32 accumulation.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import Policy, default_policy
+
+
+def matmul(a, b, policy: Optional[Policy] = None):
+    """a @ b with MXU-friendly dtype handling."""
+    policy = policy or default_policy()
+    a = a.astype(policy.compute_dtype)
+    b = b.astype(policy.compute_dtype)
+    return jnp.matmul(a, b, preferred_element_type=policy.accum_dtype)
+
+
+def dense(x, kernel, bias=None, policy: Optional[Policy] = None):
+    """Fully-connected transform y = x @ W (+ b).
+
+    Reference: gserver/layers/FullyConnectedLayer.cpp forward.
+    """
+    y = matmul(x, kernel, policy=policy)
+    if bias is not None:
+        y = y + bias
+    return y

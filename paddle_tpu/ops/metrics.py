@@ -1,0 +1,28 @@
+"""In-graph metric ops.
+
+Parity with the reference's metric operators (reference:
+paddle/operators/accuracy_op.cc, gserver/evaluators/Evaluator.cpp
+classification_error) — these run inside the jitted step; streaming
+aggregation across batches lives in train.evaluators.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def accuracy(logits, labels):
+    """Top-1 accuracy (reference: operators/accuracy_op.cc)."""
+    pred = jnp.argmax(logits, axis=-1)
+    return jnp.mean((pred == labels).astype(jnp.float32))
+
+
+def classification_error(logits, labels):
+    """1 - accuracy (reference: gserver ClassificationErrorEvaluator)."""
+    return 1.0 - accuracy(logits, labels)
+
+
+def top_k_accuracy(logits, labels, k: int = 5):
+    topk = jnp.argsort(-logits, axis=-1)[..., :k]
+    hit = jnp.any(topk == labels[..., None], axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))

@@ -1,0 +1,87 @@
+"""Normalization ops.
+
+Replaces the reference's BatchNormalizationLayer/CudnnBatchNormLayer
+(reference: gserver/layers/BatchNormalizationLayer.cpp,
+paddle/operators/batch_norm_op.cc), cross-map LRN (reference:
+function/CrossMapNormalOp.cpp, gserver/layers/NormLayer.cpp) and
+cross-channel norm (reference: gserver/layers/CrossChannelNormLayer.cpp).
+Running statistics are explicit state (functional), not mutable members.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import at_least_f32
+
+
+def batch_norm(
+    x,
+    scale,
+    offset,
+    running_mean,
+    running_var,
+    *,
+    training: bool,
+    momentum: float = 0.9,
+    epsilon: float = 1e-5,
+):
+    """Batch norm over all axes but the last (channel) axis.
+
+    Returns (y, new_running_mean, new_running_var). In eval mode the running
+    stats pass through unchanged.
+    """
+    reduce_axes = tuple(range(x.ndim - 1))
+    if training:
+        x32 = at_least_f32(x)
+        mean = jnp.mean(x32, axis=reduce_axes)
+        var = jnp.var(x32, axis=reduce_axes)
+        new_mean = momentum * running_mean + (1.0 - momentum) * mean
+        new_var = momentum * running_var + (1.0 - momentum) * var
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    inv = jax.lax.rsqrt(var + epsilon) * scale
+    y = (x - mean) * inv + offset
+    return y.astype(x.dtype), new_mean, new_var
+
+
+def layer_norm(x, scale, offset, *, epsilon: float = 1e-5, axis: int = -1):
+    x32 = at_least_f32(x)
+    mean = jnp.mean(x32, axis=axis, keepdims=True)
+    var = jnp.var(x32, axis=axis, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + epsilon)
+    return (y * scale + offset).astype(x.dtype)
+
+
+def lrn(x, *, size: int = 5, alpha: float = 1e-4, beta: float = 0.75, k: float = 1.0):
+    """Local response normalization across channels (NHWC).
+
+    Reference: function/CrossMapNormalOp.cpp (CrossMapNormal),
+    paddle/operators/lrn_op.cc. y = x / (k + alpha * sum_window x^2)^beta.
+    """
+    sq = jnp.square(x)
+    half = size // 2
+    # sum over a window of `size` channels centred at each channel
+    padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, size - 1 - half)])
+    window = jnp.stack(
+        [padded[..., i : i + x.shape[-1]] for i in range(size)], axis=0
+    ).sum(axis=0)
+    return x * jnp.power(k + alpha * window, -beta)
+
+
+def cross_channel_norm(x, scale, *, epsilon: float = 1e-10):
+    """L2-normalize across channels then per-channel scale.
+
+    Reference: gserver/layers/CrossChannelNormLayer.cpp (SSD norm layer).
+    """
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True) + epsilon)
+    return x / norm * scale
+
+
+def l2_normalize(x, axis: int = -1, epsilon: float = 1e-12):
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + epsilon)
+    return x / norm

@@ -1,0 +1,21 @@
+"""Optimizers, LR schedules, regularization, model averaging."""
+
+from paddle_tpu.optim.optimizers import (
+    Optimizer,
+    sgd,
+    momentum,
+    adagrad,
+    decayed_adagrad,
+    adadelta,
+    rmsprop,
+    adam,
+    adamax,
+    ftrl,
+    proximal_gd,
+    chain,
+    clip_by_global_norm,
+    clip_by_value,
+    get,
+)
+from paddle_tpu.optim import schedules
+from paddle_tpu.optim import average

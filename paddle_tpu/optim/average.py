@@ -1,0 +1,54 @@
+"""Model averaging.
+
+Parity with the reference's AverageOptimizer (reference:
+paddle/parameter/AverageOptimizer.h:23 — maintains a moving window average
+of parameter values, applied at test/save time then restored). Functional
+version: keep `(sum, count)` alongside params; `apply()` returns the
+averaged params for evaluation, training params are untouched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init(params) -> Any:
+    return {
+        "sum": jax.tree.map(lambda p: jnp.zeros_like(p, jnp.float32), params),
+        "count": jnp.zeros((), jnp.float32),
+    }
+
+
+def accumulate(avg_state, params, *, max_average_window: float = 0.0):
+    """Add current params into the running average.
+
+    With max_average_window > 0, the window restarts (EMA-style reset) once
+    count exceeds the window, mirroring the reference's window control.
+    """
+    new_sum = jax.tree.map(
+        lambda s, p: s + p.astype(jnp.float32), avg_state["sum"], params
+    )
+    new_count = avg_state["count"] + 1.0
+    if max_average_window and max_average_window > 0:
+        reset = new_count > max_average_window
+
+        def _maybe_reset(s, p):
+            return jnp.where(reset, p.astype(jnp.float32), s)
+
+        new_sum = jax.tree.map(_maybe_reset, new_sum, params)
+        new_count = jnp.where(reset, jnp.ones(()), new_count)
+    return {"sum": new_sum, "count": new_count}
+
+
+def averaged_params(avg_state, params):
+    """Averaged view of the params; falls back to raw params if count==0."""
+    count = jnp.maximum(avg_state["count"], 1.0)
+    has_avg = avg_state["count"] > 0
+    return jax.tree.map(
+        lambda s, p: jnp.where(has_avg, (s / count).astype(p.dtype), p),
+        avg_state["sum"],
+        params,
+    )

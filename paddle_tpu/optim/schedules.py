@@ -1,0 +1,77 @@
+"""Learning-rate schedules.
+
+Parity with the reference's LR schedulers (reference:
+paddle/parameter/LearningRateScheduler.cpp — constant, poly, caltechFeature
+(= inv), exp, discexp, linear, manual, pass_manual) configured by
+learning_rate_schedule in OptimizationConfig (reference:
+proto/TrainerConfig.proto). Each schedule is a pure fn: step -> lr.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple
+
+import jax.numpy as jnp
+
+Schedule = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def constant(lr: float) -> Schedule:
+    return lambda step: jnp.asarray(lr, jnp.float32)
+
+
+def poly(lr: float, a: float, b: float) -> Schedule:
+    """lr * (1 + a*step)^(-b) (reference: poly schedule)."""
+    return lambda step: lr * jnp.power(1.0 + a * step.astype(jnp.float32), -b)
+
+
+def inv(lr: float, gamma: float, power: float) -> Schedule:
+    """Caffe-style inv, the reference's caltech_feature schedule."""
+    return lambda step: lr * jnp.power(1.0 + gamma * step.astype(jnp.float32), -power)
+
+
+def exp_decay(lr: float, a: float, b: float) -> Schedule:
+    """lr * a^(step/b) (reference: exp schedule)."""
+    return lambda step: lr * jnp.power(a, step.astype(jnp.float32) / b)
+
+
+def discrete_exp(lr: float, a: float, b: float) -> Schedule:
+    """lr * a^floor(step/b) (reference: discexp schedule)."""
+    return lambda step: lr * jnp.power(a, jnp.floor(step.astype(jnp.float32) / b))
+
+
+def linear_decay(lr: float, a: float, b: float) -> Schedule:
+    """max(lr - a*step, b) (reference: linear schedule)."""
+    return lambda step: jnp.maximum(lr - a * step.astype(jnp.float32), b)
+
+
+def piecewise(boundaries: Sequence[int], values: Sequence[float]) -> Schedule:
+    """Manual step schedule (reference: manual/pass_manual schedules,
+    segments 'step1:lr1,step2:lr2,...')."""
+    bs = jnp.asarray(list(boundaries), jnp.int32)
+    vs = jnp.asarray(list(values), jnp.float32)
+
+    def fn(step):
+        idx = jnp.sum((step >= bs).astype(jnp.int32))
+        return vs[jnp.clip(idx, 0, len(values) - 1)]
+
+    return fn
+
+
+def warmup_cosine(lr: float, warmup_steps: int, total_steps: int, min_lr: float = 0.0) -> Schedule:
+    """Modern extra (no reference counterpart): linear warmup + cosine decay."""
+
+    def fn(step):
+        s = step.astype(jnp.float32)
+        warm = lr * s / max(warmup_steps, 1)
+        prog = jnp.clip((s - warmup_steps) / max(total_steps - warmup_steps, 1), 0.0, 1.0)
+        cos = min_lr + 0.5 * (lr - min_lr) * (1.0 + jnp.cos(jnp.pi * prog))
+        return jnp.where(s < warmup_steps, warm, cos)
+
+    return fn
+
+
+def resolve(lr) -> Schedule:
+    if callable(lr):
+        return lr
+    return constant(float(lr))

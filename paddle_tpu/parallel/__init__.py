@@ -1,0 +1,12 @@
+"""Mesh parallelism: sharding rules, pjit train steps, collectives."""
+
+from paddle_tpu.parallel.sharding import (
+    make_param_shardings,
+    batch_sharding,
+    zero_shardings,
+    MEGATRON_RULES,
+)
+from paddle_tpu.parallel.train_step import (
+    make_sharded_train_step,
+    shard_train_state,
+)

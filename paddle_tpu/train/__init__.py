@@ -1,0 +1,5 @@
+"""Training drivers, events, state."""
+
+from paddle_tpu.train import events
+from paddle_tpu.train.state import TrainState
+from paddle_tpu.train.trainer import Trainer, make_train_step, make_eval_step

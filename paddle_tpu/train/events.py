@@ -1,0 +1,44 @@
+"""Trainer events.
+
+Parity with the reference's v2 event loop (reference:
+python/paddle/v2/event.py — BeginPass/EndPass/BeginIteration/EndIteration
+with cost + evaluator results, TestResult) used by
+SGD.train(event_handler=...) (reference: python/paddle/v2/trainer.py:124).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class BeginPass:
+    pass_id: int
+
+
+@dataclasses.dataclass
+class EndPass:
+    pass_id: int
+    evaluator_results: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class BeginIteration:
+    pass_id: int
+    batch_id: int
+
+
+@dataclasses.dataclass
+class EndIteration:
+    pass_id: int
+    batch_id: int
+    cost: float
+    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class TestResult:
+    pass_id: int
+    cost: float
+    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)

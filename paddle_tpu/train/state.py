@@ -1,0 +1,29 @@
+"""TrainState: the complete training state as one pytree.
+
+Replaces the reference's scattered mutable state — Parameter buffer sets
+(PARAMETER_VALUE/GRADIENT/MOMENTUM..., reference: utils/GlobalConstants.h:28)
+plus pass/batch counters in Trainer — with a single immutable pytree that
+jits, shards, and checkpoints as a unit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple
+
+import jax.numpy as jnp
+
+
+class TrainState(NamedTuple):
+    params: Any
+    model_state: Any  # mutable layer statistics (BN running stats)
+    opt_state: Any
+    step: jnp.ndarray  # int32 scalar
+
+    @classmethod
+    def create(cls, params, model_state, optimizer):
+        return cls(
+            params=params,
+            model_state=model_state,
+            opt_state=optimizer.init(params),
+            step=jnp.zeros((), jnp.int32),
+        )

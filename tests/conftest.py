@@ -1,0 +1,41 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's in-process multi-node simulation strategy
+(reference: trainer/tests/test_TrainerOnePass.cpp:127 runs real pservers on
+localhost) — here multi-chip sharding is validated on XLA's host platform
+with 8 virtual devices. Must set flags before jax initializes.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+prev = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The environment's TPU plugin (sitecustomize) force-selects its platform
+# at config level, which outranks the env var — override it back to cpu
+# before any backend initializes so tests never touch the real chip.
+jax.config.update("jax_platforms", "cpu")
+
+# float64 available for numeric gradient checks (the fluid op_test.py
+# approach: numeric grads in double precision); float32 remains the default
+# dtype for params since initializers request it explicitly.
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return jax.random.key(0)
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.RandomState(0)

@@ -1,0 +1,52 @@
+"""Numeric-vs-analytic gradient checking utilities.
+
+The rebuild's version of the reference's layer gradient harness
+(reference: gserver/tests/LayerGradUtil.h:298 testLayerGrad — perturb along
+a random direction, compare analytic directional derivative to central
+difference) and fluid's numeric checker (reference:
+python/paddle/v2/fluid/tests/op_test.py get_numeric_gradient, which works
+in double precision). Requires jax_enable_x64 (set in conftest).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.core.dtypes import Policy, default_policy, set_default_policy
+
+
+def directional_grad_check(f, x, *, eps: float = 1e-5, atol: float = 1e-5,
+                           rtol: float = 1e-3, seed: int = 0, n_dirs: int = 3):
+    """Check d/dt f(x + t*v) at t=0 against jax.grad along random directions.
+
+    f: pytree -> scalar. x: pytree of float arrays. Runs f in float64 (both
+    by casting inputs and by overriding the global dtype policy) so the
+    central difference isn't drowned by float32 cancellation.
+    """
+    x64 = jax.tree.map(lambda l: jnp.asarray(l, jnp.float64), x)
+    prev_policy = default_policy()
+    set_default_policy(
+        Policy(param_dtype=jnp.float64, compute_dtype=jnp.float64,
+               accum_dtype=jnp.float64)
+    )
+    try:
+        g = jax.grad(lambda p: jnp.asarray(f(p), jnp.float64))(x64)
+        rng = np.random.RandomState(seed)
+        leaves, treedef = jax.tree.flatten(x64)
+        g_leaves = treedef.flatten_up_to(g)
+        for d in range(n_dirs):
+            vs = [rng.randn(*l.shape) for l in leaves]
+            analytic = sum(
+                float(jnp.sum(gl * v)) for gl, v in zip(g_leaves, vs)
+            )
+            xp = treedef.unflatten([l + eps * v for l, v in zip(leaves, vs)])
+            xm = treedef.unflatten([l - eps * v for l, v in zip(leaves, vs)])
+            numeric = (float(f(xp)) - float(f(xm))) / (2 * eps)
+            np.testing.assert_allclose(
+                analytic, numeric, rtol=rtol, atol=atol,
+                err_msg=f"direction {d}: analytic {analytic} vs numeric {numeric}",
+            )
+    finally:
+        set_default_policy(prev_policy)

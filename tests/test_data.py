@@ -1,0 +1,146 @@
+"""Data pipeline tests (reference: python/paddle/v2/reader/tests/)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.data import batch as B
+from paddle_tpu.data import datasets, reader as R
+
+
+def counting_reader(n=10):
+    def r():
+        return iter(range(n))
+
+    return r
+
+
+class TestReaders:
+    def test_map_readers(self):
+        r = R.map_readers(lambda a, b: a + b, counting_reader(3), counting_reader(3))
+        assert list(r()) == [0, 2, 4]
+
+    def test_shuffle_preserves_items(self):
+        r = R.shuffle(counting_reader(20), 5, seed=0)
+        assert sorted(r()) == list(range(20))
+
+    def test_chain(self):
+        r = R.chain(counting_reader(2), counting_reader(3))
+        assert list(r()) == [0, 1, 0, 1, 2]
+
+    def test_compose(self):
+        r = R.compose(counting_reader(3), counting_reader(3))
+        assert list(r()) == [(0, 0), (1, 1), (2, 2)]
+
+    def test_compose_misaligned_raises(self):
+        r = R.compose(counting_reader(2), counting_reader(3))
+        with pytest.raises(R.ComposeNotAligned):
+            list(r())
+
+    def test_buffered(self):
+        r = R.buffered(counting_reader(50), 8)
+        assert list(r()) == list(range(50))
+
+    def test_buffered_propagates_error(self):
+        def bad():
+            yield 1
+            raise RuntimeError("boom")
+
+        with pytest.raises(RuntimeError):
+            list(R.buffered(lambda: bad(), 2)())
+
+    def test_firstn(self):
+        assert list(R.firstn(counting_reader(10), 3)()) == [0, 1, 2]
+
+    def test_xmap_unordered(self):
+        r = R.xmap_readers(lambda x: x * 2, counting_reader(20), 4, 8)
+        assert sorted(r()) == [2 * i for i in range(20)]
+
+    def test_xmap_ordered(self):
+        r = R.xmap_readers(lambda x: x * 2, counting_reader(20), 4, 8, order=True)
+        assert list(r()) == [2 * i for i in range(20)]
+
+    def test_cache(self):
+        calls = []
+
+        def src():
+            calls.append(1)
+            return iter(range(3))
+
+        r = R.cache(src)
+        assert list(r()) == [0, 1, 2]
+        assert list(r()) == [0, 1, 2]
+        assert len(calls) == 1
+
+
+class TestBatch:
+    def test_batch_drop_last(self):
+        b = B.batch(counting_reader(10), 4)
+        batches = list(b())
+        assert [len(x) for x in batches] == [4, 4]
+
+    def test_batch_keep_last(self):
+        b = B.batch(counting_reader(10), 4, drop_last=False)
+        assert [len(x) for x in b()] == [4, 4, 2]
+
+    def test_stack_columns(self):
+        samples = [(np.zeros((2,)), 1), (np.ones((2,)), 0)]
+        x, y = B.stack_columns(samples)
+        assert x.shape == (2, 2) and y.shape == (2,)
+
+    def test_pack_sequences(self):
+        seqs = [np.arange(3), np.arange(5), np.arange(2)]
+        sb = B.pack_sequences(seqs, capacity=16, max_seqs=4)
+        assert sb.tokens.shape == (16,)
+        assert sb.num_seqs == 3
+        np.testing.assert_array_equal(sb.lengths, [3, 5, 2, 0])
+        np.testing.assert_array_equal(sb.segment_ids[:3], [0, 0, 0])
+        np.testing.assert_array_equal(sb.segment_ids[3:8], [1] * 5)
+        np.testing.assert_array_equal(sb.positions[3:8], np.arange(5))
+        assert sb.mask[:10].all() and not sb.mask[10:].any()
+
+    def test_pack_overflow_raises(self):
+        with pytest.raises(ValueError):
+            B.pack_sequences([np.arange(10)], capacity=8)
+
+    def test_pad_sequences(self):
+        x, lens = B.pad_sequences([np.arange(3), np.arange(1)])
+        assert x.shape == (2, 3)
+        np.testing.assert_array_equal(lens, [3, 1])
+        np.testing.assert_array_equal(x[1], [0, 0, 0])
+
+    def test_bucket_by_length(self):
+        data = [np.zeros(n) for n in [2, 9, 3, 8, 2, 9]]
+        r = B.bucket_by_length(lambda: iter(data), 2, [4])
+        batches = list(r())
+        for b in batches:
+            lens = [len(s) for s in b]
+            assert all(l <= 4 for l in lens) or all(l > 4 for l in lens)
+
+
+class TestDatasets:
+    def test_mnist_schema(self):
+        it = datasets.mnist("train", synthetic_n=8)()
+        img, lbl = next(it)
+        assert img.shape == (28, 28, 1)
+        assert img.dtype == np.float32
+        assert 0 <= int(lbl) < 10
+
+    def test_text_classification_schema(self):
+        it = datasets.synthetic_text_classification(n=5)()
+        tokens, label = next(it)
+        assert tokens.ndim == 1 and tokens.dtype == np.int32
+
+    def test_tagging_schema(self):
+        it = datasets.synthetic_tagging(n=3)()
+        tokens, tags = next(it)
+        assert tokens.shape == tags.shape
+
+    def test_translation_schema(self):
+        it = datasets.synthetic_translation(n=3)()
+        src, tgt = next(it)
+        assert len(src) == len(tgt)
+
+    def test_ctr_schema(self):
+        it = datasets.synthetic_ctr(n=3)()
+        ids, dense, click = next(it)
+        assert ids.shape == (3,) and dense.shape == (8,)

@@ -1,0 +1,186 @@
+"""Op-level tests: shapes, known values, gradient checks.
+
+Mirrors the reference's per-op test style (reference:
+python/paddle/v2/fluid/tests/op_test.py check_output/check_grad).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import activations as A
+from paddle_tpu.ops import conv as C
+from paddle_tpu.ops import losses as L
+from paddle_tpu.ops import metrics as M
+from paddle_tpu.ops import norm as N
+
+from gradcheck import directional_grad_check
+
+
+class TestActivations:
+    @pytest.mark.parametrize(
+        "name",
+        ["sigmoid", "tanh", "relu", "brelu", "softrelu", "stanh", "abs",
+         "square", "exponential", "softmax", "swish", "leaky_relu",
+         "hard_sigmoid", "soft_shrink"],
+    )
+    def test_finite_and_shape(self, name, np_rng):
+        x = jnp.asarray(np_rng.randn(4, 7), jnp.float32)
+        y = A.get(name)(x)
+        assert y.shape == x.shape
+        assert bool(jnp.all(jnp.isfinite(y)))
+
+    def test_registry_unknown(self):
+        with pytest.raises(ValueError):
+            A.get("nope")
+
+    def test_brelu_clips(self):
+        x = jnp.asarray([-5.0, 3.0, 30.0])
+        np.testing.assert_allclose(A.brelu(x), [0.0, 3.0, 24.0])
+
+    def test_softmax_sums_to_one(self, np_rng):
+        x = jnp.asarray(np_rng.randn(3, 9), jnp.float32)
+        np.testing.assert_allclose(jnp.sum(A.softmax(x), -1), np.ones(3), rtol=1e-5)
+
+
+class TestConv:
+    def test_conv2d_shape_same(self, np_rng):
+        x = jnp.asarray(np_rng.randn(2, 8, 8, 3), jnp.float32)
+        k = jnp.asarray(np_rng.randn(3, 3, 3, 16) * 0.1, jnp.float32)
+        y = C.conv2d(x, k, stride=2, padding="SAME")
+        assert y.shape == (2, 4, 4, 16)
+
+    def test_conv2d_identity_kernel(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        k = jnp.zeros((3, 3, 1, 1)).at[1, 1, 0, 0].set(1.0)
+        y = C.conv2d(x, k, padding="SAME")
+        np.testing.assert_allclose(y, x, rtol=1e-6)
+
+    def test_depthwise(self, np_rng):
+        x = jnp.asarray(np_rng.randn(2, 8, 8, 4), jnp.float32)
+        k = jnp.asarray(np_rng.randn(3, 3, 1, 4) * 0.1, jnp.float32)
+        y = C.depthwise_conv2d(x, k)
+        assert y.shape == (2, 8, 8, 4)
+
+    def test_max_pool(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        y = C.max_pool2d(x, 2)
+        np.testing.assert_allclose(y[0, :, :, 0], [[5.0, 7.0], [13.0, 15.0]])
+
+    def test_avg_pool(self):
+        x = jnp.ones((1, 4, 4, 1))
+        y = C.avg_pool2d(x, 2)
+        np.testing.assert_allclose(y, np.ones((1, 2, 2, 1)))
+
+    def test_conv_grad(self, np_rng):
+        x = jnp.asarray(np_rng.randn(1, 5, 5, 2), jnp.float32)
+        k = jnp.asarray(np_rng.randn(3, 3, 2, 3) * 0.3, jnp.float32)
+        directional_grad_check(
+            lambda p: jnp.sum(jnp.square(C.conv2d(x, p["k"]))), {"k": k}
+        )
+
+    def test_im2col_shape(self, np_rng):
+        x = jnp.asarray(np_rng.randn(2, 6, 6, 3), jnp.float32)
+        p = C.im2col(x, 3, stride=1, padding="VALID")
+        assert p.shape == (2, 4, 4, 27)
+
+    def test_roi_pool_shape(self, np_rng):
+        x = jnp.asarray(np_rng.randn(2, 8, 8, 3), jnp.float32)
+        rois = jnp.asarray([[0, 0, 0, 4, 4], [1, 2, 2, 7, 7]], jnp.float32)
+        y = C.roi_pool(x, rois, (2, 2))
+        assert y.shape == (2, 2, 2, 3)
+
+
+class TestNorm:
+    def test_batch_norm_train_normalizes(self, np_rng):
+        x = jnp.asarray(np_rng.randn(64, 5) * 3 + 2, jnp.float32)
+        y, m, v = N.batch_norm(
+            x, jnp.ones(5), jnp.zeros(5), jnp.zeros(5), jnp.ones(5),
+            training=True,
+        )
+        np.testing.assert_allclose(np.mean(np.asarray(y), 0), np.zeros(5), atol=1e-4)
+        np.testing.assert_allclose(np.std(np.asarray(y), 0), np.ones(5), atol=1e-2)
+
+    def test_batch_norm_eval_uses_running(self, np_rng):
+        x = jnp.asarray(np_rng.randn(8, 3), jnp.float32)
+        y, m, v = N.batch_norm(
+            x, jnp.ones(3), jnp.zeros(3), jnp.zeros(3), jnp.ones(3),
+            training=False, epsilon=0.0,
+        )
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-5)
+
+    def test_lrn_shape(self, np_rng):
+        x = jnp.asarray(np_rng.randn(2, 4, 4, 8), jnp.float32)
+        y = N.lrn(x)
+        assert y.shape == x.shape
+
+    def test_layer_norm(self, np_rng):
+        x = jnp.asarray(np_rng.randn(4, 6) * 5, jnp.float32)
+        y = N.layer_norm(x, jnp.ones(6), jnp.zeros(6))
+        np.testing.assert_allclose(np.mean(np.asarray(y), -1), np.zeros(4), atol=1e-4)
+
+
+class TestLosses:
+    def test_softmax_ce_matches_manual(self, np_rng):
+        logits = jnp.asarray(np_rng.randn(6, 4), jnp.float32)
+        labels = jnp.asarray([0, 1, 2, 3, 0, 1])
+        got = L.softmax_cross_entropy(logits, labels)
+        logp = np.log(np.asarray(A.softmax(logits)))
+        want = -logp[np.arange(6), np.asarray(labels)]
+        np.testing.assert_allclose(got, want, rtol=1e-3)
+
+    def test_sigmoid_ce_stable(self):
+        logits = jnp.asarray([1000.0, -1000.0])
+        labels = jnp.asarray([1.0, 0.0])
+        got = L.sigmoid_cross_entropy(logits, labels)
+        assert bool(jnp.all(jnp.isfinite(got)))
+        np.testing.assert_allclose(got, [0.0, 0.0], atol=1e-5)
+
+    def test_squared_error(self):
+        pred = jnp.asarray([[1.0, 2.0]])
+        tgt = jnp.asarray([[0.0, 0.0]])
+        np.testing.assert_allclose(L.squared_error(pred, tgt), [2.5])
+
+    def test_huber_regression_regions(self):
+        pred = jnp.asarray([[0.5], [3.0]])
+        tgt = jnp.zeros((2, 1))
+        got = L.huber_regression(pred, tgt, delta=1.0)
+        np.testing.assert_allclose(got, [0.125, 2.5])
+
+    def test_rank_cost_symmetry(self):
+        a, b = jnp.asarray([1.0]), jnp.asarray([0.0])
+        # label 1 => prefers left higher => lower cost when left > right
+        c_hi = float(L.rank_cost(a, b, jnp.asarray([1.0]))[0])
+        c_lo = float(L.rank_cost(b, a, jnp.asarray([1.0]))[0])
+        assert c_hi < c_lo
+
+    def test_ce_grad(self, np_rng):
+        logits = jnp.asarray(np_rng.randn(5, 7), jnp.float32)
+        labels = jnp.asarray(np_rng.randint(0, 7, 5))
+        directional_grad_check(
+            lambda p: jnp.mean(L.softmax_cross_entropy(p["x"], labels)),
+            {"x": logits},
+        )
+
+    def test_cos_sim(self):
+        a = jnp.asarray([[1.0, 0.0]])
+        np.testing.assert_allclose(L.cos_sim(a, a), [1.0], rtol=1e-5)
+
+    def test_lambda_rank_runs(self, np_rng):
+        scores = jnp.asarray(np_rng.randn(8), jnp.float32)
+        rel = jnp.asarray(np_rng.randint(0, 3, 8), jnp.float32)
+        val = L.lambda_rank_segment(scores, rel)
+        assert np.isfinite(float(val))
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        logits = jnp.asarray([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])
+        labels = jnp.asarray([0, 1, 1])
+        np.testing.assert_allclose(M.accuracy(logits, labels), 2.0 / 3.0, rtol=1e-6)
+
+    def test_top_k(self):
+        logits = jnp.asarray([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]])
+        labels = jnp.asarray([1, 0])
+        np.testing.assert_allclose(M.top_k_accuracy(logits, labels, k=2), 0.5)

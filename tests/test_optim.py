@@ -1,0 +1,146 @@
+"""Optimizer tests: each optimizer decreases a quadratic, matches known
+single-step math (the FirstOrderOptimizer update rules, reference:
+paddle/parameter/FirstOrderOptimizer.h)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import optim
+from paddle_tpu.optim import schedules
+
+
+def quad_loss(params):
+    return 0.5 * jnp.sum(jnp.square(params["w"] - 3.0))
+
+
+ALL_OPTS = [
+    ("sgd", dict(learning_rate=0.1)),
+    ("momentum", dict(learning_rate=0.1, mu=0.9)),
+    ("adagrad", dict(learning_rate=0.5)),
+    ("decayed_adagrad", dict(learning_rate=0.3)),
+    ("adadelta", dict(rho=0.9)),
+    ("rmsprop", dict(learning_rate=0.05)),
+    ("adam", dict(learning_rate=0.2)),
+    ("adamax", dict(learning_rate=0.2)),
+    ("ftrl", dict(learning_rate=0.5)),
+    ("proximal_gd", dict(learning_rate=0.1)),
+]
+
+
+@pytest.mark.parametrize("name,kwargs", ALL_OPTS)
+def test_decreases_quadratic(name, kwargs):
+    opt = optim.get(name, **kwargs)
+    params = {"w": jnp.asarray([0.0, 1.0, 5.0])}
+    opt_state = opt.init(params)
+    step = jnp.zeros((), jnp.int32)
+    loss0 = float(quad_loss(params))
+
+    @jax.jit
+    def run(params, opt_state):
+        def body(carry, i):
+            params, opt_state = carry
+            grads = jax.grad(quad_loss)(params)
+            params, opt_state = opt.update(grads, opt_state, params, step + i)
+            return (params, opt_state), None
+
+        (params, opt_state), _ = jax.lax.scan(
+            body, (params, opt_state), jnp.arange(300)
+        )
+        return params, opt_state
+
+    params, opt_state = run(params, opt_state)
+    assert float(quad_loss(params)) < loss0 * 0.5, name
+
+
+def test_sgd_exact_step():
+    opt = optim.sgd(0.1)
+    params = {"w": jnp.asarray([1.0])}
+    grads = {"w": jnp.asarray([2.0])}
+    new_params, _ = opt.update(grads, opt.init(params), params, jnp.zeros((), jnp.int32))
+    np.testing.assert_allclose(new_params["w"], [0.8], rtol=1e-6)
+
+
+def test_momentum_accumulates():
+    opt = optim.momentum(0.1, mu=0.5)
+    params = {"w": jnp.asarray([0.0])}
+    st = opt.init(params)
+    g = {"w": jnp.asarray([1.0])}
+    s = jnp.zeros((), jnp.int32)
+    params, st = opt.update(g, st, params, s)       # v=1, w=-0.1
+    np.testing.assert_allclose(params["w"], [-0.1], rtol=1e-6)
+    params, st = opt.update(g, st, params, s)       # v=1.5, w=-0.25
+    np.testing.assert_allclose(params["w"], [-0.25], rtol=1e-6)
+
+
+def test_adam_bias_correction_first_step():
+    opt = optim.adam(learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=0.0)
+    params = {"w": jnp.asarray([1.0])}
+    g = {"w": jnp.asarray([0.5])}
+    new_params, _ = opt.update(g, opt.init(params), params, jnp.zeros((), jnp.int32))
+    # first adam step with bias correction moves by ~lr in grad direction
+    np.testing.assert_allclose(new_params["w"], [1.0 - 0.001], rtol=1e-4)
+
+
+def test_clip_global_norm():
+    grads = {"a": jnp.asarray([3.0]), "b": jnp.asarray([4.0])}
+    clipped, norm = optim.clip_by_global_norm(grads, 1.0)
+    np.testing.assert_allclose(float(norm), 5.0, rtol=1e-6)
+    total = np.sqrt(sum(float(jnp.sum(jnp.square(v))) for v in clipped.values()))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_weight_decay_chain():
+    opt = optim.chain(optim.sgd(0.1), weight_decay=0.5)
+    params = {"w": jnp.asarray([2.0])}
+    grads = {"w": jnp.asarray([0.0])}
+    new_params, _ = opt.update(grads, opt.init(params), params, jnp.zeros((), jnp.int32))
+    np.testing.assert_allclose(new_params["w"], [2.0 - 0.1 * 0.5 * 2.0], rtol=1e-6)
+
+
+class TestSchedules:
+    def test_constant(self):
+        s = schedules.constant(0.5)
+        assert float(s(jnp.asarray(100))) == 0.5
+
+    def test_discrete_exp(self):
+        s = schedules.discrete_exp(1.0, 0.5, 10)
+        np.testing.assert_allclose(float(s(jnp.asarray(0))), 1.0)
+        np.testing.assert_allclose(float(s(jnp.asarray(10))), 0.5)
+        np.testing.assert_allclose(float(s(jnp.asarray(25))), 0.25)
+
+    def test_linear(self):
+        s = schedules.linear_decay(1.0, 0.01, 0.1)
+        np.testing.assert_allclose(float(s(jnp.asarray(50))), 0.5)
+        np.testing.assert_allclose(float(s(jnp.asarray(1000))), 0.1)
+
+    def test_piecewise(self):
+        s = schedules.piecewise([10, 20], [1.0, 0.1, 0.01])
+        assert float(s(jnp.asarray(5))) == 1.0
+        assert float(s(jnp.asarray(15))) == pytest.approx(0.1)
+        assert float(s(jnp.asarray(25))) == pytest.approx(0.01)
+
+    def test_poly(self):
+        s = schedules.poly(1.0, 1.0, 1.0)
+        np.testing.assert_allclose(float(s(jnp.asarray(1))), 0.5)
+
+
+class TestModelAverage:
+    def test_average(self):
+        from paddle_tpu.optim import average
+
+        params = {"w": jnp.asarray([0.0])}
+        st = average.init(params)
+        for v in [1.0, 2.0, 3.0]:
+            st = average.accumulate(st, {"w": jnp.asarray([v])})
+        avg = average.averaged_params(st, params)
+        np.testing.assert_allclose(avg["w"], [2.0], rtol=1e-6)
+
+    def test_empty_falls_back(self):
+        from paddle_tpu.optim import average
+
+        params = {"w": jnp.asarray([7.0])}
+        st = average.init(params)
+        avg = average.averaged_params(st, params)
+        np.testing.assert_allclose(avg["w"], [7.0])

@@ -1,0 +1,82 @@
+"""End-to-end convergence: LeNet/MLP on synthetic MNIST — the 'book test'
+(reference: python/paddle/v2/fluid/tests/book/test_recognize_digits_mlp.py,
+v1_api_demo/mnist/api_train.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import data, models, nn, optim
+from paddle_tpu.data import datasets, reader as R
+from paddle_tpu.nn.module import ShapeSpec
+from paddle_tpu.ops import losses, metrics
+from paddle_tpu.train import Trainer, events as E
+
+
+def _mnist_batches(batch_size=32, n=512, mode="train"):
+    r = R.shuffle(datasets.mnist(mode, synthetic_n=n, seed=0), 256, seed=1)
+    br = data.batch_reader(r, batch_size)
+    feeder = data.DataFeeder()
+    return lambda: feeder(br)
+
+
+def test_mlp_converges():
+    model = models.lenet.mlp(10, hidden=(64,))
+    trainer = Trainer(
+        model,
+        loss_fn=lambda logits, labels: jnp.mean(
+            losses.softmax_cross_entropy(logits, labels)
+        ),
+        optimizer=optim.adam(1e-3),
+        metrics_fn=lambda logits, labels: {"acc": metrics.accuracy(logits, labels)},
+        seed=0,
+    )
+    state = trainer.init_state(ShapeSpec((32, 28, 28, 1)))
+
+    seen = {"first": None, "last": None, "events": 0}
+
+    def handler(ev):
+        if isinstance(ev, E.EndIteration):
+            if seen["first"] is None:
+                seen["first"] = ev.cost
+            seen["last"] = ev.cost
+            seen["events"] += 1
+
+    state = trainer.train(
+        state, _mnist_batches(), num_passes=3, event_handler=handler
+    )
+    assert seen["events"] > 0
+    assert seen["last"] < seen["first"] * 0.5, (seen["first"], seen["last"])
+
+    # eval accuracy on held-out synthetic digits should beat chance by a lot
+    res = trainer.evaluate(state, _mnist_batches(mode="test", n=256))
+    assert res.metrics["acc"] > 0.5, res
+
+
+def test_lenet_one_step_runs():
+    model = models.lenet.lenet(10, with_bn=True)
+    trainer = Trainer(
+        model,
+        loss_fn=lambda logits, labels: jnp.mean(
+            losses.softmax_cross_entropy(logits, labels)
+        ),
+        optimizer=optim.momentum(0.01, mu=0.9),
+        seed=0,
+    )
+    state = trainer.init_state(ShapeSpec((8, 28, 28, 1)))
+    batches = _mnist_batches(batch_size=8, n=16)
+    state = trainer.train(state, batches, num_passes=1)
+    assert int(state.step) == 2  # 16 samples / 8 per batch
+    # BN running stats moved
+    bn_means = [
+        v for name, v in _named(state.model_state) if name.endswith("mean")
+    ]
+    assert any(float(np.abs(np.asarray(m)).sum()) > 0 for m in bn_means)
+
+
+def _named(tree, prefix=""):
+    for k, v in tree.items():
+        name = f"{prefix}/{k}" if prefix else k
+        if isinstance(v, dict):
+            yield from _named(v, name)
+        else:
+            yield name, v
