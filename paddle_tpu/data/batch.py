@@ -45,7 +45,8 @@ class SequenceBatch:
 
     tokens:     [capacity, ...] concatenated timesteps of all sequences
     segment_ids:[capacity] int32, which sequence each position belongs to
-                (== num_seqs for padding slots)
+                (== max_seqs for padding slots; ops treat ids >= max_seqs
+                as invalid)
     positions:  [capacity] int32, timestep index within the sequence
     lengths:    [max_seqs] int32 per-sequence lengths (0 for empty slots)
     num_seqs:   int, actual number of sequences
@@ -99,7 +100,9 @@ def pack_sequences(
     feat_shape = seqs[0].shape[1:] if seqs else ()
     dtype = seqs[0].dtype if seqs else np.float32
     tokens = np.zeros((capacity,) + feat_shape, dtype=dtype)
-    segment_ids = np.full((capacity,), len(seqs), np.int32)
+    # padding slots carry segment id == max_seqs, which every segment op
+    # treats as invalid (ids are valid iff < num_segments == max_seqs)
+    segment_ids = np.full((capacity,), max_seqs, np.int32)
     positions = np.zeros((capacity,), np.int32)
     mask = np.zeros((capacity,), bool)
     out_lengths = np.zeros((max_seqs,), np.int32)

@@ -6,3 +6,6 @@ benchmark/paddle/rnn/rnn.py, v1_api_demo/sequence_tagging/rnn_crf.py.
 """
 
 from paddle_tpu.models import lenet
+from paddle_tpu.models import text_lstm
+from paddle_tpu.models import bilstm_crf
+from paddle_tpu.models import seq2seq_attn

@@ -1,0 +1,54 @@
+"""Stacked-LSTM text classifier.
+
+Parity target: the reference's IMDB benchmark network — embedding → 2
+stacked LSTMs → pooled features → fc (reference: benchmark/paddle/rnn/
+rnn.py, v1_api_demo/quick_start/trainer_config.lstm.py). Consumes dense
+padded [B, T] token batches + lengths.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializers
+from paddle_tpu.ops import rnn as rnn_ops
+from paddle_tpu.ops import sequence as seq_ops
+from paddle_tpu.ops import linalg
+
+
+def init_params(
+    rng,
+    vocab_size: int,
+    num_classes: int = 2,
+    *,
+    embed_dim: int = 64,
+    hidden: int = 128,
+    num_layers: int = 2,
+):
+    keys = jax.random.split(rng, num_layers + 2)
+    params = {
+        "embed": initializers.normal(0.05)(keys[0], (vocab_size, embed_dim)),
+        "fc": {
+            "kernel": initializers.smart_uniform()(
+                keys[-1], (hidden, num_classes)
+            ),
+            "bias": jnp.zeros((num_classes,)),
+        },
+    }
+    in_dim = embed_dim
+    for i in range(num_layers):
+        params[f"lstm{i}"] = rnn_ops.init_lstm_params(keys[i + 1], in_dim, hidden)
+        in_dim = hidden
+    return params
+
+
+def apply(params, tokens, lengths, *, num_layers: int = 2, pool: str = "max"):
+    """tokens: [B, T] int32; lengths: [B]. Returns logits [B, C]."""
+    x = jnp.take(params["embed"], tokens, axis=0)
+    for i in range(num_layers):
+        x, _ = rnn_ops.lstm(params[f"lstm{i}"], x, lengths)
+    pooled = seq_ops.dense_sequence_pool(x, lengths, pool)
+    return linalg.dense(pooled, params["fc"]["kernel"], params["fc"]["bias"])

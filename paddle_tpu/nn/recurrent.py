@@ -1,0 +1,85 @@
+"""Recurrent layers over the module system.
+
+Layer-level wrappers of ops.rnn (reference: gserver/layers/LstmLayer.cpp,
+GatedRecurrentLayer.cpp, RecurrentLayer.cpp and the prebuilt networks
+simple_lstm/bidirectional_lstm in trainer_config_helpers/networks.py:553,
+1230). Inputs are dense padded [B, T, F] plus lengths [B]; use
+data.batch.pad_sequences to build them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn.module import Layer, ShapeSpec
+from paddle_tpu.ops import rnn as rnn_ops
+
+
+class LSTM(Layer):
+    """Unidirectional LSTM; returns [B, T, H] outputs."""
+
+    def __init__(self, hidden: int, *, reverse: bool = False,
+                 name: Optional[str] = None):
+        self.hidden = hidden
+        self.reverse = reverse
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, lengths_spec=None, _abstract=False):
+        b, t, f = spec.shape
+        out = ShapeSpec((b, t, self.hidden), spec.dtype)
+        if _abstract:
+            return {}, {}, out
+        return rnn_ops.init_lstm_params(rng, f, self.hidden), {}, out
+
+    def _apply(self, params, state, x, lengths=None, *, training: bool, rng):
+        out, _ = rnn_ops.lstm(params, x, lengths, reverse=self.reverse)
+        return out, {}
+
+
+class GRU(Layer):
+    def __init__(self, hidden: int, *, reverse: bool = False,
+                 name: Optional[str] = None):
+        self.hidden = hidden
+        self.reverse = reverse
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, lengths_spec=None, _abstract=False):
+        b, t, f = spec.shape
+        out = ShapeSpec((b, t, self.hidden), spec.dtype)
+        if _abstract:
+            return {}, {}, out
+        return rnn_ops.init_gru_params(rng, f, self.hidden), {}, out
+
+    def _apply(self, params, state, x, lengths=None, *, training: bool, rng):
+        out, _ = rnn_ops.gru(params, x, lengths, reverse=self.reverse)
+        return out, {}
+
+
+class BiLSTM(Layer):
+    """Bidirectional LSTM, concat output [B, T, 2H] (reference:
+    networks.py:1230 bidirectional_lstm)."""
+
+    def __init__(self, hidden: int, name: Optional[str] = None):
+        self.hidden = hidden
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, lengths_spec=None, _abstract=False):
+        b, t, f = spec.shape
+        out = ShapeSpec((b, t, 2 * self.hidden), spec.dtype)
+        if _abstract:
+            return {}, {}, out
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "fwd": rnn_ops.init_lstm_params(k1, f, self.hidden),
+            "bwd": rnn_ops.init_lstm_params(k2, f, self.hidden),
+        }
+        return params, {}, out
+
+    def _apply(self, params, state, x, lengths=None, *, training: bool, rng):
+        out, _ = rnn_ops.bidirectional(
+            rnn_ops.lstm, params["fwd"], params["bwd"], x, lengths
+        )
+        return out, {}

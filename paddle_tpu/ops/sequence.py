@@ -1,0 +1,201 @@
+"""Segment-based sequence ops over packed ragged batches.
+
+The reference represents variable-length batches as LoD offsets
+(reference: parameter/Argument.h:84 sequenceStartPositions,
+framework/lod_tensor.h:57) and implements per-sequence ops by looping
+over offset ranges (reference: gserver/layers/SequencePoolLayer.cpp,
+SequenceConcatLayer.cpp, ExpandLayer.cpp, operators/sequence_pool_op).
+The TPU-native equivalent: fixed-capacity packed batches with a
+segment-id vector (data.batch.SequenceBatch) and jax.ops.segment_*
+reductions — static shapes, no host loops, everything fuses.
+
+Conventions for all functions here:
+  tokens      [capacity, ...]  packed values
+  segment_ids [capacity]       int32, sequence index; >= num_segments
+                               marks padding slots
+  num_segments: static int — max sequences per batch (lengths may be 0)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _valid_mask(segment_ids, num_segments):
+    return segment_ids < num_segments
+
+
+def sequence_sum(tokens, segment_ids, num_segments: int):
+    """Per-sequence sum (reference: SequencePoolLayer 'sum')."""
+    return jax.ops.segment_sum(tokens, segment_ids, num_segments=num_segments + 1)[
+        :num_segments
+    ]
+
+
+def sequence_mean(tokens, segment_ids, num_segments: int):
+    """Per-sequence average (reference: 'average' pooling, Matrix.cpp
+    sequenceAvgForward)."""
+    sums = sequence_sum(tokens, segment_ids, num_segments)
+    ones = jnp.ones(tokens.shape[:1], tokens.dtype)
+    counts = jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments + 1)[
+        :num_segments
+    ]
+    counts = jnp.maximum(counts, 1.0)
+    return sums / counts.reshape((-1,) + (1,) * (tokens.ndim - 1))
+
+
+def sequence_sqrt_pool(tokens, segment_ids, num_segments: int):
+    """Sum scaled by 1/sqrt(len) (reference: 'sqrt' average pooling)."""
+    sums = sequence_sum(tokens, segment_ids, num_segments)
+    ones = jnp.ones(tokens.shape[:1], tokens.dtype)
+    counts = jax.ops.segment_sum(ones, segment_ids, num_segments=num_segments + 1)[
+        :num_segments
+    ]
+    scale = jax.lax.rsqrt(jnp.maximum(counts, 1.0))
+    return sums * scale.reshape((-1,) + (1,) * (tokens.ndim - 1))
+
+
+def sequence_max(tokens, segment_ids, num_segments: int):
+    """Per-sequence max (reference: MaxLayer / sequence_pool 'max')."""
+    out = jax.ops.segment_max(
+        tokens, segment_ids, num_segments=num_segments + 1
+    )[:num_segments]
+    # empty sequences produce -inf from segment_max; zero them like the ref
+    return jnp.where(jnp.isfinite(out), out, 0.0)
+
+
+def sequence_first(tokens, segment_ids, positions, num_segments: int):
+    """First timestep of each sequence (reference:
+    SequenceLastInstanceLayer with select_first)."""
+    cap = tokens.shape[0]
+    is_first = (positions == 0) & _valid_mask(segment_ids, num_segments)
+    idx = jnp.where(is_first, segment_ids, num_segments)
+    onehot_rows = jax.ops.segment_sum(
+        jnp.where(is_first[:, None], tokens.reshape(cap, -1), 0.0),
+        idx,
+        num_segments=num_segments + 1,
+    )[:num_segments]
+    return onehot_rows.reshape((num_segments,) + tokens.shape[1:])
+
+
+def sequence_last(tokens, segment_ids, positions, lengths, num_segments: int):
+    """Last timestep of each sequence (reference: SequenceLastInstanceLayer)."""
+    cap = tokens.shape[0]
+    valid = _valid_mask(segment_ids, num_segments)
+    seq_len = jnp.where(valid, lengths[jnp.clip(segment_ids, 0, num_segments - 1)], -1)
+    is_last = valid & (positions == seq_len - 1)
+    idx = jnp.where(is_last, segment_ids, num_segments)
+    rows = jax.ops.segment_sum(
+        jnp.where(is_last[:, None], tokens.reshape(cap, -1), 0.0),
+        idx,
+        num_segments=num_segments + 1,
+    )[:num_segments]
+    return rows.reshape((num_segments,) + tokens.shape[1:])
+
+
+def sequence_softmax(scores, segment_ids, num_segments: int):
+    """Softmax within each sequence (reference: SequenceSoftmax activation,
+    operators/sequence_softmax_op.cc). scores: [capacity]."""
+    valid = _valid_mask(segment_ids, num_segments)
+    safe_ids = jnp.where(valid, segment_ids, num_segments)
+    masked = jnp.where(valid, scores, NEG_INF)
+    seg_max = jax.ops.segment_max(masked, safe_ids, num_segments=num_segments + 1)
+    seg_max = jnp.where(jnp.isfinite(seg_max), seg_max, 0.0)
+    shifted = jnp.where(valid, masked - seg_max[safe_ids], NEG_INF)
+    exp = jnp.where(valid, jnp.exp(shifted), 0.0)
+    denom = jax.ops.segment_sum(exp, safe_ids, num_segments=num_segments + 1)
+    denom = jnp.maximum(denom, 1e-12)
+    return exp / denom[safe_ids]
+
+
+def sequence_expand(seq_values, segment_ids, num_segments: int):
+    """Broadcast one row per sequence out to every position of that
+    sequence (reference: ExpandLayer, operators/seq_expand_op.cc).
+
+    seq_values: [num_segments, ...] -> [capacity, ...]."""
+    safe = jnp.clip(segment_ids, 0, num_segments - 1)
+    out = seq_values[safe]
+    valid = _valid_mask(segment_ids, num_segments)
+    return jnp.where(
+        valid.reshape((-1,) + (1,) * (out.ndim - 1)), out, 0.0
+    ).astype(seq_values.dtype)
+
+
+def masked_positions(tokens, mask, fill=0.0):
+    """Zero-out padding slots."""
+    return jnp.where(mask.reshape((-1,) + (1,) * (tokens.ndim - 1)), tokens, fill)
+
+
+# ---------------------------------------------------------------------------
+# dense [B, T] layout helpers (time-recurrent ops consume this layout —
+# the SequenceToBatch equivalent, reference: gserver/layers/SequenceToBatch.h:41)
+# ---------------------------------------------------------------------------
+
+
+def length_mask(lengths, max_len: int):
+    """[B, T] boolean mask from lengths."""
+    return jnp.arange(max_len)[None, :] < lengths[:, None]
+
+
+def dense_sequence_pool(x, lengths, mode: str = "mean"):
+    """Pool a padded dense [B, T, F] batch per sequence."""
+    b, t = x.shape[0], x.shape[1]
+    mask = length_mask(lengths, t)
+    maskf = mask.astype(x.dtype)[..., None]
+    if mode == "sum":
+        return jnp.sum(x * maskf, axis=1)
+    if mode == "mean":
+        denom = jnp.maximum(lengths.astype(x.dtype), 1)[:, None]
+        return jnp.sum(x * maskf, axis=1) / denom
+    if mode == "sqrt":
+        denom = jnp.sqrt(jnp.maximum(lengths.astype(x.dtype), 1))[:, None]
+        return jnp.sum(x * maskf, axis=1) / denom
+    if mode == "max":
+        neg = jnp.where(mask[..., None], x, NEG_INF)
+        out = jnp.max(neg, axis=1)
+        return jnp.where(out <= NEG_INF / 2, 0.0, out)
+    if mode == "last":
+        idx = jnp.clip(lengths - 1, 0, t - 1)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+    if mode == "first":
+        return x[:, 0]
+    raise ValueError(f"unknown pool mode {mode!r}")
+
+
+def pack_to_dense(tokens, segment_ids, positions, num_segments: int, max_len: int):
+    """Packed [capacity, F] -> dense [num_segments, max_len, F] + mask.
+
+    The LoD→tensor unpack (reference: RecurrentGradientMachine
+    createInFrameInfo splitting a ragged batch into per-step frames)."""
+    valid = _valid_mask(segment_ids, num_segments) & (positions < max_len)
+    flat_idx = jnp.where(
+        valid, segment_ids * max_len + positions, num_segments * max_len
+    )
+    feat = tokens.reshape(tokens.shape[0], -1)
+    dense = jax.ops.segment_sum(
+        jnp.where(valid[:, None], feat, 0.0),
+        flat_idx,
+        num_segments=num_segments * max_len + 1,
+    )[: num_segments * max_len]
+    dense = dense.reshape((num_segments, max_len) + tokens.shape[1:])
+    mask = jax.ops.segment_sum(
+        valid.astype(jnp.int32), flat_idx, num_segments=num_segments * max_len + 1
+    )[: num_segments * max_len].reshape(num_segments, max_len)
+    return dense, mask > 0
+
+
+def dense_to_pack(dense, segment_ids, positions, num_segments: int):
+    """Dense [num_segments, T, F] -> packed [capacity, F] at (seg, pos)."""
+    t = dense.shape[1]
+    valid = _valid_mask(segment_ids, num_segments) & (positions < t)
+    safe_seg = jnp.clip(segment_ids, 0, num_segments - 1)
+    safe_pos = jnp.clip(positions, 0, t - 1)
+    out = dense[safe_seg, safe_pos]
+    return jnp.where(
+        valid.reshape((-1,) + (1,) * (out.ndim - 1)), out, 0.0
+    ).astype(dense.dtype)

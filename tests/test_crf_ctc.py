@@ -1,0 +1,177 @@
+"""CRF and CTC tests: brute-force enumeration checks on tiny cases (the
+strongest possible correctness oracle), gradient checks, and decode
+consistency (reference: gserver/tests/test_CRFLayerGrad.cpp,
+test_LinearChainCRF.cpp, test_WarpCTCLayer.cpp)."""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import crf as C
+from paddle_tpu.ops import ctc as K
+from gradcheck import directional_grad_check
+
+
+def brute_force_log_norm(params, emissions, length):
+    """Enumerate all tag paths for one sequence."""
+    n = emissions.shape[-1]
+    start, end, trans = map(np.asarray, params)
+    e = np.asarray(emissions)
+    scores = []
+    for path in itertools.product(range(n), repeat=length):
+        s = start[path[0]] + e[0, path[0]] + end[path[-1]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + e[t, path[t]]
+        scores.append(s)
+    m = np.max(scores)
+    return m + np.log(np.sum(np.exp(np.asarray(scores) - m)))
+
+
+class TestCRF:
+    def test_log_norm_matches_brute_force(self, rng, np_rng):
+        n, t = 3, 4
+        params = C.init_crf_params(rng, n)
+        emissions = np_rng.randn(1, t, n).astype(np.float32)
+        got = float(C.crf_log_norm(params, jnp.asarray(emissions), jnp.asarray([t]))[0])
+        want = brute_force_log_norm(params, emissions[0], t)
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_log_norm_ragged(self, rng, np_rng):
+        n = 3
+        params = C.init_crf_params(rng, n)
+        emissions = np_rng.randn(2, 5, n).astype(np.float32)
+        lengths = jnp.asarray([2, 5])
+        got = C.crf_log_norm(params, jnp.asarray(emissions), lengths)
+        want0 = brute_force_log_norm(params, emissions[0], 2)
+        np.testing.assert_allclose(float(got[0]), want0, rtol=1e-4)
+
+    def test_log_likelihood_normalized(self, rng, np_rng):
+        """Sum over all paths of exp(loglik) must be 1."""
+        n, t = 2, 3
+        params = C.init_crf_params(rng, n)
+        emissions = jnp.asarray(np_rng.randn(1, t, n), jnp.float32)
+        total = 0.0
+        for path in itertools.product(range(n), repeat=t):
+            tags = jnp.asarray([list(path)])
+            ll = C.crf_log_likelihood(params, emissions, tags, jnp.asarray([t]))
+            total += float(jnp.exp(ll[0]))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+    def test_decode_matches_brute_force(self, rng, np_rng):
+        n, t = 3, 4
+        params = C.init_crf_params(rng, n)
+        emissions = np_rng.randn(1, t, n).astype(np.float32)
+        tags, score = C.crf_decode(params, jnp.asarray(emissions), jnp.asarray([t]))
+        # brute force best path
+        start, end, trans = map(np.asarray, params)
+        e = emissions[0]
+        best, best_s = None, -1e30
+        for path in itertools.product(range(n), repeat=t):
+            s = start[path[0]] + e[0, path[0]] + end[path[-1]]
+            for i in range(1, t):
+                s += trans[path[i - 1], path[i]] + e[i, path[i]]
+            if s > best_s:
+                best, best_s = path, s
+        assert tuple(np.asarray(tags)[0]) == best
+        np.testing.assert_allclose(float(score[0]), best_s, rtol=1e-4)
+
+    def test_grad(self, rng, np_rng):
+        n, t = 3, 4
+        params = C.init_crf_params(rng, n)
+        emissions = jnp.asarray(np_rng.randn(2, t, n), jnp.float32)
+        tags = jnp.asarray(np_rng.randint(0, n, (2, t)))
+        lengths = jnp.asarray([t, t - 1])
+
+        def loss(p):
+            cp = C.CRFParams(**p)
+            return -jnp.mean(C.crf_log_likelihood(cp, emissions, tags, lengths))
+
+        directional_grad_check(
+            loss, {"start": params.start, "end": params.end, "trans": params.trans}
+        )
+
+
+def brute_force_ctc(log_p, labels, blank=0):
+    """Sum probability over all alignments for one sequence."""
+    t, c = log_p.shape
+    total = -np.inf
+    for align in itertools.product(range(c), repeat=t):
+        # collapse
+        collapsed = []
+        prev = None
+        for a in align:
+            if a != blank and a != prev:
+                collapsed.append(a)
+            prev = a
+        if collapsed == list(labels):
+            s = sum(log_p[i, a] for i, a in enumerate(align))
+            total = np.logaddexp(total, s)
+    return -total
+
+
+class TestCTC:
+    def test_matches_brute_force(self, np_rng):
+        t, c = 4, 3
+        logits = np_rng.randn(1, t, c).astype(np.float32)
+        log_p = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+        labels = np.asarray([[1, 2]])
+        got = float(
+            K.ctc_loss(
+                jnp.asarray(log_p), jnp.asarray([t]), jnp.asarray(labels),
+                jnp.asarray([2]),
+            )[0]
+        )
+        want = brute_force_ctc(log_p[0], [1, 2])
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_repeated_label(self, np_rng):
+        t, c = 5, 3
+        logits = np_rng.randn(1, t, c).astype(np.float32)
+        log_p = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+        got = float(
+            K.ctc_loss(
+                jnp.asarray(log_p), jnp.asarray([t]), jnp.asarray([[1, 1]]),
+                jnp.asarray([2]),
+            )[0]
+        )
+        want = brute_force_ctc(log_p[0], [1, 1])
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_ragged_input_lengths(self, np_rng):
+        t, c = 6, 3
+        logits = np_rng.randn(2, t, c).astype(np.float32)
+        log_p = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+        got = K.ctc_loss(
+            jnp.asarray(log_p), jnp.asarray([3, 6]), jnp.asarray([[1], [2]]),
+            jnp.asarray([1, 1]),
+        )
+        want0 = brute_force_ctc(log_p[0, :3], [1])
+        np.testing.assert_allclose(float(got[0]), want0, rtol=1e-4)
+
+    def test_grad_finite(self, np_rng):
+        t, c = 5, 4
+        logits = jnp.asarray(np_rng.randn(2, t, c), jnp.float32)
+
+        def loss(p):
+            log_p = jax.nn.log_softmax(p["x"], axis=-1)
+            return jnp.sum(
+                K.ctc_loss(
+                    log_p, jnp.asarray([t, t - 1]), jnp.asarray([[1, 2], [3, 0]]),
+                    jnp.asarray([2, 1]),
+                )
+            )
+
+        directional_grad_check(loss, {"x": logits}, rtol=5e-3)
+
+    def test_greedy_decode(self):
+        # frames argmax: [1, 1, 0, 2, 2] -> collapse -> [1, 2]
+        lp = np.full((1, 5, 3), -5.0, np.float32)
+        for i, k in enumerate([1, 1, 0, 2, 2]):
+            lp[0, i, k] = 0.0
+        decoded, lens = K.ctc_greedy_decode(jnp.asarray(lp), jnp.asarray([5]))
+        assert int(lens[0]) == 2
+        np.testing.assert_array_equal(np.asarray(decoded)[0, :2], [1, 2])
+        assert np.all(np.asarray(decoded)[0, 2:] == -1)

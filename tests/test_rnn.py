@@ -1,0 +1,128 @@
+"""RNN tests: shapes, ragged masking semantics, gradcheck, and
+impl-equivalence against a plain python step loop (the reference's
+topology-equivalence style, e.g. recurrent_group vs fused LstmLayer,
+gserver/tests/test_CompareTwoNets.cpp)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import rnn as R
+from gradcheck import directional_grad_check
+
+
+def _np_lstm_ref(params, x):
+    """Step-by-step reference implementation (no masking)."""
+    w_ih, w_hh, b = map(np.asarray, (params["w_ih"], params["w_hh"], params["b"]))
+    bsz, t, f = x.shape
+    h_dim = w_hh.shape[0]
+    h = np.zeros((bsz, h_dim), np.float32)
+    c = np.zeros((bsz, h_dim), np.float32)
+    outs = []
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    for step in range(t):
+        gates = x[:, step] @ w_ih + h @ w_hh + b
+        i, fgt, g, o = np.split(gates, 4, axis=-1)
+        c = sig(fgt) * c + sig(i) * np.tanh(g)
+        h = sig(o) * np.tanh(c)
+        outs.append(h.copy())
+    return np.stack(outs, axis=1)
+
+
+class TestLSTM:
+    def test_matches_reference_loop(self, rng, np_rng):
+        params = R.init_lstm_params(rng, 4, 6)
+        x = np_rng.randn(3, 5, 4).astype(np.float32)
+        out, final = R.lstm(params, jnp.asarray(x))
+        want = _np_lstm_ref(params, x)
+        np.testing.assert_allclose(np.asarray(out), want, rtol=2e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(final.h), want[:, -1], rtol=2e-4, atol=1e-5)
+
+    def test_ragged_masking(self, rng, np_rng):
+        params = R.init_lstm_params(rng, 4, 6)
+        x = np_rng.randn(2, 6, 4).astype(np.float32)
+        lengths = jnp.asarray([3, 6])
+        out, final = R.lstm(params, jnp.asarray(x), lengths)
+        # outputs past length are zero
+        np.testing.assert_allclose(np.asarray(out)[0, 3:], 0.0)
+        # final state equals state at step len-1
+        out_full, _ = R.lstm(params, jnp.asarray(x[:, :3]))
+        np.testing.assert_allclose(
+            np.asarray(final.h)[0], np.asarray(out_full)[0, -1], rtol=1e-5
+        )
+
+    def test_reverse_matches_flipped(self, rng, np_rng):
+        params = R.init_lstm_params(rng, 3, 5)
+        x = np_rng.randn(2, 4, 3).astype(np.float32)
+        out_rev, _ = R.lstm(params, jnp.asarray(x), reverse=True)
+        out_flip, _ = R.lstm(params, jnp.asarray(x[:, ::-1]))
+        np.testing.assert_allclose(
+            np.asarray(out_rev), np.asarray(out_flip)[:, ::-1], rtol=1e-4, atol=1e-5
+        )
+
+    def test_grad(self, rng, np_rng):
+        params = R.init_lstm_params(rng, 3, 4)
+        x = jnp.asarray(np_rng.randn(2, 5, 3), jnp.float32)
+        lengths = jnp.asarray([3, 5])
+        directional_grad_check(
+            lambda p: jnp.sum(jnp.square(R.lstm(p, x, lengths)[0])), params
+        )
+
+
+class TestGRU:
+    def test_shapes_and_finite(self, rng, np_rng):
+        params = R.init_gru_params(rng, 4, 7)
+        x = jnp.asarray(np_rng.randn(3, 5, 4), jnp.float32)
+        out, final = R.gru(params, x, jnp.asarray([5, 2, 4]))
+        assert out.shape == (3, 5, 7)
+        assert final.shape == (3, 7)
+        assert bool(jnp.all(jnp.isfinite(out)))
+
+    def test_ragged_final_state(self, rng, np_rng):
+        params = R.init_gru_params(rng, 4, 7)
+        x = np_rng.randn(2, 6, 4).astype(np.float32)
+        out, final = R.gru(params, jnp.asarray(x), jnp.asarray([2, 6]))
+        out_short, final_short = R.gru(params, jnp.asarray(x[:, :2]))
+        np.testing.assert_allclose(
+            np.asarray(final)[0], np.asarray(final_short)[0], rtol=1e-5
+        )
+
+    def test_grad(self, rng, np_rng):
+        params = R.init_gru_params(rng, 3, 4)
+        x = jnp.asarray(np_rng.randn(2, 4, 3), jnp.float32)
+        directional_grad_check(
+            lambda p: jnp.sum(jnp.square(R.gru(p, x)[0])), params
+        )
+
+
+class TestSimpleRNNAndBidi:
+    def test_simple_rnn(self, rng, np_rng):
+        params = R.init_rnn_params(rng, 3, 5)
+        x = jnp.asarray(np_rng.randn(2, 4, 3), jnp.float32)
+        out, final = R.simple_rnn(params, x)
+        assert out.shape == (2, 4, 5)
+
+    def test_bidirectional_concat(self, rng, np_rng):
+        k1, k2 = jax.random.split(rng)
+        fwd = R.init_lstm_params(k1, 3, 4)
+        bwd = R.init_lstm_params(k2, 3, 4)
+        x = jnp.asarray(np_rng.randn(2, 5, 3), jnp.float32)
+        out, _ = R.bidirectional(R.lstm, fwd, bwd, x, jnp.asarray([5, 3]))
+        assert out.shape == (2, 5, 8)
+        f_out, _ = R.lstm(fwd, x, jnp.asarray([5, 3]))
+        np.testing.assert_allclose(np.asarray(out)[..., :4], np.asarray(f_out))
+
+
+class TestLayers:
+    def test_lstm_layer_in_module_system(self, rng, np_rng):
+        from paddle_tpu import nn
+
+        layer = nn.BiLSTM(6)
+        x = jnp.asarray(np_rng.randn(2, 5, 3), jnp.float32)
+        params, state = layer.init(rng, nn.ShapeSpec(x.shape))
+        out, _ = layer.apply(params, state, x, jnp.asarray([5, 2]))
+        assert out.shape == (2, 5, 12)

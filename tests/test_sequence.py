@@ -1,0 +1,114 @@
+"""Sequence-op tests: packed segment ops vs straightforward per-sequence
+numpy computation (the topology-equivalence test style, reference:
+gserver/tests/test_RecurrentGradientMachine.cpp comparing nested vs plain)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.data import batch as B
+from paddle_tpu.ops import sequence as S
+
+
+@pytest.fixture
+def packed(np_rng):
+    seqs = [np_rng.randn(n, 3).astype(np.float32) for n in [4, 2, 5]]
+    sb = B.pack_sequences(seqs, capacity=16, max_seqs=4)
+    return seqs, sb
+
+
+class TestSegmentPooling:
+    def test_sum(self, packed):
+        seqs, sb = packed
+        out = S.sequence_sum(jnp.asarray(sb.tokens), jnp.asarray(sb.segment_ids), 4)
+        for i, s in enumerate(seqs):
+            np.testing.assert_allclose(out[i], s.sum(0), rtol=1e-5)
+        np.testing.assert_allclose(out[3], 0.0)
+
+    def test_mean(self, packed):
+        seqs, sb = packed
+        out = S.sequence_mean(jnp.asarray(sb.tokens), jnp.asarray(sb.segment_ids), 4)
+        for i, s in enumerate(seqs):
+            np.testing.assert_allclose(out[i], s.mean(0), rtol=1e-5)
+
+    def test_max(self, packed):
+        seqs, sb = packed
+        out = S.sequence_max(jnp.asarray(sb.tokens), jnp.asarray(sb.segment_ids), 4)
+        for i, s in enumerate(seqs):
+            np.testing.assert_allclose(out[i], s.max(0), rtol=1e-5)
+        np.testing.assert_allclose(out[3], 0.0)  # empty slot zeroed
+
+    def test_first_last(self, packed):
+        seqs, sb = packed
+        first = S.sequence_first(
+            jnp.asarray(sb.tokens), jnp.asarray(sb.segment_ids),
+            jnp.asarray(sb.positions), 4,
+        )
+        last = S.sequence_last(
+            jnp.asarray(sb.tokens), jnp.asarray(sb.segment_ids),
+            jnp.asarray(sb.positions), jnp.asarray(sb.lengths), 4,
+        )
+        for i, s in enumerate(seqs):
+            np.testing.assert_allclose(first[i], s[0], rtol=1e-6)
+            np.testing.assert_allclose(last[i], s[-1], rtol=1e-6)
+
+    def test_softmax_per_sequence(self, np_rng):
+        seqs = [np_rng.randn(n).astype(np.float32) for n in [3, 5]]
+        sb = B.pack_sequences(seqs, capacity=8, max_seqs=2)
+        out = S.sequence_softmax(jnp.asarray(sb.tokens), jnp.asarray(sb.segment_ids), 2)
+        out = np.asarray(out)
+        # each segment sums to 1, padding exactly 0
+        np.testing.assert_allclose(out[:3].sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(out[3:8].sum(), 1.0, rtol=1e-5)
+        e0 = np.exp(seqs[0] - seqs[0].max())
+        np.testing.assert_allclose(out[:3], e0 / e0.sum(), rtol=1e-5)
+
+    def test_expand(self, packed):
+        seqs, sb = packed
+        vals = jnp.asarray(np.arange(4 * 3, dtype=np.float32).reshape(4, 3))
+        out = S.sequence_expand(vals, jnp.asarray(sb.segment_ids), 4)
+        np.testing.assert_allclose(out[0], vals[0])
+        np.testing.assert_allclose(out[4], vals[1])  # second sequence start
+        np.testing.assert_allclose(np.asarray(out)[~sb.mask], 0.0)
+
+
+class TestDenseHelpers:
+    def test_pack_to_dense_roundtrip(self, packed):
+        seqs, sb = packed
+        dense, mask = S.pack_to_dense(
+            jnp.asarray(sb.tokens), jnp.asarray(sb.segment_ids),
+            jnp.asarray(sb.positions), 4, 6,
+        )
+        assert dense.shape == (4, 6, 3)
+        for i, s in enumerate(seqs):
+            np.testing.assert_allclose(dense[i, : len(s)], s, rtol=1e-6)
+            assert bool(mask[i, : len(s)].all())
+            assert not bool(mask[i, len(s):].any())
+        back = S.dense_to_pack(
+            dense, jnp.asarray(sb.segment_ids), jnp.asarray(sb.positions), 4
+        )
+        np.testing.assert_allclose(
+            np.asarray(back)[sb.mask], sb.tokens[sb.mask], rtol=1e-6
+        )
+
+    def test_dense_pool_modes(self, np_rng):
+        x = np_rng.randn(2, 5, 3).astype(np.float32)
+        lengths = np.asarray([3, 5], np.int32)
+        xs = jnp.asarray(x)
+        for mode, ref in [
+            ("sum", lambda s: s.sum(0)),
+            ("mean", lambda s: s.mean(0)),
+            ("max", lambda s: s.max(0)),
+            ("last", lambda s: s[-1]),
+            ("first", lambda s: s[0]),
+        ]:
+            out = S.dense_sequence_pool(xs, jnp.asarray(lengths), mode)
+            for i, n in enumerate(lengths):
+                np.testing.assert_allclose(
+                    np.asarray(out)[i], ref(x[i, :n]), rtol=1e-5,
+                    err_msg=f"mode {mode} seq {i}",
+                )
+
+    def test_pool_unknown_mode(self):
+        with pytest.raises(ValueError):
+            S.dense_sequence_pool(jnp.ones((1, 2, 3)), jnp.asarray([2]), "nope")
