@@ -19,9 +19,11 @@ class Policy:
     """Dtype policy applied by layers.
 
     param_dtype:   dtype parameters are stored in (master weights).
-    compute_dtype: dtype inputs/weights are cast to before matmul/conv so
-                   the MXU runs in bf16 while accumulation stays f32.
-    accum_dtype:   preferred_element_type for dot/conv accumulation.
+    compute_dtype: dtype inputs/weights are cast to before matmul/conv
+                   (the MXU accumulates bf16 dots in f32 internally).
+    accum_dtype:   preferred_element_type for dot/conv outputs. Keep it
+                   equal to compute_dtype (see bf16_compute_policy);
+                   recurrent carries are held at >= f32 separately.
     """
 
     param_dtype: jnp.dtype = jnp.float32
@@ -48,11 +50,18 @@ def set_default_policy(policy: Policy) -> None:
 
 
 def bf16_compute_policy() -> Policy:
-    """The standard TPU training policy: f32 params, bf16 MXU compute."""
+    """The standard TPU training policy: f32 params, bf16 MXU compute.
+
+    accum_dtype stays bfloat16 at the jax level: forcing
+    preferred_element_type=f32 on bf16 inputs breaks the conv transpose
+    (grad) rule (f32 cotangent vs bf16 primal), and the MXU accumulates
+    bf16 dots in f32 internally regardless — reductions that need f32
+    (BN stats, losses) upcast explicitly via at_least_f32.
+    """
     return Policy(
         param_dtype=jnp.float32,
         compute_dtype=jnp.bfloat16,
-        accum_dtype=jnp.float32,
+        accum_dtype=jnp.bfloat16,
     )
 
 

@@ -6,6 +6,10 @@ benchmark/paddle/rnn/rnn.py, v1_api_demo/sequence_tagging/rnn_crf.py.
 """
 
 from paddle_tpu.models import lenet
+from paddle_tpu.models import resnet
+from paddle_tpu.models import vgg
+from paddle_tpu.models import alexnet
+from paddle_tpu.models import googlenet
 from paddle_tpu.models import text_lstm
 from paddle_tpu.models import bilstm_crf
 from paddle_tpu.models import seq2seq_attn

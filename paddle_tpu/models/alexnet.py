@@ -1,0 +1,36 @@
+"""AlexNet, NHWC.
+
+Parity target: reference benchmark/paddle/image/alexnet.py (5 convs with
+LRN after conv1/conv2, 3 fc with dropout). Grouped convs of the original
+paper are kept as an option (groups=2) since the reference config uses
+groups=1.
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import nn
+
+
+def alexnet(num_classes: int = 1000, *, groups: int = 1,
+            dropout: float = 0.5) -> nn.Sequential:
+    return nn.Sequential(
+        [
+            nn.Conv2D(96, 11, stride=4, padding="VALID", activation="relu", name="conv1"),
+            nn.LRN(5, name="lrn1"),
+            nn.MaxPool2D(3, stride=2, name="pool1"),
+            nn.Conv2D(256, 5, padding="SAME", groups=groups, activation="relu", name="conv2"),
+            nn.LRN(5, name="lrn2"),
+            nn.MaxPool2D(3, stride=2, name="pool2"),
+            nn.Conv2D(384, 3, padding="SAME", activation="relu", name="conv3"),
+            nn.Conv2D(384, 3, padding="SAME", groups=groups, activation="relu", name="conv4"),
+            nn.Conv2D(256, 3, padding="SAME", groups=groups, activation="relu", name="conv5"),
+            nn.MaxPool2D(3, stride=2, name="pool5"),
+            nn.Flatten(name="flatten"),
+            nn.Dense(4096, activation="relu", name="fc6"),
+            nn.Dropout(dropout, name="drop6"),
+            nn.Dense(4096, activation="relu", name="fc7"),
+            nn.Dropout(dropout, name="drop7"),
+            nn.Dense(num_classes, name="logits"),
+        ],
+        name="alexnet",
+    )

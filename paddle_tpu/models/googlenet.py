@@ -1,0 +1,71 @@
+"""GoogLeNet (Inception v1), NHWC.
+
+Parity target: reference benchmark/paddle/image/googlenet.py — inception
+blocks expressed there as parallel conv projections into one concat layer;
+here as an nn.Branches combinator. Aux classifier towers of the paper are
+omitted, matching the reference benchmark config (it trains the main tower
+only).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import nn
+
+
+def _inception(name, c1, c3r, c3, c5r, c5, proj) -> nn.Layer:
+    return nn.Branches(
+        [
+            nn.Conv2D(c1, 1, activation="relu", name=f"{name}_1x1"),
+            nn.Sequential(
+                [
+                    nn.Conv2D(c3r, 1, activation="relu", name=f"{name}_3x3r"),
+                    nn.Conv2D(c3, 3, padding="SAME", activation="relu", name=f"{name}_3x3"),
+                ],
+                name=f"{name}_b3",
+            ),
+            nn.Sequential(
+                [
+                    nn.Conv2D(c5r, 1, activation="relu", name=f"{name}_5x5r"),
+                    nn.Conv2D(c5, 5, padding="SAME", activation="relu", name=f"{name}_5x5"),
+                ],
+                name=f"{name}_b5",
+            ),
+            nn.Sequential(
+                [
+                    nn.MaxPool2D(3, stride=1, padding=1, name=f"{name}_poolp"),
+                    nn.Conv2D(proj, 1, activation="relu", name=f"{name}_proj"),
+                ],
+                name=f"{name}_bp",
+            ),
+        ],
+        name=name,
+    )
+
+
+def googlenet(num_classes: int = 1000, *, dropout: float = 0.4) -> nn.Sequential:
+    return nn.Sequential(
+        [
+            nn.Conv2D(64, 7, stride=2, padding="SAME", activation="relu", name="conv1"),
+            nn.MaxPool2D(3, stride=2, padding="SAME", name="pool1"),
+            nn.LRN(5, name="lrn1"),
+            nn.Conv2D(64, 1, activation="relu", name="conv2r"),
+            nn.Conv2D(192, 3, padding="SAME", activation="relu", name="conv2"),
+            nn.LRN(5, name="lrn2"),
+            nn.MaxPool2D(3, stride=2, padding="SAME", name="pool2"),
+            _inception("i3a", 64, 96, 128, 16, 32, 32),
+            _inception("i3b", 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, stride=2, padding="SAME", name="pool3"),
+            _inception("i4a", 192, 96, 208, 16, 48, 64),
+            _inception("i4b", 160, 112, 224, 24, 64, 64),
+            _inception("i4c", 128, 128, 256, 24, 64, 64),
+            _inception("i4d", 112, 144, 288, 32, 64, 64),
+            _inception("i4e", 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, stride=2, padding="SAME", name="pool4"),
+            _inception("i5a", 256, 160, 320, 32, 128, 128),
+            _inception("i5b", 384, 192, 384, 48, 128, 128),
+            nn.GlobalAvgPool2D(name="gap"),
+            nn.Dropout(dropout, name="drop"),
+            nn.Dense(num_classes, name="logits"),
+        ],
+        name="googlenet",
+    )

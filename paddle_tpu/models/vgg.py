@@ -1,0 +1,47 @@
+"""VGG family (11/13/16/19), NHWC.
+
+Parity target: reference benchmark/paddle/image/vgg.py (img_conv_group
+stacks of 3x3 convs + pooling, two 4096 fc + dropout) and the MNIST VGG
+demo (reference: v1_api_demo/mnist/vgg_16_mnist.py,
+python/paddle/trainer_config_helpers/networks.py:468 vgg_16_network).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import nn
+
+_CFG = {
+    11: (1, 1, 2, 2, 2),
+    13: (2, 2, 2, 2, 2),
+    16: (2, 2, 3, 3, 3),
+    19: (2, 2, 4, 4, 4),
+}
+
+
+def vgg(depth: int = 16, num_classes: int = 1000, *, with_bn: bool = True,
+        fc_dim: int = 4096, dropout: float = 0.5) -> nn.Sequential:
+    reps = _CFG[depth]
+    layers = []
+    ch = 64
+    for stage, n in enumerate(reps):
+        for i in range(n):
+            name = f"s{stage}_c{i}"
+            if with_bn:
+                layers += [
+                    nn.Conv2D(ch, 3, padding="SAME", use_bias=False, name=f"{name}_conv"),
+                    nn.BatchNorm(activation="relu", name=f"{name}_bn"),
+                ]
+            else:
+                layers.append(nn.Conv2D(ch, 3, padding="SAME", activation="relu",
+                                        name=f"{name}_conv"))
+        layers.append(nn.MaxPool2D(2, name=f"s{stage}_pool"))
+        ch = min(ch * 2, 512)
+    layers += [
+        nn.Flatten(name="flatten"),
+        nn.Dense(fc_dim, activation="relu", name="fc6"),
+        nn.Dropout(dropout, name="drop6"),
+        nn.Dense(fc_dim, activation="relu", name="fc7"),
+        nn.Dropout(dropout, name="drop7"),
+        nn.Dense(num_classes, name="logits"),
+    ]
+    return nn.Sequential(layers, name=f"vgg{depth}")

@@ -11,9 +11,11 @@ from paddle_tpu.nn.layers import (
     GlobalAvgPool2D,
     BatchNorm,
     LayerNorm,
+    LRN,
     Dropout,
     Embedding,
     Flatten,
     Activation,
     Lambda,
 )
+from paddle_tpu.nn.composite import Residual, Branches

@@ -252,6 +252,29 @@ class LayerNorm(Layer):
         return norm_ops.layer_norm(x, params["scale"], params["offset"], epsilon=self.epsilon), {}
 
 
+class LRN(Layer):
+    """Cross-map local response normalization (reference:
+    gserver/layers/NormLayer.cpp cmrnorm-projection,
+    function/CrossMapNormalOp.cpp, operators/lrn_op.cc)."""
+
+    def __init__(self, size: int = 5, *, alpha: float = 1e-4, beta: float = 0.75,
+                 k: float = 1.0, name: Optional[str] = None):
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        return {}, {}, spec
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return (
+            norm_ops.lrn(x, size=self.size, alpha=self.alpha, beta=self.beta, k=self.k),
+            {},
+        )
+
+
 class Dropout(Layer):
     """Dropout (reference: Layer.h dropout hookup + operators/dropout_op.cc).
 

@@ -72,17 +72,26 @@ def gru_step(params, x_t, h, *, activation=jnp.tanh,
     return (1.0 - z) * n + z * h
 
 
+def _carry_dtype():
+    """Recurrent carries accumulate across T steps — keep them at least f32
+    even under a bf16 compute policy (the gate matmuls still run bf16)."""
+    return jnp.promote_types(default_policy().accum_dtype, jnp.float32)
+
+
 def _masked_scan(step_fn, init_state, xs, mask, reverse: bool, unroll: int = 1):
     """Scan over time with per-step carry masking for ragged batches."""
 
     def body(carry, inp):
         x_t, m_t = inp
         new_carry = step_fn(carry, x_t)
-        # keep old state where the sequence has ended
+        # keep old state where the sequence has ended; cast back so the
+        # carry dtype is loop-invariant even if the step math ran bf16
         merged = jax.tree.map(
-            lambda new, old: jnp.where(m_t[:, None], new, old), new_carry, carry
+            lambda new, old: jnp.where(m_t[:, None], new, old).astype(old.dtype),
+            new_carry,
+            carry,
         )
-        return merged, jax.tree.map(lambda v: v, merged)
+        return merged, merged
 
     final, ys = jax.lax.scan(
         body, init_state, (xs, mask), reverse=reverse, unroll=unroll
@@ -99,10 +108,12 @@ def lstm(params, x, lengths=None, *, initial_state: Optional[LSTMState] = None,
     """
     b, t, _ = x.shape
     hdim = params["w_hh"].shape[0]
-    cdtype = default_policy().accum_dtype
     if initial_state is None:
+        # c is the additive accumulator -> keep it >= f32; h feeds the next
+        # step's matmul anyway, so it can live in the compute dtype
         initial_state = LSTMState(
-            jnp.zeros((b, hdim), cdtype), jnp.zeros((b, hdim), cdtype)
+            jnp.zeros((b, hdim), default_policy().compute_dtype),
+            jnp.zeros((b, hdim), _carry_dtype()),
         )
     if lengths is None:
         mask = jnp.ones((b, t), bool)
@@ -128,7 +139,7 @@ def gru(params, x, lengths=None, *, initial_state=None, reverse: bool = False,
     b, t, _ = x.shape
     hdim = params["w_hh"].shape[0]
     if initial_state is None:
-        initial_state = jnp.zeros((b, hdim), default_policy().accum_dtype)
+        initial_state = jnp.zeros((b, hdim), _carry_dtype())
     if lengths is None:
         mask = jnp.ones((b, t), bool)
     else:
@@ -139,15 +150,9 @@ def gru(params, x, lengths=None, *, initial_state=None, reverse: bool = False,
     def step(h, x_t):
         return gru_step(params, x_t, h)
 
-    def body(carry, inp):
-        x_t, m_t = inp
-        new_h = step(carry, x_t)
-        merged = jnp.where(m_t[:, None], new_h, carry)
-        return merged, merged
-
-    final, ys = jax.lax.scan(body, initial_state, (xs, ms), reverse=reverse,
-                             unroll=unroll)
-    outputs = jnp.swapaxes(ys, 0, 1) * mask[..., None].astype(x.dtype)
+    final, ys = _masked_scan(step, initial_state, xs, ms, reverse, unroll)
+    outputs = jnp.swapaxes(ys, 0, 1)
+    outputs = outputs * mask[..., None].astype(outputs.dtype)
     return outputs, final
 
 
@@ -157,7 +162,7 @@ def simple_rnn(params, x, lengths=None, *, activation=jnp.tanh,
     gserver/layers/RecurrentLayer.cpp)."""
     b, t, _ = x.shape
     hdim = params["w_hh"].shape[0]
-    h0 = jnp.zeros((b, hdim), default_policy().accum_dtype)
+    h0 = jnp.zeros((b, hdim), _carry_dtype())
     if lengths is None:
         mask = jnp.ones((b, t), bool)
     else:
@@ -165,17 +170,15 @@ def simple_rnn(params, x, lengths=None, *, activation=jnp.tanh,
     xs = jnp.swapaxes(x, 0, 1)
     ms = jnp.swapaxes(mask, 0, 1)
 
-    def body(h, inp):
-        x_t, m_t = inp
-        new_h = activation(
+    def step(h, x_t):
+        return activation(
             linalg.matmul(x_t, params["w_ih"]) + linalg.matmul(h, params["w_hh"])
             + params["b"]
         )
-        merged = jnp.where(m_t[:, None], new_h, h)
-        return merged, merged
 
-    final, ys = jax.lax.scan(body, h0, (xs, ms), reverse=reverse)
-    return jnp.swapaxes(ys, 0, 1) * mask[..., None].astype(x.dtype), final
+    final, ys = _masked_scan(step, h0, xs, ms, reverse)
+    outputs = jnp.swapaxes(ys, 0, 1)
+    return outputs * mask[..., None].astype(outputs.dtype), final
 
 
 def bidirectional(run_fn, fwd_params, bwd_params, x, lengths=None, **kw):

@@ -66,7 +66,7 @@ def sequence_max(tokens, segment_ids, num_segments: int):
         tokens, segment_ids, num_segments=num_segments + 1
     )[:num_segments]
     # empty sequences produce -inf from segment_max; zero them like the ref
-    return jnp.where(jnp.isfinite(out), out, 0.0)
+    return jnp.where(jnp.isfinite(out), out, jnp.zeros((), out.dtype))
 
 
 def sequence_first(tokens, segment_ids, positions, num_segments: int):
@@ -75,8 +75,9 @@ def sequence_first(tokens, segment_ids, positions, num_segments: int):
     cap = tokens.shape[0]
     is_first = (positions == 0) & _valid_mask(segment_ids, num_segments)
     idx = jnp.where(is_first, segment_ids, num_segments)
+    zero = jnp.zeros((), tokens.dtype)
     onehot_rows = jax.ops.segment_sum(
-        jnp.where(is_first[:, None], tokens.reshape(cap, -1), 0.0),
+        jnp.where(is_first[:, None], tokens.reshape(cap, -1), zero),
         idx,
         num_segments=num_segments + 1,
     )[:num_segments]
@@ -90,8 +91,9 @@ def sequence_last(tokens, segment_ids, positions, lengths, num_segments: int):
     seq_len = jnp.where(valid, lengths[jnp.clip(segment_ids, 0, num_segments - 1)], -1)
     is_last = valid & (positions == seq_len - 1)
     idx = jnp.where(is_last, segment_ids, num_segments)
+    zero = jnp.zeros((), tokens.dtype)
     rows = jax.ops.segment_sum(
-        jnp.where(is_last[:, None], tokens.reshape(cap, -1), 0.0),
+        jnp.where(is_last[:, None], tokens.reshape(cap, -1), zero),
         idx,
         num_segments=num_segments + 1,
     )[:num_segments]
@@ -159,11 +161,13 @@ def dense_sequence_pool(x, lengths, mode: str = "mean"):
         neg = jnp.where(mask[..., None], x, NEG_INF)
         out = jnp.max(neg, axis=1)
         return jnp.where(out <= NEG_INF / 2, 0.0, out)
+    nonempty = (lengths > 0).astype(x.dtype)[:, None]
     if mode == "last":
         idx = jnp.clip(lengths - 1, 0, t - 1)
-        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0] * nonempty
     if mode == "first":
-        return x[:, 0]
+        # zero-length rows return 0, consistent with sum/mean/max
+        return x[:, 0] * nonempty
     raise ValueError(f"unknown pool mode {mode!r}")
 
 
