@@ -29,3 +29,4 @@ from paddle_tpu import data
 from paddle_tpu import train
 from paddle_tpu import parallel
 from paddle_tpu import models
+from paddle_tpu import metrics

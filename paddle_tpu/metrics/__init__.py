@@ -1,0 +1,48 @@
+"""Streaming evaluators (reference: paddle/gserver/evaluators/).
+
+Two tiers, mirroring the reference's split between in-graph metric ops
+and streaming pass-level evaluators:
+
+- in-graph accumulator functions (pure jax, safe under jit) that return
+  small accumulator arrays summed across batches — e.g. confusion
+  matrices, AUC histograms;
+- host-side `Evaluator` objects with reset/update/result, for metrics
+  whose computation is inherently sequential/ragged (chunk F1, edit
+  distance, detection mAP), just as the reference computed those on CPU.
+"""
+
+from paddle_tpu.metrics.base import CombinedEvaluator, Evaluator
+from paddle_tpu.metrics.classify import (
+    AucEvaluator,
+    ClassificationErrorEvaluator,
+    ColumnSumEvaluator,
+    PnPairEvaluator,
+    PrecisionRecallEvaluator,
+    SumEvaluator,
+    confusion_matrix,
+)
+from paddle_tpu.metrics.chunk import ChunkEvaluator, extract_chunks
+from paddle_tpu.metrics.editdist import (
+    CTCErrorEvaluator,
+    ctc_greedy_decode,
+    edit_distance,
+)
+from paddle_tpu.metrics.detection import DetectionMAPEvaluator
+
+__all__ = [
+    "Evaluator",
+    "CombinedEvaluator",
+    "AucEvaluator",
+    "ClassificationErrorEvaluator",
+    "ColumnSumEvaluator",
+    "PnPairEvaluator",
+    "PrecisionRecallEvaluator",
+    "SumEvaluator",
+    "confusion_matrix",
+    "ChunkEvaluator",
+    "extract_chunks",
+    "CTCErrorEvaluator",
+    "ctc_greedy_decode",
+    "edit_distance",
+    "DetectionMAPEvaluator",
+]

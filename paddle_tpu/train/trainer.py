@@ -70,13 +70,16 @@ def make_train_step(
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 
-def make_eval_step(model: Layer, loss_fn: LossFn, *, metrics_fn=None):
+def make_eval_step(model: Layer, loss_fn: LossFn, *, metrics_fn=None,
+                   return_outputs: bool = False):
     def step(state: TrainState, inputs, labels):
         inputs = inputs if isinstance(inputs, tuple) else (inputs,)
         labels = labels if isinstance(labels, tuple) else (labels,)
         out, _ = model.apply(state.params, state.model_state, *inputs, training=False)
         loss = loss_fn(out, *labels)
         metrics = metrics_fn(out, *labels) if metrics_fn else {}
+        if return_outputs:
+            return loss, metrics, out
         return loss, metrics
 
     return jax.jit(step)
@@ -158,15 +161,49 @@ class Trainer:
             handler(E.EndPass(pass_id, results))
         return state
 
-    def evaluate(self, state: TrainState, batch_iter_factory) -> E.TestResult:
+    def evaluate(self, state: TrainState, batch_iter_factory,
+                 evaluators=None) -> E.TestResult:
+        """Streaming evaluation; `evaluators` (metrics.Evaluator objects,
+        reference: gserver/evaluators/) get update(outputs, *labels) per
+        batch and their results merged into the returned metrics."""
         total, n = 0.0, 0
         agg: Dict[str, float] = {}
+        eval_step = self._eval_step
+        if evaluators:
+            if not hasattr(self, "_eval_step_with_outputs"):
+                self._eval_step_with_outputs = make_eval_step(
+                    self.model, self.loss_fn, metrics_fn=self.metrics_fn,
+                    return_outputs=True)
+            eval_step = self._eval_step_with_outputs
+            for ev in evaluators:
+                ev.reset()
         for batch in batch_iter_factory():
             inputs, labels = self._split_batch(batch)
-            loss, metrics = self._eval_step(state, inputs, labels)
+            if evaluators:
+                loss, metrics, out = eval_step(state, inputs, labels)
+                import numpy as np
+                for ev in evaluators:
+                    ev.update(np.asarray(out), *[np.asarray(l) for l in labels])
+            else:
+                loss, metrics = eval_step(state, inputs, labels)
             total += float(loss)
             for k, v in metrics.items():
                 agg[k] = agg.get(k, 0.0) + float(v)
             n += 1
         n = max(n, 1)
-        return E.TestResult(-1, total / n, {k: v / n for k, v in agg.items()})
+        results = {k: v / n for k, v in agg.items()}
+        if evaluators:
+            seen: Dict[str, int] = {}
+            for ev in evaluators:
+                # disambiguate same-named evaluators: second one becomes
+                # "name#1" etc. instead of silently overwriting
+                count = seen.get(ev.name, 0)
+                seen[ev.name] = count + 1
+                base = ev.name if count == 0 else f"{ev.name}#{count}"
+                r = ev.result()
+                if isinstance(r, dict):
+                    for k, v in r.items():
+                        results[f"{base}/{k}"] = v
+                else:
+                    results[base] = r
+        return E.TestResult(-1, total / n, results)

@@ -1,0 +1,223 @@
+"""Evaluator tests (reference test model: gserver/tests evaluator checks +
+hand-computed small cases)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import metrics as M
+
+
+def test_classification_error_stream():
+    ev = M.ClassificationErrorEvaluator()
+    ev.update(np.array([0, 1, 2, 2]), np.array([0, 1, 1, 2]))
+    ev.update(np.array([1, 1]), np.array([0, 1]))
+    assert ev.result() == pytest.approx(2 / 6)
+
+
+def test_precision_recall_binary():
+    ev = M.PrecisionRecallEvaluator(num_classes=2, positive_label=1)
+    # preds: tp=2, fp=1, fn=1, tn=2
+    ev.update(np.array([1, 1, 1, 0, 0, 0]), np.array([1, 1, 0, 1, 0, 0]))
+    r = ev.result()
+    assert r["precision"] == pytest.approx(2 / 3)
+    assert r["recall"] == pytest.approx(2 / 3)
+
+
+def test_precision_recall_from_logits_and_macro():
+    ev = M.PrecisionRecallEvaluator(num_classes=3)
+    logits = np.eye(3)[[0, 1, 2, 0]] * 5.0  # preds 0,1,2,0
+    labels = np.array([0, 1, 2, 1])
+    ev.update(logits, labels)
+    r = ev.result()
+    # class0: p=1/2 r=1; class1: p=1 r=1/2; class2: p=1 r=1
+    assert r["precision"] == pytest.approx((0.5 + 1 + 1) / 3)
+    assert r["recall"] == pytest.approx((1 + 0.5 + 1) / 3)
+
+
+def test_confusion_matrix_jax_matches_numpy():
+    import jax.numpy as jnp
+
+    pred = np.array([0, 1, 1, 2, 2, 2])
+    lab = np.array([0, 1, 2, 2, 2, 0])
+    cm = np.asarray(M.confusion_matrix(jnp.asarray(pred), jnp.asarray(lab), 3))
+    ref = np.zeros((3, 3), int)
+    np.add.at(ref, (lab, pred), 1)
+    np.testing.assert_array_equal(cm, ref)
+    # streamed through the evaluator via pre-reduced matrix
+    ev = M.PrecisionRecallEvaluator(num_classes=3)
+    ev.update(cm, None)
+    assert ev._cm.sum() == 6
+
+
+def test_auc_exact_on_separable():
+    ev = M.AucEvaluator(num_buckets=1024)
+    scores = np.array([0.9, 0.8, 0.7, 0.3, 0.2, 0.1])
+    labels = np.array([1, 1, 1, 0, 0, 0])
+    ev.update(scores, labels)
+    assert ev.result() == pytest.approx(1.0)
+
+
+def test_auc_approximates_rank_auc():
+    rng = np.random.RandomState(0)
+    scores = rng.rand(4000)
+    labels = (scores + rng.randn(4000) * 0.3 > 0.5).astype(int)
+    ev = M.AucEvaluator()
+    # stream in two chunks
+    ev.update(scores[:2000], labels[:2000])
+    ev.update(scores[2000:], labels[2000:])
+    # exact AUC by rank statistic
+    pos, neg = scores[labels == 1], scores[labels == 0]
+    exact = (pos[:, None] > neg[None, :]).mean() \
+        + 0.5 * (pos[:, None] == neg[None, :]).mean()
+    assert ev.result() == pytest.approx(exact, abs=2e-3)
+
+
+def test_pnpair():
+    ev = M.PnPairEvaluator()
+    # query 0: pos 0.9 vs negs 0.1, 0.5 -> 2 right
+    # query 1: pos 0.2 vs neg 0.8 -> 1 wrong
+    ev.update(np.array([0.9, 0.1, 0.5]), np.array([1, 0, 0]), np.array([0, 0, 0]))
+    ev.update(np.array([0.2, 0.8]), np.array([1, 0]), np.array([1, 1]))
+    r = ev.result()
+    assert r["right"] == 2 and r["wrong"] == 1
+    assert r["ratio"] == pytest.approx(2 / 3)
+
+
+def test_sum_and_column_sum():
+    s = M.SumEvaluator()
+    s.update(np.array([1.0, 2.0, 3.0]))
+    s.update(np.array([4.0]))
+    assert s.result() == pytest.approx(10.0)
+    c = M.ColumnSumEvaluator()
+    c.update(np.array([[1.0, 2.0], [3.0, 4.0]]))
+    np.testing.assert_allclose(c.result(), [2.0, 3.0])
+
+
+# ---- chunk evaluator ----
+
+def _iob(b_or_i, ctype):  # IOB: tag = type*2 + (0 for B, 1 for I)
+    return ctype * 2 + b_or_i
+
+
+def test_extract_chunks_iob():
+    O = 2  # 1 chunk type -> outside id = 2
+    # B I O B -> chunks (0,0,2), (0,3,4)
+    tags = [_iob(0, 0), _iob(1, 0), O, _iob(0, 0)]
+    assert M.extract_chunks(tags, "IOB", 1) == [(0, 0, 2), (0, 3, 4)]
+    # I at sequence start begins a chunk (untagged-begin convention)
+    assert M.extract_chunks([_iob(1, 0), _iob(1, 0)], "IOB", 1) == [(0, 0, 2)]
+
+
+def test_extract_chunks_ioe():
+    # IOE with 1 type: I=0, E=1, outside=2 — I I E is ONE chunk
+    assert M.extract_chunks([0, 0, 1], "IOE", 1) == [(0, 0, 3)]
+    # E alone ends a single-token chunk; trailing I without E still flushes
+    assert M.extract_chunks([1, 2, 0, 0], "IOE", 1) == [(0, 0, 1), (0, 2, 4)]
+
+
+def test_extract_chunks_iobes():
+    # IOBES with 1 type: B=0 I=1 E=2 S=3, outside=4
+    assert M.extract_chunks([3, 4, 0, 1, 2], "IOBES", 1) == [(0, 0, 1), (0, 2, 5)]
+
+
+def test_extract_chunks_plain():
+    # plain: runs of same type; outside id = num_types
+    assert M.extract_chunks([0, 0, 1, 2, 1], "plain", 2) == [
+        (0, 0, 2), (1, 2, 3), (1, 4, 5)]
+
+
+def test_chunk_f1_stream():
+    ev = M.ChunkEvaluator(scheme="IOB", num_chunk_types=1)
+    O = 2
+    label = np.array([[0, 1, O, 0, O]])
+    pred = np.array([[0, 1, O, O, O]])  # finds 1 of 2 chunks, outputs 1
+    ev.update(pred, label)
+    r = ev.result()
+    assert r["precision"] == pytest.approx(1.0)
+    assert r["recall"] == pytest.approx(0.5)
+    assert r["f1"] == pytest.approx(2 / 3)
+
+
+# ---- edit distance / CTC ----
+
+def test_edit_distance():
+    assert M.edit_distance([1, 2, 3], [1, 2, 3]) == 0
+    assert M.edit_distance([1, 2, 3], [1, 3]) == 1
+    assert M.edit_distance([], [1, 2]) == 2
+    assert M.edit_distance([1, 2], [2, 1]) == 2
+    assert M.edit_distance([1, 2, 3, 4], [1, 9, 3]) == 2
+
+
+def test_ctc_greedy_decode():
+    assert M.ctc_greedy_decode([0, 1, 1, 0, 2, 2, 2, 0, 1]) == [1, 2, 1]
+    assert M.ctc_greedy_decode([0, 0, 0]) == []
+
+
+def test_ctc_error_evaluator():
+    ev = M.CTCErrorEvaluator(blank=0)
+    # frames decode to [1,2,1]; label [1,2,1] -> 0 errors
+    ev.update(np.array([[0, 1, 1, 0, 2, 2, 0, 1]]), np.array([[1, 2, 1]]))
+    # frames decode to [3]; label [3,4] -> dist 1, len 2
+    ev.update(np.array([[3, 3, 0, 0, 0, 0, 0, 0]]), np.array([[3, 4, 0]]))
+    r = ev.result()
+    assert r["error_rate"] == pytest.approx(1 / 5)
+    assert r["seq_error_rate"] == pytest.approx(1 / 2)
+
+
+# ---- detection mAP ----
+
+def test_detection_map_perfect():
+    ev = M.DetectionMAPEvaluator()
+    gt = np.array([[1, 0, 0, 10, 10], [2, 20, 20, 30, 30]])
+    det = np.array([
+        [1, 0.9, 0, 0, 10, 10],
+        [2, 0.8, 20, 20, 30, 30],
+    ])
+    ev.update(det, gt)
+    assert ev.result()["mAP"] == pytest.approx(1.0)
+
+
+def test_detection_map_with_fp_and_miss():
+    ev = M.DetectionMAPEvaluator(ap_type="integral")
+    gt = np.array([[1, 0, 0, 10, 10], [1, 50, 50, 60, 60]])
+    det = np.array([
+        [1, 0.9, 0, 0, 10, 10],     # tp
+        [1, 0.8, 100, 100, 110, 110],  # fp
+    ])
+    ev.update(det, gt)
+    # recall reaches 0.5 with precision 1 -> integral AP = 0.5
+    assert ev.result()["mAP"] == pytest.approx(0.5)
+
+
+def test_combined_evaluator():
+    a = M.ClassificationErrorEvaluator()
+    b = M.PrecisionRecallEvaluator(num_classes=2, positive_label=1)
+    comb = M.CombinedEvaluator([a, b])
+    comb.update(np.array([1, 0]), np.array([1, 1]))
+    r = comb.result()
+    assert r["classification_error"] == pytest.approx(0.5)
+    assert r["precision_recall"]["recall"] == pytest.approx(0.5)
+    comb.reset()
+    assert a.result() == 0.0
+
+
+def test_trainer_evaluate_with_evaluators():
+    import jax
+    from paddle_tpu import nn, optim
+    from paddle_tpu.nn.module import ShapeSpec
+    from paddle_tpu.ops import losses
+    from paddle_tpu.train.trainer import Trainer
+    import jax.numpy as jnp
+
+    model = nn.Sequential([nn.Dense(8, name="fc", activation="relu"),
+                           nn.Dense(3, name="out")])
+    tr = Trainer(model, lambda o, y: jnp.mean(losses.softmax_cross_entropy(o, y)),
+                 optim.sgd(0.1))
+    state = tr.init_state(ShapeSpec((4, 5)))
+    rng = np.random.RandomState(0)
+    batches = [(rng.rand(4, 5).astype(np.float32),
+                rng.randint(0, 3, 4)) for _ in range(3)]
+    ev = M.ClassificationErrorEvaluator()
+    res = tr.evaluate(state, lambda: iter(batches), evaluators=[ev])
+    assert "classification_error" in res.metrics
+    assert 0.0 <= res.metrics["classification_error"] <= 1.0
