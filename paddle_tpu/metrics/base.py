@@ -50,4 +50,13 @@ class CombinedEvaluator(Evaluator):
             ev.update(*args, **kwargs)
 
     def result(self) -> Dict[str, Any]:
-        return {ev.name: ev.result() for ev in self.evaluators}
+        out: Dict[str, Any] = {}
+        seen: Dict[str, int] = {}
+        for ev in self.evaluators:
+            # same-named members become "name#1" etc. rather than silently
+            # overwriting
+            count = seen.get(ev.name, 0)
+            seen[ev.name] = count + 1
+            key = ev.name if count == 0 else f"{ev.name}#{count}"
+            out[key] = ev.result()
+        return out

@@ -191,10 +191,14 @@ class PnPairEvaluator(Evaluator):
             pos, neg = s[l != 0], s[l == 0]
             if len(pos) == 0 or len(neg) == 0:
                 continue
-            diff = pos[:, None] - neg[None, :]
-            right += float((diff > 0).sum())
-            wrong += float((diff < 0).sum())
-            tie += float((diff == 0).sum())
+            # sort+searchsorted pair counting: O(n log n), no dense
+            # pos×neg matrix
+            neg_sorted = np.sort(neg)
+            below = np.searchsorted(neg_sorted, pos, side="left")
+            below_or_eq = np.searchsorted(neg_sorted, pos, side="right")
+            right += float(below.sum())
+            tie += float((below_or_eq - below).sum())
+            wrong += float((len(neg) - below_or_eq).sum())
         denom = max(right + wrong + tie, 1.0)
         return {"right": right, "wrong": wrong,
                 "ratio": (right + 0.5 * tie) / denom}

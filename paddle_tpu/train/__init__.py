@@ -3,3 +3,10 @@
 from paddle_tpu.train import events
 from paddle_tpu.train.state import TrainState
 from paddle_tpu.train.trainer import Trainer, make_train_step, make_eval_step
+from paddle_tpu.train.checkpoint import (
+    CheckpointManager,
+    export_inference_artifact,
+    load_inference_artifact,
+    load_parameters_tar,
+    save_parameters_tar,
+)

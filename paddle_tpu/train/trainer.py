@@ -16,6 +16,7 @@ from typing import Any, Callable, Dict, Iterable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.nn.module import Layer, merge_state
 from paddle_tpu.optim.optimizers import Optimizer
@@ -134,7 +135,13 @@ class Trainer:
         num_passes: int = 1,
         event_handler: Optional[Callable] = None,
         test_iter_factory: Optional[Callable[[], Iterable]] = None,
+        checkpoint_manager=None,
+        checkpoint_every_n_batches: Optional[int] = None,
     ) -> TrainState:
+        """checkpoint_manager: train.CheckpointManager; saves every pass
+        end, plus every checkpoint_every_n_batches batches if set
+        (reference: save_dir + saving_period flags,
+        trainer/Trainer.cpp:60-89)."""
         handler = event_handler or (lambda ev: None)
         for pass_id in range(num_passes):
             handler(E.BeginPass(pass_id))
@@ -153,6 +160,13 @@ class Trainer:
                         metrics={k: float(v) for k, v in metrics.items()},
                     )
                 )
+                if (checkpoint_manager is not None
+                        and checkpoint_every_n_batches
+                        and (batch_id + 1) % checkpoint_every_n_batches == 0):
+                    checkpoint_manager.save(state)
+            if (checkpoint_manager is not None
+                    and checkpoint_manager.latest_step() != int(state.step)):
+                checkpoint_manager.save(state)
             results: Dict[str, float] = {}
             if test_iter_factory is not None:
                 test_res = self.evaluate(state, test_iter_factory)
@@ -181,7 +195,6 @@ class Trainer:
             inputs, labels = self._split_batch(batch)
             if evaluators:
                 loss, metrics, out = eval_step(state, inputs, labels)
-                import numpy as np
                 for ev in evaluators:
                     ev.update(np.asarray(out), *[np.asarray(l) for l in labels])
             else:
