@@ -32,19 +32,15 @@ def embedding_lookup(table, ids):
     return jnp.take(table, ids, axis=0)
 
 
-def embedding_bag(table, ids, segment_ids, num_segments: int, *,
-                  combiner: str = "sum"):
-    """Lookup + per-segment combine, the CTR 'sparse feature bag' op
-    (reference: gserver TableProjection + sequence pooling of id features).
-
-    ids, segment_ids: [K] flat id/segment pairs.
-    """
-    vecs = jnp.take(table, ids, axis=0)  # [K, D]
+def combine_bags(vecs, ids, segment_ids, num_segments: int, combiner: str,
+                 dtype):
+    """Per-segment combine of looked-up vectors (shared by the dense and
+    mesh-sharded embedding-bag paths)."""
     sums = jax.ops.segment_sum(vecs, segment_ids, num_segments=num_segments)
     if combiner == "sum":
         return sums
     counts = jax.ops.segment_sum(
-        jnp.ones_like(ids, table.dtype), segment_ids, num_segments=num_segments
+        jnp.ones_like(ids, dtype), segment_ids, num_segments=num_segments
     )
     if combiner == "mean":
         return sums / jnp.maximum(counts, 1.0)[:, None]
@@ -53,11 +49,26 @@ def embedding_bag(table, ids, segment_ids, num_segments: int, *,
     raise ValueError(f"unknown combiner {combiner!r}")
 
 
+def embedding_bag(table, ids, segment_ids, num_segments: int, *,
+                  combiner: str = "sum"):
+    """Lookup + per-segment combine, the CTR 'sparse feature bag' op
+    (reference: gserver TableProjection + sequence pooling of id features).
+
+    ids, segment_ids: [K] flat id/segment pairs.
+    """
+    vecs = jnp.take(table, ids, axis=0)  # [K, D]
+    return combine_bags(vecs, ids, segment_ids, num_segments, combiner,
+                        table.dtype)
+
+
 def shard_table_rows(table, mesh: Mesh):
     """Place an embedding table row-sharded over the model axis — the
     pserver row-shard equivalent; XLA then turns lookups into
-    gather + all-to-all over ICI."""
-    return jax.device_put(table, NamedSharding(mesh, P(MODEL_AXIS, None)))
+    gather + all-to-all over ICI. Delegates to parallel.sparse.shard_rows
+    (which also validates divisibility)."""
+    from paddle_tpu.parallel.sparse import shard_rows
+
+    return shard_rows(table, mesh, MODEL_AXIS)
 
 
 def one_hot_matmul_lookup(table, ids, *, dtype=None):

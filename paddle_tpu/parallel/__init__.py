@@ -10,3 +10,12 @@ from paddle_tpu.parallel.train_step import (
     make_sharded_train_step,
     shard_train_state,
 )
+from paddle_tpu.parallel import collectives
+from paddle_tpu.parallel.sparse import (
+    ShardedEmbedding,
+    rowwise_sgd_update,
+    shard_rows,
+    sharded_embedding_bag,
+    sharded_lookup,
+    unique_rows_grad,
+)

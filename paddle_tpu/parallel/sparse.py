@@ -1,0 +1,173 @@
+"""Sharded sparse-embedding training (the reference's "EP" path).
+
+Reference machinery being replaced: embedding tables row-sharded across
+parameter servers with trainers prefetching only touched rows
+(reference: math/SparseRowMatrix.h:206 SparsePrefetchRowCpuMatrix,
+pserver/ParameterServer2.h:510 getParameterSparse,
+gserver/gradientmachines/NeuralNetwork.cpp:208-245 prefetch) and
+SelectedRows {rows, values} sparse gradients (reference:
+framework/selected_rows.h, operators/math/selected_rows_functor.*).
+
+TPU-native design: the table lives row-sharded over the mesh `model`
+axis. A lookup runs under shard_map — each shard takes from its local
+rows with out-of-range ids masked to zero, then one psum over the model
+axis assembles full vectors. The exchange is a single ICI all-reduce
+instead of per-row RPCs. Gradients flow through the same program, so
+backward is a local scatter-add + the mirrored psum — SelectedRows
+semantics without a dense [V, D] gradient materializing per step when
+using `rowwise_update` (the reference's sparse-row optimizer update,
+parameter/FirstOrderOptimizer.h SparseMomentum analog).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.mesh import MODEL_AXIS
+from paddle_tpu.ops.embedding import combine_bags
+
+
+def shard_rows(table, mesh: Mesh, axis: str = MODEL_AXIS):
+    """Row-shard a [V, D] table over a mesh axis; V must divide evenly
+    (pad the vocab up — the reference's block-sharding padded too)."""
+    n = mesh.shape[axis]
+    if table.shape[0] % n != 0:
+        raise ValueError(
+            f"vocab {table.shape[0]} not divisible by {axis} axis size {n}; "
+            f"pad the table")
+    return jax.device_put(table, NamedSharding(mesh, P(axis, None)))
+
+
+def sharded_lookup(table, ids, mesh: Mesh, *, axis: str = MODEL_AXIS):
+    """Lookup into a row-sharded table: local masked take + one psum.
+
+    table: [V, D] sharded P(axis, None); ids: int array of any shape
+    (replicated or data-sharded). Returns [*ids.shape, D] with the
+    table's sharding-free (replicated over `axis`) result.
+
+    Out-of-range ids (negative or >= V) return ZERO vectors — unlike
+    jnp.take, which wraps/clips. This makes -1 a natural padding id, but
+    means sharded and dense lookups only agree on in-range ids.
+    """
+    n = mesh.shape[axis]
+    vocab = table.shape[0]
+    rows_per_shard = vocab // n
+
+    def body(tab_shard, ids_local):
+        shard = jax.lax.axis_index(axis)
+        lo = shard * rows_per_shard
+        local = ids_local - lo
+        in_range = (local >= 0) & (local < rows_per_shard)
+        safe = jnp.clip(local, 0, rows_per_shard - 1)
+        vecs = jnp.take(tab_shard, safe, axis=0)
+        vecs = jnp.where(in_range[..., None], vecs, 0)
+        return jax.lax.psum(vecs, axis_name=axis)
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P()),
+        out_specs=P(),
+    )
+    return fn(table, ids)
+
+
+def sharded_embedding_bag(table, ids, segment_ids, num_segments: int,
+                          mesh: Mesh, *, axis: str = MODEL_AXIS,
+                          combiner: str = "sum"):
+    """Bag-combine on top of sharded_lookup: the CTR sparse-feature path.
+    Segment-sum happens AFTER the psum so each shard only moves [K, D]
+    vectors once over ICI."""
+    vecs = sharded_lookup(table, ids, mesh, axis=axis)  # [K, D]
+    return combine_bags(vecs, ids, segment_ids, num_segments, combiner,
+                        table.dtype)
+
+
+def rowwise_sgd_update(table, ids, row_grads, lr, mesh: Optional[Mesh] = None,
+                       *, axis: str = MODEL_AXIS):
+    """Apply SGD to ONLY the touched rows (SelectedRows-style update;
+    reference: operators/sgd_op kernel's SelectedRows branch +
+    SparseRowCpuMatrix sgdUpdate, math/SparseRowMatrix.h:106).
+
+    ids: [K] row indices (duplicates fine — contributions add);
+    row_grads: [K, D] gradients for those rows.
+    With a mesh, the scatter-add runs under shard_map so each shard only
+    touches its local rows and no dense [V, D] gradient ever exists.
+    """
+    if mesh is None:
+        return table.at[ids].add(-lr * row_grads.astype(table.dtype))
+
+    n = mesh.shape[axis]
+    rows_per_shard = table.shape[0] // n
+
+    def body(tab_shard, ids_g, grads_g):
+        shard = jax.lax.axis_index(axis)
+        lo = shard * rows_per_shard
+        local = ids_g - lo
+        in_range = (local >= 0) & (local < rows_per_shard)
+        safe = jnp.clip(local, 0, rows_per_shard - 1)
+        contrib = jnp.where(in_range[:, None], grads_g, 0)
+        return tab_shard.at[safe].add(-lr * contrib.astype(tab_shard.dtype))
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(), P()),
+        out_specs=P(axis, None),
+    )
+    return fn(table, ids, row_grads)
+
+
+def unique_rows_grad(ids, row_grads, max_unique: Optional[int] = None):
+    """Deduplicate (ids, grads) into (unique_ids, summed_grads) with a
+    static size — the SelectedRows merge (reference:
+    operators/math/selected_rows_functor.cc MergeAdd). Padding slots get
+    id 0 with zero grad, so downstream scatter-adds are no-ops.
+
+    max_unique defaults to ids.size (always safe). WARNING: if you pass a
+    smaller max_unique and the batch has more distinct ids than that,
+    jnp.unique TRUNCATES — the excess rows' gradients are silently
+    dropped. Only under-size it when the id distribution guarantees the
+    bound.
+    """
+    if max_unique is None:
+        max_unique = ids.size
+    uids, inv = jnp.unique(
+        ids, return_inverse=True, size=max_unique, fill_value=0)
+    summed = jax.ops.segment_sum(row_grads, inv.reshape(-1),
+                                 num_segments=max_unique)
+    return uids, summed
+
+
+class ShardedEmbedding:
+    """Module-flavored wrapper holding vocab/dim + mesh placement, for use
+    inside models that train large sparse tables (reference:
+    gserver/layers/TableProjection.cpp + SparseRemoteParameterUpdater)."""
+
+    def __init__(self, vocab: int, dim: int, mesh: Mesh, *,
+                 axis: str = MODEL_AXIS, name: str = "embedding",
+                 init_scale: float = 0.01):
+        n = mesh.shape[axis]
+        self.padded_vocab = ((vocab + n - 1) // n) * n
+        self.vocab, self.dim, self.mesh, self.axis = vocab, dim, mesh, axis
+        self.name = name
+        self.init_scale = init_scale
+
+    def init(self, rng):
+        table = jax.random.normal(
+            rng, (self.padded_vocab, self.dim), jnp.float32) * self.init_scale
+        return shard_rows(table, self.mesh, self.axis)
+
+    def lookup(self, table, ids):
+        return sharded_lookup(table, ids, self.mesh, axis=self.axis)
+
+    def bag(self, table, ids, segment_ids, num_segments, combiner="sum"):
+        return sharded_embedding_bag(
+            table, ids, segment_ids, num_segments, self.mesh,
+            axis=self.axis, combiner=combiner)
+
+    def apply_row_grads(self, table, ids, row_grads, lr):
+        return rowwise_sgd_update(
+            table, ids, row_grads, lr, self.mesh, axis=self.axis)
