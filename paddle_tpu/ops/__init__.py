@@ -13,10 +13,12 @@ from paddle_tpu.ops import beam_search
 from paddle_tpu.ops import conv
 from paddle_tpu.ops import crf
 from paddle_tpu.ops import ctc
+from paddle_tpu.ops import detection
 from paddle_tpu.ops import embedding
 from paddle_tpu.ops import linalg
 from paddle_tpu.ops import losses
 from paddle_tpu.ops import metrics
 from paddle_tpu.ops import norm
 from paddle_tpu.ops import rnn
+from paddle_tpu.ops import sampling
 from paddle_tpu.ops import sequence

@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
@@ -255,3 +256,65 @@ def roi_pool(x, rois, output_size: Tuple[int, int], spatial_scale: float = 1.0):
         return jnp.stack(rows)
 
     return jax.vmap(one_roi)(rois.astype(jnp.float32))
+
+
+def conv3d(x, kernel, *, stride=1, padding="SAME", bias=None,
+           policy: Optional[Policy] = None):
+    """3-D convolution (reference: gserver/layers/Conv3DLayer.cpp,
+    operators/conv3d variants). x: [N,D,H,W,C], kernel: [kd,kh,kw,Cin,Cout]."""
+    policy = policy or default_policy()
+    x = x.astype(policy.compute_dtype)
+    kernel = kernel.astype(policy.compute_dtype)
+    s = (stride,) * 3 if isinstance(stride, int) else tuple(stride)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+        pad = [(q, q) for q in p]
+    y = lax.conv_general_dilated(
+        x, kernel, window_strides=s, padding=pad,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        preferred_element_type=policy.accum_dtype,
+    )
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def _pool3d(x, window, stride, padding, init, op):
+    w = (window,) * 3 if isinstance(window, int) else tuple(window)
+    s = w if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    dims = (1, *w, 1)
+    strides = (1, *s, 1)
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+        pad = ((0, 0), *[(q, q) for q in p], (0, 0))
+    return lax.reduce_window(x, init, op, dims, strides, pad)
+
+
+def max_pool3d(x, window=2, *, stride=None, padding="VALID"):
+    """3-D max pooling (reference: gserver/layers/Pool3DLayer.cpp).
+    x: [N,D,H,W,C]."""
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    return _pool3d(x, window, stride, padding, init, lax.max)
+
+
+def avg_pool3d(x, window=2, *, stride=None, padding="VALID"):
+    """3-D average pooling. x: [N,D,H,W,C]."""
+    w = (window,) * 3 if isinstance(window, int) else tuple(window)
+    summed = _pool3d(x, window, stride, padding, 0.0, lax.add)
+    return summed / float(np.prod(w))
+
+
+def maxout(x, groups: int):
+    """Maxout over channel groups (reference:
+    gserver/layers/MaxOutLayer.cpp): [..., C] -> [..., C/groups], max over
+    each group of `groups` consecutive channels."""
+    c = x.shape[-1]
+    if c % groups != 0:
+        raise ValueError(f"channels {c} not divisible by groups {groups}")
+    return x.reshape(*x.shape[:-1], c // groups, groups).max(-1)

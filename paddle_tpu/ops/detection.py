@@ -1,0 +1,239 @@
+"""SSD-style detection ops.
+
+Reference: gserver/layers/PriorBox.cpp (anchor generation),
+gserver/layers/MultiBoxLossLayer.cpp (prior↔GT matching, hard negative
+mining, loc smooth-L1 + conf cross-entropy) and
+gserver/layers/DetectionOutputLayer.cpp + DetectionUtil.cpp (box decode
++ per-class NMS).
+
+TPU-shaped design: everything is fixed-shape and mask-based — matching
+produces per-prior match indices with -1 sentinels instead of dynamic
+lists; hard negative mining selects a static-size top-k of negatives by
+loss; NMS is the O(k²) masked suppression over a static top-k candidate
+set (the standard TPU NMS formulation) instead of a dynamic queue.
+Boxes are (x1, y1, x2, y2) normalized to [0, 1].
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def prior_boxes(feature_hw: Tuple[int, int], image_hw: Tuple[int, int],
+                min_sizes: Sequence[float], max_sizes: Sequence[float] = (),
+                aspect_ratios: Sequence[float] = (2.0,),
+                *, flip: bool = True, clip: bool = True) -> np.ndarray:
+    """Anchor grid for one feature map (reference:
+    gserver/layers/PriorBox.cpp forward). Returns [H*W*A, 4] float32 in
+    normalized corner form. Pure numpy — priors are static per config.
+    """
+    fh, fw = feature_hw
+    ih, iw = image_hw
+    step_x, step_y = 1.0 / fw, 1.0 / fh
+    ratios = [1.0]
+    for r in aspect_ratios:
+        ratios.append(r)
+        if flip:
+            ratios.append(1.0 / r)
+    boxes = []
+    for y in range(fh):
+        for x in range(fw):
+            cx, cy = (x + 0.5) * step_x, (y + 0.5) * step_y
+            for k, ms in enumerate(min_sizes):
+                bw, bh = ms / iw, ms / ih
+                boxes.append([cx - bw / 2, cy - bh / 2,
+                              cx + bw / 2, cy + bh / 2])
+                if k < len(max_sizes):
+                    s = float(np.sqrt(ms * max_sizes[k]))
+                    bw, bh = s / iw, s / ih
+                    boxes.append([cx - bw / 2, cy - bh / 2,
+                                  cx + bw / 2, cy + bh / 2])
+                for r in ratios:
+                    if abs(r - 1.0) < 1e-6:
+                        continue
+                    bw = ms * np.sqrt(r) / iw
+                    bh = ms / np.sqrt(r) / ih
+                    boxes.append([cx - bw / 2, cy - bh / 2,
+                                  cx + bw / 2, cy + bh / 2])
+    out = np.asarray(boxes, np.float32)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    return out
+
+
+def _corner_to_center(b):
+    wh = b[..., 2:] - b[..., :2]
+    c = (b[..., 2:] + b[..., :2]) / 2
+    return c, wh
+
+
+def encode_boxes(gt, priors, variances=(0.1, 0.1, 0.2, 0.2)):
+    """GT corners -> regression targets relative to priors (reference:
+    DetectionUtil.cpp encodeBBoxWithVar)."""
+    pc, pwh = _corner_to_center(priors)
+    gc, gwh = _corner_to_center(gt)
+    v = jnp.asarray(variances)
+    d_center = (gc - pc) / (pwh * v[:2])
+    d_size = jnp.log(jnp.maximum(gwh / pwh, 1e-8)) / v[2:]
+    return jnp.concatenate([d_center, d_size], axis=-1)
+
+
+def decode_boxes(deltas, priors, variances=(0.1, 0.1, 0.2, 0.2)):
+    """Inverse of encode_boxes (reference: DetectionUtil.cpp
+    decodeBBoxWithVar)."""
+    pc, pwh = _corner_to_center(priors)
+    v = jnp.asarray(variances)
+    c = deltas[..., :2] * v[:2] * pwh + pc
+    wh = jnp.exp(deltas[..., 2:] * v[2:]) * pwh
+    return jnp.concatenate([c - wh / 2, c + wh / 2], axis=-1)
+
+
+def iou(boxes_a, boxes_b):
+    """Pairwise IoU [N, M] for corner boxes (jax)."""
+    a, b = boxes_a[:, None], boxes_b[None, :]
+    ix = jnp.maximum(
+        0.0, jnp.minimum(a[..., 2], b[..., 2]) - jnp.maximum(a[..., 0], b[..., 0]))
+    iy = jnp.maximum(
+        0.0, jnp.minimum(a[..., 3], b[..., 3]) - jnp.maximum(a[..., 1], b[..., 1]))
+    inter = ix * iy
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_b = (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-10)
+
+
+def match_priors(priors, gt_boxes, gt_valid, threshold: float = 0.5):
+    """Bipartite + per-prediction matching (reference:
+    DetectionUtil.cpp matchBBox): every GT grabs its best prior; remaining
+    priors match their best GT if IoU >= threshold.
+
+    gt_boxes: [M, 4] padded; gt_valid: [M] bool. Returns match [N] int32
+    (GT index or -1).
+    """
+    n = priors.shape[0]
+    ious = iou(priors, gt_boxes)                      # [N, M]
+    ious = jnp.where(gt_valid[None, :], ious, -1.0)
+    best_gt = jnp.argmax(ious, axis=1)                # [N]
+    best_gt_iou = jnp.max(ious, axis=1)
+    match = jnp.where(best_gt_iou >= threshold, best_gt, -1)
+    # force-match each valid GT to its best prior; invalid (padded) GTs
+    # scatter out-of-range and are dropped, so they can't clobber prior 0
+    # (two valid GTs sharing a best prior: last one wins, as in the
+    # reference's sequential matching)
+    best_prior = jnp.argmax(ious, axis=0)             # [M]
+    m = gt_boxes.shape[0]
+    scatter_idx = jnp.where(gt_valid, best_prior, n)
+    forced = jnp.full((n,), -1, jnp.int32).at[scatter_idx].set(
+        jnp.arange(m, dtype=jnp.int32), mode="drop")
+    return jnp.where(forced >= 0, forced, match).astype(jnp.int32)
+
+
+def multibox_loss(loc_preds, conf_logits, priors, gt_boxes, gt_labels,
+                  gt_valid, *, overlap_threshold: float = 0.5,
+                  neg_pos_ratio: float = 3.0, background_id: int = 0,
+                  variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training loss for ONE image (vmap over the batch) (reference:
+    gserver/layers/MultiBoxLossLayer.cpp forward/backward).
+
+    loc_preds: [N, 4]; conf_logits: [N, C]; priors: [N, 4];
+    gt_boxes: [M, 4]; gt_labels: [M] (class ids, background excluded);
+    gt_valid: [M] bool. Returns scalar loss = (loc + conf) / num_matched.
+    """
+    match = match_priors(priors, gt_boxes, gt_valid, overlap_threshold)
+    pos = match >= 0                                   # [N]
+    num_pos = jnp.maximum(pos.sum(), 1)
+
+    # localization: smooth-L1 on matched priors
+    safe_match = jnp.maximum(match, 0)
+    target = encode_boxes(jnp.take(gt_boxes, safe_match, axis=0), priors,
+                          variances)
+    diff = jnp.abs(loc_preds - target)
+    loc_l = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5).sum(-1)
+    loc_loss = jnp.where(pos, loc_l, 0.0).sum()
+
+    # confidence: CE with hard negative mining at neg_pos_ratio
+    labels = jnp.where(
+        pos, jnp.take(gt_labels, safe_match), background_id)
+    logp = jax.nn.log_softmax(conf_logits, axis=-1)
+    ce = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]  # [N]
+    neg_score = jnp.where(pos, -jnp.inf, -logp[:, background_id])
+    # top-k negatives by background loss, k = ratio * num_pos (static cap N)
+    k = jnp.minimum((neg_pos_ratio * num_pos).astype(jnp.int32),
+                    pos.shape[0])
+    order = jnp.argsort(-neg_score)
+    rank = jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
+    neg = (~pos) & (rank < k) & jnp.isfinite(neg_score)
+    conf_loss = jnp.where(pos | neg, ce, 0.0).sum()
+
+    return (loc_loss + conf_loss) / num_pos
+
+
+def nms_mask(boxes, scores, *, iou_threshold: float = 0.45):
+    """Masked O(k²) NMS keep-mask over a fixed candidate set (the
+    TPU-friendly formulation of DetectionUtil.cpp applyNMSFast).
+
+    Returns bool [K] keep mask; assumes scores sorted descending is NOT
+    required — suppression is by higher-scored overlapping boxes.
+    """
+    k = boxes.shape[0]
+    ious = iou(boxes, boxes)
+    # suppressor[i, j]: box i outranks box j (higher score, index as
+    # tie-break) and overlaps it
+    higher = scores[:, None] > scores[None, :]
+    tie = (scores[:, None] == scores[None, :]) & \
+        (jnp.arange(k)[:, None] < jnp.arange(k)[None, :])
+    suppressor = (higher | tie) & (ious > iou_threshold)
+
+    def step(_, keep):
+        # a box stays iff no currently-KEPT suppressor overlaps it; the
+        # fixed point resolves suppression chains (A kills B revives C)
+        suppressed = jnp.einsum(
+            "ij,i->j", suppressor.astype(jnp.float32),
+            keep.astype(jnp.float32)) > 0
+        return ~suppressed
+
+    keep = jnp.ones((k,), bool)
+    return jax.lax.fori_loop(0, k, step, keep)
+
+
+def detection_output(loc_preds, conf_logits, priors, *,
+                     num_classes: int, background_id: int = 0,
+                     score_threshold: float = 0.01,
+                     iou_threshold: float = 0.45, top_k: int = 100,
+                     pre_nms_top_k: int = 200,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """Decode + per-class NMS for ONE image (reference:
+    gserver/layers/DetectionOutputLayer.cpp forward). Returns fixed-shape
+    (classes [K], scores [K], boxes [K, 4]) with score 0 padding, K =
+    top_k.
+
+    Per class, only the pre_nms_top_k highest-scored candidates enter
+    NMS (the static candidate set), so the cost is
+    O(C * (N log N + pre_nms_top_k²)) instead of O(C * N³).
+    """
+    boxes = decode_boxes(loc_preds, priors, variances)     # [N, 4]
+    probs = jax.nn.softmax(conf_logits, axis=-1)           # [N, C]
+    n = boxes.shape[0]
+    cap = min(pre_nms_top_k, n)
+
+    all_scores, all_classes, all_boxes = [], [], []
+    for c in range(num_classes):
+        if c == background_id:
+            continue
+        s_top, idx = jax.lax.top_k(probs[:, c], cap)       # [cap]
+        cboxes = jnp.take(boxes, idx, axis=0)
+        keep = nms_mask(cboxes, s_top, iou_threshold=iou_threshold)
+        s = jnp.where(keep & (s_top >= score_threshold), s_top, 0.0)
+        all_scores.append(s)
+        all_classes.append(jnp.full((cap,), c, jnp.int32))
+        all_boxes.append(cboxes)
+    scores = jnp.concatenate(all_scores)                   # [(C-1)*cap]
+    classes = jnp.concatenate(all_classes)
+    boxes_cat = jnp.concatenate(all_boxes, axis=0)
+    top = jax.lax.top_k(scores, min(top_k, scores.shape[0]))
+    idx = top[1]
+    return (jnp.take(classes, idx), top[0],
+            jnp.take(boxes_cat, idx, axis=0))

@@ -32,3 +32,12 @@ def dense(x, kernel, bias=None, policy: Optional[Policy] = None):
     if bias is not None:
         y = y + bias
     return y
+
+
+def multiplex(index, *inputs):
+    """Row-wise select among inputs by per-row index (reference:
+    operators/multiplex_op.cc): out[i] = inputs[index[i]][i]."""
+    stacked = jnp.stack(inputs)  # [K, B, ...]
+    idx = index.reshape(-1).astype(jnp.int32)
+    batch = jnp.arange(stacked.shape[1])
+    return stacked[idx, batch]

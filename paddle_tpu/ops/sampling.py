@@ -1,0 +1,142 @@
+"""Sampled / tree-structured output losses for large vocabularies.
+
+Reference: gserver/layers/NCELayer.cpp (noise-contrastive estimation over
+sampled negative classes) and gserver/layers/HierarchicalSigmoidLayer.cpp
+(binary-tree sigmoid over log(V) node decisions). Both exist to avoid a
+full V-way softmax; on TPU the full softmax is often fine up to ~100k
+classes (one big MXU matmul), but these remain the right tool for
+multi-million-class vocabularies, and are needed for reference parity.
+
+TPU-shaped design: fixed sample counts (static shapes), sampling outside
+jit or via jax.random inside, and the per-example class matmul as a
+batched gather + dot rather than a sparse matmul.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def log_uniform_sample(rng, num_samples: int, vocab: int, shape=()):
+    """Zipf-ish negative sampling (P(k) ∝ log((k+2)/(k+1))), the classic
+    log-uniform candidate sampler used with NCE over frequency-sorted
+    vocabularies. Returns int ids of shape (*shape, num_samples)."""
+    u = jax.random.uniform(rng, (*shape, num_samples))
+    ids = jnp.exp(u * jnp.log(float(vocab + 1))) - 1.0
+    return jnp.clip(ids.astype(jnp.int32), 0, vocab - 1)
+
+
+def log_uniform_prob(ids, vocab: int):
+    k = ids.astype(jnp.float32)
+    return jnp.log((k + 2.0) / (k + 1.0)) / jnp.log(float(vocab + 1))
+
+
+def nce_loss(weights, bias, hidden, labels, noise_ids,
+             *, noise_probs=None, true_probs=None):
+    """Noise-contrastive estimation loss (reference:
+    gserver/layers/NCELayer.cpp forward/backward).
+
+    weights: [V, D] output embedding; bias: [V]; hidden: [B, D];
+    labels: [B] true class ids; noise_ids: [B, S] sampled negatives.
+    noise_probs: sampler probabilities for the log(k·Q) log-odds
+    correction — either a [V] per-class distribution (true-class Q is
+    looked up from it) or a [B, S] per-sample array, in which case
+    true_probs [B] MUST also be given so the correction stays symmetric
+    (NCE consistency requires it on both sides). None = plain binary
+    logistic, the reference's behavior with uniform noise.
+
+    Returns per-example loss [B].
+    """
+    true_logit = (jnp.take(weights, labels, axis=0) * hidden).sum(-1) \
+        + jnp.take(bias, labels)                   # [B]
+    noise_w = jnp.take(weights, noise_ids, axis=0)  # [B, S, D]
+    noise_logit = jnp.einsum("bsd,bd->bs", noise_w, hidden) \
+        + jnp.take(bias, noise_ids)                # [B, S]
+
+    if noise_probs is not None:
+        # subtract log(k * Q(w)) — the NCE log-odds correction
+        k = noise_ids.shape[-1]
+        if np.ndim(noise_probs) == 1:
+            true_q = jnp.take(jnp.asarray(noise_probs), labels)
+            nq = jnp.take(jnp.asarray(noise_probs), noise_ids)
+        else:
+            if true_probs is None:
+                raise ValueError(
+                    "noise_probs is per-sample [B, S]; pass true_probs [B] "
+                    "so the log(k*Q) correction applies to the true class "
+                    "too (omitting it biases the NCE objective)")
+            true_q = jnp.asarray(true_probs)
+            nq = noise_probs
+        true_logit = true_logit - jnp.log(k * true_q + 1e-20)
+        noise_logit = noise_logit - jnp.log(k * nq + 1e-20)
+
+    pos = jax.nn.softplus(-true_logit)             # -log sigmoid(s+)
+    neg = jax.nn.softplus(noise_logit).sum(-1)     # -sum log(1-sigmoid(s-))
+    return pos + neg
+
+
+def build_binary_tree_codes(num_classes: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Complete-binary-tree paths for hierarchical sigmoid (reference:
+    HierarchicalSigmoidLayer's implicit complete tree over classes).
+
+    Returns (node_ids [V, depth], signs [V, depth]) with -1 node padding;
+    internal node i has children 2i+1, 2i+2; classes are the leaves
+    appended after num_classes-1 internal nodes.
+    """
+    num_internal = num_classes - 1
+    depth = max(int(np.ceil(np.log2(max(num_classes, 2)))), 1)
+    node_ids = np.full((num_classes, depth), -1, np.int32)
+    signs = np.zeros((num_classes, depth), np.float32)
+    for c in range(num_classes):
+        # leaf index in the heap = num_internal + c; walk up to root
+        path = []
+        node = num_internal + c
+        while node > 0:
+            parent = (node - 1) // 2
+            is_left = node == 2 * parent + 1
+            path.append((parent, 1.0 if is_left else -1.0))
+            node = parent
+        path.reverse()
+        for d, (nid, sign) in enumerate(path):
+            node_ids[c, d] = nid
+            signs[c, d] = sign
+    return node_ids, signs
+
+
+def hsigmoid_loss(node_weights, node_bias, hidden, labels,
+                  node_ids, signs):
+    """Hierarchical-sigmoid loss (reference:
+    gserver/layers/HierarchicalSigmoidLayer.cpp).
+
+    node_weights: [num_internal, D]; node_bias: [num_internal];
+    hidden: [B, D]; labels: [B]; node_ids/signs: [V, depth] codes from
+    build_binary_tree_codes. Returns per-example loss [B].
+    """
+    ids = jnp.take(jnp.asarray(node_ids), labels, axis=0)     # [B, depth]
+    sgn = jnp.take(jnp.asarray(signs), labels, axis=0)        # [B, depth]
+    valid = ids >= 0
+    safe = jnp.maximum(ids, 0)
+    w = jnp.take(node_weights, safe, axis=0)                  # [B, depth, D]
+    b = jnp.take(node_bias, safe)                             # [B, depth]
+    logits = jnp.einsum("bkd,bd->bk", w, hidden) + b
+    # -log sigmoid(sign * logit) at valid nodes
+    losses = jax.nn.softplus(-sgn * logits)
+    return jnp.where(valid, losses, 0.0).sum(-1)
+
+
+def hsigmoid_predict(node_weights, node_bias, hidden, node_ids, signs):
+    """Exact class scores under the tree: log P(class) for every class
+    (V small enough to enumerate; for decode-time use)."""
+    ids = jnp.asarray(node_ids)                               # [V, depth]
+    sgn = jnp.asarray(signs)
+    valid = ids >= 0
+    safe = jnp.maximum(ids, 0)
+    w = jnp.take(node_weights, safe, axis=0)                  # [V, depth, D]
+    b = jnp.take(node_bias, safe)                             # [V, depth]
+    logits = jnp.einsum("vkd,bd->bvk", w, hidden) + b[None]
+    logp = -jax.nn.softplus(-sgn[None] * logits)
+    return jnp.where(valid[None], logp, 0.0).sum(-1)          # [B, V]

@@ -98,7 +98,12 @@ def rowwise_sgd_update(table, ids, row_grads, lr, mesh: Optional[Mesh] = None,
     touches its local rows and no dense [V, D] gradient ever exists.
     """
     if mesh is None:
-        return table.at[ids].add(-lr * row_grads.astype(table.dtype))
+        # mask out-of-range (e.g. -1 padding) ids so both paths agree:
+        # jnp's default scatter would wrap negative ids to the last row
+        in_range = (ids >= 0) & (ids < table.shape[0])
+        safe = jnp.clip(ids, 0, table.shape[0] - 1)
+        contrib = jnp.where(in_range[:, None], row_grads, 0)
+        return table.at[safe].add(-lr * contrib.astype(table.dtype))
 
     n = mesh.shape[axis]
     rows_per_shard = table.shape[0] // n

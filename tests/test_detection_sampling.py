@@ -1,0 +1,262 @@
+"""Tests for SSD detection ops, NCE, hierarchical sigmoid, maxout,
+multiplex, conv3d (reference test model: gserver/tests/test_LayerGrad.cpp
+covers MultiBoxLoss/PriorBox/NCE/hsigmoid/maxout variants)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import conv, detection, linalg, sampling
+from tests.gradcheck import directional_grad_check
+
+
+# ---- prior boxes / box codec ----
+
+def test_prior_boxes_shapes_and_range():
+    pb = detection.prior_boxes((2, 2), (64, 64), min_sizes=[16.0],
+                               max_sizes=[32.0], aspect_ratios=[2.0])
+    # per cell: 1 min + 1 max + 2 ratio boxes = 4
+    assert pb.shape == (2 * 2 * 4, 4)
+    assert (pb >= 0).all() and (pb <= 1).all()
+    # first cell's min box centered at (0.25, 0.25)
+    np.testing.assert_allclose(pb[0], [0.25 - 0.125, 0.25 - 0.125,
+                                       0.25 + 0.125, 0.25 + 0.125])
+
+
+def test_box_encode_decode_roundtrip():
+    priors = jnp.asarray([[0.1, 0.1, 0.4, 0.5], [0.5, 0.5, 0.9, 0.8]])
+    gt = jnp.asarray([[0.15, 0.12, 0.45, 0.52], [0.48, 0.52, 0.88, 0.79]])
+    deltas = detection.encode_boxes(gt, priors)
+    back = detection.decode_boxes(deltas, priors)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(gt), atol=1e-6)
+
+
+def test_iou_values():
+    a = jnp.asarray([[0.0, 0.0, 1.0, 1.0]])
+    b = jnp.asarray([[0.0, 0.0, 0.5, 1.0], [1.0, 1.0, 2.0, 2.0]])
+    got = np.asarray(detection.iou(a, b))
+    np.testing.assert_allclose(got, [[0.5, 0.0]], atol=1e-6)
+
+
+def test_match_priors_forced_and_threshold():
+    priors = jnp.asarray([
+        [0.0, 0.0, 0.2, 0.2],   # overlaps gt0 strongly
+        [0.5, 0.5, 0.7, 0.7],   # overlaps gt1 weakly
+        [0.8, 0.8, 1.0, 1.0],   # no overlap
+    ])
+    gt = jnp.asarray([[0.0, 0.0, 0.2, 0.2], [0.55, 0.55, 0.95, 0.95]])
+    valid = jnp.asarray([True, True])
+    match = np.asarray(detection.match_priors(priors, gt, valid, 0.5))
+    assert match[0] == 0
+    assert match[1] == 1  # forced: best prior for gt1 even if IoU < thresh
+    assert match[2] in (-1, 1)
+
+
+def test_multibox_loss_decreases_with_better_preds():
+    priors = jnp.asarray(detection.prior_boxes((4, 4), (64, 64),
+                                               min_sizes=[24.0]))
+    n = priors.shape[0]
+    gt = jnp.asarray([[0.1, 0.1, 0.35, 0.35]])
+    labels = jnp.asarray([1])
+    valid = jnp.asarray([True])
+    match = detection.match_priors(priors, gt, valid, 0.5)
+    perfect_loc = detection.encode_boxes(
+        jnp.broadcast_to(gt[0], (n, 4)), priors)
+    perfect_conf = jnp.where(
+        (match >= 0)[:, None], jnp.asarray([[-5.0, 5.0]]),
+        jnp.asarray([[5.0, -5.0]]))
+    good = detection.multibox_loss(perfect_loc, perfect_conf, priors,
+                                   gt, labels, valid)
+    bad = detection.multibox_loss(jnp.zeros((n, 4)), jnp.zeros((n, 2)),
+                                  priors, gt, labels, valid)
+    assert float(good) < float(bad)
+
+
+def test_multibox_loss_gradcheck():
+    priors = jnp.asarray(detection.prior_boxes((2, 2), (32, 32),
+                                               min_sizes=[12.0]))
+    n = priors.shape[0]
+    gt = jnp.asarray([[0.2, 0.2, 0.6, 0.6]])
+    rng = np.random.RandomState(0)
+    x = {"loc": jnp.asarray(rng.randn(n, 4) * 0.1),
+         "conf": jnp.asarray(rng.randn(n, 3) * 0.1)}
+
+    def f(p):
+        return detection.multibox_loss(
+            p["loc"], p["conf"], priors, gt, jnp.asarray([1]),
+            jnp.asarray([True]))
+
+    directional_grad_check(f, x, rtol=5e-3)
+
+
+def test_match_priors_padded_gt_cannot_clobber():
+    """A padded (invalid) GT's argmax lands on prior 0; it must not erase
+    prior 0's real match."""
+    priors = jnp.asarray([[0.0, 0.0, 0.2, 0.2], [0.6, 0.6, 0.8, 0.8]])
+    gt = jnp.asarray([[0.0, 0.0, 0.22, 0.22], [0.0, 0.0, 0.0, 0.0]])
+    valid = jnp.asarray([True, False])
+    match = np.asarray(detection.match_priors(priors, gt, valid, 0.5))
+    assert match[0] == 0
+    assert match[1] == -1
+
+
+def test_nms_mask_suppression_chain():
+    # A(0.9) suppresses B(0.8); B would suppress C(0.7) but B is gone;
+    # A does not overlap C -> keep A and C
+    boxes = jnp.asarray([
+        [0.0, 0.0, 0.4, 0.4],
+        [0.2, 0.2, 0.6, 0.6],
+        [0.42, 0.42, 0.8, 0.8],
+    ])
+    scores = jnp.asarray([0.9, 0.8, 0.7])
+    keep = np.asarray(detection.nms_mask(boxes, scores, iou_threshold=0.1))
+    np.testing.assert_array_equal(keep, [True, False, True])
+
+
+def test_detection_output_end_to_end():
+    priors = jnp.asarray(detection.prior_boxes((4, 4), (64, 64),
+                                               min_sizes=[24.0]))
+    n = priors.shape[0]
+    # target box near prior 0's cell
+    target = jnp.asarray([0.05, 0.05, 0.3, 0.3])
+    loc = detection.encode_boxes(jnp.broadcast_to(target, (n, 4)), priors)
+    conf = jnp.full((n, 2), -3.0).at[:, 0].set(3.0)
+    conf = conf.at[0].set(jnp.asarray([-3.0, 3.0]))  # prior 0 confident class 1
+    classes, scores, boxes = detection.detection_output(
+        loc, conf, priors, num_classes=2, top_k=5)
+    assert classes.shape == (5,) and boxes.shape == (5, 4)
+    assert int(classes[0]) == 1
+    assert float(scores[0]) > 0.9
+    np.testing.assert_allclose(np.asarray(boxes[0]), np.asarray(target),
+                               atol=1e-5)
+
+
+# ---- NCE / hsigmoid ----
+
+def test_nce_loss_prefers_true_class():
+    rng = np.random.RandomState(0)
+    v, d, b, s = 50, 8, 4, 10
+    weights = jnp.asarray(rng.randn(v, d) * 0.1)
+    bias = jnp.zeros((v,))
+    hidden = jnp.asarray(rng.randn(b, d))
+    labels = jnp.asarray([3, 7, 11, 13])
+    noise = jnp.asarray(rng.randint(0, v, (b, s)))
+    base = sampling.nce_loss(weights, bias, hidden, labels, noise)
+    assert base.shape == (b,)
+    # push true-class weights toward hidden -> loss must drop
+    better = weights.at[labels].add(0.5 * hidden)
+    improved = sampling.nce_loss(better, bias, hidden, labels, noise)
+    assert float(improved.mean()) < float(base.mean())
+
+
+def test_nce_loss_gradcheck():
+    rng = np.random.RandomState(1)
+    v, d, b, s = 12, 4, 3, 5
+    x = {"w": jnp.asarray(rng.randn(v, d) * 0.3),
+         "b": jnp.asarray(rng.randn(v) * 0.1),
+         "h": jnp.asarray(rng.randn(b, d) * 0.3)}
+    labels = jnp.asarray([1, 5, 9])
+    noise = jnp.asarray(rng.randint(0, v, (b, s)))
+
+    def f(p):
+        return sampling.nce_loss(p["w"], p["b"], p["h"], labels, noise).sum()
+
+    directional_grad_check(f, x)
+
+
+def test_nce_with_sampler_correction():
+    rng = np.random.RandomState(2)
+    v, d, b, s = 20, 4, 2, 6
+    weights = jnp.asarray(rng.randn(v, d) * 0.1)
+    bias = jnp.zeros((v,))
+    hidden = jnp.asarray(rng.randn(b, d))
+    labels = jnp.asarray([0, 1])
+    key = jax.random.key(0)
+    noise = sampling.log_uniform_sample(key, s, v, shape=(b,))
+    assert noise.shape == (b, s) and (np.asarray(noise) < v).all()
+    probs = sampling.log_uniform_prob(jnp.arange(v), v)
+    assert float(probs.sum()) == pytest.approx(1.0, abs=1e-5)
+    out = sampling.nce_loss(weights, bias, hidden, labels, noise,
+                            noise_probs=probs)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_binary_tree_codes():
+    ids, signs = sampling.build_binary_tree_codes(4)
+    # 4 classes: 3 internal nodes, depth 2; every leaf has a full path
+    assert ids.shape == (4, 2)
+    assert (ids >= 0).all()
+    # root decisions split classes 0,1 (left) vs 2,3 (right)
+    assert signs[0, 0] == signs[1, 0] != signs[2, 0]
+
+
+def test_hsigmoid_sums_to_one():
+    """Sum over classes of exp(log P(class)) == 1 for a proper tree."""
+    rng = np.random.RandomState(3)
+    num_classes, d = 8, 5
+    ids, signs = sampling.build_binary_tree_codes(num_classes)
+    w = jnp.asarray(rng.randn(num_classes - 1, d) * 0.3)
+    b = jnp.asarray(rng.randn(num_classes - 1) * 0.1)
+    h = jnp.asarray(rng.randn(2, d))
+    logp = sampling.hsigmoid_predict(w, b, h, ids, signs)
+    totals = np.exp(np.asarray(logp)).sum(-1)
+    np.testing.assert_allclose(totals, [1.0, 1.0], rtol=1e-5)
+    # loss == -logp at the label
+    labels = jnp.asarray([2, 6])
+    loss = sampling.hsigmoid_loss(w, b, h, labels, ids, signs)
+    np.testing.assert_allclose(
+        np.asarray(loss),
+        -np.asarray(logp)[np.arange(2), np.asarray(labels)], rtol=1e-5)
+
+
+def test_hsigmoid_gradcheck():
+    rng = np.random.RandomState(4)
+    num_classes, d = 6, 4
+    ids, signs = sampling.build_binary_tree_codes(num_classes)
+    x = {"w": jnp.asarray(rng.randn(num_classes - 1, d) * 0.3),
+         "b": jnp.asarray(rng.randn(num_classes - 1) * 0.1),
+         "h": jnp.asarray(rng.randn(3, d) * 0.3)}
+    labels = jnp.asarray([0, 3, 5])
+
+    def f(p):
+        return sampling.hsigmoid_loss(p["w"], p["b"], p["h"], labels,
+                                      ids, signs).sum()
+
+    directional_grad_check(f, x)
+
+
+# ---- maxout / multiplex / conv3d ----
+
+def test_maxout():
+    x = jnp.asarray([[1.0, 5.0, 2.0, 8.0]])
+    np.testing.assert_allclose(np.asarray(conv.maxout(x, 2)), [[5.0, 8.0]])
+    with pytest.raises(ValueError, match="divisible"):
+        conv.maxout(x, 3)
+
+
+def test_multiplex():
+    a = jnp.asarray([[1.0, 1.0], [2.0, 2.0]])
+    b = jnp.asarray([[3.0, 3.0], [4.0, 4.0]])
+    out = linalg.multiplex(jnp.asarray([1, 0]), a, b)
+    np.testing.assert_allclose(np.asarray(out), [[3.0, 3.0], [2.0, 2.0]])
+
+
+def test_conv3d_matches_manual():
+    rng = np.random.RandomState(5)
+    x = jnp.asarray(rng.randn(1, 3, 4, 4, 2))
+    k = jnp.asarray(rng.randn(2, 2, 2, 2, 3))
+    y = conv.conv3d(x, k, padding="VALID")
+    assert y.shape == (1, 2, 3, 3, 3)
+    # one output element by hand
+    want = float((np.asarray(x)[0, :2, :2, :2] * np.asarray(k)[..., 0]).sum())
+    assert float(y[0, 0, 0, 0, 0]) == pytest.approx(want, rel=1e-5)
+
+
+def test_pool3d():
+    x = jnp.arange(16.0).reshape(1, 2, 2, 4, 1)
+    mx = conv.max_pool3d(x, (2, 2, 2))
+    assert mx.shape == (1, 1, 1, 2, 1)
+    np.testing.assert_allclose(np.asarray(mx).reshape(-1), [13.0, 15.0])
+    av = conv.avg_pool3d(x, (2, 2, 2))
+    np.testing.assert_allclose(np.asarray(av).reshape(-1), [6.5, 8.5])
