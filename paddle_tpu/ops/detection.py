@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from paddle_tpu.ops import losses
+
 
 def prior_boxes(feature_hw: Tuple[int, int], image_hw: Tuple[int, int],
                 min_sizes: Sequence[float], max_sizes: Sequence[float] = (),
@@ -119,15 +121,17 @@ def match_priors(priors, gt_boxes, gt_valid, threshold: float = 0.5):
     best_gt = jnp.argmax(ious, axis=1)                # [N]
     best_gt_iou = jnp.max(ious, axis=1)
     match = jnp.where(best_gt_iou >= threshold, best_gt, -1)
-    # force-match each valid GT to its best prior; invalid (padded) GTs
-    # scatter out-of-range and are dropped, so they can't clobber prior 0
-    # (two valid GTs sharing a best prior: last one wins, as in the
-    # reference's sequential matching)
+    # force-match each valid GT to its best prior; two valid GTs sharing a
+    # best prior resolve to the last (highest-index) one, as in the
+    # reference's sequential matching — computed as a max-reduction so the
+    # tie-break is deterministic across backends (XLA scatter-set with
+    # duplicate indices has an unspecified winner).
     best_prior = jnp.argmax(ious, axis=0)             # [M]
     m = gt_boxes.shape[0]
-    scatter_idx = jnp.where(gt_valid, best_prior, n)
-    forced = jnp.full((n,), -1, jnp.int32).at[scatter_idx].set(
-        jnp.arange(m, dtype=jnp.int32), mode="drop")
+    hit = gt_valid[None, :] & (
+        best_prior[None, :] == jnp.arange(n)[:, None])        # [N, M]
+    forced = jnp.max(
+        jnp.where(hit, jnp.arange(m, dtype=jnp.int32)[None, :], -1), axis=1)
     return jnp.where(forced >= 0, forced, match).astype(jnp.int32)
 
 
@@ -150,8 +154,7 @@ def multibox_loss(loc_preds, conf_logits, priors, gt_boxes, gt_labels,
     safe_match = jnp.maximum(match, 0)
     target = encode_boxes(jnp.take(gt_boxes, safe_match, axis=0), priors,
                           variances)
-    diff = jnp.abs(loc_preds - target)
-    loc_l = jnp.where(diff < 1.0, 0.5 * diff * diff, diff - 0.5).sum(-1)
+    loc_l = losses.smooth_l1(loc_preds, target)        # [N]
     loc_loss = jnp.where(pos, loc_l, 0.0).sum()
 
     # confidence: CE with hard negative mining at neg_pos_ratio
@@ -233,7 +236,16 @@ def detection_output(loc_preds, conf_logits, priors, *,
     scores = jnp.concatenate(all_scores)                   # [(C-1)*cap]
     classes = jnp.concatenate(all_classes)
     boxes_cat = jnp.concatenate(all_boxes, axis=0)
-    top = jax.lax.top_k(scores, min(top_k, scores.shape[0]))
+    if scores.shape[0] < top_k:
+        # pad so the documented fixed [top_k] contract holds even when
+        # (C-1)*cap < top_k
+        padn = top_k - scores.shape[0]
+        scores = jnp.concatenate([scores, jnp.zeros((padn,), scores.dtype)])
+        classes = jnp.concatenate(
+            [classes, jnp.zeros((padn,), classes.dtype)])
+        boxes_cat = jnp.concatenate(
+            [boxes_cat, jnp.zeros((padn, 4), boxes_cat.dtype)], axis=0)
+    top = jax.lax.top_k(scores, top_k)
     idx = top[1]
     return (jnp.take(classes, idx), top[0],
             jnp.take(boxes_cat, idx, axis=0))

@@ -11,6 +11,13 @@ from paddle_tpu.parallel.train_step import (
     shard_train_state,
 )
 from paddle_tpu.parallel import collectives
+# NB: the bare in-shard_map `ring_attention` fn stays on the submodule —
+# re-exporting it here would shadow the `parallel.ring_attention` module.
+from paddle_tpu.parallel.ring_attention import (
+    dense_attention,
+    make_sequence_parallel_attention,
+    ulysses_attention,
+)
 from paddle_tpu.parallel.sparse import (
     ShardedEmbedding,
     rowwise_sgd_update,

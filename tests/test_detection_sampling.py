@@ -260,3 +260,30 @@ def test_pool3d():
     np.testing.assert_allclose(np.asarray(mx).reshape(-1), [13.0, 15.0])
     av = conv.avg_pool3d(x, (2, 2, 2))
     np.testing.assert_allclose(np.asarray(av).reshape(-1), [6.5, 8.5])
+    # padded average excludes the padding from the divisor (exclusive avg)
+    ones = jnp.ones((1, 2, 2, 2, 1))
+    av_pad = conv.avg_pool3d(ones, 2, stride=1, padding=1)
+    np.testing.assert_allclose(np.asarray(av_pad), np.ones_like(av_pad))
+
+
+def test_detection_output_pads_to_top_k():
+    # 3 priors, 2 classes -> (C-1)*cap = 3 candidates < top_k = 100
+    priors = jnp.asarray([[0.1, 0.1, 0.3, 0.3],
+                          [0.4, 0.4, 0.6, 0.6],
+                          [0.7, 0.7, 0.9, 0.9]])
+    loc = jnp.zeros((3, 4))
+    conf = jnp.zeros((3, 2))
+    classes, scores, boxes = detection.detection_output(
+        loc, conf, priors, num_classes=2, top_k=100)
+    assert classes.shape == (100,)
+    assert scores.shape == (100,)
+    assert boxes.shape == (100, 4)
+
+
+def test_match_priors_duplicate_best_prior_deterministic():
+    # two valid GTs whose best prior is the same: highest GT index wins
+    priors = jnp.asarray([[0.0, 0.0, 0.5, 0.5], [0.5, 0.5, 1.0, 1.0]])
+    gt = jnp.asarray([[0.0, 0.0, 0.5, 0.5], [0.01, 0.01, 0.5, 0.5]])
+    valid = jnp.asarray([True, True])
+    match = np.asarray(detection.match_priors(priors, gt, valid, 0.99))
+    assert match[0] == 1

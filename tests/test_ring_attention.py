@@ -1,0 +1,86 @@
+"""Ring / Ulysses sequence-parallel attention vs dense reference.
+
+Validated on the 8-device CPU mesh (conftest), mirroring the reference's
+in-process multi-node simulation strategy.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.core import mesh as mesh_lib
+from paddle_tpu.parallel import ring_attention as ra
+
+
+def _qkv(np_rng, b=2, t=32, h=4, d=8, dtype=jnp.float32):
+    q = jnp.asarray(np_rng.randn(b, t, h, d), dtype)
+    k = jnp.asarray(np_rng.randn(b, t, h, d), dtype)
+    v = jnp.asarray(np_rng.randn(b, t, h, d), dtype)
+    return q, k, v
+
+
+def _seq_mesh(n=4):
+    return mesh_lib.build_mesh(
+        mesh_lib.MeshConfig(data=1, model=1, seq=n),
+        devices=jax.devices()[:n])
+
+
+@pytest.mark.parametrize("kind", ["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [False, True])
+def test_sequence_parallel_matches_dense(np_rng, kind, causal):
+    q, k, v = _qkv(np_rng)
+    mesh = _seq_mesh(4)
+    fn = ra.make_sequence_parallel_attention(mesh, kind=kind, causal=causal)
+    out = jax.jit(fn)(q, k, v)
+    ref = ra.dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_attention_grads_match_dense(np_rng):
+    q, k, v = _qkv(np_rng, b=1, t=16, h=2, d=4)
+    mesh = _seq_mesh(4)
+    fn = ra.make_sequence_parallel_attention(mesh, kind="ring", causal=True)
+
+    def loss_sp(q, k, v):
+        return jnp.sum(fn(q, k, v) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(ra.dense_attention(q, k, v, causal=True) ** 2)
+
+    g_sp = jax.jit(jax.grad(loss_sp, argnums=(0, 1, 2)))(q, k, v)
+    g_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_sp, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ring_attention_batch_and_seq_axes(np_rng):
+    """seq axis composes with data-parallel batch sharding."""
+    q, k, v = _qkv(np_rng, b=4, t=16)
+    mesh = mesh_lib.build_mesh(
+        mesh_lib.MeshConfig(data=2, model=1, seq=4))
+    fn = ra.make_sequence_parallel_attention(
+        mesh, kind="ring", causal=True, batch_axis=mesh_lib.DATA_AXIS)
+    out = jax.jit(fn)(q, k, v)
+    ref = ra.dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_dense_attention_mask(np_rng):
+    q, k, v = _qkv(np_rng, b=2, t=8, h=2, d=4)
+    mask = jnp.asarray(np_rng.rand(2, 8, 8) > 0.3)
+    mask = mask | jnp.eye(8, dtype=bool)[None]  # keep rows non-empty
+    out = ra.dense_attention(q, k, v, mask=mask)
+    # brute-force per-row check
+    d = q.shape[-1]
+    scores = np.einsum("bqhd,bkhd->bhqk", np.asarray(q), np.asarray(k))
+    scores /= np.sqrt(d)
+    scores = np.where(np.asarray(mask)[:, None], scores, -1e30)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, np.asarray(v))
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
