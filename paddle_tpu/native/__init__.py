@@ -1,0 +1,17 @@
+"""Native (C++) runtime components: recordio chunk files, task-queue
+master, TCP master service. Python binds via ctypes — no pybind."""
+
+from paddle_tpu.native.build import ensure_built, lib_path
+from paddle_tpu.native.recordio import (
+    RecordReader,
+    RecordWriter,
+    count_chunks,
+    read_records,
+    write_records,
+)
+from paddle_tpu.native.taskqueue import (
+    MasterClient,
+    MasterServer,
+    TaskQueue,
+    TaskStatus,
+)

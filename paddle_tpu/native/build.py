@@ -1,0 +1,38 @@
+"""Build the native runtime library (g++ → libpaddle_tpu_native.so).
+
+The reference ships its runtime as compiled C++/Go (recordio chunking +
+the Go master, reference: go/master/service.go); ours compiles on first
+use and caches the .so beside the sources.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src")
+_SOURCES = ["recordio.cc", "taskqueue.cc"]
+_LIB = os.path.join(_DIR, "libpaddle_tpu_native.so")
+_lock = threading.Lock()
+
+
+def lib_path() -> str:
+    return _LIB
+
+
+def ensure_built(force: bool = False) -> str:
+    """Compile the shared library if missing or stale; returns its path."""
+    with _lock:
+        srcs = [os.path.join(_SRC, s) for s in _SOURCES]
+        if not force and os.path.exists(_LIB):
+            so_mtime = os.path.getmtime(_LIB)
+            if all(os.path.getmtime(s) <= so_mtime for s in srcs):
+                return _LIB
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
+            "-Wall", "-o", _LIB, *srcs,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        return _LIB

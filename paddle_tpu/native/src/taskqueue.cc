@@ -1,0 +1,495 @@
+// Fault-tolerant task-queue master — the TPU-native equivalent of the
+// reference's Go master service (reference: go/master/service.go:140-481:
+// todo/pending/done queues, task lease timeout checkTimeoutFunc:341,
+// retry-then-discard processFailedTask:313, pass barriers GetTask:368,
+// snapshot/recover :166,207, save-model election RequestSaveModel:481).
+//
+// Core is an in-process C-ABI object (Python binds via ctypes); a
+// length-framed TCP service over the same object replaces the Go RPC so
+// multiple trainer processes can share one master.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int64_t now_ms() {
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+struct Task {
+  uint64_t id = 0;
+  std::string payload;
+  int failures = 0;
+};
+
+// get_task statuses (shared with the Python client)
+enum Status : uint8_t {
+  OK = 0,
+  NOT_STARTED = 1,   // start() not called yet (ErrPassBefore)
+  PENDING_WAIT = 2,  // todo drained, leases outstanding — retry later
+  PASS_END = 3,      // every task done/discarded (ErrPassAfter)
+};
+
+struct Queue {
+  std::mutex mu;
+  std::deque<Task> todo;
+  std::map<uint64_t, std::pair<Task, int64_t>> pending;  // id -> (task, deadline)
+  std::vector<Task> done, discarded;
+  uint64_t next_id = 1;
+  int64_t timeout_ms = 60000;
+  int max_retries = 3;
+  int64_t pass = -1;  // -1 until start()
+  // save-model election
+  int64_t save_grant_trainer = -1;
+  int64_t save_grant_expires = 0;
+
+  void check_timeouts_locked() {
+    int64_t t = now_ms();
+    for (auto it = pending.begin(); it != pending.end();) {
+      if (it->second.second <= t) {
+        Task task = std::move(it->second.first);
+        it = pending.erase(it);
+        task.failures++;
+        if (task.failures > max_retries) {
+          discarded.push_back(std::move(task));
+        } else {
+          todo.push_back(std::move(task));
+        }
+      } else {
+        ++it;
+      }
+    }
+  }
+};
+
+// ---- snapshot format: u64 pass, then per-section counts + tasks ----
+
+void write_task(FILE* f, const Task& t) {
+  uint64_t len = t.payload.size();
+  fwrite(&t.id, 8, 1, f);
+  fwrite(&t.failures, 4, 1, f);
+  fwrite(&len, 8, 1, f);
+  if (len) fwrite(t.payload.data(), len, 1, f);
+}
+
+bool read_task(FILE* f, Task* t) {
+  uint64_t len;
+  if (fread(&t->id, 8, 1, f) != 1 || fread(&t->failures, 4, 1, f) != 1 ||
+      fread(&len, 8, 1, f) != 1)
+    return false;
+  t->payload.resize(len);
+  return len == 0 || fread(&t->payload[0], len, 1, f) == 1;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* tq_create(int64_t timeout_ms, int max_retries) {
+  auto* q = new Queue();
+  if (timeout_ms > 0) q->timeout_ms = timeout_ms;
+  if (max_retries >= 0) q->max_retries = max_retries;
+  return q;
+}
+
+void tq_destroy(void* h) { delete static_cast<Queue*>(h); }
+
+uint64_t tq_add_task(void* h, const char* payload, uint64_t len) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  Task t;
+  t.id = q->next_id++;
+  uint64_t id = t.id;
+  t.payload.assign(payload, len);
+  q->todo.push_back(std::move(t));
+  return id;
+}
+
+void tq_start(void* h) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  if (q->pass < 0) q->pass = 0;
+}
+
+// Fills *id; payload copied into buf (up to buf_cap); *payload_len is the
+// full length. Returns a Status.
+uint8_t tq_get_task(void* h, uint64_t* id, char* buf, uint64_t buf_cap,
+                    uint64_t* payload_len) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  if (q->pass < 0) return NOT_STARTED;
+  q->check_timeouts_locked();
+  if (q->todo.empty()) {
+    return q->pending.empty() ? PASS_END : PENDING_WAIT;
+  }
+  Task t = std::move(q->todo.front());
+  q->todo.pop_front();
+  *id = t.id;
+  *payload_len = t.payload.size();
+  if (buf && buf_cap >= t.payload.size() && !t.payload.empty())
+    memcpy(buf, t.payload.data(), t.payload.size());
+  q->pending[t.id] = {std::move(t), now_ms() + q->timeout_ms};
+  return OK;
+}
+
+// 0 ok; -1 unknown id (double-finish after timeout re-assignment is
+// tolerated silently when the task already completed: returns 1)
+int tq_finish_task(void* h, uint64_t id) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  auto it = q->pending.find(id);
+  if (it == q->pending.end()) {
+    for (const auto& d : q->done)
+      if (d.id == id) return 1;
+    return -1;
+  }
+  q->done.push_back(std::move(it->second.first));
+  q->pending.erase(it);
+  return 0;
+}
+
+int tq_fail_task(void* h, uint64_t id) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  auto it = q->pending.find(id);
+  if (it == q->pending.end()) return -1;
+  Task t = std::move(it->second.first);
+  q->pending.erase(it);
+  t.failures++;
+  if (t.failures > q->max_retries) {
+    q->discarded.push_back(std::move(t));
+  } else {
+    q->todo.push_front(std::move(t));  // retry soon, as the Go master does
+  }
+  return 0;
+}
+
+// Recycle done (+discarded, with reset failure counts) into todo for the
+// next pass. Returns the new pass number, or -1 if leases are still
+// outstanding (callers must drain the pass first).
+int64_t tq_next_pass(void* h) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  q->check_timeouts_locked();
+  if (!q->pending.empty() || !q->todo.empty()) return -1;
+  for (auto* src : {&q->done, &q->discarded}) {
+    for (auto& t : *src) {
+      t.failures = 0;
+      q->todo.push_back(std::move(t));
+    }
+    src->clear();
+  }
+  return ++q->pass;
+}
+
+int64_t tq_pass(void* h) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  return q->pass;
+}
+
+void tq_counts(void* h, uint64_t* todo, uint64_t* pending, uint64_t* done,
+               uint64_t* discarded) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  q->check_timeouts_locked();
+  *todo = q->todo.size();
+  *pending = q->pending.size();
+  *done = q->done.size();
+  *discarded = q->discarded.size();
+}
+
+// Save-model election (reference: go/master/service.go:481 — exactly one
+// trainer should save per checkpoint window). Returns 1 if this trainer
+// holds the grant, 0 otherwise.
+int tq_request_save_model(void* h, int64_t trainer_id, int64_t ttl_ms) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  int64_t t = now_ms();
+  if (q->save_grant_trainer == trainer_id && q->save_grant_expires > t) {
+    q->save_grant_expires = t + ttl_ms;
+    return 1;
+  }
+  if (q->save_grant_expires <= t) {
+    q->save_grant_trainer = trainer_id;
+    q->save_grant_expires = t + ttl_ms;
+    return 1;
+  }
+  return 0;
+}
+
+// ---- snapshot / recover (reference: go/master/service.go:166,207 —
+// gob+gzip to etcd there; binary file here) ----
+
+int tq_snapshot(void* h, const char* path) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  q->check_timeouts_locked();
+  FILE* f = fopen(path, "wb");
+  if (!f) return -1;
+  fwrite(&q->pass, 8, 1, f);
+  fwrite(&q->next_id, 8, 1, f);
+  // pending tasks snapshot back into todo: a recovered master re-leases
+  uint64_t n_todo = q->todo.size() + q->pending.size();
+  uint64_t n_done = q->done.size(), n_disc = q->discarded.size();
+  fwrite(&n_todo, 8, 1, f);
+  for (const auto& t : q->todo) write_task(f, t);
+  for (const auto& kv : q->pending) write_task(f, kv.second.first);
+  fwrite(&n_done, 8, 1, f);
+  for (const auto& t : q->done) write_task(f, t);
+  fwrite(&n_disc, 8, 1, f);
+  for (const auto& t : q->discarded) write_task(f, t);
+  int rc = ferror(f) ? -1 : 0;
+  fclose(f);
+  return rc;
+}
+
+int tq_restore(void* h, const char* path) {
+  auto* q = static_cast<Queue*>(h);
+  std::lock_guard<std::mutex> g(q->mu);
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  Queue fresh;
+  uint64_t n_todo, n_done, n_disc;
+  bool ok = fread(&fresh.pass, 8, 1, f) == 1 &&
+            fread(&fresh.next_id, 8, 1, f) == 1 &&
+            fread(&n_todo, 8, 1, f) == 1;
+  if (ok)
+    for (uint64_t i = 0; i < n_todo && ok; i++) {
+      Task t;
+      ok = read_task(f, &t);
+      if (ok) fresh.todo.push_back(std::move(t));
+    }
+  ok = ok && fread(&n_done, 8, 1, f) == 1;
+  if (ok)
+    for (uint64_t i = 0; i < n_done && ok; i++) {
+      Task t;
+      ok = read_task(f, &t);
+      if (ok) fresh.done.push_back(std::move(t));
+    }
+  ok = ok && fread(&n_disc, 8, 1, f) == 1;
+  if (ok)
+    for (uint64_t i = 0; i < n_disc && ok; i++) {
+      Task t;
+      ok = read_task(f, &t);
+      if (ok) fresh.discarded.push_back(std::move(t));
+    }
+  fclose(f);
+  if (!ok) return -2;
+  q->todo = std::move(fresh.todo);
+  q->pending.clear();
+  q->done = std::move(fresh.done);
+  q->discarded = std::move(fresh.discarded);
+  q->pass = fresh.pass;
+  q->next_id = fresh.next_id;
+  return 0;
+}
+
+// ---- TCP service over the same queue (replaces the Go RPC layer) ----
+//
+// Frame: u32 length, then payload. Request payload: u8 opcode + args.
+// Response payload: u8 status + body. Integers little-endian.
+
+namespace {
+
+enum Op : uint8_t {
+  OP_GET = 1,        // -> status, u64 id, payload
+  OP_FINISH = 2,     // u64 id -> status
+  OP_FAIL = 3,       // u64 id -> status
+  OP_NEXT_PASS = 4,  // -> status, i64 pass
+  OP_COUNTS = 5,     // -> status, 4 x u64
+  OP_SAVE_ELECT = 6, // i64 trainer, i64 ttl -> status, u8 granted
+  OP_ADD = 7,        // payload -> status, u64 id
+  OP_START = 8,      // -> status
+  OP_PASS = 9,       // -> status, i64 pass
+};
+
+struct Server {
+  Queue* q = nullptr;
+  int listen_fd = -1;
+  std::atomic<bool> stop{false};
+  std::thread thr;
+  std::vector<std::thread> workers;
+};
+
+bool read_full(int fd, void* buf, size_t len) {
+  auto* p = static_cast<char*>(buf);
+  while (len) {
+    ssize_t n = read(fd, p, len);
+    if (n <= 0) return false;
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t len) {
+  auto* p = static_cast<const char*>(buf);
+  while (len) {
+    ssize_t n = write(fd, p, len);
+    if (n <= 0) return false;
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+void append_u64(std::string* s, uint64_t v) {
+  s->append(reinterpret_cast<const char*>(&v), 8);
+}
+
+void handle_conn(Server* srv, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint32_t len;
+    if (!read_full(fd, &len, 4) || len == 0 || len > (64u << 20)) break;
+    std::string req(len, '\0');
+    if (!read_full(fd, &req[0], len)) break;
+    uint8_t op = static_cast<uint8_t>(req[0]);
+    std::string resp;
+    Queue* q = srv->q;
+    switch (op) {
+      case OP_GET: {
+        uint64_t id = 0, plen = 0;
+        std::string buf(1 << 20, '\0');
+        uint8_t st = tq_get_task(q, &id, &buf[0], buf.size(), &plen);
+        resp.push_back(static_cast<char>(st));
+        if (st == OK) {
+          append_u64(&resp, id);
+          resp.append(buf.data(), plen);
+        }
+        break;
+      }
+      case OP_FINISH:
+      case OP_FAIL: {
+        uint64_t id;
+        memcpy(&id, req.data() + 1, 8);
+        int rc = op == OP_FINISH ? tq_finish_task(q, id) : tq_fail_task(q, id);
+        resp.push_back(rc < 0 ? 255 : 0);
+        break;
+      }
+      case OP_NEXT_PASS: {
+        int64_t p = tq_next_pass(q);
+        resp.push_back(0);
+        append_u64(&resp, static_cast<uint64_t>(p));
+        break;
+      }
+      case OP_COUNTS: {
+        uint64_t a, b, c, d;
+        tq_counts(q, &a, &b, &c, &d);
+        resp.push_back(0);
+        append_u64(&resp, a);
+        append_u64(&resp, b);
+        append_u64(&resp, c);
+        append_u64(&resp, d);
+        break;
+      }
+      case OP_SAVE_ELECT: {
+        int64_t trainer, ttl;
+        memcpy(&trainer, req.data() + 1, 8);
+        memcpy(&ttl, req.data() + 9, 8);
+        int granted = tq_request_save_model(q, trainer, ttl);
+        resp.push_back(0);
+        resp.push_back(static_cast<char>(granted));
+        break;
+      }
+      case OP_ADD: {
+        uint64_t id = tq_add_task(q, req.data() + 1, req.size() - 1);
+        resp.push_back(0);
+        append_u64(&resp, id);
+        break;
+      }
+      case OP_START:
+        tq_start(q);
+        resp.push_back(0);
+        break;
+      case OP_PASS: {
+        resp.push_back(0);
+        append_u64(&resp, static_cast<uint64_t>(tq_pass(q)));
+        break;
+      }
+      default:
+        resp.push_back(254);
+    }
+    uint32_t rlen = static_cast<uint32_t>(resp.size());
+    if (!write_full(fd, &rlen, 4) || !write_full(fd, resp.data(), rlen)) break;
+  }
+  close(fd);
+}
+
+}  // namespace
+
+// Returns an opaque server handle (nullptr on bind failure). Port 0 picks
+// a free port; tq_serve_port reports the bound port.
+void* tq_serve_start(void* h, int port) {
+  auto* srv = new Server();
+  srv->q = static_cast<Queue*>(h);
+  srv->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) {
+    delete srv;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) <
+          0 ||
+      listen(srv->listen_fd, 64) < 0) {
+    close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  srv->thr = std::thread([srv] {
+    while (!srv->stop.load()) {
+      int fd = accept(srv->listen_fd, nullptr, nullptr);
+      if (fd < 0) break;
+      srv->workers.emplace_back(handle_conn, srv, fd);
+    }
+  });
+  return srv;
+}
+
+int tq_serve_port(void* sh) {
+  auto* srv = static_cast<Server*>(sh);
+  sockaddr_in addr{};
+  socklen_t len = sizeof(addr);
+  if (getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len) <
+      0)
+    return -1;
+  return ntohs(addr.sin_port);
+}
+
+void tq_serve_stop(void* sh) {
+  auto* srv = static_cast<Server*>(sh);
+  srv->stop.store(true);
+  shutdown(srv->listen_fd, SHUT_RDWR);
+  close(srv->listen_fd);
+  if (srv->thr.joinable()) srv->thr.join();
+  for (auto& w : srv->workers)
+    if (w.joinable()) w.join();
+  delete srv;
+}
+
+}  // extern "C"

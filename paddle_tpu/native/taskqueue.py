@@ -1,0 +1,295 @@
+"""Task-queue master bindings: in-process (ctypes) + TCP client.
+
+Mirrors the reference Go master's API surface (reference:
+go/master/service.go GetTask/TaskFinished/TaskFailed, snapshot/recover,
+RequestSaveModel; go/master/client.go NextRecord streaming).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import enum
+import json
+import socket
+import struct
+from typing import List, Optional, Tuple
+
+from paddle_tpu.native.build import ensure_built
+
+
+class TaskStatus(enum.IntEnum):
+    OK = 0
+    NOT_STARTED = 1    # ErrPassBefore equivalent
+    PENDING_WAIT = 2   # todo drained, leases outstanding
+    PASS_END = 3       # ErrPassAfter equivalent
+
+
+def _lib():
+    lib = ctypes.CDLL(ensure_built())
+    c = ctypes
+    lib.tq_create.restype = c.c_void_p
+    lib.tq_create.argtypes = [c.c_int64, c.c_int]
+    lib.tq_destroy.argtypes = [c.c_void_p]
+    lib.tq_add_task.restype = c.c_uint64
+    lib.tq_add_task.argtypes = [c.c_void_p, c.c_char_p, c.c_uint64]
+    lib.tq_start.argtypes = [c.c_void_p]
+    lib.tq_get_task.restype = c.c_uint8
+    lib.tq_get_task.argtypes = [c.c_void_p, c.POINTER(c.c_uint64),
+                                c.c_char_p, c.c_uint64,
+                                c.POINTER(c.c_uint64)]
+    lib.tq_finish_task.restype = c.c_int
+    lib.tq_finish_task.argtypes = [c.c_void_p, c.c_uint64]
+    lib.tq_fail_task.restype = c.c_int
+    lib.tq_fail_task.argtypes = [c.c_void_p, c.c_uint64]
+    lib.tq_next_pass.restype = c.c_int64
+    lib.tq_next_pass.argtypes = [c.c_void_p]
+    lib.tq_pass.restype = c.c_int64
+    lib.tq_pass.argtypes = [c.c_void_p]
+    lib.tq_counts.argtypes = [c.c_void_p] + [c.POINTER(c.c_uint64)] * 4
+    lib.tq_request_save_model.restype = c.c_int
+    lib.tq_request_save_model.argtypes = [c.c_void_p, c.c_int64, c.c_int64]
+    lib.tq_snapshot.restype = c.c_int
+    lib.tq_snapshot.argtypes = [c.c_void_p, c.c_char_p]
+    lib.tq_restore.restype = c.c_int
+    lib.tq_restore.argtypes = [c.c_void_p, c.c_char_p]
+    lib.tq_serve_start.restype = c.c_void_p
+    lib.tq_serve_start.argtypes = [c.c_void_p, c.c_int]
+    lib.tq_serve_port.restype = c.c_int
+    lib.tq_serve_port.argtypes = [c.c_void_p]
+    lib.tq_serve_stop.argtypes = [c.c_void_p]
+    return lib
+
+
+_cached = None
+
+
+def get_lib():
+    global _cached
+    if _cached is None:
+        _cached = _lib()
+    return _cached
+
+
+_MAX_PAYLOAD = 1 << 20
+
+
+class TaskQueue:
+    """In-process master core (the unit the TCP service wraps)."""
+
+    def __init__(self, timeout_ms: int = 60000, max_retries: int = 3):
+        self._lib = get_lib()
+        self._h = self._lib.tq_create(timeout_ms, max_retries)
+
+    def add_task(self, payload: bytes) -> int:
+        return self._lib.tq_add_task(self._h, payload, len(payload))
+
+    def add_file_chunks(self, path: str, chunks_per_task: int = 1) -> int:
+        """Partition a recordio file into chunk-range tasks (reference:
+        go/master/service.go:106 partition). Payload is JSON
+        {path, chunk_begin, chunk_end}."""
+        from paddle_tpu.native.recordio import count_chunks
+
+        n = count_chunks(path)
+        added = 0
+        for begin in range(0, n, chunks_per_task):
+            payload = json.dumps({
+                "path": path, "chunk_begin": begin,
+                "chunk_end": min(begin + chunks_per_task, n),
+            }).encode()
+            self.add_task(payload)
+            added += 1
+        return added
+
+    def start(self):
+        self._lib.tq_start(self._h)
+
+    def get_task(self) -> Tuple[TaskStatus, int, bytes]:
+        tid = ctypes.c_uint64()
+        plen = ctypes.c_uint64()
+        buf = ctypes.create_string_buffer(_MAX_PAYLOAD)
+        st = self._lib.tq_get_task(self._h, ctypes.byref(tid), buf,
+                                   _MAX_PAYLOAD, ctypes.byref(plen))
+        status = TaskStatus(st)
+        if status != TaskStatus.OK:
+            return status, 0, b""
+        return status, tid.value, buf.raw[: plen.value]
+
+    def finish_task(self, task_id: int):
+        if self._lib.tq_finish_task(self._h, task_id) < 0:
+            raise KeyError(f"unknown task id {task_id}")
+
+    def fail_task(self, task_id: int):
+        if self._lib.tq_fail_task(self._h, task_id) < 0:
+            raise KeyError(f"unknown task id {task_id}")
+
+    def next_pass(self) -> int:
+        p = self._lib.tq_next_pass(self._h)
+        if p < 0:
+            raise RuntimeError("pass not drained: tasks still outstanding")
+        return p
+
+    @property
+    def pass_num(self) -> int:
+        return self._lib.tq_pass(self._h)
+
+    def counts(self) -> dict:
+        vals = [ctypes.c_uint64() for _ in range(4)]
+        self._lib.tq_counts(self._h, *[ctypes.byref(v) for v in vals])
+        return dict(zip(("todo", "pending", "done", "discarded"),
+                        (v.value for v in vals)))
+
+    def request_save_model(self, trainer_id: int, ttl_ms: int = 60000) -> bool:
+        return bool(self._lib.tq_request_save_model(self._h, trainer_id,
+                                                    ttl_ms))
+
+    def snapshot(self, path: str):
+        if self._lib.tq_snapshot(self._h, path.encode()) != 0:
+            raise OSError(f"snapshot to {path} failed")
+
+    def restore(self, path: str):
+        rc = self._lib.tq_restore(self._h, path.encode())
+        if rc != 0:
+            raise OSError(f"restore from {path} failed (rc={rc})")
+
+    def close(self):
+        if self._h:
+            self._lib.tq_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class MasterServer:
+    """TCP service over a TaskQueue (loopback), replacing the Go RPC."""
+
+    def __init__(self, queue: TaskQueue, port: int = 0):
+        self.queue = queue
+        self._lib = get_lib()
+        self._srv = self._lib.tq_serve_start(queue._h, port)
+        if not self._srv:
+            raise OSError(f"cannot bind master service on port {port}")
+        self.port = self._lib.tq_serve_port(self._srv)
+
+    def stop(self):
+        if self._srv:
+            self._lib.tq_serve_stop(self._srv)
+            self._srv = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+_OP_GET, _OP_FINISH, _OP_FAIL, _OP_NEXT_PASS, _OP_COUNTS = 1, 2, 3, 4, 5
+_OP_SAVE_ELECT, _OP_ADD, _OP_START, _OP_PASS = 6, 7, 8, 9
+
+
+class MasterClient:
+    """Socket client for MasterServer (reference: go/master/client.go)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.create_connection((host, port))
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _call(self, payload: bytes) -> bytes:
+        self._sock.sendall(struct.pack("<I", len(payload)) + payload)
+        hdr = self._recv_full(4)
+        (n,) = struct.unpack("<I", hdr)
+        return self._recv_full(n)
+
+    def _recv_full(self, n: int) -> bytes:
+        chunks = []
+        while n:
+            b = self._sock.recv(n)
+            if not b:
+                raise ConnectionError("master connection closed")
+            chunks.append(b)
+            n -= len(b)
+        return b"".join(chunks)
+
+    def add_task(self, payload: bytes) -> int:
+        resp = self._call(bytes([_OP_ADD]) + payload)
+        return struct.unpack_from("<Q", resp, 1)[0]
+
+    def start(self):
+        self._call(bytes([_OP_START]))
+
+    def get_task(self) -> Tuple[TaskStatus, int, bytes]:
+        resp = self._call(bytes([_OP_GET]))
+        status = TaskStatus(resp[0])
+        if status != TaskStatus.OK:
+            return status, 0, b""
+        (tid,) = struct.unpack_from("<Q", resp, 1)
+        return status, tid, resp[9:]
+
+    def finish_task(self, task_id: int):
+        resp = self._call(bytes([_OP_FINISH]) + struct.pack("<Q", task_id))
+        if resp[0] == 255:
+            raise KeyError(f"unknown task id {task_id}")
+
+    def fail_task(self, task_id: int):
+        resp = self._call(bytes([_OP_FAIL]) + struct.pack("<Q", task_id))
+        if resp[0] == 255:
+            raise KeyError(f"unknown task id {task_id}")
+
+    def next_pass(self) -> int:
+        resp = self._call(bytes([_OP_NEXT_PASS]))
+        (p,) = struct.unpack_from("<q", resp, 1)
+        if p < 0:
+            raise RuntimeError("pass not drained: tasks still outstanding")
+        return p
+
+    def counts(self) -> dict:
+        resp = self._call(bytes([_OP_COUNTS]))
+        vals = struct.unpack_from("<QQQQ", resp, 1)
+        return dict(zip(("todo", "pending", "done", "discarded"), vals))
+
+    def request_save_model(self, trainer_id: int, ttl_ms: int = 60000) -> bool:
+        resp = self._call(bytes([_OP_SAVE_ELECT]) +
+                          struct.pack("<qq", trainer_id, ttl_ms))
+        return bool(resp[1])
+
+    @property
+    def pass_num(self) -> int:
+        resp = self._call(bytes([_OP_PASS]))
+        return struct.unpack_from("<q", resp, 1)[0]
+
+    def close(self):
+        self._sock.close()
+
+    # -- record streaming (go/master/client.go NextRecord equivalent) --
+
+    def record_reader(self):
+        """Reader over the master's recordio-chunk tasks: pulls a task,
+        streams its records, marks it finished; yields until PASS_END."""
+        def reader():
+            while True:
+                status, tid, payload = self.get_task()
+                if status == TaskStatus.PASS_END:
+                    return
+                if status in (TaskStatus.PENDING_WAIT,
+                              TaskStatus.NOT_STARTED):
+                    import time
+
+                    time.sleep(0.05)
+                    continue
+                spec = json.loads(payload.decode())
+                try:
+                    from paddle_tpu.native.recordio import RecordReader
+
+                    with RecordReader(spec["path"], spec["chunk_begin"],
+                                      spec["chunk_end"]) as rr:
+                        for rec in rr:
+                            yield rec
+                except Exception:
+                    self.fail_task(tid)
+                    raise
+                self.finish_task(tid)
+
+        return reader

@@ -307,6 +307,13 @@ def avg_pool3d(x, window=2, *, stride=None, padding="VALID"):
     """3-D average pooling. x: [N,D,H,W,C]. Padding is excluded from the
     divisor (reference Pool3DLayer's exclusive average)."""
     summed = _pool3d(x, window, stride, padding, 0.0, lax.add)
+    w = (window,) * 3 if isinstance(window, int) else tuple(window)
+    no_pad = padding == "VALID" or (
+        not isinstance(padding, str) and all(
+            p == 0 for p in ((padding,) * 3 if isinstance(padding, int)
+                             else tuple(padding))))
+    if no_pad:
+        return summed / float(np.prod(w))
     counts = _pool3d(jnp.ones(x.shape[1:-1], x.dtype)[None, ..., None],
                      window, stride, padding, 0.0, lax.add)
     return summed / counts

@@ -155,7 +155,12 @@ def make_sequence_parallel_attention(
     Takes global [B, T, H, D] arrays; shard_map internally shards T over
     the seq axis (and optionally B over `batch_axis`).
     """
-    inner = ring_attention if kind == "ring" else ulysses_attention
+    if kind == "ring":
+        inner = ring_attention
+    elif kind == "ulysses":
+        inner = ulysses_attention
+    else:
+        raise ValueError(f"unknown kind {kind!r}: expected 'ring' or 'ulysses'")
     spec = P(batch_axis, axis, None, None)
 
     @functools.partial(
