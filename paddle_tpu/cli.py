@@ -1,0 +1,297 @@
+"""Command-line driver (reference: the `paddle` shell dispatcher,
+scripts/submit_local.sh.in:3-14 — train | pserver | merge_model |
+dump_config | version; TrainerMain.cpp:32).
+
+Subcommands:
+  version      — build/runtime info
+  train        — run a config script's training job
+  dump-config  — print a config script's resolved topology as JSON
+  merge-model  — config + trained params -> single compiled artifact
+  infer        — run a compiled artifact on .npy inputs
+  master       — serve a task-queue master over a recordio dataset
+  bench        — run the benchmark entry
+
+A config script is a Python file defining `get_config()` returning a dict:
+  model      (nn.Layer, required)
+  input_spec (ShapeSpec or tuple shape, required)
+  loss_fn / optimizer / metrics_fn / reader / num_passes (train keys)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import runpy
+import sys
+import time
+from typing import Optional
+
+
+def _load_config(path: str) -> dict:
+    ns = runpy.run_path(path)
+    if "get_config" not in ns:
+        raise SystemExit(f"{path} does not define get_config()")
+    cfg = ns["get_config"]()
+    if "model" not in cfg or "input_spec" not in cfg:
+        raise SystemExit("get_config() must provide 'model' and 'input_spec'")
+    return cfg
+
+
+def _input_spec(cfg):
+    from paddle_tpu.nn.module import ShapeSpec
+
+    spec = cfg["input_spec"]
+    return spec if isinstance(spec, ShapeSpec) else ShapeSpec(tuple(spec))
+
+
+def cmd_version(_args) -> int:
+    import jax
+
+    import paddle_tpu
+
+    print(f"paddle_tpu {paddle_tpu.__version__}")
+    print(f"jax {jax.__version__}")
+    try:
+        devs = jax.devices()
+        print(f"devices: {len(devs)} x {devs[0].platform}")
+    except Exception as e:  # no backend available
+        print(f"devices: unavailable ({e})")
+    return 0
+
+
+def cmd_dump_config(args) -> int:
+    import jax
+
+    cfg = _load_config(args.config)
+    model = cfg["model"]
+    spec = _input_spec(cfg)
+    params, mstate = model.init(jax.random.key(0), spec)
+    leaves = jax.tree_util.tree_leaves(params)
+    out = {
+        "model": type(model).__name__,
+        "input_shape": list(spec.shape),
+        "num_parameters": int(sum(x.size for x in leaves)),
+        "num_tensors": len(leaves),
+        "parameters": {
+            "/".join(map(str, path)): list(x.shape)
+            for path, x in _named_leaves(params)
+        },
+    }
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def _named_leaves(tree):
+    import jax
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        keys = []
+        for p in path:
+            keys.append(getattr(p, "key", getattr(p, "idx", p)))
+        yield keys, leaf
+
+
+def cmd_train(args) -> int:
+    import jax.numpy as jnp
+
+    from paddle_tpu import data as data_mod
+    from paddle_tpu import optim
+    from paddle_tpu.ops import losses
+    from paddle_tpu.train import Trainer, events as E
+    from paddle_tpu.train.checkpoint import save_parameters_tar
+
+    cfg = _load_config(args.config)
+    loss_fn = cfg.get("loss_fn") or (
+        lambda lo, la: jnp.mean(losses.softmax_cross_entropy(lo, la)))
+    trainer = Trainer(
+        cfg["model"],
+        loss_fn=loss_fn,
+        optimizer=cfg.get("optimizer") or optim.sgd(args.learning_rate),
+        metrics_fn=cfg.get("metrics_fn"),
+        num_inputs=cfg.get("num_inputs", 1),
+        seed=args.seed,
+    )
+    state = trainer.init_state(_input_spec(cfg))
+    reader = cfg.get("reader")
+    if reader is None:
+        raise SystemExit("config provides no 'reader' for training")
+    feeder = data_mod.DataFeeder()
+    batches = lambda: feeder(data_mod.batch_reader(reader, args.batch_size))
+
+    t0 = time.time()
+
+    def handler(ev):
+        if isinstance(ev, E.EndIteration) and ev.batch_id % args.log_period == 0:
+            print(f"pass {ev.pass_id} batch {ev.batch_id} "
+                  f"cost {ev.cost:.6f} ({time.time() - t0:.1f}s)")
+        if isinstance(ev, E.EndPass):
+            print(f"=== pass {ev.pass_id} done ===")
+
+    state = trainer.train(
+        state, batches, num_passes=cfg.get("num_passes", args.num_passes),
+        event_handler=handler)
+    if args.save_dir:
+        import os
+
+        os.makedirs(args.save_dir, exist_ok=True)
+        out = os.path.join(args.save_dir, "params.tar")
+        save_parameters_tar(state.params, out)
+        print(f"saved parameters to {out}")
+    return 0
+
+
+def cmd_merge_model(args) -> int:
+    import jax
+    import numpy as np
+
+    from paddle_tpu.serve import export_compiled_model
+    from paddle_tpu.train.checkpoint import load_parameters_tar
+
+    cfg = _load_config(args.config)
+    model = cfg["model"]
+    spec = _input_spec(cfg)
+    params, mstate = model.init(jax.random.key(0), spec)
+    if args.params:
+        params = load_parameters_tar(params, args.params)
+
+    def forward(x):
+        out, _ = model.apply(params, mstate, x, training=False)
+        return out
+
+    x = np.zeros(spec.shape, np.float32)
+    export_compiled_model(forward, [x], args.output,
+                          name=cfg.get("name", "model"))
+    print(f"wrote compiled artifact {args.output}")
+    return 0
+
+
+def cmd_infer(args) -> int:
+    import numpy as np
+
+    from paddle_tpu.serve import load_compiled_model
+
+    m = load_compiled_model(args.artifact)
+    inputs = [np.load(p) for p in args.inputs]
+    out = m.predict(*inputs)
+    import jax
+
+    for i, o in enumerate(jax.tree_util.tree_leaves(out)):
+        o = np.asarray(o)
+        if args.output_prefix:
+            np.save(f"{args.output_prefix}{i}.npy", o)
+        print(f"output[{i}] shape={o.shape} dtype={o.dtype} "
+              f"mean={float(o.mean()):.6f}")
+    return 0
+
+
+def cmd_master(args) -> int:
+    from paddle_tpu.native import MasterServer, TaskQueue
+
+    q = TaskQueue(timeout_ms=args.task_timeout_ms,
+                  max_retries=args.max_retries)
+    if args.snapshot and _exists(args.snapshot):
+        q.restore(args.snapshot)
+        print(f"recovered master state from {args.snapshot}")
+    else:
+        for path in args.dataset:
+            n = q.add_file_chunks(path, chunks_per_task=args.chunks_per_task)
+            print(f"{path}: {n} tasks")
+    q.start()
+    srv = MasterServer(q, port=args.port)
+    print(f"master serving on 127.0.0.1:{srv.port}")
+    try:
+        while True:
+            time.sleep(args.snapshot_period)
+            if args.snapshot:
+                q.snapshot(args.snapshot)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if args.snapshot:
+            q.snapshot(args.snapshot)
+        srv.stop()
+    return 0
+
+
+def _exists(p: str) -> bool:
+    import os
+
+    return os.path.exists(p)
+
+
+def cmd_bench(_args) -> int:
+    import os
+    import runpy
+
+    bench = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")
+    if not _exists(bench):
+        raise SystemExit("bench.py not found beside the package")
+    runpy.run_path(bench, run_name="__main__")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="paddle_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("version").set_defaults(fn=cmd_version)
+
+    t = sub.add_parser("train")
+    t.add_argument("--config", required=True)
+    t.add_argument("--batch-size", type=int, default=32)
+    t.add_argument("--num-passes", type=int, default=1)
+    t.add_argument("--learning-rate", type=float, default=0.01)
+    t.add_argument("--log-period", type=int, default=10)
+    t.add_argument("--save-dir", default=None)
+    t.add_argument("--seed", type=int, default=0)
+    t.set_defaults(fn=cmd_train)
+
+    d = sub.add_parser("dump-config")
+    d.add_argument("--config", required=True)
+    d.set_defaults(fn=cmd_dump_config)
+
+    m = sub.add_parser("merge-model")
+    m.add_argument("--config", required=True)
+    m.add_argument("--params", default=None,
+                   help="params.tar from `train --save-dir`")
+    m.add_argument("--output", required=True)
+    m.set_defaults(fn=cmd_merge_model)
+
+    i = sub.add_parser("infer")
+    i.add_argument("--artifact", required=True)
+    i.add_argument("--output-prefix", default=None)
+    i.add_argument("inputs", nargs="+", help=".npy input files")
+    i.set_defaults(fn=cmd_infer)
+
+    ms = sub.add_parser("master")
+    ms.add_argument("--port", type=int, default=0)
+    ms.add_argument("--dataset", nargs="*", default=[],
+                    help="recordio files to partition into tasks")
+    ms.add_argument("--chunks-per-task", type=int, default=1)
+    ms.add_argument("--task-timeout-ms", type=int, default=60000)
+    ms.add_argument("--max-retries", type=int, default=3)
+    ms.add_argument("--snapshot", default=None)
+    ms.add_argument("--snapshot-period", type=float, default=30.0)
+    ms.set_defaults(fn=cmd_master)
+
+    sub.add_parser("bench").set_defaults(fn=cmd_bench)
+    return p
+
+
+def main(argv: Optional[list] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # stdout consumer (e.g. `| head`) closed early — exit quietly
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,3 +36,36 @@ def ensure_built(force: bool = False) -> str:
         ]
         subprocess.run(cmd, check=True, capture_output=True, text=True)
         return _LIB
+
+
+_CAPI_SRC = os.path.join(_SRC, "capi.cc")
+_CAPI_LIB = os.path.join(_DIR, "libpaddle_tpu_capi.so")
+
+
+def _python_config(flag: str) -> list:
+    import sysconfig
+
+    args = [flag] + (["--embed"] if flag == "--ldflags" else [])
+    exe = f"python{sysconfig.get_python_version()}-config"
+    try:
+        out = subprocess.run([exe, *args], check=True,
+                             capture_output=True, text=True).stdout
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        out = subprocess.run(["python3-config", *args], check=True,
+                             capture_output=True, text=True).stdout
+    return out.split()
+
+
+def ensure_capi_built(force: bool = False) -> str:
+    """Compile the C inference ABI library (embeds CPython)."""
+    with _lock:
+        if (not force and os.path.exists(_CAPI_LIB)
+                and os.path.getmtime(_CAPI_SRC) <= os.path.getmtime(_CAPI_LIB)):
+            return _CAPI_LIB
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-Wall",
+            *_python_config("--includes"), "-o", _CAPI_LIB, _CAPI_SRC,
+            *_python_config("--ldflags"),
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        return _CAPI_LIB

@@ -1,0 +1,183 @@
+// C inference ABI — the reference's deployment surface was a C API over
+// the C++ engine (reference: capi/gradient_machine.h:36-112
+// paddle_gradient_machine_create_for_inference_with_parameters / forward,
+// exported symbols capi/paddle_capi.map). The TPU-native engine is a
+// serialized StableHLO program executed by jax; this library embeds
+// CPython (as the reference embedded Python for its config parser,
+// utils/PythonUtil.h:47) and drives paddle_tpu.serve.capi_bridge.
+//
+// All functions return 0 on success (or non-NULL); pt_last_error() gives
+// the failure message for the calling thread.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+thread_local std::string g_error;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_error = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      g_error = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+struct Model {
+  long long mid = 0;
+  std::string signature;
+};
+
+PyObject* bridge() {
+  static PyObject* mod = nullptr;
+  if (!mod) mod = PyImport_ImportModule("paddle_tpu.serve.capi_bridge");
+  return mod;
+}
+
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* pt_last_error() { return g_error.c_str(); }
+
+// Initialize the embedded interpreter. extra_sys_path (may be NULL) is
+// prepended to sys.path so paddle_tpu can be imported from a source tree.
+int pt_init(const char* extra_sys_path) {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // Release the GIL acquired by initialization so worker threads (and
+    // this one, via Gil) can take it.
+    PyEval_SaveThread();
+  }
+  Gil gil;
+  if (extra_sys_path && *extra_sys_path) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(extra_sys_path);
+    if (!sys_path || !p || PyList_Insert(sys_path, 0, p) != 0) {
+      Py_XDECREF(p);
+      set_error_from_python();
+      return -1;
+    }
+    Py_DECREF(p);
+  }
+  if (!bridge()) {
+    set_error_from_python();
+    return -1;
+  }
+  return 0;
+}
+
+void* pt_load(const char* artifact_path) {
+  Gil gil;
+  PyObject* mod = bridge();
+  if (!mod) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* mid = PyObject_CallMethod(mod, "load", "s", artifact_path);
+  if (!mid) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* sig = PyObject_CallMethod(mod, "signature", "O", mid);
+  if (!sig) {
+    Py_DECREF(mid);
+    set_error_from_python();
+    return nullptr;
+  }
+  auto* m = new Model();
+  m->mid = PyLong_AsLongLong(mid);
+  m->signature = PyUnicode_AsUTF8(sig);
+  Py_DECREF(mid);
+  Py_DECREF(sig);
+  return m;
+}
+
+// JSON signature {inputs: [{shape, dtype}...], outputs: [...]}; owned by
+// the model handle.
+const char* pt_signature(void* handle) {
+  return static_cast<Model*>(handle)->signature.c_str();
+}
+
+// Run the forward. Inputs are raw buffers matching the signature's
+// dtype/shape. Outputs are malloc'd (pt_free_outputs releases).
+int pt_forward(void* handle, const char** in_bufs, const uint64_t* in_lens,
+               int n_in, char*** out_bufs, uint64_t** out_lens, int* n_out) {
+  auto* m = static_cast<Model*>(handle);
+  Gil gil;
+  PyObject* list = PyList_New(n_in);
+  for (int i = 0; i < n_in; i++) {
+    PyList_SET_ITEM(list, i, PyBytes_FromStringAndSize(
+                                 in_bufs[i], static_cast<Py_ssize_t>(
+                                                 in_lens[i])));
+  }
+  PyObject* result = PyObject_CallMethod(bridge(), "forward", "LO",
+                                         (long long)m->mid, list);
+  Py_DECREF(list);
+  if (!result) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(result);
+  *n_out = static_cast<int>(n);
+  *out_bufs = static_cast<char**>(malloc(sizeof(char*) * n));
+  *out_lens = static_cast<uint64_t*>(malloc(sizeof(uint64_t) * n));
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject* tup = PyList_GetItem(result, i);           // borrowed
+    PyObject* bytes = PyTuple_GetItem(tup, 0);           // borrowed
+    char* data;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(bytes, &data, &len) != 0) {
+      set_error_from_python();
+      Py_DECREF(result);
+      return -1;
+    }
+    (*out_bufs)[i] = static_cast<char*>(malloc(len));
+    memcpy((*out_bufs)[i], data, len);
+    (*out_lens)[i] = static_cast<uint64_t>(len);
+  }
+  Py_DECREF(result);
+  return 0;
+}
+
+void pt_free_outputs(char** out_bufs, uint64_t* out_lens, int n_out) {
+  for (int i = 0; i < n_out; i++) free(out_bufs[i]);
+  free(out_bufs);
+  free(out_lens);
+}
+
+void pt_release(void* handle) {
+  auto* m = static_cast<Model*>(handle);
+  {
+    Gil gil;
+    PyObject* r =
+        PyObject_CallMethod(bridge(), "release", "L", (long long)m->mid);
+    Py_XDECREF(r);
+    if (!r) PyErr_Clear();
+  }
+  delete m;
+}
+
+}  // extern "C"

@@ -81,13 +81,20 @@ struct Reader {
         error = true;
         return false;
       }
+      int64_t idx = chunk_idx++;
+      if (idx < chunk_begin) {
+        // seek past unwanted chunk bodies: O(slice) I/O per ranged task
+        if (fseek(f, static_cast<long>(body_len), SEEK_CUR) != 0) {
+          error = true;
+          return false;
+        }
+        continue;
+      }
       std::string body(body_len, '\0');
       if (body_len && fread(&body[0], body_len, 1, f) != 1) {
         error = true;
         return false;
       }
-      int64_t idx = chunk_idx++;
-      if (idx < chunk_begin) continue;  // skip to range
       if (crc32_update(0, reinterpret_cast<const unsigned char*>(body.data()),
                        body.size()) != crc) {
         error = true;

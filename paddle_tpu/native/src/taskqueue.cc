@@ -14,6 +14,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <cstdint>
@@ -39,6 +40,11 @@ struct Task {
   std::string payload;
   int failures = 0;
 };
+
+// Hard cap on task payloads: the get-task wire path and in-process
+// bindings use fixed 1MB buffers. Payloads are small task specs (file
+// chunk ranges), never data.
+constexpr uint64_t kMaxPayload = 1u << 20;
 
 // get_task statuses (shared with the Python client)
 enum Status : uint8_t {
@@ -112,8 +118,10 @@ void* tq_create(int64_t timeout_ms, int max_retries) {
 
 void tq_destroy(void* h) { delete static_cast<Queue*>(h); }
 
+// Returns the new task id, or 0 if the payload exceeds kMaxPayload.
 uint64_t tq_add_task(void* h, const char* payload, uint64_t len) {
   auto* q = static_cast<Queue*>(h);
+  if (len > kMaxPayload) return 0;
   std::lock_guard<std::mutex> g(q->mu);
   Task t;
   t.id = q->next_id++;
@@ -150,8 +158,9 @@ uint8_t tq_get_task(void* h, uint64_t* id, char* buf, uint64_t buf_cap,
   return OK;
 }
 
-// 0 ok; -1 unknown id (double-finish after timeout re-assignment is
-// tolerated silently when the task already completed: returns 1)
+// 0 ok; 1 stale-but-known no-op (already done, or lease timed out and
+// the task was re-queued — the Go master likewise tolerates stale
+// finishes); -1 truly unknown id.
 int tq_finish_task(void* h, uint64_t id) {
   auto* q = static_cast<Queue*>(h);
   std::lock_guard<std::mutex> g(q->mu);
@@ -159,6 +168,10 @@ int tq_finish_task(void* h, uint64_t id) {
   if (it == q->pending.end()) {
     for (const auto& d : q->done)
       if (d.id == id) return 1;
+    for (const auto& t : q->todo)
+      if (t.id == id) return 1;
+    for (const auto& t : q->discarded)
+      if (t.id == id) return 1;
     return -1;
   }
   q->done.push_back(std::move(it->second.first));
@@ -328,6 +341,8 @@ struct Server {
   std::atomic<bool> stop{false};
   std::thread thr;
   std::vector<std::thread> workers;
+  std::mutex conn_mu;
+  std::vector<int> conn_fds;  // open client fds, shut down on stop
 };
 
 bool read_full(int fd, void* buf, size_t len) {
@@ -356,6 +371,20 @@ void append_u64(std::string* s, uint64_t v) {
   s->append(reinterpret_cast<const char*>(&v), 8);
 }
 
+// Minimum request payload size (incl. opcode byte) per opcode; ops not
+// listed take opcode-only (or, for OP_ADD, any length).
+size_t min_req_len(uint8_t op) {
+  switch (op) {
+    case OP_FINISH:
+    case OP_FAIL:
+      return 9;
+    case OP_SAVE_ELECT:
+      return 17;
+    default:
+      return 1;
+  }
+}
+
 void handle_conn(Server* srv, int fd) {
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -367,15 +396,21 @@ void handle_conn(Server* srv, int fd) {
     uint8_t op = static_cast<uint8_t>(req[0]);
     std::string resp;
     Queue* q = srv->q;
+    if (req.size() < min_req_len(op)) {
+      resp.push_back(static_cast<char>(254));
+      uint32_t rl = static_cast<uint32_t>(resp.size());
+      if (!write_full(fd, &rl, 4) || !write_full(fd, resp.data(), rl)) break;
+      continue;
+    }
     switch (op) {
       case OP_GET: {
         uint64_t id = 0, plen = 0;
-        std::string buf(1 << 20, '\0');
+        std::string buf(kMaxPayload, '\0');
         uint8_t st = tq_get_task(q, &id, &buf[0], buf.size(), &plen);
         resp.push_back(static_cast<char>(st));
         if (st == OK) {
           append_u64(&resp, id);
-          resp.append(buf.data(), plen);
+          resp.append(buf.data(), std::min<uint64_t>(plen, buf.size()));
         }
         break;
       }
@@ -414,7 +449,7 @@ void handle_conn(Server* srv, int fd) {
       }
       case OP_ADD: {
         uint64_t id = tq_add_task(q, req.data() + 1, req.size() - 1);
-        resp.push_back(0);
+        resp.push_back(id == 0 ? 255 : 0);  // 0 = payload too large
         append_u64(&resp, id);
         break;
       }
@@ -432,6 +467,11 @@ void handle_conn(Server* srv, int fd) {
     }
     uint32_t rlen = static_cast<uint32_t>(resp.size());
     if (!write_full(fd, &rlen, 4) || !write_full(fd, resp.data(), rlen)) break;
+  }
+  {
+    std::lock_guard<std::mutex> g(srv->conn_mu);
+    auto& v = srv->conn_fds;
+    v.erase(std::remove(v.begin(), v.end(), fd), v.end());
   }
   close(fd);
 }
@@ -465,6 +505,10 @@ void* tq_serve_start(void* h, int port) {
     while (!srv->stop.load()) {
       int fd = accept(srv->listen_fd, nullptr, nullptr);
       if (fd < 0) break;
+      {
+        std::lock_guard<std::mutex> g(srv->conn_mu);
+        srv->conn_fds.push_back(fd);
+      }
       srv->workers.emplace_back(handle_conn, srv, fd);
     }
   });
@@ -487,6 +531,11 @@ void tq_serve_stop(void* sh) {
   shutdown(srv->listen_fd, SHUT_RDWR);
   close(srv->listen_fd);
   if (srv->thr.joinable()) srv->thr.join();
+  {
+    // unblock workers parked in read_full on live client connections
+    std::lock_guard<std::mutex> g(srv->conn_mu);
+    for (int fd : srv->conn_fds) shutdown(fd, SHUT_RDWR);
+  }
   for (auto& w : srv->workers)
     if (w.joinable()) w.join();
   delete srv;

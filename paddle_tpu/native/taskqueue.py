@@ -81,7 +81,12 @@ class TaskQueue:
         self._h = self._lib.tq_create(timeout_ms, max_retries)
 
     def add_task(self, payload: bytes) -> int:
-        return self._lib.tq_add_task(self._h, payload, len(payload))
+        tid = self._lib.tq_add_task(self._h, payload, len(payload))
+        if tid == 0:
+            raise ValueError(
+                f"task payload of {len(payload)} bytes exceeds the "
+                f"{_MAX_PAYLOAD}-byte cap (payloads are task specs, not data)")
+        return tid
 
     def add_file_chunks(self, path: str, chunks_per_task: int = 1) -> int:
         """Partition a recordio file into chunk-range tasks (reference:
@@ -115,6 +120,9 @@ class TaskQueue:
         return status, tid.value, buf.raw[: plen.value]
 
     def finish_task(self, task_id: int):
+        """No-op (like the Go master) if the lease already timed out and
+        the task was re-queued or completed elsewhere; raises only for an
+        id the master never issued."""
         if self._lib.tq_finish_task(self._h, task_id) < 0:
             raise KeyError(f"unknown task id {task_id}")
 
@@ -215,6 +223,8 @@ class MasterClient:
 
     def add_task(self, payload: bytes) -> int:
         resp = self._call(bytes([_OP_ADD]) + payload)
+        if resp[0] != 0:
+            raise ValueError("task payload rejected (exceeds size cap)")
         return struct.unpack_from("<Q", resp, 1)[0]
 
     def start(self):
@@ -279,8 +289,8 @@ class MasterClient:
 
                     time.sleep(0.05)
                     continue
-                spec = json.loads(payload.decode())
                 try:
+                    spec = json.loads(payload.decode())
                     from paddle_tpu.native.recordio import RecordReader
 
                     with RecordReader(spec["path"], spec["chunk_begin"],

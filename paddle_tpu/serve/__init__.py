@@ -1,0 +1,8 @@
+"""Serving/deployment: self-contained compiled inference artifacts and
+the C inference ABI (reference: paddle/capi + merge_model)."""
+
+from paddle_tpu.serve.artifact import (
+    CompiledModel,
+    export_compiled_model,
+    load_compiled_model,
+)

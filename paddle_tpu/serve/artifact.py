@@ -1,0 +1,105 @@
+"""Self-contained compiled inference artifacts.
+
+The reference deploys a model as ONE file holding weights + topology
+(reference: python/paddle/utils/merge_model.py, trainer/MergeModel.cpp),
+loaded by the C inference API (reference: capi/gradient_machine.h:36
+paddle_gradient_machine_create_for_inference_with_parameters). The
+TPU-native artifact is the XLA-era equivalent: the jitted forward —
+weights folded in as constants — serialized as a portable StableHLO
+program via jax.export, plus a JSON signature. Loading needs no model
+code, only jax.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import tarfile
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_META_NAME = "meta.json"
+_PROGRAM_NAME = "program.stablehlo"
+
+FORMAT_VERSION = 1
+
+
+class CompiledModel:
+    """A deserialized compiled forward: call .predict(*inputs)."""
+
+    def __init__(self, exported, meta: dict):
+        self._exported = exported
+        self.meta = meta
+
+    @property
+    def input_signature(self):
+        return self.meta["inputs"]
+
+    @property
+    def output_signature(self):
+        return self.meta["outputs"]
+
+    def predict(self, *inputs):
+        arrs = [jnp.asarray(x) for x in inputs]
+        sig = self.meta["inputs"]
+        if len(arrs) != len(sig):
+            raise ValueError(
+                f"model takes {len(sig)} inputs, got {len(arrs)}")
+        for a, s in zip(arrs, sig):
+            if list(a.shape) != s["shape"]:
+                raise ValueError(
+                    f"input shape {list(a.shape)} != exported {s['shape']}")
+        out = self._exported.call(*arrs)
+        return out
+
+
+def export_compiled_model(
+    forward: Callable,
+    example_inputs: Sequence[Any],
+    path: str,
+    *,
+    name: str = "model",
+    extra_meta: Optional[dict] = None,
+) -> None:
+    """Export `forward(*inputs)` (weights closed over, folded into the
+    program) to a single-file artifact at `path`."""
+    shapes = [jax.ShapeDtypeStruct(np.shape(x), jnp.asarray(x).dtype)
+              for x in example_inputs]
+    exported = jax.export.export(jax.jit(forward))(*shapes)
+    program = exported.serialize()
+
+    outs = jax.eval_shape(forward, *shapes)
+    out_list = jax.tree_util.tree_leaves(outs)
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "name": name,
+        "inputs": [{"shape": list(s.shape), "dtype": str(s.dtype)}
+                   for s in shapes],
+        "outputs": [{"shape": list(o.shape), "dtype": str(o.dtype)}
+                    for o in out_list],
+    }
+    if extra_meta:
+        meta.update(extra_meta)
+
+    with tarfile.open(path, "w") as tar:
+        mb = json.dumps(meta, indent=1).encode()
+        info = tarfile.TarInfo(_META_NAME)
+        info.size = len(mb)
+        tar.addfile(info, io.BytesIO(mb))
+        info = tarfile.TarInfo(_PROGRAM_NAME)
+        info.size = len(program)
+        tar.addfile(info, io.BytesIO(program))
+
+
+def load_compiled_model(path: str) -> CompiledModel:
+    with tarfile.open(path, "r") as tar:
+        meta = json.loads(tar.extractfile(_META_NAME).read().decode())
+        if meta.get("format_version") != FORMAT_VERSION:
+            raise ValueError(
+                f"unsupported artifact version {meta.get('format_version')}")
+        program = tar.extractfile(_PROGRAM_NAME).read()
+    exported = jax.export.deserialize(program)
+    return CompiledModel(exported, meta)

@@ -1,0 +1,71 @@
+"""Python side of the C inference ABI.
+
+The C library (native/src/capi.cc) embeds CPython — exactly as the
+reference's C++ engine embedded Python for its config parser (reference:
+utils/PythonUtil.h:47) — and calls these functions with raw byte buffers.
+Mirrors capi/gradient_machine.h: load-with-merged-parameters, forward,
+shared-model clones for multi-thread serving are free here because
+CompiledModel.predict is pure/reentrant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import List, Tuple
+
+import numpy as np
+
+if os.environ.get("PADDLE_TPU_PLATFORM"):
+    # Embedded-interpreter hosts can't easily reach jax.config before this
+    # module loads; honor an env override (e.g. "cpu" for tests) here.
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["PADDLE_TPU_PLATFORM"])
+
+from paddle_tpu.serve.artifact import CompiledModel, load_compiled_model
+
+_models = {}
+_next_id = [1]
+_lock = threading.Lock()
+
+
+def load(path: str) -> int:
+    model = load_compiled_model(path)
+    with _lock:
+        mid = _next_id[0]
+        _next_id[0] += 1
+        _models[mid] = model
+    return mid
+
+
+def signature(mid: int) -> str:
+    return json.dumps(_models[mid].meta)
+
+
+def forward(mid: int, in_bufs: List[bytes]) -> List[Tuple[bytes, str, List[int]]]:
+    """in_bufs: one raw buffer per exported input (dtype/shape from the
+    signature). Returns [(bytes, dtype_str, shape), ...] per output."""
+    model = _models[mid]
+    sig = model.meta["inputs"]
+    if len(in_bufs) != len(sig):
+        raise ValueError(f"expected {len(sig)} inputs, got {len(in_bufs)}")
+    arrays = []
+    for buf, s in zip(in_bufs, sig):
+        a = np.frombuffer(buf, dtype=np.dtype(s["dtype"]))
+        arrays.append(a.reshape(s["shape"]))
+    outs = model.predict(*arrays)
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(outs)
+    result = []
+    for o in leaves:
+        o = np.asarray(o)
+        result.append((o.tobytes(), str(o.dtype), list(o.shape)))
+    return result
+
+
+def release(mid: int) -> None:
+    with _lock:
+        _models.pop(mid, None)

@@ -1,0 +1,104 @@
+/* C driver for the inference ABI — the parity check for the reference's
+ * capi consumer programs (reference: capi/examples, go/pserver/client/c/
+ * test/test_cclient.c style: a real C main driving the library).
+ *
+ * Usage: capi_driver <libpaddle_tpu_capi.so> <repo_root> <artifact.tar>
+ *        <n_floats_in> <n_floats_out_expected>
+ * Feeds an all-0.5 buffer, checks output count and finiteness, prints
+ * the first output value as "OUT0 <v>".
+ */
+#include <dlfcn.h>
+#include <math.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef int (*pt_init_t)(const char*);
+typedef void* (*pt_load_t)(const char*);
+typedef const char* (*pt_signature_t)(void*);
+typedef int (*pt_forward_t)(void*, const char**, const uint64_t*, int,
+                            char***, uint64_t**, int*);
+typedef void (*pt_free_outputs_t)(char**, uint64_t*, int);
+typedef void (*pt_release_t)(void*);
+typedef const char* (*pt_last_error_t)(void);
+
+int main(int argc, char** argv) {
+  if (argc != 6) {
+    fprintf(stderr, "usage: %s lib.so repo_root artifact n_in n_out\n",
+            argv[0]);
+    return 2;
+  }
+  void* lib = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!lib) {
+    fprintf(stderr, "dlopen: %s\n", dlerror());
+    return 2;
+  }
+  pt_init_t pt_init = (pt_init_t)dlsym(lib, "pt_init");
+  pt_load_t pt_load = (pt_load_t)dlsym(lib, "pt_load");
+  pt_signature_t pt_signature = (pt_signature_t)dlsym(lib, "pt_signature");
+  pt_forward_t pt_forward = (pt_forward_t)dlsym(lib, "pt_forward");
+  pt_free_outputs_t pt_free_outputs =
+      (pt_free_outputs_t)dlsym(lib, "pt_free_outputs");
+  pt_release_t pt_release = (pt_release_t)dlsym(lib, "pt_release");
+  pt_last_error_t pt_last_error =
+      (pt_last_error_t)dlsym(lib, "pt_last_error");
+  if (!pt_init || !pt_load || !pt_forward || !pt_free_outputs ||
+      !pt_release || !pt_signature || !pt_last_error) {
+    fprintf(stderr, "missing symbols\n");
+    return 2;
+  }
+
+  if (pt_init(argv[2]) != 0) {
+    fprintf(stderr, "pt_init: %s\n", pt_last_error());
+    return 1;
+  }
+  void* model = pt_load(argv[3]);
+  if (!model) {
+    fprintf(stderr, "pt_load: %s\n", pt_last_error());
+    return 1;
+  }
+  printf("SIGNATURE %s\n", pt_signature(model));
+
+  long n_in = strtol(argv[4], NULL, 10);
+  long n_out_expected = strtol(argv[5], NULL, 10);
+  float* in = (float*)malloc(sizeof(float) * n_in);
+  for (long i = 0; i < n_in; i++) in[i] = 0.5f;
+  const char* in_bufs[1] = {(const char*)in};
+  uint64_t in_lens[1] = {(uint64_t)(sizeof(float) * n_in)};
+
+  char** out_bufs;
+  uint64_t* out_lens;
+  int n_out;
+  if (pt_forward(model, in_bufs, in_lens, 1, &out_bufs, &out_lens, &n_out) !=
+      0) {
+    fprintf(stderr, "pt_forward: %s\n", pt_last_error());
+    return 1;
+  }
+  uint64_t total = 0;
+  for (int i = 0; i < n_out; i++) total += out_lens[i] / sizeof(float);
+  if (total != (uint64_t)n_out_expected) {
+    fprintf(stderr, "expected %ld output floats, got %llu\n", n_out_expected,
+            (unsigned long long)total);
+    return 1;
+  }
+  float* out0 = (float*)out_bufs[0];
+  for (uint64_t i = 0; i < out_lens[0] / sizeof(float); i++) {
+    if (!isfinite(out0[i])) {
+      fprintf(stderr, "non-finite output\n");
+      return 1;
+    }
+  }
+  printf("OUT0 %f\n", out0[0]);
+
+  /* second forward on the same handle (serving reuse) */
+  if (pt_forward(model, in_bufs, in_lens, 1, &out_bufs, &out_lens, &n_out) !=
+      0) {
+    fprintf(stderr, "second pt_forward: %s\n", pt_last_error());
+    return 1;
+  }
+  pt_free_outputs(out_bufs, out_lens, n_out);
+  pt_release(model);
+  free(in);
+  printf("CAPI_OK\n");
+  return 0;
+}

@@ -1,0 +1,98 @@
+"""CLI driver (reference: `paddle train|merge_model|dump_config|version`,
+scripts/submit_local.sh.in:3-14). Runs in-process via cli.main."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.cli import main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CONFIG = """
+import jax.numpy as jnp
+import numpy as np
+from paddle_tpu import nn, optim
+from paddle_tpu.ops import losses
+
+
+def _reader():
+    rng = np.random.RandomState(0)
+    for _ in range(128):
+        x = rng.rand(16).astype(np.float32)
+        yield x, int(x.sum() > 8)
+
+
+def get_config():
+    return {
+        "name": "toy_mlp",
+        "model": nn.Sequential(
+            [nn.Dense(32, name="fc1", activation="relu"),
+             nn.Dense(2, name="logits")]),
+        "input_spec": (32, 16),
+        "optimizer": optim.adam(1e-2),
+        "loss_fn": lambda lo, la: jnp.mean(
+            losses.softmax_cross_entropy(lo, la)),
+        "reader": _reader,
+        "num_passes": 2,
+    }
+"""
+
+
+@pytest.fixture
+def config_file(tmp_path):
+    p = tmp_path / "config.py"
+    p.write_text(CONFIG)
+    return str(p)
+
+
+def test_version(capsys):
+    assert main(["version"]) == 0
+    out = capsys.readouterr().out
+    assert "paddle_tpu" in out and "jax" in out
+
+
+def test_dump_config(config_file, capsys):
+    assert main(["dump-config", "--config", config_file]) == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["input_shape"] == [32, 16]
+    # fc1: 16*32 + 32; logits: 32*2 + 2
+    assert d["num_parameters"] == 16 * 32 + 32 + 32 * 2 + 2
+    assert any("kernel" in k for k in d["parameters"])
+
+
+def test_train_save_merge_infer(config_file, tmp_path, capsys):
+    save_dir = str(tmp_path / "out")
+    assert main(["train", "--config", config_file, "--batch-size", "32",
+                 "--save-dir", save_dir]) == 0
+    out = capsys.readouterr().out
+    assert "pass 0 batch 0" in out
+    params_tar = os.path.join(save_dir, "params.tar")
+    assert os.path.exists(params_tar)
+
+    artifact = str(tmp_path / "model.ptc")
+    assert main(["merge-model", "--config", config_file,
+                 "--params", params_tar, "--output", artifact]) == 0
+    capsys.readouterr()
+
+    x = np.random.RandomState(0).rand(32, 16).astype(np.float32)
+    xnpy = str(tmp_path / "x.npy")
+    np.save(xnpy, x)
+    prefix = str(tmp_path / "y")
+    assert main(["infer", "--artifact", artifact,
+                 "--output-prefix", prefix, xnpy]) == 0
+    y = np.load(prefix + "0.npy")
+    assert y.shape == (32, 2)
+    assert np.isfinite(y).all()
+
+
+def test_cli_subprocess_entry():
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run([sys.executable, "-m", "paddle_tpu", "version"],
+                       capture_output=True, text=True, env=env, timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "paddle_tpu" in r.stdout

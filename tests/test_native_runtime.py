@@ -159,6 +159,38 @@ def test_taskqueue_snapshot_recover(tmp_path):
     assert q2.counts()["done"] == 4
 
 
+def test_taskqueue_payload_cap():
+    q = TaskQueue()
+    with pytest.raises(ValueError, match="cap"):
+        q.add_task(b"x" * (2 << 20))
+
+
+def test_stale_finish_is_noop():
+    # worker outlives its lease; the requeued task's finish must not raise
+    q = _make_queue(1, timeout_ms=60, max_retries=3)
+    st, tid, _ = q.get_task()
+    assert st == TaskStatus.OK
+    time.sleep(0.12)  # lease expires...
+    assert q.counts()["todo"] == 1  # ...and timeout processing requeues it
+    q.finish_task(tid)  # stale finish: tolerated no-op
+    st2, tid2, _ = q.get_task()
+    assert st2 == TaskStatus.OK
+    q.finish_task(tid2)
+    with pytest.raises(KeyError):
+        q.finish_task(99999)  # never-issued ids still rejected
+
+
+def test_late_finish_before_timeout_processing_counts():
+    # lease expired but no queue operation has run timeout processing yet:
+    # the late finish is accepted (work did complete; no requeue needed)
+    q = _make_queue(1, timeout_ms=60, max_retries=3)
+    _, tid, _ = q.get_task()
+    time.sleep(0.12)
+    q.finish_task(tid)
+    assert q.counts() == {"todo": 0, "pending": 0, "done": 1,
+                          "discarded": 0}
+
+
 def test_save_model_election():
     q = _make_queue(1)
     assert q.request_save_model(trainer_id=0, ttl_ms=60000)
@@ -228,6 +260,50 @@ def test_master_multiple_workers_share_tasks():
         assert sorted(results) == sorted(f"w-{i}".encode() for i in range(40))
         assert len(set(results)) == 40  # exactly-once on the happy path
         setup.close()
+
+
+def test_server_stop_with_open_client_connection():
+    """stop() must not deadlock while a client connection is parked."""
+    q = TaskQueue()
+    srv = MasterServer(q)
+    cli = MasterClient(port=srv.port)
+    cli.add_task(b"t")
+
+    done = threading.Event()
+
+    def stopper():
+        srv.stop()
+        done.set()
+
+    t = threading.Thread(target=stopper)
+    t.start()
+    t.join(timeout=10)
+    assert done.is_set(), "MasterServer.stop() deadlocked on open client"
+    cli.close()
+
+
+def test_malformed_frame_rejected():
+    import socket
+    import struct as st
+
+    q = TaskQueue()
+    with MasterServer(q) as srv:
+        s = socket.create_connection(("127.0.0.1", srv.port))
+        # OP_FINISH with no id bytes: must get an error status, not crash
+        s.sendall(st.pack("<I", 1) + bytes([2]))
+        hdr = s.recv(4)
+        (n,) = st.unpack("<I", hdr)
+        resp = s.recv(n)
+        assert resp[0] == 254
+        s.close()
+        # master still functional afterwards
+        cli = MasterClient(port=srv.port)
+        cli.add_task(b"ok")
+        cli.start()
+        status, tid, payload = cli.get_task()
+        assert status == TaskStatus.OK and payload == b"ok"
+        cli.finish_task(tid)
+        cli.close()
 
 
 # ---- end-to-end: recordio dataset partitioned into tasks, streamed ----

@@ -1,0 +1,99 @@
+"""Compiled inference artifacts + C ABI (reference: paddle/capi,
+merge_model single-file deployment)."""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu import models, nn
+from paddle_tpu.nn.module import ShapeSpec
+from paddle_tpu.serve import (export_compiled_model, load_compiled_model)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _export_mlp(path, batch=4, din=16, dout=3):
+    model = nn.Sequential([nn.Dense(8, activation="relu"), nn.Dense(dout)])
+    params, mstate = model.init(jax.random.key(0), ShapeSpec((batch, din)))
+
+    def forward(x):
+        out, _ = model.apply(params, mstate, x, training=False)
+        return out
+
+    x = np.random.RandomState(0).rand(batch, din).astype(np.float32)
+    export_compiled_model(forward, [x], path, name="mlp")
+    return forward, x
+
+
+def test_artifact_roundtrip(tmp_path):
+    path = str(tmp_path / "mlp.ptc")
+    forward, x = _export_mlp(path)
+    m = load_compiled_model(path)
+    assert m.meta["name"] == "mlp"
+    assert m.input_signature[0]["shape"] == [4, 16]
+    got = m.predict(x)
+    want = forward(x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_artifact_input_validation(tmp_path):
+    path = str(tmp_path / "mlp.ptc")
+    _export_mlp(path)
+    m = load_compiled_model(path)
+    with pytest.raises(ValueError, match="takes 1 inputs"):
+        m.predict(np.zeros((4, 16), np.float32), np.zeros(3))
+    with pytest.raises(ValueError, match="input shape"):
+        m.predict(np.zeros((2, 16), np.float32))
+
+
+def test_artifact_needs_no_model_code(tmp_path):
+    """Loading runs in a fresh process that never builds the model."""
+    path = str(tmp_path / "mlp.ptc")
+    _, x = _export_mlp(path)
+    code = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from paddle_tpu.serve import load_compiled_model
+m = load_compiled_model({path!r})
+x = np.random.RandomState(0).rand(4, 16).astype(np.float32)
+out = np.asarray(m.predict(x))
+assert out.shape == (4, 3), out.shape
+assert np.isfinite(out).all()
+print("STANDALONE_OK")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=300)
+    assert "STANDALONE_OK" in r.stdout, r.stderr[-2000:]
+
+
+def test_capi_end_to_end(tmp_path):
+    """Real C program drives the embedded-interpreter inference ABI."""
+    from paddle_tpu.native.build import ensure_capi_built
+
+    capi = ensure_capi_built()
+    artifact = str(tmp_path / "mlp.ptc")
+    forward, x = _export_mlp(artifact)
+    want = np.asarray(forward(np.full((4, 16), 0.5, np.float32)))
+
+    driver_src = os.path.join(REPO, "tests", "capi_driver.c")
+    driver = str(tmp_path / "capi_driver")
+    subprocess.run(["gcc", "-O1", "-o", driver, driver_src, "-ldl", "-lm"],
+                   check=True, capture_output=True, text=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PADDLE_TPU_PLATFORM="cpu",
+               PYTHONPATH=REPO)
+    r = subprocess.run(
+        [driver, capi, REPO, artifact, str(4 * 16), str(4 * 3)],
+        capture_output=True, text=True, env=env, timeout=600)
+    assert r.returncode == 0, (r.stdout, r.stderr[-2000:])
+    assert "CAPI_OK" in r.stdout
+    out0 = float([l for l in r.stdout.splitlines()
+                  if l.startswith("OUT0")][0].split()[1])
+    assert out0 == pytest.approx(float(want[0, 0]), rel=1e-4)
