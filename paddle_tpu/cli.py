@@ -128,9 +128,11 @@ def cmd_train(args) -> int:
         if isinstance(ev, E.EndPass):
             print(f"=== pass {ev.pass_id} done ===")
 
+    # explicit --num-passes wins over the config's num_passes
+    num_passes = (args.num_passes if args.num_passes is not None
+                  else cfg.get("num_passes", 1))
     state = trainer.train(
-        state, batches, num_passes=cfg.get("num_passes", args.num_passes),
-        event_handler=handler)
+        state, batches, num_passes=num_passes, event_handler=handler)
     if args.save_dir:
         import os
 
@@ -241,7 +243,8 @@ def build_parser() -> argparse.ArgumentParser:
     t = sub.add_parser("train")
     t.add_argument("--config", required=True)
     t.add_argument("--batch-size", type=int, default=32)
-    t.add_argument("--num-passes", type=int, default=1)
+    t.add_argument("--num-passes", type=int, default=None,
+                   help="overrides the config's num_passes (default 1)")
     t.add_argument("--learning-rate", type=float, default=0.01)
     t.add_argument("--log-period", type=int, default=10)
     t.add_argument("--save-dir", default=None)

@@ -179,11 +179,21 @@ int tq_finish_task(void* h, uint64_t id) {
   return 0;
 }
 
+// Same stale-id tolerance as tq_finish_task: a fail for a lease that
+// already timed out (task back on todo / done / discarded) is a no-op.
 int tq_fail_task(void* h, uint64_t id) {
   auto* q = static_cast<Queue*>(h);
   std::lock_guard<std::mutex> g(q->mu);
   auto it = q->pending.find(id);
-  if (it == q->pending.end()) return -1;
+  if (it == q->pending.end()) {
+    for (const auto& t : q->todo)
+      if (t.id == id) return 1;
+    for (const auto& d : q->done)
+      if (d.id == id) return 1;
+    for (const auto& t : q->discarded)
+      if (t.id == id) return 1;
+    return -1;
+  }
   Task t = std::move(it->second.first);
   q->pending.erase(it);
   t.failures++;

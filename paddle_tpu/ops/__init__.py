@@ -15,6 +15,7 @@ from paddle_tpu.ops import crf
 from paddle_tpu.ops import ctc
 from paddle_tpu.ops import detection
 from paddle_tpu.ops import embedding
+from paddle_tpu.ops import flash_attention
 from paddle_tpu.ops import linalg
 from paddle_tpu.ops import losses
 from paddle_tpu.ops import metrics

@@ -1,0 +1,253 @@
+"""Flash attention — Pallas TPU kernel for the hot attention path.
+
+The reference predates attention kernels entirely (its attention is the
+additive `simple_attention` composed from layers, reference:
+python/paddle/trainer_config_helpers/networks.py:1320); the TPU-native
+framework makes fused O(T) -memory attention a first-class op:
+
+  * forward: a Pallas kernel tiled for the MXU (q blocks in VMEM,
+    streaming-softmax accumulation over k/v blocks) that never
+    materialises the [T, T] score matrix and also emits the row
+    log-sum-exp needed by the backward;
+  * backward: blockwise recomputation in plain JAX (lax.scan over k
+    blocks) — O(T·block) memory, XLA-fused matmuls;
+  * composes with the mesh: wrap in shard_map and the seq axis via
+    parallel.ring_attention for context parallelism, or shard heads.
+
+On non-TPU backends the kernel runs in Pallas interpret mode (tests) —
+production CPU users should prefer ops in dense form.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific memory spaces; absent on some builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
+_LANE = 128  # TPU minimum tile width (lane count)
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                 *, scale: float, causal: bool, t_kv: int):
+    """One (batch*head, q-block, k-block) grid step. The innermost grid
+    dim walks k/v blocks sequentially (TPU grids are sequential), so
+    VMEM scratch (acc/m/l) carries streaming-softmax state across k
+    steps; only one [BK, D] k/v tile is resident at a time.
+
+    Refs: q [1,BQ,D]; k/v [1,BK,D]; o [1,BQ,D]; lse [1,BQ,LANE];
+    scratch acc [BQ,D] f32, m/l [BQ,LANE] f32.
+    """
+    qi = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+    bq = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # causal: k blocks entirely above the diagonal contribute nothing
+    needed = True if not causal else (j * block_k <= (qi + 1) * bq - 1)
+
+    @pl.when(needed)
+    def _compute():
+        # native-dtype (e.g. bf16) operands on the MXU, f32 accumulation
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [BQ, BK]
+        kpos = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, block_k), 1)
+        valid = kpos < t_kv                            # tail padding
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            valid = valid & (qpos >= kpos)
+        s = jnp.where(valid, s, NEG_INF)
+        m_prev = m_ref[:, :1]                          # [BQ, 1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)                # [BQ, 1]
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jax.lax.broadcast_in_dim(
+            m_new[:, 0], m_ref.shape, (0,))
+        l_ref[:] = jax.lax.broadcast_in_dim(
+            l_new[:, 0], l_ref.shape, (0,))
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l_safe = jnp.maximum(l_ref[:, :1], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:] + jnp.log(jnp.maximum(l_ref[:], 1e-30))
+
+
+def _pad_to(x, size, axis):
+    pad = size - x.shape[axis]
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    """q,k,v: [BH, T, D] -> (o [BH, T, D], lse [BH, T])."""
+    if pltpu is None:
+        raise NotImplementedError(
+            "Pallas TPU support is unavailable in this jax build; use "
+            "parallel.dense_attention instead")
+    bh, t, d = q.shape
+    t_kv = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, max(t, 1))
+    block_k = min(block_k, max(t_kv, 1))
+    tq_pad = pl.cdiv(t, block_q) * block_q
+    tk_pad = pl.cdiv(t_kv, block_k) * block_k
+    qp = _pad_to(q, tq_pad, 1)
+    kp = _pad_to(k, tk_pad, 1)
+    vp = _pad_to(v, tk_pad, 1)
+
+    grid = (bh, tq_pad // block_q, tk_pad // block_k)
+    kwargs = dict(memory_space=_VMEM) if (_VMEM is not None
+                                          and not interpret) else {}
+    scratch = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, _LANE), jnp.float32),
+        pltpu.VMEM((block_q, _LANE), jnp.float32),
+    ]
+    o, lse = pl.pallas_call(
+        functools.partial(_attn_kernel, scale=scale, causal=causal,
+                          t_kv=t_kv),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         **kwargs),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         **kwargs),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         **kwargs),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         **kwargs),
+            pl.BlockSpec((1, block_q, _LANE), lambda b, i, j: (b, i, 0),
+                         **kwargs),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, tq_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, tq_pad, _LANE), jnp.float32),
+        ],
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(qp, kp, vp)
+    return o[:, :t], lse[:, :t, 0]
+
+
+def _blockwise_backward(q, k, v, o, lse, g, *, causal: bool, block_k: int):
+    """Recompute-based flash backward in plain JAX, O(T·block) memory."""
+    bh, t, d = q.shape
+    t_kv = k.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    qf = q.astype(jnp.float32) * scale
+    gf = g.astype(jnp.float32)
+    delta = jnp.sum(gf * o.astype(jnp.float32), axis=-1)   # [BH, T]
+
+    tk_pad = pl.cdiv(t_kv, block_k) * block_k
+    kp = _pad_to(k.astype(jnp.float32), tk_pad, 1)
+    vp = _pad_to(v.astype(jnp.float32), tk_pad, 1)
+    kb = kp.reshape(bh, tk_pad // block_k, block_k, d).transpose(1, 0, 2, 3)
+    vb = vp.reshape(bh, tk_pad // block_k, block_k, d).transpose(1, 0, 2, 3)
+    kpos_base = jnp.arange(block_k)
+    qpos = jnp.arange(t)
+
+    def step(dq_acc, blk):
+        j, kj, vj = blk                                    # kj/vj [BH,BK,D]
+        s = jnp.einsum("bqd,bkd->bqk", qf, kj)
+        kpos = j * block_k + kpos_base
+        valid = (kpos < t_kv)[None, None, :]
+        if causal:
+            valid = valid & (qpos[None, :, None] >= kpos[None, None, :])
+        p = jnp.where(valid, jnp.exp(s - lse[..., None]), 0.0)  # [BH,Tq,BK]
+        dv = jnp.einsum("bqk,bqd->bkd", p, gf)
+        dp = jnp.einsum("bqd,bkd->bqk", gf, vj)
+        ds = p * (dp - delta[..., None])
+        dq_acc = dq_acc + jnp.einsum("bqk,bkd->bqd", ds, kj)
+        dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+        return dq_acc, (dk, dv)
+
+    nblk = tk_pad // block_k
+    dq, (dks, dvs) = jax.lax.scan(
+        step, jnp.zeros((bh, t, d), jnp.float32),
+        (jnp.arange(nblk), kb, vb))
+    dk = dks.transpose(1, 0, 2, 3).reshape(bh, tk_pad, d)[:, :t_kv]
+    dv = dvs.transpose(1, 0, 2, 3).reshape(bh, tk_pad, d)[:, :t_kv]
+    return ((dq * scale).astype(q.dtype), dk.astype(k.dtype),
+            dv.astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    interpret = jax.default_backend() != "tpu"
+    o, _ = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                          block_k=block_k, interpret=interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    interpret = jax.default_backend() != "tpu"
+    o, lse = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+                            block_k=block_k, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, res, g):
+    q, k, v, o, lse = res
+    return _blockwise_backward(q, k, v, o, lse, g, causal=causal,
+                               block_k=block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    """Fused scaled-dot-product attention.
+
+    q: [B, Tq, H, D]; k, v: [B, Tkv, H, D]. Returns [B, Tq, H, D].
+    O(T·block) memory; exact (fp32 accumulation internally).
+    """
+    if q.ndim != 4:
+        raise ValueError(f"expected [B, T, H, D], got {q.shape}")
+    b, t, h, d = q.shape
+    t_kv = k.shape[1]
+
+    def flat(x, tt):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, tt, d)
+
+    o = _flash(flat(q, t), flat(k, t_kv), flat(v, t_kv), causal, block_q,
+               block_k)
+    return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)

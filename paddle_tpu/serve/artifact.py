@@ -71,8 +71,7 @@ def export_compiled_model(
     exported = jax.export.export(jax.jit(forward))(*shapes)
     program = exported.serialize()
 
-    outs = jax.eval_shape(forward, *shapes)
-    out_list = jax.tree_util.tree_leaves(outs)
+    out_list = list(exported.out_avals)  # already traced during export
     meta = {
         "format_version": FORMAT_VERSION,
         "name": name,
