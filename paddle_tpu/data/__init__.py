@@ -3,6 +3,7 @@
 from paddle_tpu.data import reader
 from paddle_tpu.data import batch
 from paddle_tpu.data import datasets
+from paddle_tpu.data import dataset_zoo
 from paddle_tpu.data.batch import (
     batch as batch_reader,
     SequenceBatch,

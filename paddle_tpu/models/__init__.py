@@ -13,3 +13,5 @@ from paddle_tpu.models import googlenet
 from paddle_tpu.models import text_lstm
 from paddle_tpu.models import bilstm_crf
 from paddle_tpu.models import seq2seq_attn
+from paddle_tpu.models import gan
+from paddle_tpu.models import vae

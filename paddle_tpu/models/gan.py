@@ -1,0 +1,133 @@
+"""GAN — generator + discriminator trained adversarially with two
+optimizers (reference: v1_api_demo/gan/gan_trainer.py, which builds two
+GradientMachines over a shared config and alternates d/g updates).
+
+TPU-native shape: both nets are ordinary Layer modules; the two update
+steps are jitted pure functions over a combined train state, so the
+whole alternation compiles to two XLA programs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import nn, optim
+from paddle_tpu.nn.module import ShapeSpec
+from paddle_tpu.ops import losses
+
+
+def mlp_generator(out_dim: int, noise_dim: int = 64,
+                  hidden: Tuple[int, ...] = (256, 256)) -> nn.Layer:
+    """Noise [B, noise_dim] -> sample [B, out_dim] in (0, 1) (reference:
+    gan_conf.py generator: fc stack + sigmoid-ish output)."""
+    layers = [nn.Dense(h, activation="relu", name=f"g_fc{i}")
+              for i, h in enumerate(hidden)]
+    layers.append(nn.Dense(out_dim, activation="sigmoid", name="g_out"))
+    return nn.Sequential(layers)
+
+
+def mlp_discriminator(hidden: Tuple[int, ...] = (256, 256)) -> nn.Layer:
+    """Sample [B, D] -> logit [B, 1] (real vs fake)."""
+    layers = [nn.Dense(h, activation="relu", name=f"d_fc{i}")
+              for i, h in enumerate(hidden)]
+    layers.append(nn.Dense(1, name="d_out"))
+    return nn.Sequential(layers)
+
+
+@dataclasses.dataclass
+class GANState:
+    g_params: Any
+    g_state: Any
+    g_opt: Any
+    d_params: Any
+    d_state: Any
+    d_opt: Any
+    step: int = 0
+
+
+jax.tree_util.register_dataclass(
+    GANState,
+    data_fields=["g_params", "g_state", "g_opt", "d_params", "d_state",
+                 "d_opt", "step"],
+    meta_fields=[])
+
+
+class GANTrainer:
+    """Alternating adversarial trainer (reference: gan_trainer.py
+    prepare_discriminator_data_batch / train d then g per iteration)."""
+
+    def __init__(self, generator: nn.Layer, discriminator: nn.Layer,
+                 data_dim: int, noise_dim: int = 64,
+                 g_optimizer=None, d_optimizer=None):
+        self.g, self.d = generator, discriminator
+        self.data_dim, self.noise_dim = data_dim, noise_dim
+        self.g_optim = g_optimizer or optim.adam(2e-4, beta1=0.5)
+        self.d_optim = d_optimizer or optim.adam(2e-4, beta1=0.5)
+        self._d_step = jax.jit(self._d_step_impl)
+        self._g_step = jax.jit(self._g_step_impl, static_argnums=2)
+
+    def init_state(self, rng, batch_size: int) -> GANState:
+        rg, rd = jax.random.split(rng)
+        g_params, g_state = self.g.init(
+            rg, ShapeSpec((batch_size, self.noise_dim)))
+        d_params, d_state = self.d.init(
+            rd, ShapeSpec((batch_size, self.data_dim)))
+        return GANState(
+            g_params, g_state, self.g_optim.init(g_params),
+            d_params, d_state, self.d_optim.init(d_params))
+
+    def _gen(self, g_params, g_state, rng, n):
+        z = jax.random.normal(rng, (n, self.noise_dim))
+        fake, _ = self.g.apply(g_params, g_state, z, training=True, rng=rng)
+        return fake
+
+    def _d_step_impl(self, state: GANState, real, rng):
+        def loss_fn(d_params):
+            fake = self._gen(state.g_params, state.g_state, rng,
+                             real.shape[0])
+            logit_r, _ = self.d.apply(d_params, state.d_state, real,
+                                      training=True, rng=rng)
+            logit_f, _ = self.d.apply(d_params, state.d_state, fake,
+                                      training=True, rng=rng)
+            # non-saturating GAN loss: real->1, fake->0
+            lr = losses.sigmoid_cross_entropy(
+                logit_r[:, 0], jnp.ones(real.shape[0]))
+            lf = losses.sigmoid_cross_entropy(
+                logit_f[:, 0], jnp.zeros(real.shape[0]))
+            return jnp.mean(lr) + jnp.mean(lf)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.d_params)
+        d_params, d_opt = self.d_optim.update(grads, state.d_opt,
+                                              state.d_params, state.step)
+        return dataclasses.replace(state, d_params=d_params, d_opt=d_opt,
+                                   step=state.step + 1), loss
+
+    def _g_step_impl(self, state: GANState, rng, batch_size: int):
+        def loss_fn(g_params):
+            fake = self._gen(g_params, state.g_state, rng, batch_size)
+            logit_f, _ = self.d.apply(state.d_params, state.d_state, fake,
+                                      training=True, rng=rng)
+            return jnp.mean(losses.sigmoid_cross_entropy(
+                logit_f[:, 0], jnp.ones(batch_size)))
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.g_params)
+        g_params, g_opt = self.g_optim.update(grads, state.g_opt,
+                                              state.g_params, state.step)
+        return dataclasses.replace(state, g_params=g_params,
+                                   g_opt=g_opt), loss
+
+
+    def train_step(self, state: GANState, real, rng):
+        """One alternation: d update on (real, fake), then g update.
+        Returns (state, d_loss, g_loss)."""
+        rd, rg = jax.random.split(rng)
+        state, d_loss = self._d_step(state, real, rd)
+        state, g_loss = self._g_step(state, rg, real.shape[0])
+        return state, d_loss, g_loss
+
+    def sample(self, state: GANState, rng, n: int):
+        return self._gen(state.g_params, state.g_state, rng, n)

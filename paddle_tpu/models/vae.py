@@ -1,0 +1,67 @@
+"""Variational autoencoder (reference: v1_api_demo/vae/vae_conf.py — MLP
+encoder/decoder with the reparameterization trick, trained on MNIST).
+
+The encoder produces (mu, logvar); the ELBO loss is reconstruction
+binary CE + KL(q(z|x) || N(0, I)).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.nn.module import Layer, ShapeSpec
+from paddle_tpu.ops import losses
+
+
+class VAE(Layer):
+    """apply(x, rng) -> (reconstruction_logits, mu, logvar)."""
+
+    def __init__(self, data_dim: int, latent_dim: int = 32,
+                 hidden: Tuple[int, ...] = (256,), name: str = "vae"):
+        self.data_dim, self.latent_dim = data_dim, latent_dim
+        self.name = name
+        enc = [nn.Dense(h, activation="relu", name=f"enc{i}")
+               for i, h in enumerate(hidden)]
+        enc.append(nn.Dense(2 * latent_dim, name="enc_out"))
+        self.encoder = nn.Sequential(enc)
+        dec = [nn.Dense(h, activation="relu", name=f"dec{i}")
+               for i, h in enumerate(reversed(hidden))]
+        dec.append(nn.Dense(data_dim, name="dec_out"))
+        self.decoder = nn.Sequential(dec)
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        re, rd = jax.random.split(rng)
+        enc_p, enc_s, _ = self.encoder._init(re, spec)
+        dec_p, dec_s, out = self.decoder._init(
+            rd, ShapeSpec((spec.shape[0], self.latent_dim), spec.dtype))
+        return ({"encoder": enc_p, "decoder": dec_p},
+                {"encoder": enc_s, "decoder": dec_s}, out)
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        h, _ = self.encoder.apply(params["encoder"], state["encoder"], x,
+                                  training=training, rng=rng)
+        mu, logvar = jnp.split(h, 2, axis=-1)
+        if rng is None:
+            z = mu
+        else:
+            eps = jax.random.normal(rng, mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+        logits, _ = self.decoder.apply(params["decoder"], state["decoder"],
+                                       z, training=training, rng=rng)
+        return (logits, mu, logvar), {}
+
+    def decode(self, params, state, z):
+        logits, _ = self.decoder.apply(params["decoder"], state["decoder"], z)
+        return jax.nn.sigmoid(logits)
+
+
+def elbo_loss(outputs, x, *, kl_weight: float = 1.0):
+    """Negative ELBO: BCE(recon, x) + kl_weight * KL(q || N(0,I))."""
+    logits, mu, logvar = outputs
+    rec = jnp.sum(losses.sigmoid_cross_entropy(logits, x), axis=-1)
+    kl = 0.5 * jnp.sum(jnp.exp(logvar) + mu * mu - 1.0 - logvar, axis=-1)
+    return jnp.mean(rec + kl_weight * kl)

@@ -39,7 +39,18 @@ struct Task {
   uint64_t id = 0;
   std::string payload;
   int failures = 0;
+  uint16_t epoch = 0;  // bumped per lease; stale handles can't act
 };
+
+// Lease handles pack (epoch << 48 | id) so a finish/fail from a worker
+// whose lease timed out and was re-issued to another worker is detected
+// as stale instead of acting on the new lease.
+constexpr uint64_t kIdMask = (1ull << 48) - 1;
+
+uint64_t make_handle(const Task& t) {
+  return (static_cast<uint64_t>(t.epoch) << 48) | t.id;
+}
+
 
 // Hard cap on task payloads: the get-task wire path and in-process
 // bindings use fixed 1MB buffers. Payloads are small task specs (file
@@ -86,21 +97,39 @@ struct Queue {
   }
 };
 
+// True if the bare id exists in any terminal/requeued container — used
+// to tell a stale-but-known handle (tolerated no-op) from a bogus id.
+bool known_id_locked(Queue* q, uint64_t bare_id) {
+  for (const auto& t : q->todo)
+    if (t.id == bare_id) return true;
+  for (const auto& d : q->done)
+    if (d.id == bare_id) return true;
+  for (const auto& t : q->discarded)
+    if (t.id == bare_id) return true;
+  return false;
+}
+
 // ---- snapshot format: u64 pass, then per-section counts + tasks ----
 
 void write_task(FILE* f, const Task& t) {
   uint64_t len = t.payload.size();
   fwrite(&t.id, 8, 1, f);
   fwrite(&t.failures, 4, 1, f);
+  // epoch persists so lease handles issued before a snapshot can't
+  // collide with fresh leases after recovery
+  uint32_t epoch = t.epoch;
+  fwrite(&epoch, 4, 1, f);
   fwrite(&len, 8, 1, f);
   if (len) fwrite(t.payload.data(), len, 1, f);
 }
 
 bool read_task(FILE* f, Task* t) {
   uint64_t len;
+  uint32_t epoch;
   if (fread(&t->id, 8, 1, f) != 1 || fread(&t->failures, 4, 1, f) != 1 ||
-      fread(&len, 8, 1, f) != 1)
+      fread(&epoch, 4, 1, f) != 1 || fread(&len, 8, 1, f) != 1)
     return false;
+  t->epoch = static_cast<uint16_t>(epoch);
   t->payload.resize(len);
   return len == 0 || fread(&t->payload[0], len, 1, f) == 1;
 }
@@ -150,7 +179,8 @@ uint8_t tq_get_task(void* h, uint64_t* id, char* buf, uint64_t buf_cap,
   }
   Task t = std::move(q->todo.front());
   q->todo.pop_front();
-  *id = t.id;
+  t.epoch++;
+  *id = make_handle(t);
   *payload_len = t.payload.size();
   if (buf && buf_cap >= t.payload.size() && !t.payload.empty())
     memcpy(buf, t.payload.data(), t.payload.size());
@@ -158,42 +188,31 @@ uint8_t tq_get_task(void* h, uint64_t* id, char* buf, uint64_t buf_cap,
   return OK;
 }
 
-// 0 ok; 1 stale-but-known no-op (already done, or lease timed out and
-// the task was re-queued — the Go master likewise tolerates stale
-// finishes); -1 truly unknown id.
-int tq_finish_task(void* h, uint64_t id) {
+// 0 ok; 1 stale-but-known no-op (already done, lease timed out and the
+// task was re-queued, or the handle's lease epoch was superseded — the
+// Go master likewise tolerates stale finishes); -1 truly unknown id.
+int tq_finish_task(void* h, uint64_t handle) {
   auto* q = static_cast<Queue*>(h);
+  uint64_t id = handle & kIdMask;
   std::lock_guard<std::mutex> g(q->mu);
   auto it = q->pending.find(id);
-  if (it == q->pending.end()) {
-    for (const auto& d : q->done)
-      if (d.id == id) return 1;
-    for (const auto& t : q->todo)
-      if (t.id == id) return 1;
-    for (const auto& t : q->discarded)
-      if (t.id == id) return 1;
-    return -1;
-  }
+  if (it == q->pending.end())
+    return known_id_locked(q, id) ? 1 : -1;
+  if (make_handle(it->second.first) != handle) return 1;  // superseded lease
   q->done.push_back(std::move(it->second.first));
   q->pending.erase(it);
   return 0;
 }
 
-// Same stale-id tolerance as tq_finish_task: a fail for a lease that
-// already timed out (task back on todo / done / discarded) is a no-op.
-int tq_fail_task(void* h, uint64_t id) {
+// Same stale-handle tolerance as tq_finish_task.
+int tq_fail_task(void* h, uint64_t handle) {
   auto* q = static_cast<Queue*>(h);
+  uint64_t id = handle & kIdMask;
   std::lock_guard<std::mutex> g(q->mu);
   auto it = q->pending.find(id);
-  if (it == q->pending.end()) {
-    for (const auto& t : q->todo)
-      if (t.id == id) return 1;
-    for (const auto& d : q->done)
-      if (d.id == id) return 1;
-    for (const auto& t : q->discarded)
-      if (t.id == id) return 1;
-    return -1;
-  }
+  if (it == q->pending.end())
+    return known_id_locked(q, id) ? 1 : -1;
+  if (make_handle(it->second.first) != handle) return 1;  // superseded lease
   Task t = std::move(it->second.first);
   q->pending.erase(it);
   t.failures++;

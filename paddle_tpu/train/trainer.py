@@ -120,6 +120,74 @@ class Trainer:
         params, mstate = self.model.init(init_rng, *input_specs)
         return TrainState.create(params, mstate, self.optimizer)
 
+    def check_gradients(self, state: TrainState, batch, *,
+                        eps: float = 1e-3, num_directions: int = 4,
+                        seed: int = 0) -> float:
+        """`--job=checkgrad` equivalent (reference: Trainer::checkGradient,
+        trainer/Trainer.cpp:303-377): compare the autodiff directional
+        derivative against a central finite difference along random
+        parameter directions. Returns the worst relative error."""
+        from paddle_tpu.core import dtypes
+
+        inputs, labels = self._split_batch(batch)
+        rng = jax.random.key(seed)
+        # the check needs double precision: a float32 forward drowns the
+        # central difference in rounding noise. Enable x64 for the
+        # duration (the reference's checkgrad is likewise its own job).
+        x64_was_on = bool(jax.config.jax_enable_x64)
+        old_policy = dtypes.default_policy()
+        check_dtype = jnp.float64
+        try:
+            if not x64_was_on:
+                jax.config.update("jax_enable_x64", True)
+            dtypes.set_default_policy(dtypes.Policy(
+                compute_dtype=check_dtype, accum_dtype=check_dtype))
+            params0 = jax.tree.map(lambda p: p.astype(check_dtype),
+                                   state.params)
+            inputs = tuple(
+                x.astype(check_dtype) if jnp.issubdtype(
+                    jnp.asarray(x).dtype, jnp.floating) else x
+                for x in inputs)
+
+            def scalar_loss(params):
+                outs, _ = self.model.apply(params, state.model_state,
+                                           *inputs, training=False, rng=None)
+                outs = outs if isinstance(outs, tuple) else (outs,)
+                return jnp.asarray(self.loss_fn(*outs, *labels), check_dtype)
+
+            return self._check_gradients_impl(
+                scalar_loss, params0, rng, eps, num_directions)
+        finally:
+            dtypes.set_default_policy(old_policy)
+            if not x64_was_on:
+                jax.config.update("jax_enable_x64", False)
+
+    def _check_gradients_impl(self, scalar_loss, params0, rng, eps,
+                              num_directions) -> float:
+        grads = jax.grad(scalar_loss)(params0)
+        worst = 0.0
+        leaves, treedef = jax.tree_util.tree_flatten(params0)
+        for i in range(num_directions):
+            rng, sub = jax.random.split(rng)
+            dirs = [jax.random.normal(r, l.shape, l.dtype)
+                    for r, l in zip(
+                        jax.random.split(sub, len(leaves)), leaves)]
+            norm = jnp.sqrt(sum(jnp.vdot(d, d).real for d in dirs))
+            dirs = [d / norm for d in dirs]
+            direction = jax.tree_util.tree_unflatten(treedef, dirs)
+            analytic = sum(
+                jnp.vdot(g, d).real for g, d in zip(
+                    jax.tree_util.tree_leaves(grads), dirs))
+            plus = jax.tree.map(lambda p, d: p + eps * d, params0,
+                                direction)
+            minus = jax.tree.map(lambda p, d: p - eps * d, params0,
+                                 direction)
+            numeric = (scalar_loss(plus) - scalar_loss(minus)) / (2 * eps)
+            denom = max(abs(float(numeric)), abs(float(analytic)), 1e-12)
+            rel = abs(float(numeric) - float(analytic)) / denom
+            worst = max(worst, rel)
+        return worst
+
     def _split_batch(self, batch):
         if isinstance(batch, tuple) and len(batch) > self.num_inputs:
             return tuple(batch[: self.num_inputs]), tuple(batch[self.num_inputs :])

@@ -1,0 +1,10 @@
+"""Support utilities: timers/stats, profiler hooks, numeric debugging."""
+
+from paddle_tpu.utils.stats import Stat, global_stat, timer
+from paddle_tpu.utils.profiler import (
+    debug_nans,
+    named_scope,
+    start_trace,
+    stop_trace,
+    trace,
+)

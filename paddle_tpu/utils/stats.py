@@ -1,0 +1,80 @@
+"""Scoped timers aggregated in a global registry (reference:
+utils/Stat.h:63-111 REGISTER_TIMER / globalStat, printed every
+--log_period and per pass by TrainerInternal.cpp:113-171)."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Optional
+
+
+class _Entry:
+    __slots__ = ("total", "count", "max")
+
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+        self.max = 0.0
+
+    def add(self, dt: float):
+        self.total += dt
+        self.count += 1
+        if dt > self.max:
+            self.max = dt
+
+
+class Stat:
+    """Named-timer registry; thread-safe."""
+
+    def __init__(self):
+        self._entries: Dict[str, _Entry] = {}
+        self._lock = threading.Lock()
+
+    @contextlib.contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self._entries.setdefault(name, _Entry()).add(dt)
+
+    def add(self, name: str, seconds: float):
+        with self._lock:
+            self._entries.setdefault(name, _Entry()).add(seconds)
+
+    def summary(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                k: {"total_s": e.total, "count": e.count,
+                    "mean_ms": 1000.0 * e.total / max(e.count, 1),
+                    "max_ms": 1000.0 * e.max}
+                for k, e in sorted(self._entries.items())
+            }
+
+    def report(self) -> str:
+        lines = ["===== timer stats ====="]
+        for name, s in self.summary().items():
+            lines.append(
+                f"  {name:<32} total {s['total_s']:8.3f}s  "
+                f"calls {s['count']:6d}  mean {s['mean_ms']:8.3f}ms  "
+                f"max {s['max_ms']:8.3f}ms")
+        return "\n".join(lines)
+
+    def reset(self, name: Optional[str] = None):
+        with self._lock:
+            if name is None:
+                self._entries.clear()
+            else:
+                self._entries.pop(name, None)
+
+
+global_stat = Stat()
+
+
+def timer(name: str):
+    """`with timer("forwardBackward"): ...` on the global registry."""
+    return global_stat.timer(name)

@@ -61,7 +61,7 @@ def test_grads_match_dense(np_rng, causal):
                                    rtol=2e-4, atol=2e-4)
 
 
-def test_jit_and_vmap_compose(np_rng):
+def test_jit_composes(np_rng):
     q, k, v = _qkv(np_rng, b=1, t=16, h=1, d=8)
     f = jax.jit(lambda q, k, v: flash_attention(q, k, v, block_q=8,
                                                 block_k=8))

@@ -180,6 +180,24 @@ def test_stale_finish_is_noop():
         q.finish_task(99999)  # never-issued ids still rejected
 
 
+def test_superseded_lease_cannot_act():
+    # A's lease times out, B re-leases the SAME task; A's late finish and
+    # fail must both be stale no-ops against B's live lease.
+    q = _make_queue(1, timeout_ms=60, max_retries=3)
+    _, handle_a, _ = q.get_task()
+    time.sleep(0.12)
+    assert q.counts()["todo"] == 1  # timeout processed, requeued
+    st, handle_b, _ = q.get_task()
+    assert st == TaskStatus.OK
+    assert handle_a != handle_b  # distinct lease epochs
+    q.finish_task(handle_a)  # stale: must NOT complete B's lease
+    assert q.counts()["pending"] == 1
+    q.fail_task(handle_a)    # stale: must NOT revoke B's lease
+    assert q.counts()["pending"] == 1
+    q.finish_task(handle_b)  # the live lease completes normally
+    assert q.counts()["done"] == 1
+
+
 def test_late_finish_before_timeout_processing_counts():
     # lease expired but no queue operation has run timeout processing yet:
     # the late finish is accepted (work did complete; no requeue needed)

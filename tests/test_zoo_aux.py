@@ -1,0 +1,182 @@
+"""Dataset zoo schemas, GAN/VAE training, timers/profiler, checkgrad."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import nn, optim
+from paddle_tpu.data import dataset_zoo as Z
+from paddle_tpu.models import gan as gan_mod, vae as vae_mod
+from paddle_tpu.nn.module import ShapeSpec
+from paddle_tpu.ops import losses
+from paddle_tpu.train import Trainer
+from paddle_tpu.utils import Stat, global_stat, named_scope, timer
+
+
+# ---- dataset zoo schemas (reference: v2/dataset/*) ----
+
+def test_imdb_schema():
+    d = Z.imdb_word_dict()
+    samples = list(Z.imdb_train(d, n=20)())
+    assert len(samples) == 20
+    for ids, label in samples:
+        assert ids.dtype == np.int64 and ids.min() >= 0
+        assert ids.max() < len(d)
+        assert label in (0, 1)
+
+
+def test_imikolov_ngrams():
+    d = Z.imikolov_build_dict(200)
+    grams = list(Z.imikolov(d, n=5, sentences=10)())
+    assert all(len(g) == 5 for g in grams)
+    assert all(0 <= w < 200 for g in grams for w in g)
+    # deterministic across calls
+    assert grams == list(Z.imikolov(d, n=5, sentences=10)())
+
+
+def test_movielens_schema():
+    for u, g, a, j, m, c, score in Z.movielens(n=50)():
+        assert 0 <= u < Z.movielens_max_user_id()
+        assert 0 <= m < Z.movielens_max_movie_id()
+        assert 1.0 <= score <= 5.0
+
+
+def test_conll05_schema():
+    word_d, verb_d, label_d = Z.conll05_get_dict()
+    for words, verb, mark, labels in Z.conll05(n=20)():
+        assert len(words) == len(mark) == len(labels)
+        assert mark.sum() == 1
+        assert 0 <= verb < len(verb_d)
+        assert labels.max() < len(label_d)
+        assert labels[mark.argmax()] == 1  # predicate position labeled
+
+
+def test_wmt14_shifted_targets():
+    for src, trg_in, trg_next in Z.wmt14(n=20)():
+        assert trg_in[0] == 0          # <s>
+        assert trg_next[-1] == 1       # <e>
+        np.testing.assert_array_equal(trg_in[1:], trg_next[:-1])
+
+
+def test_mq2007_formats():
+    pw = list(Z.mq2007(format="pairwise", n_queries=4)())
+    assert pw and all(a.shape == (46,) and b.shape == (46,) for a, b in pw)
+    lw = list(Z.mq2007(format="listwise", n_queries=4)())
+    assert len(lw) == 4
+    qid, feats, rel = lw[0]
+    assert feats.shape == (8, 46) and rel.shape == (8,)
+    pt = list(Z.mq2007(format="pointwise", n_queries=2)())
+    assert all(r in (0, 1, 2) for _, r in pt)
+
+
+def test_flowers_voc_schema():
+    img, lbl = next(iter(Z.flowers(n=2)()))
+    assert img.shape == (64, 64, 3) and 0 <= lbl < 102
+    img, boxes, labels, difficult = next(iter(Z.voc2012(n=2)()))
+    assert img.shape == (96, 96, 3)
+    assert boxes.shape[1] == 4 and boxes.min() >= 0 and boxes.max() <= 1
+    assert len(labels) == len(boxes) == len(difficult)
+
+
+# ---- GAN (reference: v1_api_demo/gan/gan_trainer.py) ----
+
+def test_gan_trains():
+    data_dim = 16
+    tr = gan_mod.GANTrainer(
+        gan_mod.mlp_generator(data_dim, noise_dim=8, hidden=(32,)),
+        gan_mod.mlp_discriminator(hidden=(32,)),
+        data_dim=data_dim, noise_dim=8)
+    state = tr.init_state(jax.random.key(0), batch_size=32)
+    rng = np.random.RandomState(0)
+    # real data: narrow gaussian blob around 0.7
+    key = jax.random.key(1)
+    d_losses, g_losses = [], []
+    for i in range(20):
+        real = jnp.asarray(
+            0.7 + 0.05 * rng.randn(32, data_dim), jnp.float32)
+        key, sub = jax.random.split(key)
+        state, d_loss, g_loss = tr.train_step(state, real, sub)
+        d_losses.append(float(d_loss))
+        g_losses.append(float(g_loss))
+    assert np.isfinite(d_losses).all() and np.isfinite(g_losses).all()
+    samples = tr.sample(state, jax.random.key(2), 64)
+    assert samples.shape == (64, data_dim)
+    # generator output should drift toward the data blob mean
+    assert abs(float(samples.mean()) - 0.7) < 0.25
+
+
+# ---- VAE (reference: v1_api_demo/vae) ----
+
+def test_vae_trains():
+    model = vae_mod.VAE(data_dim=32, latent_dim=8, hidden=(64,))
+    params, mstate = model.init(jax.random.key(0), ShapeSpec((16, 32)))
+    opt = optim.adam(1e-2)
+    opt_state = opt.init(params)
+    rng = np.random.RandomState(0)
+    proto = (rng.rand(4, 32) > 0.5).astype(np.float32)
+
+    @jax.jit
+    def step(params, opt_state, x, key, i):
+        def loss_fn(p):
+            outs, _ = model.apply(p, mstate, x, training=True, rng=key)
+            return vae_mod.elbo_loss(outs, x)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = opt.update(grads, opt_state, params, i)
+        return params, opt_state, loss
+
+    losses_seen = []
+    key = jax.random.key(1)
+    for i in range(60):
+        x = jnp.asarray(proto[rng.randint(0, 4, 16)])
+        key, sub = jax.random.split(key)
+        params, opt_state, loss = step(params, opt_state, x, sub, i)
+        losses_seen.append(float(loss))
+    assert losses_seen[-1] < losses_seen[0] * 0.8
+    # decode from prior works
+    imgs = model.decode(params, mstate, jnp.zeros((3, 8)))
+    assert imgs.shape == (3, 32)
+    assert 0.0 <= float(imgs.min()) and float(imgs.max()) <= 1.0
+
+
+# ---- stats / profiler / checkgrad ----
+
+def test_stat_timers():
+    s = Stat()
+    with s.timer("fwd"):
+        pass
+    with s.timer("fwd"):
+        pass
+    with s.timer("bwd"):
+        pass
+    summ = s.summary()
+    assert summ["fwd"]["count"] == 2 and summ["bwd"]["count"] == 1
+    assert "fwd" in s.report()
+    s.reset("fwd")
+    assert "fwd" not in s.summary()
+    with timer("global"):
+        pass
+    assert global_stat.summary()["global"]["count"] >= 1
+
+
+def test_named_scope_compiles():
+    @jax.jit
+    def f(x):
+        with named_scope("layer1"):
+            return x * 2
+
+    assert float(f(jnp.asarray(3.0))) == 6.0
+
+
+def test_trainer_checkgrad():
+    model = nn.Sequential([nn.Dense(8, activation="tanh"), nn.Dense(3)])
+    tr = Trainer(model,
+                 loss_fn=lambda lo, la: jnp.mean(
+                     losses.softmax_cross_entropy(lo, la)),
+                 optimizer=optim.sgd(0.1), seed=0)
+    state = tr.init_state(ShapeSpec((8, 4)))
+    rng = np.random.RandomState(0)
+    batch = (jnp.asarray(rng.rand(8, 4), jnp.float32),
+             jnp.asarray(rng.randint(0, 3, 8)))
+    err = tr.check_gradients(state, batch, eps=1e-4)
+    assert err < 1e-4, err
