@@ -1,0 +1,192 @@
+"""Benchmark suite reproducing the reference's published benchmark
+configs (reference: benchmark/README.md — AlexNet/GoogleNet/VGG/ResNet
+ms/batch at batch 64/128/256 on K40m; benchmark/rnn/rnn.py LSTM
+text-classification ms/batch at hidden 256/512; CPU tables in
+IntelOptimizedPaddle.md). Prints one JSON line per config:
+
+  {"bench": ..., "batch": ..., "ms_per_batch": ..., "imgs_per_sec": ...,
+   "ref_ms_per_batch": ..., "speedup_vs_ref": ...}
+
+Run: python benchmarks/suite.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# the TPU plugin force-selects its platform at config level, outranking
+# JAX_PLATFORMS — mirror a cpu request into the config so a cpu smoke
+# run never claims the chip (same pattern as __graft_entry__)
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+REF = {
+    # reference numbers: ms/batch on 1x K40m (benchmark/README.md:33-58)
+    ("alexnet", 64): 195.0, ("alexnet", 128): 334.0, ("alexnet", 256): 602.0,
+    ("googlenet", 64): 613.0, ("googlenet", 128): 1149.0,
+    ("googlenet", 256): 2348.0,
+    # CPU tables (IntelOptimizedPaddle.md): imgs/sec -> ms/batch
+    ("vgg19", 64): 64 / 28.5 * 1000, ("vgg19", 128): 128 / 29.8 * 1000,
+    ("resnet50", 64): 64 / 81.7 * 1000, ("resnet50", 128): 128 / 82.4 * 1000,
+    ("resnet50", 256): 256 / 84.1 * 1000,
+    # LSTM text classification, bs 64, hidden 256/512 (README.md:115-119)
+    ("lstm_h256", 64): 83.0, ("lstm_h512", 64): 184.0,
+}
+
+# analytic fwd GFLOPs per image at 224x224 (2*MACs), for MFU reporting
+FWD_GFLOPS = {"resnet50": 8.2, "vgg19": 39.0, "alexnet": 1.4,
+              "googlenet": 3.0}
+V5E_PEAK_TFLOPS = 197.0
+
+
+def _image_model(name):
+    from paddle_tpu import models
+
+    if name == "alexnet":
+        return models.alexnet.alexnet(num_classes=1000)
+    if name == "googlenet":
+        return models.googlenet.googlenet(num_classes=1000)
+    if name == "vgg19":
+        return models.vgg.vgg(19, num_classes=1000)
+    if name == "resnet50":
+        return models.resnet.resnet(50, num_classes=1000)
+    raise ValueError(name)
+
+
+def bench_image(name: str, batch: int, *, hw: int = 224, iters: int = 20):
+    from paddle_tpu import optim
+    from paddle_tpu.nn.module import ShapeSpec
+    from paddle_tpu.ops import losses
+    from paddle_tpu.train.state import TrainState
+    from paddle_tpu.train.trainer import make_train_step
+
+    model = _image_model(name)
+    rng = jax.random.key(0)
+    params, mstate = model.init(rng, ShapeSpec((batch, hw, hw, 3)))
+    opt = optim.momentum(0.1, mu=0.9)
+    state = TrainState.create(params, mstate, opt)
+    step = make_train_step(
+        model, lambda lo, la: jnp.mean(losses.softmax_cross_entropy(lo, la)),
+        opt, donate=True)
+    x = jnp.asarray(np.random.RandomState(0).rand(batch, hw, hw, 3),
+                    jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, batch))
+    state, loss, _ = step(state, rng, (x,), (y,))
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss, _ = step(state, rng, (x,), (y,))
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    return dt
+
+
+def bench_lstm(hidden: int, batch: int, *, seq_len: int = 100,
+               vocab: int = 10000, iters: int = 20):
+    """2-layer LSTM + fc text classifier (reference: benchmark/rnn/rnn.py
+    with num_layer=2)."""
+    from paddle_tpu import nn, optim
+    from paddle_tpu.nn.module import ShapeSpec
+    from paddle_tpu.ops import losses
+    from paddle_tpu.train.state import TrainState
+    from paddle_tpu.train.trainer import make_train_step
+
+    model = nn.Sequential([
+        nn.Embedding(vocab, hidden, name="emb"),
+        nn.LSTM(hidden, name="lstm1"),
+        nn.LSTM(hidden, name="lstm2"),
+        nn.Lambda(lambda x: x.mean(axis=1), name="pool",
+                  out_spec_fn=lambda s: ShapeSpec(
+                      (s.shape[0], s.shape[2]), s.dtype)),
+        nn.Dense(2, name="fc"),
+    ])
+    rng = jax.random.key(0)
+    params, mstate = model.init(
+        rng, ShapeSpec((batch, seq_len), jnp.int32))
+    opt = optim.adam(1e-3)
+    state = TrainState.create(params, mstate, opt)
+    step = make_train_step(
+        model, lambda lo, la: jnp.mean(losses.softmax_cross_entropy(lo, la)),
+        opt, donate=True)
+    x = jnp.asarray(np.random.RandomState(0).randint(
+        0, vocab, (batch, seq_len)), jnp.int32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 2, batch))
+    state, loss, _ = step(state, rng, (x,), (y,))
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss, _ = step(state, rng, (x,), (y,))
+    float(loss)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small shapes/iters (CPU smoke test)")
+    ap.add_argument("--only", default=None,
+                    help="comma-separated bench names")
+    args = ap.parse_args()
+
+    from paddle_tpu.core import dtypes
+
+    dtypes.set_default_policy(dtypes.bf16_compute_policy())
+    on_tpu = jax.devices()[0].platform != "cpu"
+    quick = args.quick or not on_tpu
+    hw = 128 if quick else 224  # stride stacks collapse below ~96px
+    iters = 2 if quick else 20
+
+    image_cfgs = [(n, b) for n in ("alexnet", "googlenet", "vgg19",
+                                   "resnet50")
+                  for b in ((64,) if quick else (64, 128, 256))]
+    lstm_cfgs = [("lstm_h256", 256, 64), ("lstm_h512", 512, 64)]
+    only = set(args.only.split(",")) if args.only else None
+
+    for name, batch in image_cfgs:
+        if only and name not in only:
+            continue
+        dt = bench_image(name, batch, hw=hw, iters=iters)
+        rec = {
+            "bench": name, "batch": batch,
+            "ms_per_batch": round(1000 * dt, 2),
+            "imgs_per_sec": round(batch / dt, 1),
+        }
+        ref = REF.get((name, batch))
+        if ref and not quick:
+            rec["ref_ms_per_batch"] = round(ref, 1)
+            rec["speedup_vs_ref"] = round(ref / (1000 * dt), 2)
+        if not quick and name in FWD_GFLOPS:
+            tflops = (batch / dt) * 3 * FWD_GFLOPS[name] / 1000
+            rec["mfu_pct"] = round(100 * tflops / V5E_PEAK_TFLOPS, 1)
+        print(json.dumps(rec))
+
+    for name, hidden, batch in lstm_cfgs:
+        if only and name not in only:
+            continue
+        dt = bench_lstm(hidden, batch, seq_len=16 if quick else 100,
+                        vocab=1000 if quick else 10000, iters=iters)
+        rec = {
+            "bench": name, "batch": batch,
+            "ms_per_batch": round(1000 * dt, 2),
+            "seqs_per_sec": round(batch / dt, 1),
+        }
+        ref = REF.get((name, batch))
+        if ref and not quick:
+            rec["ref_ms_per_batch"] = round(ref, 1)
+            rec["speedup_vs_ref"] = round(ref / (1000 * dt), 2)
+        print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

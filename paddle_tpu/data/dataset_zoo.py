@@ -19,6 +19,23 @@ import numpy as np
 
 from paddle_tpu.data.datasets import DATA_HOME
 
+
+def _local_npz(dataset: str, mode: str):
+    """Local-file path: DATA_HOME/<dataset>/<mode>.npz (object arrays for
+    ragged fields). Returns the npz dict or None."""
+    path = os.path.join(DATA_HOME, dataset, f"{mode}.npz")
+    if not os.path.exists(path):
+        return None
+    return np.load(path, allow_pickle=True)
+
+
+def _rows(blob, *keys):
+    """Iterate parallel columns of a loaded npz as row tuples."""
+    cols = [blob[k] for k in keys]
+    for row in zip(*cols):
+        yield row if len(row) > 1 else row[0]
+
+
 # ---- imdb (reference: v2/dataset/imdb.py) ----
 
 _IMDB_VOCAB = 2000
@@ -30,10 +47,16 @@ def imdb_word_dict(vocab_size: int = _IMDB_VOCAB) -> Dict[str, int]:
     return {f"w{k}": k for k in range(vocab_size)}
 
 
-def _imdb_reader(mode: str, word_idx, n: int, seed: int):
+def _imdb_reader(mode: str, word_idx, n: int, seed: int,
+                 dataset: str = "imdb"):
     vocab = len(word_idx)
 
     def reader() -> Iterator:
+        blob = _local_npz(dataset, mode)
+        if blob is not None:  # columns: ids (object array), labels
+            for ids, label in _rows(blob, "ids", "labels"):
+                yield np.asarray(ids, np.int64), int(label)
+            return
         rng = np.random.RandomState(seed + (0 if mode == "train" else 991))
         for _ in range(n):
             label = rng.randint(2)
@@ -83,6 +106,13 @@ def imikolov(word_idx=None, n: int = 5, mode: str = "train",
     vocab = len(word_idx)
 
     def reader() -> Iterator:
+        blob = _local_npz("imikolov", mode)
+        if blob is not None:  # column: sentences (object array of id lists)
+            for sent in blob["sentences"]:
+                ids = [vocab - 2] + list(np.asarray(sent)) + [vocab - 1]
+                for i in range(n, len(ids) + 1):
+                    yield tuple(int(w) for w in ids[i - n:i])
+            return
         rng = np.random.RandomState(seed + (0 if mode == "train" else 77))
         for _ in range(sentences):
             ids = ([vocab - 2] +
@@ -118,6 +148,14 @@ def movielens(mode: str = "train", n: int = 2048, seed: int = 0):
     score)."""
 
     def reader() -> Iterator:
+        blob = _local_npz("movielens", mode)
+        if blob is not None:
+            for row in _rows(blob, "user", "gender", "age", "job", "movie",
+                             "category", "score"):
+                u, g, a, j, m, c, s = row
+                yield (int(u), int(g), int(a), int(j), int(m), int(c),
+                       float(s))
+            return
         rng = np.random.RandomState(seed + (0 if mode == "train" else 13))
         lat = np.random.RandomState(99)
         u_vec = lat.randn(_ML_USERS, 4)
@@ -152,6 +190,14 @@ def conll05(mode: str = "train", n: int = 256, word_vocab: int = 500,
     Labels follow token identity near the predicate."""
 
     def reader() -> Iterator:
+        blob = _local_npz("conll05", mode)
+        if blob is not None:
+            for words, verb, mark, labels in _rows(
+                    blob, "words", "verbs", "marks", "labels"):
+                yield (np.asarray(words, np.int64), int(verb),
+                       np.asarray(mark, np.int64),
+                       np.asarray(labels, np.int64))
+            return
         rng = np.random.RandomState(seed + (0 if mode == "train" else 3))
         for _ in range(n):
             length = rng.randint(5, 30)
@@ -186,6 +232,14 @@ def wmt14(mode: str = "train", dict_size: int = 300, n: int = 384,
     task: target = reversed source over a shifted vocab."""
 
     def reader() -> Iterator:
+        blob = _local_npz("wmt14", mode)
+        if blob is not None:  # columns: src, trg (object arrays)
+            for src, trg in _rows(blob, "src", "trg"):
+                src = np.asarray(src, np.int64)
+                trg = np.asarray(trg, np.int64)
+                yield (src, np.concatenate([[_WMT_START], trg]),
+                       np.concatenate([trg, [_WMT_END]]))
+            return
         rng = np.random.RandomState(seed + (0 if mode == "train" else 5))
         for _ in range(n):
             length = rng.randint(3, 16)
@@ -209,7 +263,7 @@ def sentiment(mode: str = "train", n: int = 384, seed: int = 0,
     """(word_id_list, label) like imdb but the nltk movie-review corpus
     in the reference."""
     return _imdb_reader(mode, {k: k for k in range(vocab_size)}, n,
-                        seed + 31)
+                        seed + 31, dataset="sentiment")
 
 
 # ---- mq2007 learning-to-rank (reference: v2/dataset/mq2007.py) ----
@@ -225,18 +279,28 @@ def mq2007(mode: str = "train", format: str = "pairwise", n_queries: int = 64,
     Relevance is a noisy linear function of the features."""
 
     def reader() -> Iterator:
-        rng = np.random.RandomState(seed + (0 if mode == "train" else 17))
-        w = np.random.RandomState(55).randn(n_features).astype(np.float32)
-        for qid in range(n_queries):
-            feats = rng.randn(docs_per_query, n_features).astype(np.float32)
-            scores = feats @ w + 0.2 * rng.randn(docs_per_query)
-            rel = np.digitize(scores, np.quantile(scores, [0.5, 0.85]))
+        blob = _local_npz("mq2007", mode)
+        if blob is not None:  # columns: qids, features (object), rels (object)
+            groups = list(_rows(blob, "qids", "features", "rels"))
+        else:
+            rng = np.random.RandomState(seed + (0 if mode == "train" else 17))
+            w = np.random.RandomState(55).randn(n_features).astype(np.float32)
+            groups = []
+            for qid in range(n_queries):
+                feats = rng.randn(docs_per_query,
+                                  n_features).astype(np.float32)
+                scores = feats @ w + 0.2 * rng.randn(docs_per_query)
+                rel = np.digitize(scores, np.quantile(scores, [0.5, 0.85]))
+                groups.append((qid, feats, rel))
+        for qid, feats, rel in groups:
+            feats = np.asarray(feats, np.float32)
+            rel = np.asarray(rel)
             if format == "pointwise":
                 for f, r in zip(feats, rel):
                     yield f, int(r)
             elif format == "pairwise":
-                for i in range(docs_per_query):
-                    for j in range(docs_per_query):
+                for i in range(len(feats)):
+                    for j in range(len(feats)):
                         if rel[i] > rel[j]:
                             yield feats[i], feats[j]
             elif format == "listwise":
@@ -281,6 +345,15 @@ def voc2012(mode: str = "train", n: int = 128, size: int = 96,
     boxes contain class-colored rectangles so detection heads can learn."""
 
     def reader() -> Iterator:
+        blob = _local_npz("voc2012", mode)
+        if blob is not None:
+            for img, boxes, labels, difficult in _rows(
+                    blob, "images", "boxes", "labels", "difficult"):
+                yield (np.asarray(img, np.float32),
+                       np.asarray(boxes, np.float32),
+                       np.asarray(labels, np.int64),
+                       np.asarray(difficult, np.int64))
+            return
         rng = np.random.RandomState(seed + (0 if mode == "train" else 29))
         colors = np.random.RandomState(88).rand(num_classes, 3)
         for _ in range(n):

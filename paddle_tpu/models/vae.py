@@ -34,10 +34,13 @@ class VAE(Layer):
         self.decoder = nn.Sequential(dec)
 
     def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        z_spec = ShapeSpec((spec.shape[0], self.latent_dim), spec.dtype)
+        if _abstract:
+            _, _, out = self.decoder._init(None, z_spec, _abstract=True)
+            return {}, {}, out
         re, rd = jax.random.split(rng)
         enc_p, enc_s, _ = self.encoder._init(re, spec)
-        dec_p, dec_s, out = self.decoder._init(
-            rd, ShapeSpec((spec.shape[0], self.latent_dim), spec.dtype))
+        dec_p, dec_s, out = self.decoder._init(rd, z_spec)
         return ({"encoder": enc_p, "decoder": dec_p},
                 {"encoder": enc_s, "decoder": dec_s}, out)
 

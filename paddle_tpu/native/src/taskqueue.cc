@@ -109,7 +109,13 @@ bool known_id_locked(Queue* q, uint64_t bare_id) {
   return false;
 }
 
-// ---- snapshot format: u64 pass, then per-section counts + tasks ----
+// ---- snapshot format: magic+version header, u64 pass, then
+// per-section counts + tasks. The version gates task-record layout
+// changes (e.g. the epoch field) so an old-format snapshot fails with a
+// clean error instead of misparsing. ----
+
+constexpr uint32_t kSnapMagic = 0x50545153;  // "PTQS"
+constexpr uint32_t kSnapVersion = 2;         // v2: task records carry epoch
 
 void write_task(FILE* f, const Task& t) {
   uint64_t len = t.payload.size();
@@ -287,6 +293,8 @@ int tq_snapshot(void* h, const char* path) {
   q->check_timeouts_locked();
   FILE* f = fopen(path, "wb");
   if (!f) return -1;
+  fwrite(&kSnapMagic, 4, 1, f);
+  fwrite(&kSnapVersion, 4, 1, f);
   fwrite(&q->pass, 8, 1, f);
   fwrite(&q->next_id, 8, 1, f);
   // pending tasks snapshot back into todo: a recovered master re-leases
@@ -309,6 +317,12 @@ int tq_restore(void* h, const char* path) {
   std::lock_guard<std::mutex> g(q->mu);
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
+  uint32_t magic = 0, version = 0;
+  if (fread(&magic, 4, 1, f) != 1 || fread(&version, 4, 1, f) != 1 ||
+      magic != kSnapMagic || version != kSnapVersion) {
+    fclose(f);
+    return -3;  // unrecognized or incompatible snapshot format
+  }
   Queue fresh;
   uint64_t n_todo, n_done, n_disc;
   bool ok = fread(&fresh.pass, 8, 1, f) == 1 &&

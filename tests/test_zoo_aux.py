@@ -78,6 +78,51 @@ def test_flowers_voc_schema():
     assert len(labels) == len(boxes) == len(difficult)
 
 
+def test_local_file_path(tmp_path, monkeypatch):
+    """Loaders prefer DATA_HOME npz files over the synthetic fallback."""
+    import paddle_tpu.data.datasets as ds
+    import importlib
+
+    monkeypatch.setattr(ds, "DATA_HOME", str(tmp_path))
+    monkeypatch.setattr(Z, "DATA_HOME", str(tmp_path))
+    (tmp_path / "imdb").mkdir()
+    ids = np.empty(2, object)
+    ids[0] = np.asarray([5, 6, 7])
+    ids[1] = np.asarray([8, 9])
+    np.savez(tmp_path / "imdb" / "train.npz", ids=ids,
+             labels=np.asarray([1, 0]))
+    got = list(Z.imdb_train(n=999)())
+    assert len(got) == 2
+    np.testing.assert_array_equal(got[0][0], [5, 6, 7])
+    assert got[0][1] == 1 and got[1][1] == 0
+
+    (tmp_path / "wmt14").mkdir()
+    src = np.empty(1, object); src[0] = np.asarray([4, 5])
+    trg = np.empty(1, object); trg[0] = np.asarray([6, 7])
+    np.savez(tmp_path / "wmt14" / "train.npz", src=src, trg=trg)
+    s, ti, tn = next(iter(Z.wmt14()()))
+    np.testing.assert_array_equal(ti, [0, 6, 7])
+    np.testing.assert_array_equal(tn, [6, 7, 1])
+
+
+def test_snapshot_version_gate(tmp_path):
+    from paddle_tpu.native import TaskQueue
+
+    bad = tmp_path / "old.snap"
+    bad.write_bytes(b"\x00" * 64)  # wrong magic
+    q = TaskQueue()
+    import pytest as _pytest
+
+    with _pytest.raises(OSError, match="rc=-3"):
+        q.restore(str(bad))
+
+
+def test_vae_abstract_init():
+    v = vae_mod.VAE(data_dim=16, latent_dim=4)
+    _, _, out = v._init(None, ShapeSpec((8, 16)), _abstract=True)
+    assert out.shape == (8, 16)
+
+
 # ---- GAN (reference: v1_api_demo/gan/gan_trainer.py) ----
 
 def test_gan_trains():
