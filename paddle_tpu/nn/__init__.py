@@ -18,4 +18,4 @@ from paddle_tpu.nn.layers import (
     Activation,
     Lambda,
 )
-from paddle_tpu.nn.composite import Residual, Branches
+from paddle_tpu.nn.composite import Residual, Branches, MultiTask

@@ -81,6 +81,59 @@ class Residual(Layer):
         return self.activation(y + sc), new_state
 
 
+class MultiTask(Layer):
+    """Several independent sub-networks trained jointly (reference:
+    gserver/gradientmachines/MultiNetwork.h — one input per sub-network,
+    forward all, total cost = caller's combination of the outputs).
+
+    init takes one ShapeSpec per sub-network (in order); apply takes one
+    input per sub-network and returns a tuple of outputs.
+    """
+
+    def __init__(self, networks, name=None):
+        """networks: list of (name, Layer) pairs or a dict."""
+        if isinstance(networks, dict):
+            networks = list(networks.items())
+        self.networks = list(networks)
+        self.name = name
+
+    def _init(self, rng, *specs, _abstract: bool = False):
+        from paddle_tpu.core.errors import enforce
+
+        enforce(len(specs) == len(self.networks),
+                f"{len(self.networks)} sub-networks but {len(specs)} specs")
+        params, state, outs = {}, {}, []
+        for (key, net), spec in zip(self.networks, specs):
+            if _abstract:
+                sub_p, sub_s, out = net._init(None, spec, _abstract=True)
+            else:
+                rng, sub = jax.random.split(rng)
+                sub_p, sub_s, out = net._init(sub, spec)
+            if sub_p:
+                params[key] = sub_p
+            if sub_s:
+                state[key] = sub_s
+            outs.append(out)
+        return params, state, tuple(outs)
+
+    def _apply(self, params, state, *inputs, training: bool, rng):
+        from paddle_tpu.core.errors import enforce
+
+        enforce(len(inputs) == len(self.networks),
+                f"{len(self.networks)} sub-networks but {len(inputs)} inputs")
+        outs, new_state = [], {}
+        for (key, net), x in zip(self.networks, inputs):
+            sub_rng = None
+            if rng is not None:
+                rng, sub_rng = jax.random.split(rng)
+            out, sub_s = net._apply(params.get(key, {}), state.get(key, {}),
+                                    x, training=training, rng=sub_rng)
+            if sub_s:
+                new_state[key] = sub_s
+            outs.append(out)
+        return tuple(outs), new_state
+
+
 class Branches(Layer):
     """Apply N sub-layers to the same input; concatenate outputs on the
     channel (last) axis — the inception pattern (reference: concat_layer in

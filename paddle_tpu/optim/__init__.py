@@ -19,3 +19,5 @@ from paddle_tpu.optim.optimizers import (
 )
 from paddle_tpu.optim import schedules
 from paddle_tpu.optim import average
+from paddle_tpu.optim import hooks
+from paddle_tpu.optim.hooks import magnitude_masks, with_pruning, with_update_hook

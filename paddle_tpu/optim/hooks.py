@@ -1,0 +1,77 @@
+"""Parameter-update hooks (reference: parameter/ParameterUpdaterHook.cpp:39
+— the static pruning hook masks parameter values after every update;
+masks are built from initial magnitude at a given sparsity ratio).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.optim.optimizers import Optimizer
+
+
+def magnitude_masks(params: Any, sparsity_ratio: float,
+                    match: Optional[Callable[[str], bool]] = None):
+    """Per-tensor binary masks keeping the top (1-ratio) fraction of
+    entries by |value| (reference: StaticPruningHook::generateMask).
+
+    match: optional predicate on the flattened param path ("a/b/kernel");
+    unmatched tensors get an all-ones mask.
+    """
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+
+    def path_str(path):
+        return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+
+    masks = []
+    for path, leaf in flat:
+        if match is not None and not match(path_str(path)):
+            masks.append(jnp.ones_like(leaf, dtype=bool))
+            continue
+        k = int(leaf.size * (1.0 - sparsity_ratio))
+        if k <= 0:
+            masks.append(jnp.zeros_like(leaf, dtype=bool))
+            continue
+        # rank-based (not threshold-based) so exactly k entries survive
+        # even with tied magnitudes (e.g. zero-initialized tensors)
+        order = jnp.argsort(-jnp.abs(leaf).ravel())
+        mask = jnp.zeros((leaf.size,), bool).at[order[:k]].set(True)
+        masks.append(mask.reshape(leaf.shape))
+    return jax.tree_util.tree_unflatten(treedef, masks)
+
+
+def with_pruning(optimizer: Optimizer, masks: Any) -> Optimizer:
+    """Wrap an optimizer so updated params are masked every step (the
+    update-hook composition point; reference:
+    Parameter::updateHook chain)."""
+
+    def init(params):
+        return optimizer.init(params)
+
+    def update(grads, opt_state, params, step):
+        new_params, new_opt = optimizer.update(grads, opt_state, params,
+                                               step)
+        new_params = jax.tree.map(
+            lambda p, m: p * m.astype(p.dtype), new_params, masks)
+        return new_params, new_opt
+
+    return Optimizer(init, update)
+
+
+def with_update_hook(optimizer: Optimizer,
+                     hook: Callable[[Any, Any], Any]) -> Optimizer:
+    """General post-update hook: params = hook(params, step)."""
+
+    def init(params):
+        return optimizer.init(params)
+
+    def update(grads, opt_state, params, step):
+        new_params, new_opt = optimizer.update(grads, opt_state, params,
+                                               step)
+        return hook(new_params, step), new_opt
+
+    return Optimizer(init, update)

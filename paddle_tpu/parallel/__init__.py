@@ -26,3 +26,4 @@ from paddle_tpu.parallel.sparse import (
     sharded_lookup,
     unique_rows_grad,
 )
+from paddle_tpu.parallel import distributed

@@ -77,6 +77,7 @@ def make_sharded_train_step(
     *,
     metrics_fn: Optional[Callable] = None,
     donate: bool = True,
+    remat: bool = False,
 ):
     """Jitted train step whose inputs arrive batch-sharded over `data`.
 
@@ -89,5 +90,6 @@ def make_sharded_train_step(
     """
     del mesh
     return make_train_step(
-        model, loss_fn, optimizer, metrics_fn=metrics_fn, donate=donate
+        model, loss_fn, optimizer, metrics_fn=metrics_fn, donate=donate,
+        remat=remat,
     )

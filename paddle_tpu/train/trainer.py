@@ -33,22 +33,33 @@ def make_train_step(
     *,
     metrics_fn: Optional[Callable] = None,
     donate: bool = True,
+    remat: bool = False,
 ):
     """Build the jitted train step.
 
     loss_fn(outputs, *labels) -> scalar loss.
     metrics_fn(outputs, *labels) -> dict of scalar metrics (optional).
+    remat=True rematerialises the forward during the backward
+    (jax.checkpoint) — trades FLOPs for HBM on long sequences / deep
+    nets (the reference had no activation checkpointing; its long-seq
+    memory grew linearly, SURVEY §5).
     The returned step: (state: TrainState, rng, inputs, labels) ->
     (new_state, loss, metrics).
     """
+
+    def apply_model(params, mstate, rng, *inputs):
+        return model.apply(params, mstate, *inputs, training=True, rng=rng)
+
+    if remat:
+        apply_model = jax.checkpoint(apply_model)
 
     def step(state: TrainState, rng, inputs, labels):
         inputs = inputs if isinstance(inputs, tuple) else (inputs,)
         labels = labels if isinstance(labels, tuple) else (labels,)
 
         def compute_loss(params):
-            out, new_mstate = model.apply(
-                params, state.model_state, *inputs, training=True, rng=rng
+            out, new_mstate = apply_model(
+                params, state.model_state, rng, *inputs
             )
             loss = loss_fn(out, *labels)
             return loss, (out, new_mstate)

@@ -1,0 +1,130 @@
+"""Multi-host helpers, remat train step, pruning hooks, MultiTask."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import nn, optim, parallel
+from paddle_tpu.nn.module import ShapeSpec
+from paddle_tpu.ops import losses
+from paddle_tpu.optim.hooks import magnitude_masks, with_pruning
+from paddle_tpu.parallel import distributed as D
+from paddle_tpu.train.state import TrainState
+from paddle_tpu.train.trainer import make_train_step
+
+
+def test_distributed_single_process_noops():
+    D.initialize()  # must not raise without a coordinator
+    assert D.process_count() == 1
+    assert D.process_index() == 0
+    assert D.is_primary()
+    D.sync_hosts()  # no-op
+    tree = {"a": np.ones(3)}
+    assert D.broadcast_from_primary(tree) is tree
+    assert D.replicated_agree(np.asarray([1, 2]))
+
+
+def _fit_step(remat):
+    model = nn.Sequential([nn.Dense(16, activation="relu"), nn.Dense(4)])
+    params, mstate = model.init(jax.random.key(0), ShapeSpec((8, 8)))
+    opt = optim.sgd(0.1)
+    state = TrainState.create(params, mstate, opt)
+    step = make_train_step(
+        model, lambda lo, la: jnp.mean(losses.softmax_cross_entropy(lo, la)),
+        opt, remat=remat, donate=False)
+    x = jnp.asarray(np.random.RandomState(0).rand(8, 8), jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 4, 8))
+    rng = jax.random.key(1)
+    s1, l1, _ = step(state, rng, (x,), (y,))
+    return float(l1), s1
+
+
+def test_remat_matches_plain():
+    l_plain, s_plain = _fit_step(remat=False)
+    l_remat, s_remat = _fit_step(remat=True)
+    assert l_plain == l_remat
+    for a, b in zip(jax.tree_util.tree_leaves(s_plain.params),
+                    jax.tree_util.tree_leaves(s_remat.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_magnitude_masks_exact_k_with_ties():
+    # zero-initialized tensor: every magnitude ties at 0; exactly k
+    # entries must still survive
+    params = {"b": jnp.zeros(8)}
+    masks = magnitude_masks(params, 0.75)
+    assert int(np.asarray(masks["b"]).sum()) == 2
+
+
+def test_multitask_wrong_arity_raises():
+    import pytest as _pytest
+
+    model = nn.MultiTask({"a": nn.Dense(2), "b": nn.Dense(3)})
+    params, mstate = model.init(jax.random.key(0), ShapeSpec((4, 5)),
+                                ShapeSpec((4, 6)))
+    with _pytest.raises(Exception, match="sub-networks"):
+        model.apply(params, mstate, jnp.ones((4, 5)))
+
+
+def test_magnitude_masks_and_pruning():
+    params = {"fc": {"kernel": jnp.asarray(
+        np.random.RandomState(0).randn(8, 8), jnp.float32),
+        "bias": jnp.zeros(8)}}
+    masks = magnitude_masks(params, 0.75,
+                            match=lambda path: "kernel" in path)
+    km = np.asarray(masks["fc"]["kernel"])
+    assert km.sum() == 16  # kept 25% of 64
+    assert np.asarray(masks["fc"]["bias"]).all()  # unmatched -> all ones
+
+    opt = with_pruning(optim.sgd(0.1), masks)
+    opt_state = opt.init(params)
+    grads = jax.tree.map(jnp.ones_like, params)
+    new_params, _ = opt.update(grads, opt_state, params, 0)
+    nk = np.asarray(new_params["fc"]["kernel"])
+    assert (nk[~km] == 0).all()          # pruned entries forced to zero
+    assert (nk[km] != 0).any()
+
+
+def test_multitask_joint_training():
+    model = nn.MultiTask([
+        ("cls", nn.Sequential([nn.Dense(8, activation="relu"),
+                               nn.Dense(2)])),
+        ("reg", nn.Dense(1)),
+    ])
+    params, mstate = model.init(jax.random.key(0), ShapeSpec((4, 6)),
+                                ShapeSpec((4, 3)))
+    assert set(params) == {"cls", "reg"}
+    (cls_out, reg_out), _ = model.apply(
+        params, mstate, jnp.ones((4, 6)), jnp.ones((4, 3)))
+    assert cls_out.shape == (4, 2) and reg_out.shape == (4, 1)
+
+    # joint loss trains both heads in one step
+    opt = optim.adam(1e-2)
+    state = TrainState.create(params, mstate, opt)
+
+    def loss_fn(outputs, labels_cls, labels_reg):
+        c, r = outputs
+        return (jnp.mean(losses.softmax_cross_entropy(c, labels_cls))
+                + jnp.mean((r[:, 0] - labels_reg) ** 2))
+
+    step = make_train_step(model, loss_fn, opt, donate=False)
+    rngs = np.random.RandomState(0)
+    x1 = jnp.asarray(rngs.rand(4, 6), jnp.float32)
+    x2 = jnp.asarray(rngs.rand(4, 3), jnp.float32)
+    y1 = jnp.asarray(rngs.randint(0, 2, 4))
+    y2 = jnp.asarray(rngs.rand(4), jnp.float32)
+    state2, loss, _ = step(state, jax.random.key(1), (x1, x2), (y1, y2))
+    assert np.isfinite(float(loss))
+    changed = any(
+        not np.allclose(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                        jax.tree_util.tree_leaves(state2.params)))
+    assert changed
+
+
+def test_multitask_abstract_out_spec():
+    model = nn.MultiTask({"a": nn.Dense(2), "b": nn.Dense(3)})
+    _, _, outs = model._init(None, ShapeSpec((4, 6)), ShapeSpec((4, 3)),
+                             _abstract=True)
+    assert outs[0].shape == (4, 2) and outs[1].shape == (4, 3)
