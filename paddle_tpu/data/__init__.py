@@ -12,4 +12,4 @@ from paddle_tpu.data.batch import (
     bucket_by_length,
     stack_columns,
 )
-from paddle_tpu.data.feeder import DataFeeder
+from paddle_tpu.data.feeder import DataFeeder, prefetch_to_device

@@ -66,3 +66,38 @@ class DataFeeder:
                 )
             else:
                 yield jax.tree.map(jax.device_put, host_batch)
+
+
+def prefetch_to_device(iterator: Iterator, size: int = 2,
+                       sharding=None) -> Iterator:
+    """Keep `size` batches already transferred ahead of the consumer.
+
+    Each buffered batch is device_put here (async — the transfer runs in
+    the background), so the NEXT batch's H2D DMA overlaps the current
+    step's compute — the device-side half of the reference's
+    DoubleBuffer (reference: gserver/dataproviders/DataProvider.h:249;
+    its GPU path staged into pinned memory the same way). Re-putting an
+    already-device-resident batch (e.g. from DataFeeder) is a no-op.
+    """
+    import collections
+
+    def put(batch):
+        if sharding is not None:
+            return jax.tree.map(lambda x: jax.device_put(x, sharding),
+                                batch)
+        return jax.tree.map(jax.device_put, batch)
+
+    buf = collections.deque()
+    it = iter(iterator)
+    try:
+        for _ in range(size):
+            buf.append(put(next(it)))
+    except StopIteration:
+        pass
+    while buf:
+        nxt = buf.popleft()
+        try:
+            buf.append(put(next(it)))
+        except StopIteration:
+            pass
+        yield nxt

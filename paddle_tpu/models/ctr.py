@@ -1,0 +1,128 @@
+"""Sparse CTR model — wide (sparse logistic) + deep (embedding MLP).
+
+Parity target: the reference's high-dimensional sparse CTR training,
+where row-sharded embedding tables live on pservers and trainers
+prefetch only touched rows (reference: gserver/layers/TableProjection +
+SparseRemoteParameterUpdater, math/SparseRowMatrix.h:206,
+pserver/ParameterServer2.h:510 getParameterSparse). TPU-native: tables
+row-sharded over the mesh `model` axis via parallel.ShardedEmbedding,
+lookups ride all-to-all, updates are row-sparse scatter-adds.
+
+Features are multi-hot sparse ids (padded to slots_per_sample with the
+sentinel id == vocab).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from paddle_tpu import nn
+from paddle_tpu.nn.module import ShapeSpec
+from paddle_tpu.parallel.sparse import ShardedEmbedding
+
+
+@dataclasses.dataclass
+class CTRModel:
+    """Wide&deep over a sharded sparse table.
+
+    vocab: id space size (sentinel id == vocab means "empty slot").
+    """
+
+    vocab: int
+    embed_dim: int
+    mesh: Mesh
+    hidden: Tuple[int, ...] = (64, 32)
+
+    def __post_init__(self):
+        self.table = ShardedEmbedding(self.vocab + 1, self.embed_dim,
+                                      self.mesh, name="deep_table")
+        self.wide = ShardedEmbedding(self.vocab + 1, 1, self.mesh,
+                                     name="wide_table")
+        layers = [nn.Dense(h, activation="relu", name=f"mlp{i}")
+                  for i, h in enumerate(self.hidden)]
+        layers.append(nn.Dense(1, name="mlp_out"))
+        self.mlp = nn.Sequential(layers)
+
+    def init(self, rng, batch: int, slots: int):
+        r1, r2, r3 = jax.random.split(rng, 3)
+        deep = self.table.init(r1)
+        wide = self.wide.init(r2)
+        mlp_p, mlp_s = self.mlp.init(
+            r3, ShapeSpec((batch, self.embed_dim)))
+        return {"deep": deep, "wide": wide, "mlp": mlp_p}, mlp_s
+
+    def _forward_from_rows(self, mlp_params, mlp_state, deep_rows,
+                           wide_rows, ids, *, training: bool, rng):
+        """Head forward given already-gathered table rows — the point
+        the backward differentiates at, so table grads are [K, D] row
+        grads, never dense [V, D]."""
+        b, slots = ids.shape
+        valid = (ids < self.vocab)[..., None]                  # [B, S, 1]
+        deep_vecs = deep_rows.reshape(b, slots, self.embed_dim)
+        pooled = jnp.sum(jnp.where(valid, deep_vecs, 0.0), axis=1)
+        denom = jnp.maximum(valid.sum(axis=1), 1.0)
+        pooled = pooled / denom                                # mean pool
+        deep_out, _ = self.mlp.apply(mlp_params, mlp_state, pooled,
+                                     training=training, rng=rng)
+        wide_vals = wide_rows.reshape(b, slots, 1)
+        wide_out = jnp.sum(jnp.where(valid, wide_vals, 0.0), axis=(1, 2))
+        return deep_out[:, 0] + wide_out
+
+    def apply(self, params, mlp_state, ids, *, training: bool = False,
+              rng=None):
+        """ids: [B, slots] int32 with sentinel == vocab for empty.
+        Returns logits [B]."""
+        flat = ids.reshape(-1)
+        deep_rows = self.table.lookup(params["deep"], flat)
+        wide_rows = self.wide.lookup(params["wide"], flat)
+        return self._forward_from_rows(params["mlp"], mlp_state, deep_rows,
+                                       wide_rows, ids, training=training,
+                                       rng=rng)
+
+    def loss(self, params, mlp_state, ids, labels, *, rng=None):
+        from paddle_tpu.ops import losses
+
+        logits = self.apply(params, mlp_state, ids, training=True, rng=rng)
+        return jnp.mean(losses.sigmoid_cross_entropy(
+            logits, labels.astype(jnp.float32)))
+
+    def make_train_step(self, optimizer, mlp_state):
+        """ONE backward pass: loss differentiated jointly w.r.t. the MLP
+        params and the GATHERED table rows ([K, D], never dense [V, D]);
+        row grads land on the sharded tables via scatter-add
+        (ShardedEmbedding.apply_row_grads — the getParameterSparse
+        'only touched rows move' semantics). Returns jitted
+        (params, opt_state, ids, labels, lr, step, rng) ->
+        (params, opt_state, loss)."""
+        from paddle_tpu.ops import losses as losses_mod
+
+        def step(params, opt_state, ids, labels, lr, step_i, rng):
+            flat = ids.reshape(-1)
+            deep_rows = self.table.lookup(params["deep"], flat)
+            wide_rows = self.wide.lookup(params["wide"], flat)
+
+            def head_loss(mlp_params, deep_rows, wide_rows):
+                logits = self._forward_from_rows(
+                    mlp_params, mlp_state, deep_rows, wide_rows, ids,
+                    training=True, rng=rng)
+                return jnp.mean(losses_mod.sigmoid_cross_entropy(
+                    logits, labels.astype(jnp.float32)))
+
+            loss, (mlp_grads, deep_row_g, wide_row_g) = jax.value_and_grad(
+                head_loss, argnums=(0, 1, 2))(
+                    params["mlp"], deep_rows, wide_rows)
+            new_mlp, new_opt = optimizer.update(
+                mlp_grads, opt_state, params["mlp"], step_i)
+            new_deep = self.table.apply_row_grads(
+                params["deep"], flat, deep_row_g, lr)
+            new_wide = self.wide.apply_row_grads(
+                params["wide"], flat, wide_row_g, lr)
+            return ({"deep": new_deep, "wide": new_wide, "mlp": new_mlp},
+                    new_opt, loss)
+
+        return jax.jit(step)

@@ -203,3 +203,77 @@ def dense_to_pack(dense, segment_ids, positions, num_segments: int):
     return jnp.where(
         valid.reshape((-1,) + (1,) * (out.ndim - 1)), out, 0.0
     ).astype(dense.dtype)
+
+
+# ---- nested (2-level) sequences ----------------------------------------
+# The reference's subSequenceStartPositions (reference:
+# parameter/Argument.h:90; RecurrentGradientMachine.cpp:706-775 nested
+# recursion; gserver/layers/SequenceToBatch + SubNestedSequenceLayer).
+# Packed form: positions carry INNER segment ids (sub-sequences) plus a
+# static [num_inner] map `outer_of_inner` assigning each sub-sequence to
+# its outer sequence.
+
+
+def outer_of_inner_map(segment_ids, outer_segment_ids, num_inner: int):
+    """Derive the [num_inner] inner->outer map from per-position ids
+    (as produced by data.batch.pack_sequences(..., outer_ids=...));
+    unused inner slots map to num_outer-sentinel = max+1 of given ids."""
+    sentinel = jnp.max(outer_segment_ids) + 1
+    first = jax.ops.segment_min(
+        outer_segment_ids, segment_ids, num_segments=num_inner + 1)
+    counts = jax.ops.segment_sum(
+        jnp.ones_like(segment_ids), segment_ids,
+        num_segments=num_inner + 1)
+    return jnp.where(counts[:num_inner] > 0, first[:num_inner],
+                     sentinel).astype(jnp.int32)
+
+
+def nested_pool(tokens, segment_ids, outer_of_inner, num_inner: int,
+                num_outer: int, *, inner_mode: str = "mean",
+                outer_mode: str = "mean"):
+    """Two-level pooling: positions -> sub-sequence -> outer sequence
+    (reference: SequencePoolLayer with trans_type='seq' over nested
+    input). Returns [num_outer, ...]."""
+    inner = {
+        "sum": sequence_sum, "mean": sequence_mean, "max": sequence_max,
+        "sqrt": sequence_sqrt_pool,
+    }[inner_mode](tokens, segment_ids, num_inner)
+    if outer_mode == "sum":
+        return jax.ops.segment_sum(inner, outer_of_inner,
+                                   num_segments=num_outer)
+    if outer_mode == "mean":
+        s = jax.ops.segment_sum(inner, outer_of_inner,
+                                num_segments=num_outer)
+        n = jax.ops.segment_sum(jnp.ones_like(outer_of_inner, jnp.float32),
+                                outer_of_inner, num_segments=num_outer)
+        return s / jnp.maximum(n, 1.0).reshape(
+            (-1,) + (1,) * (s.ndim - 1))
+    if outer_mode == "max":
+        return jax.ops.segment_max(
+            jnp.where(jnp.isfinite(inner), inner, NEG_INF), outer_of_inner,
+            num_segments=num_outer)
+    raise ValueError(f"unknown outer_mode {outer_mode!r}")
+
+
+def expand_outer_to_inner(outer_values, outer_of_inner):
+    """Broadcast per-outer-sequence values to each of its sub-sequences
+    (reference: ExpandLayer with nested input). [num_outer, ...] ->
+    [num_inner, ...]."""
+    safe = jnp.clip(outer_of_inner, 0, outer_values.shape[0] - 1)
+    valid = outer_of_inner < outer_values.shape[0]
+    out = outer_values[safe]
+    return jnp.where(valid.reshape((-1,) + (1,) * (out.ndim - 1)), out, 0.0)
+
+
+def first_subseq_of_outer(inner_values, outer_of_inner, num_outer: int):
+    """Select each outer sequence's FIRST sub-sequence value (reference:
+    SubNestedSequenceLayer / seqlastins over nested): [num_inner, ...] ->
+    [num_outer, ...]."""
+    num_inner = inner_values.shape[0]
+    idx = jnp.arange(num_inner)
+    first_idx = jax.ops.segment_min(idx, outer_of_inner,
+                                    num_segments=num_outer)
+    safe = jnp.clip(first_idx, 0, num_inner - 1)
+    valid = first_idx < num_inner
+    out = inner_values[safe]
+    return jnp.where(valid.reshape((-1,) + (1,) * (out.ndim - 1)), out, 0.0)

@@ -44,8 +44,32 @@ def initialize(coordinator_address: Optional[str] = None,
     global _initialized
     if _initialized:
         return
-    if (coordinator_address is None and num_processes is None
-            and process_id is None):
+    auto = (coordinator_address is None and num_processes is None
+            and process_id is None)
+    try:
+        from jax._src import xla_bridge
+
+        backend_up = xla_bridge.backends_are_initialized()
+    except Exception:  # private API moved: fall back to attempting init
+        backend_up = False
+    if backend_up:
+        if jax.process_count() > 1:
+            _initialized = True
+            return  # already joined
+        if auto:
+            # too late to join a cluster, but nothing suggests one was
+            # requested — benign for single-process use
+            import warnings
+
+            warnings.warn(
+                "paddle_tpu.parallel.distributed.initialize() called "
+                "after the XLA backend initialized; multi-host join is "
+                "no longer possible in this process.")
+            return
+        raise RuntimeError(
+            "distributed.initialize(coordinator_address=...) must be the "
+            "first jax-touching call in the process")
+    if auto:
         try:
             jax.distributed.initialize()
         except ValueError as e:

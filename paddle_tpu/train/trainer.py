@@ -114,6 +114,7 @@ class Trainer:
         metrics_fn: Optional[Callable] = None,
         num_inputs: int = 1,
         seed: int = 0,
+        remat: bool = False,
     ):
         self.model = model
         self.loss_fn = loss_fn
@@ -122,7 +123,7 @@ class Trainer:
         self.num_inputs = num_inputs
         self._rng = jax.random.key(seed)
         self._train_step = make_train_step(
-            model, loss_fn, optimizer, metrics_fn=metrics_fn
+            model, loss_fn, optimizer, metrics_fn=metrics_fn, remat=remat
         )
         self._eval_step = make_eval_step(model, loss_fn, metrics_fn=metrics_fn)
 

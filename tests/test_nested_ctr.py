@@ -1,0 +1,99 @@
+"""Nested (2-level) sequence ops, device prefetch, CTR sparse model."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import data, optim
+from paddle_tpu.core import mesh as mesh_lib
+from paddle_tpu.data.batch import pack_sequences
+from paddle_tpu.data.feeder import prefetch_to_device
+from paddle_tpu.models.ctr import CTRModel
+from paddle_tpu.ops import sequence as S
+
+
+def _nested_batch():
+    # outer 0 holds subseqs [1,2,3] and [4,5]; outer 1 holds [6]
+    seqs = [np.asarray([1.0, 2, 3]), np.asarray([4.0, 5]),
+            np.asarray([6.0])]
+    return pack_sequences(seqs, capacity=8, max_seqs=4,
+                          outer_ids=[0, 0, 1])
+
+
+def test_outer_of_inner_map():
+    b = _nested_batch()
+    m = np.asarray(S.outer_of_inner_map(
+        jnp.asarray(b.segment_ids), jnp.asarray(b.outer_segment_ids), 4))
+    assert list(m[:3]) == [0, 0, 1]
+    assert m[3] >= 2  # empty inner slot -> sentinel
+
+
+def test_nested_pool():
+    b = _nested_batch()
+    ooi = S.outer_of_inner_map(
+        jnp.asarray(b.segment_ids), jnp.asarray(b.outer_segment_ids), 4)
+    out = np.asarray(S.nested_pool(
+        jnp.asarray(b.tokens), jnp.asarray(b.segment_ids), ooi, 4, 2,
+        inner_mode="mean", outer_mode="mean"))
+    # outer0: mean(mean(1,2,3)=2, mean(4,5)=4.5) = 3.25; outer1: 6
+    np.testing.assert_allclose(out[:2], [3.25, 6.0], rtol=1e-6)
+
+    out_sum = np.asarray(S.nested_pool(
+        jnp.asarray(b.tokens), jnp.asarray(b.segment_ids), ooi, 4, 2,
+        inner_mode="sum", outer_mode="sum"))
+    np.testing.assert_allclose(out_sum[:2], [6 + 9, 6.0], rtol=1e-6)
+
+
+def test_expand_and_first_subseq():
+    b = _nested_batch()
+    ooi = S.outer_of_inner_map(
+        jnp.asarray(b.segment_ids), jnp.asarray(b.outer_segment_ids), 4)
+    outer_vals = jnp.asarray([10.0, 20.0])
+    inner = np.asarray(S.expand_outer_to_inner(outer_vals, ooi))
+    assert list(inner[:3]) == [10.0, 10.0, 20.0]
+    assert inner[3] == 0.0  # invalid slot zeroed
+
+    inner_vals = jnp.asarray([1.0, 2.0, 3.0, 99.0])
+    firsts = np.asarray(S.first_subseq_of_outer(inner_vals, ooi, 2))
+    assert list(firsts) == [1.0, 3.0]
+
+
+def test_prefetch_to_device_order_and_exhaustion():
+    src = [jnp.asarray([i]) for i in range(5)]
+    got = [int(x[0]) for x in prefetch_to_device(iter(src), size=2)]
+    assert got == [0, 1, 2, 3, 4]
+    assert list(prefetch_to_device(iter([]), size=3)) == []
+
+
+def test_ctr_model_trains_and_updates_only_touched_rows():
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=4, model=2))
+    vocab, slots, batch = 64, 6, 16
+    model = CTRModel(vocab=vocab, embed_dim=8, mesh=mesh, hidden=(16,))
+    params, mlp_state = model.init(jax.random.key(0), batch, slots)
+    opt = optim.adam(1e-2)
+    opt_state = opt.init(params["mlp"])
+    step = model.make_train_step(opt, mlp_state)
+
+    rng = np.random.RandomState(0)
+    # synthetic CTR: label correlates with presence of low ids
+    ids = rng.randint(0, vocab, (batch, slots)).astype(np.int32)
+    ids[:, -2:] = vocab  # empty sentinel slots
+    labels = (ids[:, :4].min(1) < vocab // 3).astype(np.float32)
+    ids_j, labels_j = jnp.asarray(ids), jnp.asarray(labels)
+
+    deep_before = np.asarray(jax.device_get(params["deep"]))
+    losses_seen = []
+    p = params
+    for i in range(10):
+        p, opt_state, loss = step(p, opt_state, ids_j, labels_j,
+                                  jnp.float32(0.1), i, jax.random.key(i))
+        losses_seen.append(float(loss))
+    assert losses_seen[-1] < losses_seen[0], losses_seen
+    deep_after = np.asarray(jax.device_get(p["deep"]))
+
+    touched = np.unique(ids[ids < vocab])
+    untouched = np.setdiff1d(np.arange(vocab + 1), touched)
+    # rows never looked up must be bit-identical (row-sparse update)
+    np.testing.assert_array_equal(deep_after[untouched],
+                                  deep_before[untouched])
+    assert not np.allclose(deep_after[touched], deep_before[touched])
