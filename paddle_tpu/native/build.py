@@ -2,11 +2,15 @@
 
 The reference ships its runtime as compiled C++/Go (recordio chunking +
 the Go master, reference: go/master/service.go); ours compiles on first
-use and caches the .so beside the sources.
+use and caches the .so beside the sources. Builds are multi-process safe:
+the compiler writes a temp file that is os.replace()d into place under an
+fcntl file lock, so concurrent trainers never dlopen a half-written .so.
 """
 
 from __future__ import annotations
 
+import contextlib
+import fcntl
 import os
 import subprocess
 import threading
@@ -22,19 +26,44 @@ def lib_path() -> str:
     return _LIB
 
 
+@contextlib.contextmanager
+def _file_lock(path: str):
+    """Advisory cross-process lock (multi-process trainers may race the
+    first build; an in-process threading.Lock alone is not enough)."""
+    with open(path, "w") as fh:
+        fcntl.flock(fh, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(fh, fcntl.LOCK_UN)
+
+
+def _compile(cmd_prefix: list, lib: str) -> None:
+    tmp = f"{lib}.tmp.{os.getpid()}"
+    try:
+        subprocess.run(cmd_prefix + ["-o", tmp], check=True,
+                       capture_output=True, text=True)
+        os.replace(tmp, lib)  # atomic publish
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _fresh(lib: str, srcs: list) -> bool:
+    if not os.path.exists(lib):
+        return False
+    so_mtime = os.path.getmtime(lib)
+    return all(os.path.getmtime(s) <= so_mtime for s in srcs)
+
+
 def ensure_built(force: bool = False) -> str:
     """Compile the shared library if missing or stale; returns its path."""
-    with _lock:
+    with _lock, _file_lock(_LIB + ".lock"):
         srcs = [os.path.join(_SRC, s) for s in _SOURCES]
-        if not force and os.path.exists(_LIB):
-            so_mtime = os.path.getmtime(_LIB)
-            if all(os.path.getmtime(s) <= so_mtime for s in srcs):
-                return _LIB
-        cmd = [
-            "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-pthread",
-            "-Wall", "-o", _LIB, *srcs,
-        ]
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        if not force and _fresh(_LIB, srcs):
+            return _LIB
+        _compile(["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+                  "-pthread", "-Wall", *srcs], _LIB)
         return _LIB
 
 
@@ -58,14 +87,10 @@ def _python_config(flag: str) -> list:
 
 def ensure_capi_built(force: bool = False) -> str:
     """Compile the C inference ABI library (embeds CPython)."""
-    with _lock:
-        if (not force and os.path.exists(_CAPI_LIB)
-                and os.path.getmtime(_CAPI_SRC) <= os.path.getmtime(_CAPI_LIB)):
+    with _lock, _file_lock(_CAPI_LIB + ".lock"):
+        if not force and _fresh(_CAPI_LIB, [_CAPI_SRC]):
             return _CAPI_LIB
-        cmd = [
-            "g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-Wall",
-            *_python_config("--includes"), "-o", _CAPI_LIB, _CAPI_SRC,
-            *_python_config("--ldflags"),
-        ]
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        _compile(["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-Wall",
+                  *_python_config("--includes"), _CAPI_SRC,
+                  *_python_config("--ldflags")], _CAPI_LIB)
         return _CAPI_LIB

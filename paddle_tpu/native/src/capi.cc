@@ -27,7 +27,12 @@ void set_error_from_python() {
   if (value) {
     PyObject* s = PyObject_Str(value);
     if (s) {
-      g_error = PyUnicode_AsUTF8(s);
+      const char* utf8 = PyUnicode_AsUTF8(s);
+      if (utf8) {
+        g_error = utf8;
+      } else {
+        PyErr_Clear();  // don't leave a fresh exception pending
+      }
       Py_DECREF(s);
     }
   }
@@ -107,9 +112,16 @@ void* pt_load(const char* artifact_path) {
     set_error_from_python();
     return nullptr;
   }
+  const char* sig_utf8 = PyUnicode_AsUTF8(sig);
+  if (!sig_utf8) {
+    Py_DECREF(mid);
+    Py_DECREF(sig);
+    set_error_from_python();
+    return nullptr;
+  }
   auto* m = new Model();
   m->mid = PyLong_AsLongLong(mid);
-  m->signature = PyUnicode_AsUTF8(sig);
+  m->signature = sig_utf8;
   Py_DECREF(mid);
   Py_DECREF(sig);
   return m;

@@ -26,6 +26,7 @@
 #include <string>
 #include <thread>
 #include <vector>
+#include <memory>
 
 namespace {
 
@@ -378,12 +379,18 @@ enum Op : uint8_t {
   OP_PASS = 9,       // -> status, i64 pass
 };
 
+struct Worker {
+  std::thread thr;
+  std::shared_ptr<std::atomic<bool>> done;
+};
+
 struct Server {
   Queue* q = nullptr;
   int listen_fd = -1;
   std::atomic<bool> stop{false};
   std::thread thr;
-  std::vector<std::thread> workers;
+  // touched only by the accept thread (until it is joined in stop)
+  std::vector<Worker> workers;
   std::mutex conn_mu;
   std::vector<int> conn_fds;  // open client fds, shut down on stop
 };
@@ -552,7 +559,25 @@ void* tq_serve_start(void* h, int port) {
         std::lock_guard<std::mutex> g(srv->conn_mu);
         srv->conn_fds.push_back(fd);
       }
-      srv->workers.emplace_back(handle_conn, srv, fd);
+      // reap finished workers so a long-lived master with churning
+      // trainer connections doesn't accumulate unjoined threads
+      auto& ws = srv->workers;
+      for (auto it = ws.begin(); it != ws.end();) {
+        if (it->done->load()) {
+          it->thr.join();
+          it = ws.erase(it);
+        } else {
+          ++it;
+        }
+      }
+      Worker w;
+      w.done = std::make_shared<std::atomic<bool>>(false);
+      auto done = w.done;
+      w.thr = std::thread([srv, fd, done] {
+        handle_conn(srv, fd);
+        done->store(true);
+      });
+      ws.push_back(std::move(w));
     }
   });
   return srv;
@@ -580,7 +605,7 @@ void tq_serve_stop(void* sh) {
     for (int fd : srv->conn_fds) shutdown(fd, SHUT_RDWR);
   }
   for (auto& w : srv->workers)
-    if (w.joinable()) w.join();
+    if (w.thr.joinable()) w.thr.join();
   delete srv;
 }
 

@@ -164,8 +164,9 @@ class Trainer:
             def scalar_loss(params):
                 outs, _ = self.model.apply(params, state.model_state,
                                            *inputs, training=False, rng=None)
-                outs = outs if isinstance(outs, tuple) else (outs,)
-                return jnp.asarray(self.loss_fn(*outs, *labels), check_dtype)
+                # same convention as make_train_step: the raw model output
+                # (tuple or single) is loss_fn's first argument
+                return jnp.asarray(self.loss_fn(outs, *labels), check_dtype)
 
             return self._check_gradients_impl(
                 scalar_loss, params0, rng, eps, num_directions)
