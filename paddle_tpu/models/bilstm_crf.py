@@ -11,6 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.nn import initializers
+from paddle_tpu.nn.recurrent_group import RecurrentGroup, lstm_group
 from paddle_tpu.ops import crf as crf_ops
 from paddle_tpu.ops import linalg
 from paddle_tpu.ops import rnn as rnn_ops
@@ -32,8 +33,19 @@ def init_params(rng, vocab_size: int, num_tags: int, *, embed_dim: int = 32,
 
 
 def emissions(params, tokens, lengths):
+    """BiLSTM mixing expressed on the recurrent-group engine: two groups
+    (forward + reverse) built from the same LSTM step sub-network
+    (reference: rnn_crf.py's paired recurrent mixed layers; topology
+    equivalence with the fused cells is tested in
+    tests/test_recurrent_group.py)."""
     x = jnp.take(params["embed"], tokens, axis=0)
-    h, _ = rnn_ops.bidirectional(rnn_ops.lstm, params["fwd"], params["bwd"], x, lengths)
+    embed_dim = x.shape[-1]
+    hidden = params["fwd"]["w_hh"].shape[0]
+    step, mems = lstm_group(embed_dim, hidden)
+    fwd_out, _ = RecurrentGroup(step, mems).run(params["fwd"], x, lengths)
+    bwd_out, _ = RecurrentGroup(step, mems, reverse=True).run(
+        params["bwd"], x, lengths)
+    h = jnp.concatenate([fwd_out, bwd_out], axis=-1)
     return linalg.dense(h, params["proj"]["kernel"], params["proj"]["bias"])
 
 

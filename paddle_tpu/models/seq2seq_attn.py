@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 
 from paddle_tpu.nn import initializers
+from paddle_tpu.nn.recurrent_group import FnStep, Memory, RecurrentGroup
 from paddle_tpu.ops import beam_search as bs
 from paddle_tpu.ops import linalg
 from paddle_tpu.ops import rnn as rnn_ops
@@ -89,30 +90,39 @@ def attention(params, dec_h, enc_out, enc_mask):
     return jnp.einsum("bs,bsf->bf", weights, enc_out.astype(weights.dtype))
 
 
-def decoder_step(params, token, dec_h, enc_out, enc_mask):
-    """One decode step: (token [B], h [B,H]) -> (logits [B,V], new_h)."""
-    emb = jnp.take(params["tgt_embed"], token, axis=0)
-    ctx = attention(params, dec_h, enc_out, enc_mask)
-    inp = jnp.concatenate([emb, ctx.astype(emb.dtype)], axis=-1)
-    new_h = rnn_ops.gru_step(params["dec_gru"], inp, dec_h)
+def _dec_step_apply(params, mems, x_emb, enc_out, enc_mask):
+    """The decoder step sub-network (attention + GRU + output proj) in
+    recurrent-group form: x_emb is the embedded input token (teacher-
+    forced at train time, GeneratedInput at decode time); enc_out/enc_mask
+    are statics; 'h' is the single memory link."""
+    ctx = attention(params, mems["h"], enc_out, enc_mask)
+    inp = jnp.concatenate([x_emb, ctx.astype(x_emb.dtype)], axis=-1)
+    new_h = rnn_ops.gru_step(params["dec_gru"], inp, mems["h"])
     logits = linalg.dense(new_h, params["out"]["kernel"], params["out"]["bias"])
-    return logits, new_h
+    return logits, {"h": new_h}
+
+
+def decoder_group(hidden: int) -> RecurrentGroup:
+    """The decoder as a RecurrentGroup (reference: recurrent_group with
+    simple_attention, trainer_config_helpers/networks.py:1320; the same
+    definition drives training and generation)."""
+    return RecurrentGroup(
+        FnStep(lambda rng, mem_specs, x_specs: {}, _dec_step_apply),
+        {"h": Memory(hidden, boot="extern", dtype=jnp.float32)},
+        out_ignore_mask=True,
+    )
 
 
 def teacher_forced_logits(params, src_tokens, src_lengths, tgt_in):
     """Training forward: tgt_in [B, T] (bos-prefixed targets) -> logits
-    [B, T, V] via scan (the recurrent_group training path)."""
+    [B, T, V] via the recurrent-group scan path."""
     b, s = src_tokens.shape
     enc_out, h0 = encode(params, src_tokens, src_lengths)
     enc_mask = jnp.arange(s)[None, :] < src_lengths[:, None]
-
-    def step(h, tok_t):
-        logits, new_h = decoder_step(params, tok_t, h, enc_out, enc_mask)
-        return new_h, logits
-
-    toks = jnp.swapaxes(tgt_in, 0, 1)  # [T, B]
-    _, logits = jax.lax.scan(step, h0, toks)
-    return jnp.swapaxes(logits, 0, 1)
+    emb = jnp.take(params["tgt_embed"], tgt_in, axis=0)  # [B, T, E]
+    logits, _ = decoder_group(h0.shape[-1]).run(
+        params, emb, boots={"h": h0}, statics=(enc_out, enc_mask))
+    return logits
 
 
 def loss(params, src_tokens, src_lengths, tgt_tokens, tgt_lengths, *,
@@ -137,22 +147,19 @@ def generate(params, src_tokens, src_lengths, *, beam_size: int = 4,
     enc_out, h0 = encode(params, src_tokens, src_lengths)
     enc_mask = jnp.arange(s)[None, :] < src_lengths[:, None]
     vocab = params["out"]["kernel"].shape[1]
-
-    def step_fn(tokens, state):
-        h, enc_out_t, enc_mask_t = state
-        logits, new_h = decoder_step(params, tokens, h, enc_out_t, enc_mask_t)
-        return logits, (new_h, enc_out_t, enc_mask_t)
-
-    return bs.beam_search(
-        (h0, enc_out, enc_mask),
-        step_fn,
+    return decoder_group(h0.shape[-1]).generate(
+        params,
+        embed_fn=lambda toks: jnp.take(params["tgt_embed"], toks, axis=0),
         batch_size=b,
-        beam_size=beam_size,
+        vocab_size=vocab,
         max_len=max_len,
         bos_id=bos_id,
         eos_id=eos_id,
-        vocab_size=vocab,
+        beam_size=beam_size,
+        boots={"h": h0},
+        statics=(enc_out, enc_mask),
         length_penalty=length_penalty,
+        greedy=False,  # beam-shaped return contract even at beam_size=1
     )
 
 
@@ -162,12 +169,15 @@ def greedy_generate(params, src_tokens, src_lengths, *, max_len: int = 20,
     b, s = src_tokens.shape
     enc_out, h0 = encode(params, src_tokens, src_lengths)
     enc_mask = jnp.arange(s)[None, :] < src_lengths[:, None]
-
-    def step_fn(tokens, state):
-        h = state
-        logits, new_h = decoder_step(params, tokens, h, enc_out, enc_mask)
-        return logits, new_h
-
-    return bs.greedy_search(
-        h0, step_fn, batch_size=b, max_len=max_len, bos_id=bos_id, eos_id=eos_id
+    return decoder_group(h0.shape[-1]).generate(
+        params,
+        embed_fn=lambda toks: jnp.take(params["tgt_embed"], toks, axis=0),
+        batch_size=b,
+        vocab_size=params["out"]["kernel"].shape[1],
+        max_len=max_len,
+        bos_id=bos_id,
+        eos_id=eos_id,
+        beam_size=1,
+        boots={"h": h0},
+        statics=(enc_out, enc_mask),
     )

@@ -28,13 +28,12 @@ void set_error_from_python() {
     PyObject* s = PyObject_Str(value);
     if (s) {
       const char* utf8 = PyUnicode_AsUTF8(s);
-      if (utf8) {
-        g_error = utf8;
-      } else {
-        PyErr_Clear();  // don't leave a fresh exception pending
-      }
+      if (utf8) g_error = utf8;
       Py_DECREF(s);
     }
+    // PyObject_Str or PyUnicode_AsUTF8 may have raised a fresh
+    // exception; never leave it pending on return
+    PyErr_Clear();
   }
   Py_XDECREF(type);
   Py_XDECREF(value);

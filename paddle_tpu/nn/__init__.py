@@ -19,3 +19,12 @@ from paddle_tpu.nn.layers import (
     Lambda,
 )
 from paddle_tpu.nn.composite import Residual, Branches, MultiTask
+from paddle_tpu.nn.recurrent_group import (
+    FnStep,
+    Memory,
+    RecurrentGroup,
+    RecurrentGroupLayer,
+    gru_group,
+    lstm_group,
+    scan_subsequences,
+)
