@@ -37,6 +37,10 @@ class CTRModel:
     embed_dim: int
     mesh: Mesh
     hidden: Tuple[int, ...] = (64, 32)
+    # "alltoall": owner-routed exchange (K·D ICI volume, preferred);
+    # "psum": every shard contributes masked [K, D] (shards·K·D volume);
+    # "auto": alltoall when the flat id count divides the mesh axis.
+    exchange: str = "auto"
 
     def __post_init__(self):
         self.table = ShardedEmbedding(self.vocab + 1, self.embed_dim,
@@ -73,13 +77,26 @@ class CTRModel:
         wide_out = jnp.sum(jnp.where(valid, wide_vals, 0.0), axis=(1, 2))
         return deep_out[:, 0] + wide_out
 
+    def _use_alltoall(self, flat_size: int) -> bool:
+        n = self.mesh.shape[self.table.axis]
+        if self.exchange == "alltoall":
+            return True
+        if self.exchange == "psum":
+            return False
+        return flat_size % n == 0
+
+    def _lookup(self, emb: ShardedEmbedding, table, flat):
+        if self._use_alltoall(flat.shape[0]):
+            return emb.alltoall_lookup(table, flat)
+        return emb.lookup(table, flat)
+
     def apply(self, params, mlp_state, ids, *, training: bool = False,
               rng=None):
         """ids: [B, slots] int32 with sentinel == vocab for empty.
         Returns logits [B]."""
         flat = ids.reshape(-1)
-        deep_rows = self.table.lookup(params["deep"], flat)
-        wide_rows = self.wide.lookup(params["wide"], flat)
+        deep_rows = self._lookup(self.table, params["deep"], flat)
+        wide_rows = self._lookup(self.wide, params["wide"], flat)
         return self._forward_from_rows(params["mlp"], mlp_state, deep_rows,
                                        wide_rows, ids, training=training,
                                        rng=rng)
@@ -103,8 +120,8 @@ class CTRModel:
 
         def step(params, opt_state, ids, labels, lr, step_i, rng):
             flat = ids.reshape(-1)
-            deep_rows = self.table.lookup(params["deep"], flat)
-            wide_rows = self.wide.lookup(params["wide"], flat)
+            deep_rows = self._lookup(self.table, params["deep"], flat)
+            wide_rows = self._lookup(self.wide, params["wide"], flat)
 
             def head_loss(mlp_params, deep_rows, wide_rows):
                 logits = self._forward_from_rows(
@@ -118,10 +135,16 @@ class CTRModel:
                     params["mlp"], deep_rows, wide_rows)
             new_mlp, new_opt = optimizer.update(
                 mlp_grads, opt_state, params["mlp"], step_i)
-            new_deep = self.table.apply_row_grads(
-                params["deep"], flat, deep_row_g, lr)
-            new_wide = self.wide.apply_row_grads(
-                params["wide"], flat, wide_row_g, lr)
+            if self._use_alltoall(flat.shape[0]):
+                new_deep = self.table.alltoall_push_row_grads(
+                    params["deep"], flat, deep_row_g, lr)
+                new_wide = self.wide.alltoall_push_row_grads(
+                    params["wide"], flat, wide_row_g, lr)
+            else:
+                new_deep = self.table.apply_row_grads(
+                    params["deep"], flat, deep_row_g, lr)
+                new_wide = self.wide.apply_row_grads(
+                    params["wide"], flat, wide_row_g, lr)
             return ({"deep": new_deep, "wide": new_wide, "mlp": new_mlp},
                     new_opt, loss)
 

@@ -20,6 +20,8 @@ from paddle_tpu.parallel.ring_attention import (
 )
 from paddle_tpu.parallel.sparse import (
     ShardedEmbedding,
+    alltoall_lookup,
+    alltoall_push_row_grads,
     rowwise_sgd_update,
     shard_rows,
     sharded_embedding_bag,

@@ -75,6 +75,147 @@ def sharded_lookup(table, ids, mesh: Mesh, *, axis: str = MODEL_AXIS):
     return fn(table, ids)
 
 
+def _route_to_owners(ids_local, n: int, rows_per_shard: int, capacity: int):
+    """Bucket local ids by owning shard into a fixed [n, capacity] send
+    buffer (pad id -1). Returns (send_ids, order, pos_in_run, kept_mask,
+    overflow_count). Static shapes throughout (XLA requirement); overflow
+    beyond `capacity` per destination is dropped and counted."""
+    k = ids_local.shape[0]
+    owner = jnp.where(
+        (ids_local >= 0) & (ids_local < n * rows_per_shard),
+        ids_local // rows_per_shard, n)  # invalid ids -> virtual owner n
+    order = jnp.argsort(owner, stable=True)
+    sorted_ids = ids_local[order]
+    sorted_owner = owner[order]
+    first_idx = jnp.searchsorted(sorted_owner, jnp.arange(n + 1))
+    pos_in_run = jnp.arange(k) - first_idx[sorted_owner]
+    kept = (pos_in_run < capacity) & (sorted_owner < n)
+    send = jnp.full((n, capacity), -1, ids_local.dtype)
+    send = send.at[sorted_owner, pos_in_run].set(
+        jnp.where(kept, sorted_ids, -1), mode="drop")
+    counts = first_idx[1:] - first_idx[:-1]  # per-owner demand [n+1]->[n]
+    overflow = jnp.sum(jnp.maximum(counts[:n] - capacity, 0))
+    return send, order, pos_in_run, kept, overflow
+
+
+def _local_take(tab_shard, ids_global, lo, rows_per_shard):
+    local = ids_global - lo
+    ok = (local >= 0) & (local < rows_per_shard)
+    safe = jnp.clip(local, 0, rows_per_shard - 1)
+    vecs = jnp.take(tab_shard, safe, axis=0)
+    return jnp.where(ok[..., None], vecs, 0)
+
+
+def alltoall_lookup(table, ids, mesh: Mesh, *, axis: str = MODEL_AXIS,
+                    capacity: Optional[int] = None,
+                    return_overflow: bool = False):
+    """Lookup into a row-sharded table via owner-routing + all-to-all —
+    the SURVEY §2.8 EP exchange (reference:
+    pserver/ParameterServer2.h:510 getParameterSparse pulls only touched
+    rows over the network; here the 'network' is ICI all-to-all).
+
+    Unlike sharded_lookup (psum of mostly-zero [K, D] contributions from
+    every shard — volume ∝ shards·K·D), this routes each id to its owning
+    shard and moves each result vector over ICI exactly once: aggregate
+    exchange volume ∝ K·D.
+
+    table: [V, D] sharded P(axis, None).
+    ids:   [K] int ids, SHARDED over `axis` (each device owns K/n ids —
+           the data-sharded CTR batch layout). K must divide the axis.
+    capacity: per-(src, dst) routing slots. Default K/n (always safe —
+           worst case every local id hits one owner). Lower values cut
+           the exchange volume to capacity·n·D per device but ids beyond
+           capacity for one destination are dropped (zero vectors);
+           check with return_overflow=True.
+
+    Returns [K, D] vectors (sharded over `axis` like ids), out-of-range
+    ids give zero vectors. With return_overflow=True returns
+    (vectors, overflow) where overflow is the global count of dropped
+    ids (0 when capacity is sufficient).
+    """
+    n = mesh.shape[axis]
+    vocab, dim = table.shape
+    rows_per_shard = vocab // n
+    k = ids.shape[0]
+    enforce_div = k % n == 0
+    if not enforce_div:
+        raise ValueError(f"ids size {k} not divisible by axis size {n}")
+    k_loc = k // n
+    cap = capacity if capacity is not None else k_loc
+
+    def body(tab_shard, ids_local):
+        shard = jax.lax.axis_index(axis)
+        lo = shard * rows_per_shard
+        send, order, pos_in_run, kept, overflow = _route_to_owners(
+            ids_local, n, rows_per_shard, cap)
+        # ship id requests to owners (int traffic, tiny)
+        recv = jax.lax.all_to_all(send, axis, 0, 0, tiled=True)  # [n, cap]
+        # serve local rows for every requester
+        vecs = _local_take(tab_shard, recv, lo, rows_per_shard)  # [n,cap,D]
+        # ship vectors back: [j, c] -> requester j's slot c
+        back = jax.lax.all_to_all(vecs, axis, 0, 0, tiled=True)
+        # un-permute into original id order
+        owner_sorted = jnp.clip(ids_local[order] // rows_per_shard, 0, n - 1)
+        got = back[owner_sorted, jnp.clip(pos_in_run, 0, cap - 1)]
+        got = jnp.where(kept[:, None], got, 0)
+        out = jnp.zeros((k_loc, dim), got.dtype).at[order].set(got)
+        return out, jax.lax.psum(overflow, axis_name=axis)
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(axis)),
+        out_specs=(P(axis, None), P()),
+    )
+    out, overflow = fn(table, ids)
+    return (out, overflow) if return_overflow else out
+
+
+def alltoall_push_row_grads(table, ids, row_grads, lr,
+                            mesh: Mesh, *, axis: str = MODEL_AXIS,
+                            capacity: Optional[int] = None):
+    """SGD update of only the touched rows with owner-routed grads —
+    the sparse push mirroring alltoall_lookup (reference: trainer->pserver
+    sparse gradient push, ParameterServer2.h addGradient sparse path).
+
+    ids/row_grads are sharded over `axis` ([K] / [K, D]); grads for the
+    same row from different devices accumulate. Returns the updated
+    sharded table; no dense [V, D] gradient and no shards·K·D traffic.
+    """
+    n = mesh.shape[axis]
+    vocab, dim = table.shape
+    rows_per_shard = vocab // n
+    k = ids.shape[0]
+    if k % n != 0:
+        raise ValueError(f"ids size {k} not divisible by axis size {n}")
+    cap = capacity if capacity is not None else k // n
+
+    def body(tab_shard, ids_local, grads_local):
+        shard = jax.lax.axis_index(axis)
+        lo = shard * rows_per_shard
+        send_ids, order, pos_in_run, kept, _ = _route_to_owners(
+            ids_local, n, rows_per_shard, cap)
+        # pack grads into the same [n, cap, D] layout as the id routing
+        sorted_owner = jnp.clip(ids_local[order] // rows_per_shard, 0, n - 1)
+        send_g = jnp.zeros((n, cap, dim), grads_local.dtype)
+        send_g = send_g.at[sorted_owner, pos_in_run].set(
+            jnp.where(kept[:, None], grads_local[order], 0), mode="drop")
+        recv_ids = jax.lax.all_to_all(send_ids, axis, 0, 0, tiled=True)
+        recv_g = jax.lax.all_to_all(send_g, axis, 0, 0, tiled=True)
+        local = recv_ids.reshape(-1) - lo
+        ok = (recv_ids.reshape(-1) >= 0) & (local >= 0) & (local < rows_per_shard)
+        safe = jnp.clip(local, 0, rows_per_shard - 1)
+        contrib = jnp.where(ok[:, None], recv_g.reshape(-1, dim), 0)
+        return tab_shard.at[safe].add(
+            -lr * contrib.astype(tab_shard.dtype))
+
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis, None), P(axis), P(axis, None)),
+        out_specs=P(axis, None),
+    )
+    return fn(table, ids, row_grads)
+
+
 def sharded_embedding_bag(table, ids, segment_ids, num_segments: int,
                           mesh: Mesh, *, axis: str = MODEL_AXIS,
                           combiner: str = "sum"):
@@ -125,16 +266,18 @@ def rowwise_sgd_update(table, ids, row_grads, lr, mesh: Optional[Mesh] = None,
     return fn(table, ids, row_grads)
 
 
-def unique_rows_grad(ids, row_grads, max_unique: Optional[int] = None):
+def unique_rows_grad(ids, row_grads, max_unique: Optional[int] = None,
+                     *, return_overflow: bool = False):
     """Deduplicate (ids, grads) into (unique_ids, summed_grads) with a
     static size — the SelectedRows merge (reference:
     operators/math/selected_rows_functor.cc MergeAdd). Padding slots get
     id 0 with zero grad, so downstream scatter-adds are no-ops.
 
-    max_unique defaults to ids.size (always safe). WARNING: if you pass a
-    smaller max_unique and the batch has more distinct ids than that,
-    jnp.unique TRUNCATES — the excess rows' gradients are silently
-    dropped. Only under-size it when the id distribution guarantees the
+    max_unique defaults to ids.size (always safe). If you pass a smaller
+    max_unique and the batch has more distinct ids than that, jnp.unique
+    truncates — pass return_overflow=True to get a third output counting
+    the dropped distinct ids (0 when the bound held) and assert on it;
+    only under-size max_unique when the id distribution guarantees the
     bound.
     """
     if max_unique is None:
@@ -143,6 +286,10 @@ def unique_rows_grad(ids, row_grads, max_unique: Optional[int] = None):
         ids, return_inverse=True, size=max_unique, fill_value=0)
     summed = jax.ops.segment_sum(row_grads, inv.reshape(-1),
                                  num_segments=max_unique)
+    if return_overflow:
+        flat = jnp.sort(ids.reshape(-1))
+        distinct = 1 + jnp.sum(flat[1:] != flat[:-1])
+        return uids, summed, jnp.maximum(distinct - max_unique, 0)
     return uids, summed
 
 
@@ -167,6 +314,19 @@ class ShardedEmbedding:
 
     def lookup(self, table, ids):
         return sharded_lookup(table, ids, self.mesh, axis=self.axis)
+
+    def alltoall_lookup(self, table, ids, *, capacity=None,
+                        return_overflow=False):
+        """Owner-routed lookup (preferred at scale — K·D exchange)."""
+        return alltoall_lookup(table, ids, self.mesh, axis=self.axis,
+                               capacity=capacity,
+                               return_overflow=return_overflow)
+
+    def alltoall_push_row_grads(self, table, ids, row_grads, lr, *,
+                                capacity=None):
+        return alltoall_push_row_grads(
+            table, ids, row_grads, lr, self.mesh, axis=self.axis,
+            capacity=capacity)
 
     def bag(self, table, ids, segment_ids, num_segments, combiner="sum"):
         return sharded_embedding_bag(

@@ -186,3 +186,148 @@ def test_broadcast_from(mesh):
     x = jax.device_put(x, jax.NamedSharding(mesh, P("data")))
     got = collectives.device_broadcast_from(x, mesh, source=1)
     np.testing.assert_allclose(np.asarray(got).reshape(-1), [20.0])
+
+
+# ---- all-to-all exchange path (round-2: VERDICT item 4) ----------------
+
+def test_alltoall_lookup_matches_dense(mesh):
+    from paddle_tpu.parallel import alltoall_lookup
+
+    table = _table()
+    sharded = shard_rows(table, mesh)
+    # ids sharded over the model axis: size divisible by 4
+    ids = jnp.asarray(np.random.RandomState(1).randint(0, 32, 24))
+    got = alltoall_lookup(sharded, ids, mesh)
+    want = jnp.take(table, ids, axis=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_alltoall_lookup_out_of_range_zero(mesh):
+    from paddle_tpu.parallel import alltoall_lookup
+
+    table = _table()
+    sharded = shard_rows(table, mesh)
+    ids = jnp.asarray([0, -1, 31, 32, 5, -7, 12, 99])
+    got = alltoall_lookup(sharded, ids, mesh)
+    want = np.take(np.asarray(table), np.clip(np.asarray(ids), 0, 31), axis=0)
+    want[[1, 3, 5, 7]] = 0.0
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+
+
+def test_alltoall_lookup_skewed_ids(mesh):
+    """Worst-case routing: every id owned by one shard — the default
+    capacity (K/n) must still be lossless."""
+    from paddle_tpu.parallel import alltoall_lookup
+
+    table = _table()
+    sharded = shard_rows(table, mesh)
+    ids = jnp.asarray(np.random.RandomState(2).randint(0, 8, 16))  # shard 0 only
+    got, overflow = alltoall_lookup(sharded, ids, mesh, return_overflow=True)
+    want = jnp.take(table, ids, axis=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+    assert int(overflow) == 0
+
+
+def test_alltoall_capacity_overflow_detected(mesh):
+    from paddle_tpu.parallel import alltoall_lookup
+
+    table = _table()
+    sharded = shard_rows(table, mesh)
+    ids = jnp.zeros((16,), jnp.int32)  # all to shard 0, 4 per device
+    got, overflow = alltoall_lookup(sharded, ids, mesh, capacity=1,
+                                    return_overflow=True)
+    # 4 model shards hold 4 ids each, all owned by shard 0: capacity 1
+    # keeps one per shard, drops 3 per shard
+    assert int(overflow) == 4 * 3
+    # kept slots still correct
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(table[0]),
+                               rtol=1e-6)
+
+
+def test_alltoall_lookup_grad_flows_to_table(mesh):
+    """Autodiff through the owner-routed exchange: table gradient equals
+    the dense lookup's scatter-add gradient."""
+    from paddle_tpu.parallel import alltoall_lookup
+
+    table = _table(16, 4)
+    sharded = shard_rows(table, mesh)
+    ids = jnp.asarray(np.random.RandomState(3).randint(0, 16, 8))
+    w = jnp.asarray(np.random.RandomState(4).randn(8, 4), jnp.float32)
+
+    def loss_sharded(tab):
+        return jnp.sum(alltoall_lookup(tab, ids, mesh) * w)
+
+    def loss_dense(tab):
+        return jnp.sum(jnp.take(tab, ids, axis=0) * w)
+
+    g_sharded = jax.grad(loss_sharded)(sharded)
+    g_dense = jax.grad(loss_dense)(table)
+    np.testing.assert_allclose(np.asarray(g_sharded), np.asarray(g_dense),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_alltoall_push_row_grads_matches_dense(mesh):
+    from paddle_tpu.parallel import alltoall_push_row_grads
+
+    table = _table(32, 6)
+    sharded = shard_rows(table, mesh)
+    rng = np.random.RandomState(5)
+    ids = jnp.asarray(rng.randint(0, 32, 16))
+    grads = jnp.asarray(rng.randn(16, 6), jnp.float32)
+    lr = 0.3
+    new = alltoall_push_row_grads(sharded, ids, grads, lr, mesh)
+    want = np.array(table)
+    for i, g in zip(np.asarray(ids), np.asarray(grads)):
+        want[i] -= lr * g
+    np.testing.assert_allclose(np.asarray(new), want, rtol=1e-5, atol=1e-6)
+
+
+def test_unique_rows_grad_overflow_flag():
+    ids = jnp.asarray([1, 2, 3, 4, 1])
+    grads = jnp.ones((5, 2))
+    _, _, overflow = unique_rows_grad(ids, grads, max_unique=2,
+                                      return_overflow=True)
+    assert int(overflow) == 2  # 4 distinct ids, bound 2
+    _, _, ok = unique_rows_grad(ids, grads, max_unique=4,
+                                return_overflow=True)
+    assert int(ok) == 0
+
+
+def test_alltoall_exchange_volume_in_hlo(mesh):
+    """The micro-bench claim (VERDICT item 4): compiled HLO's all-to-all
+    traffic is ∝ K·D while the psum path all-reduces shards·K·D."""
+    from paddle_tpu.parallel import alltoall_lookup
+
+    table = _table(32, 8)
+    sharded = shard_rows(table, mesh)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 32, 16))
+    k, d, n = 16, 8, 4
+
+    def count_bytes(hlo_text, opname):
+        import re
+        total = 0
+        for m in re.finditer(
+                r"(\w+)\[([\d,]*)\][^\n]*" + opname + r"\(", hlo_text):
+            dt, shape = m.group(1), m.group(2)
+            size = 1
+            for s in shape.split(","):
+                if s:
+                    size *= int(s)
+            width = {"f32": 4, "bf16": 2, "s32": 4, "u32": 4}.get(dt, 4)
+            total += size * width
+        return total
+
+    a2a_hlo = jax.jit(
+        lambda t, i: alltoall_lookup(t, i, mesh)).lower(sharded, ids) \
+        .compile().as_text()
+    psum_hlo = jax.jit(
+        lambda t, i: sharded_lookup(t, i, mesh)).lower(sharded, ids) \
+        .compile().as_text()
+
+    a2a_bytes = count_bytes(a2a_hlo, "all-to-all")
+    ar_bytes = count_bytes(psum_hlo, "all-reduce")
+    # vector traffic per device: a2a ~ K/n * D * 4B (+ id ints);
+    # psum all-reduce ~ K * D * 4B
+    assert a2a_bytes > 0 and ar_bytes > 0
+    assert a2a_bytes <= (k * d * 4) + (k * 4) * 2  # ≤ K·D + id traffic
+    assert ar_bytes >= k * d * 4  # the psum path moves the full K·D per shard
