@@ -9,6 +9,7 @@ from paddle_tpu.parallel.sharding import (
 from paddle_tpu.parallel.train_step import (
     make_sharded_train_step,
     shard_train_state,
+    train_state_shardings,
 )
 from paddle_tpu.parallel import collectives
 # NB: the bare in-shard_map `ring_attention` fn stays on the submodule —

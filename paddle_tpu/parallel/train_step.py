@@ -55,18 +55,25 @@ def shard_train_state(state: TrainState, mesh: Mesh,
     moment buffers across the data axis (ZeRO-style, the pserver-side
     optimizer-state sharding equivalent).
     """
+    sh = train_state_shardings(state, mesh, param_rules, zero)
+    return jax.tree.map(jax.device_put, state, sh)
+
+
+def train_state_shardings(state: TrainState, mesh: Mesh,
+                          param_rules: Optional[Sequence[shard_lib.Rule]] = None,
+                          zero: bool = False) -> TrainState:
+    """The canonical sharding tree for a TrainState on this mesh: params
+    via name-pattern rules, model statistics and the step counter
+    replicated, optimizer moments params-aligned (or ZeRO data-sliced)."""
     param_sh = shard_lib.make_param_shardings(state.params, mesh, param_rules)
-    params = jax.tree.map(jax.device_put, state.params, param_sh)
-    mstate = jax.tree.map(
-        lambda x: jax.device_put(x, shard_lib.replicated(mesh)), state.model_state
-    )
+    repl = shard_lib.replicated(mesh)
+    mstate_sh = jax.tree.map(lambda _: repl, state.model_state)
     if zero:
         opt_sh = shard_lib.zero_shardings(state.opt_state, mesh)
     else:
-        opt_sh = _align_opt_shardings(state.opt_state, state.params, param_sh, mesh)
-    opt = jax.tree.map(jax.device_put, state.opt_state, opt_sh)
-    step = jax.device_put(state.step, shard_lib.replicated(mesh))
-    return TrainState(params, mstate, opt, step)
+        opt_sh = _align_opt_shardings(state.opt_state, state.params,
+                                      param_sh, mesh)
+    return TrainState(param_sh, mstate_sh, opt_sh, repl)
 
 
 def make_sharded_train_step(
@@ -78,18 +85,31 @@ def make_sharded_train_step(
     metrics_fn: Optional[Callable] = None,
     donate: bool = True,
     remat: bool = False,
+    param_rules: Optional[Sequence[shard_lib.Rule]] = None,
+    zero: bool = False,
+    accum_steps: int = 1,
 ):
     """Jitted train step whose inputs arrive batch-sharded over `data`.
 
-    The step body is exactly the single-chip one (make_train_step); all
-    parallelism comes from input placements + XLA's partitioner (GSPMD).
-    Works for any mesh: pure DP, DP×TP (param rules shard weights over
-    `model`), and — with a seq axis in the mesh and sequence-sharded
-    inputs — SP. `mesh` is accepted for API symmetry and future
-    shard_map-based steps (pipeline stages) that need it explicitly.
+    The step body is the single-chip one (make_train_step); parallelism
+    comes from input placements + XLA's partitioner (GSPMD). The updated
+    state is PINNED to the canonical shardings (param rules, ZeRO
+    moments, replicated stats/step) via with_sharding_constraint so
+    nothing — donation, partitioner cost models — can reshard the train
+    state between steps. Works for pure DP, DP×TP (param_rules shard
+    weights over `model`; pass the same rules used in
+    shard_train_state), ZeRO (zero=True), and SP meshes.
+
+    accum_steps>1 adds gradient accumulation: the global batch is split
+    into microbatches scanned sequentially with ONE weight update.
     """
-    del mesh
+    def constrain(new_state: TrainState) -> TrainState:
+        sh = train_state_shardings(new_state, mesh, param_rules, zero)
+        return jax.tree.map(jax.lax.with_sharding_constraint,
+                            new_state, sh)
+
     return make_train_step(
         model, loss_fn, optimizer, metrics_fn=metrics_fn, donate=donate,
-        remat=remat,
+        remat=remat, accum_steps=accum_steps,
+        constrain_state_fn=constrain,
     )

@@ -34,6 +34,8 @@ def make_train_step(
     metrics_fn: Optional[Callable] = None,
     donate: bool = True,
     remat: bool = False,
+    accum_steps: int = 1,
+    constrain_state_fn: Optional[Callable] = None,
 ):
     """Build the jitted train step.
 
@@ -43,6 +45,12 @@ def make_train_step(
     (jax.checkpoint) — trades FLOPs for HBM on long sequences / deep
     nets (the reference had no activation checkpointing; its long-seq
     memory grew linearly, SURVEY §5).
+    accum_steps>1 splits the batch into that many microbatches, runs
+    forward/backward per microbatch under lax.scan and applies ONE
+    optimizer update on the averaged gradients (the batch size must be
+    divisible). Loss/metrics are microbatch means.
+    constrain_state_fn(new_state) -> new_state may pin shardings on the
+    updated state (used by the sharded step builder).
     The returned step: (state: TrainState, rng, inputs, labels) ->
     (new_state, loss, metrics).
     """
@@ -53,30 +61,79 @@ def make_train_step(
     if remat:
         apply_model = jax.checkpoint(apply_model)
 
-    def step(state: TrainState, rng, inputs, labels):
-        inputs = inputs if isinstance(inputs, tuple) else (inputs,)
-        labels = labels if isinstance(labels, tuple) else (labels,)
-
-        def compute_loss(params):
-            out, new_mstate = apply_model(
-                params, state.model_state, rng, *inputs
-            )
+    def fwd_bwd(params, mstate, rng, inputs, labels):
+        def compute_loss(p):
+            out, new_mstate = apply_model(p, mstate, rng, *inputs)
             loss = loss_fn(out, *labels)
             return loss, (out, new_mstate)
 
         (loss, (out, new_mstate)), grads = jax.value_and_grad(
             compute_loss, has_aux=True
-        )(state.params)
+        )(params)
+        metrics = metrics_fn(out, *labels) if metrics_fn else {}
+        return loss, new_mstate, grads, metrics
+
+    def step(state: TrainState, rng, inputs, labels):
+        inputs = inputs if isinstance(inputs, tuple) else (inputs,)
+        labels = labels if isinstance(labels, tuple) else (labels,)
+
+        if accum_steps == 1:
+            loss, new_mstate, grads, metrics = fwd_bwd(
+                state.params, state.model_state, rng, inputs, labels)
+        else:
+            def split(x):
+                if x.shape[0] % accum_steps != 0:
+                    raise ValueError(
+                        f"batch {x.shape[0]} not divisible by "
+                        f"accum_steps={accum_steps}")
+                return x.reshape((accum_steps, -1) + x.shape[1:])
+
+            m_inputs = jax.tree.map(split, inputs)
+            m_labels = jax.tree.map(split, labels)
+            rngs = jax.random.split(rng, accum_steps)
+
+            def body(carry, xs):
+                mstate, grad_acc, loss_acc, metric_acc = carry
+                rng_t, inp_t, lab_t = xs
+                loss, new_mstate, grads, metrics = fwd_bwd(
+                    state.params, mstate, rng_t, inp_t, lab_t)
+                grad_acc = jax.tree.map(jnp.add, grad_acc, grads)
+                metric_acc = jax.tree.map(jnp.add, metric_acc, metrics)
+                return (merge_state(mstate, new_mstate), grad_acc,
+                        loss_acc + loss, metric_acc), None
+
+            zeros_like_f32 = lambda p: jnp.zeros(p.shape, jnp.float32)
+            metric0 = {}
+            if metrics_fn:
+                probe = jax.eval_shape(
+                    lambda: metrics_fn(
+                        model.apply(state.params, state.model_state,
+                                    *jax.tree.map(lambda x: x[0], m_inputs),
+                                    training=True, rng=rng)[0],
+                        *jax.tree.map(lambda x: x[0], m_labels)))
+                metric0 = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, jnp.float32), probe)
+            init = (state.model_state,
+                    jax.tree.map(zeros_like_f32, state.params),
+                    jnp.zeros((), jnp.float32), metric0)
+            (new_mstate, grads, loss, metrics), _ = jax.lax.scan(
+                body, init, (rngs, m_inputs, m_labels))
+            inv = 1.0 / accum_steps
+            grads = jax.tree.map(lambda g: g * inv, grads)
+            loss = loss * inv
+            metrics = jax.tree.map(lambda m: m * inv, metrics)
+
         new_params, new_opt = optimizer.update(
             grads, state.opt_state, state.params, state.step
         )
-        metrics = metrics_fn(out, *labels) if metrics_fn else {}
         new_state = TrainState(
             params=new_params,
             model_state=merge_state(state.model_state, new_mstate),
             opt_state=new_opt,
             step=state.step + 1,
         )
+        if constrain_state_fn is not None:
+            new_state = constrain_state_fn(new_state)
         return new_state, loss, metrics
 
     return jax.jit(step, donate_argnums=(0,) if donate else ())
