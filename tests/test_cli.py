@@ -96,3 +96,47 @@ def test_cli_subprocess_entry():
                        capture_output=True, text=True, env=env, timeout=300)
     assert r.returncode == 0, r.stderr[-2000:]
     assert "paddle_tpu" in r.stdout
+
+
+def test_launch_dry_run(capsys):
+    from paddle_tpu.cli import main
+
+    rc = main(["launch", "--hosts", "hostA,hostB", "--dry-run",
+               "--workdir", "/tmp/w", "--",
+               "train", "--config", "cfg.py"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    lines = [l for l in out.strip().splitlines() if l]
+    assert len(lines) == 2
+    assert "hostA" in lines[0] and "hostB" in lines[1]
+    assert "--coordinator hostA:1234" in lines[0].replace("'", "")
+    assert "--process-id 0" in lines[0].replace("'", "")
+    assert "--process-id 1" in lines[1].replace("'", "")
+    assert "--num-processes 2" in lines[1].replace("'", "")
+    assert "cd /tmp/w" in lines[0]
+
+
+def test_launch_emit_jobset(capsys):
+    from paddle_tpu.cli import main
+
+    rc = main(["launch", "--emit-jobset", "myjob", "--image", "img:1",
+               "--num-hosts", "4", "--tpu-topology", "4x4", "--",
+               "train", "--config", "cfg.py"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "kind: JobSet" in out
+    assert "name: myjob" in out
+    assert "parallelism: 4" in out
+    assert '"train", "--config", "cfg.py"' in out
+    import yaml
+
+    doc = yaml.safe_load(out)
+    assert doc["spec"]["replicatedJobs"][0]["template"]["spec"][
+        "parallelism"] == 4
+
+
+def test_launch_requires_command():
+    from paddle_tpu.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["launch", "--hosts", "a,b"])
