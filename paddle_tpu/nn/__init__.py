@@ -19,6 +19,18 @@ from paddle_tpu.nn.layers import (
     Lambda,
 )
 from paddle_tpu.nn.composite import Residual, Branches, MultiTask
+from paddle_tpu.nn.wrappers import (
+    CRF,
+    CTC,
+    NCE,
+    AdditiveAttention,
+    BlockExpand,
+    Interpolate,
+    PReLU,
+    Rotate,
+    SequenceConv,
+    SequencePool,
+)
 from paddle_tpu.nn.recurrent_group import (
     FnStep,
     Memory,

@@ -1,0 +1,316 @@
+"""One-line layer wrappers fattening the nn DSL toward the reference's
+~115 registered layer types (reference: gserver/layers REGISTER_LAYER
+catalog; user DSL python/paddle/trainer_config_helpers/layers.py).
+
+Each class is a thin Layer over an existing op so the common constructs
+— PReLU, sequence conv, block expand, interpolation, sequence pooling,
+CRF/CTC/NCE costs, additive attention — are single declarations, as they
+are in the reference's config DSL.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.nn import initializers
+from paddle_tpu.nn.module import Layer, ShapeSpec
+from paddle_tpu.ops import activations as A
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import crf as crf_ops
+from paddle_tpu.ops import ctc as ctc_ops
+from paddle_tpu.ops import sampling as sampling_ops
+from paddle_tpu.ops import sequence as seq_ops
+
+
+class PReLU(Layer):
+    """Parametric ReLU (reference: gserver/layers/PReluLayer.cpp,
+    operators/prelu_op.cc). channel_shared=True learns one scalar alpha;
+    otherwise one alpha per channel (last axis)."""
+
+    def __init__(self, *, channel_shared: bool = False,
+                 alpha_init: float = 0.25, name: Optional[str] = None):
+        self.channel_shared = channel_shared
+        self.alpha_init = alpha_init
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        if _abstract:
+            return {}, {}, spec
+        shape = () if self.channel_shared else (spec.shape[-1],)
+        return {"alpha": jnp.full(shape, self.alpha_init, jnp.float32)}, \
+            {}, spec
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return A.prelu(x, params["alpha"].astype(x.dtype)), {}
+
+
+class SequenceConv(Layer):
+    """1-D sequence convolution over (x [B,T,F], lengths) (reference:
+    operators/sequence_conv_op.cc; ContextProjection + FC in gserver).
+    trainable_padding adds the reference's learned boundary rows."""
+
+    def __init__(self, features: int, context_len: int, *,
+                 context_start: Optional[int] = None,
+                 activation=None, use_bias: bool = True,
+                 trainable_padding: bool = False,
+                 kernel_init="smart", name: Optional[str] = None):
+        self.features = features
+        self.context_len = context_len
+        self.context_start = (context_start if context_start is not None
+                              else -(context_len // 2))
+        self.activation = A.get(activation)
+        self.use_bias = use_bias
+        self.trainable_padding = trainable_padding
+        self.kernel_init = initializers.get(kernel_init)
+        self.name = name
+
+    def _pad_rows(self):
+        start_pad = max(0, -self.context_start)
+        end_pad = max(0, self.context_len + self.context_start - 1)
+        return start_pad + end_pad
+
+    def _init(self, rng, spec: ShapeSpec, lengths_spec=None,
+              _abstract: bool = False):
+        b, t, f = spec.shape
+        out = ShapeSpec((b, t, self.features), spec.dtype)
+        if _abstract:
+            return {}, {}, out
+        kr, br = jax.random.split(rng)
+        params = {"filter": self.kernel_init(
+            kr, (self.context_len * f, self.features))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.features,))
+        if self.trainable_padding and self._pad_rows():
+            params["padding"] = jnp.zeros((self._pad_rows(), f))
+        return params, {}, out
+
+    def _apply(self, params, state, x, lengths=None, *, training: bool, rng):
+        y = seq_ops.sequence_conv(
+            x, lengths, params["filter"], context_len=self.context_len,
+            context_start=self.context_start, bias=params.get("bias"),
+            padding_weights=params.get("padding"))
+        return self.activation(y), {}
+
+
+class BlockExpand(Layer):
+    """Image -> block sequence (reference: BlockExpandLayer.cpp)."""
+
+    def __init__(self, block, *, stride=None, padding="VALID",
+                 name: Optional[str] = None):
+        self.block = conv_ops._pair(block)
+        self.stride = conv_ops._pair(stride if stride is not None else block)
+        self.padding = padding
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        n, h, w, c = spec.shape
+        bh, bw = self.block
+        sh, sw = self.stride
+        if self.padding == "SAME":
+            ho, wo = -(-h // sh), -(-w // sw)
+        else:
+            ho, wo = (h - bh) // sh + 1, (w - bw) // sw + 1
+        return {}, {}, ShapeSpec((n, ho * wo, bh * bw * c), spec.dtype)
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return conv_ops.block_expand(
+            x, self.block, stride=self.stride, padding=self.padding), {}
+
+
+class Interpolate(Layer):
+    """Bilinear / nearest resize (reference: BilinearInterpLayer.cpp,
+    operators/bilinear_interp_op.cc)."""
+
+    def __init__(self, out_hw: Tuple[int, int], *, method: str = "bilinear",
+                 align_corners: bool = False, name: Optional[str] = None):
+        enforce(method in ("bilinear", "nearest"),
+                "method must be bilinear|nearest, got %s", method)
+        self.out_hw = tuple(out_hw)
+        self.method = method
+        self.align_corners = align_corners
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        n, h, w, c = spec.shape
+        return {}, {}, ShapeSpec((n, *self.out_hw, c), spec.dtype)
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        if self.method == "nearest":
+            return conv_ops.nearest_interp(x, self.out_hw), {}
+        return conv_ops.bilinear_interp(
+            x, self.out_hw, align_corners=self.align_corners), {}
+
+
+class Rotate(Layer):
+    """90-degree CCW feature-map rotation (reference: RotateLayer.cpp)."""
+
+    def __init__(self, *, reverse: bool = False, name: Optional[str] = None):
+        self.reverse = reverse
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        n, h, w, c = spec.shape
+        return {}, {}, ShapeSpec((n, w, h, c), spec.dtype)
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return conv_ops.rotate90(x, reverse=self.reverse), {}
+
+
+class SequencePool(Layer):
+    """Per-sequence pooling of (x [B,T,F], lengths) -> [B,F] (reference:
+    SequencePoolLayer family — sum/mean/sqrt/max/last/first,
+    gserver/layers/SequencePoolLayer.cpp + MaxLayer/AverageLayer/
+    SequenceLastInstanceLayer)."""
+
+    def __init__(self, mode: str = "mean", name: Optional[str] = None):
+        self.mode = mode
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, lengths_spec=None,
+              _abstract: bool = False):
+        b, t, f = spec.shape
+        return {}, {}, ShapeSpec((b, f), spec.dtype)
+
+    def _apply(self, params, state, x, lengths=None, *, training: bool, rng):
+        if lengths is None:
+            lengths = jnp.full((x.shape[0],), x.shape[1], jnp.int32)
+        return seq_ops.dense_sequence_pool(x, lengths, self.mode), {}
+
+
+class CRF(Layer):
+    """Linear-chain CRF cost layer (reference: gserver/layers/CRFLayer.cpp
+    cost + CRFDecodingLayer.cpp decode; operators/linear_chain_crf_op).
+
+    apply(params, state, emissions [B,T,K], tags [B,T], lengths [B]) ->
+    per-sequence negative log-likelihood [B]. decode(params, emissions,
+    lengths) -> (tags, scores) runs Viterbi with the same transitions.
+    """
+
+    def __init__(self, num_tags: int, name: Optional[str] = None):
+        self.num_tags = num_tags
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, *rest, _abstract: bool = False):
+        b = spec.shape[0]
+        out = ShapeSpec((b,), jnp.float32)
+        if _abstract:
+            return {}, {}, out
+        return dict(crf_ops.init_crf_params(rng, self.num_tags)._asdict()), \
+            {}, out
+
+    def _apply(self, params, state, emissions, tags, lengths, *,
+               training: bool, rng):
+        ll = crf_ops.crf_log_likelihood(
+            crf_ops.CRFParams(**params), emissions, tags, lengths)
+        return -ll, {}
+
+    def decode(self, params, emissions, lengths):
+        return crf_ops.crf_decode(
+            crf_ops.CRFParams(**params), emissions, lengths)
+
+
+class CTC(Layer):
+    """CTC cost layer (reference: gserver/layers/CTCLayer.cpp /
+    WarpCTCLayer.cpp; operators warpctc). apply(params, state,
+    log_probs [B,T,V], input_lengths, labels [B,L], label_lengths) ->
+    per-sequence loss [B]."""
+
+    def __init__(self, blank: int = 0, name: Optional[str] = None):
+        self.blank = blank
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, *rest, _abstract: bool = False):
+        return {}, {}, ShapeSpec((spec.shape[0],), jnp.float32)
+
+    def _apply(self, params, state, log_probs, input_lengths, labels,
+               label_lengths, *, training: bool, rng):
+        return ctc_ops.ctc_loss(log_probs, input_lengths, labels,
+                                label_lengths, blank=self.blank), {}
+
+
+class NCE(Layer):
+    """Noise-contrastive estimation cost layer (reference:
+    gserver/layers/NCELayer.cpp). Holds the output embedding [V, D] +
+    bias; samples `num_samples` log-uniform negatives per example with
+    the step rng. apply(params, state, hidden [B,D], labels [B]) ->
+    per-example loss [B]."""
+
+    def __init__(self, num_classes: int, num_samples: int = 10, *,
+                 use_correction: bool = True, name: Optional[str] = None):
+        self.num_classes = num_classes
+        self.num_samples = num_samples
+        self.use_correction = use_correction
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, *rest, _abstract: bool = False):
+        b, d = spec.shape
+        out = ShapeSpec((b,), jnp.float32)
+        if _abstract:
+            return {}, {}, out
+        wr, _ = jax.random.split(rng)
+        return {
+            "weights": initializers.smart_uniform()(
+                wr, (self.num_classes, d)),
+            "bias": jnp.zeros((self.num_classes,)),
+        }, {}, out
+
+    def _apply(self, params, state, hidden, labels, *, training: bool, rng):
+        enforce(rng is not None, "NCE needs an rng to sample negatives")
+        noise = sampling_ops.log_uniform_sample(
+            rng, self.num_samples, self.num_classes,
+            shape=(hidden.shape[0],))
+        noise_probs = None
+        if self.use_correction:
+            ids = jnp.arange(self.num_classes)
+            noise_probs = sampling_ops.log_uniform_prob(
+                ids, self.num_classes)
+        loss = sampling_ops.nce_loss(
+            params["weights"], params["bias"], hidden, labels, noise,
+            noise_probs=noise_probs)
+        return loss, {}
+
+
+class AdditiveAttention(Layer):
+    """Bahdanau attention as a layer (reference: simple_attention,
+    python/paddle/trainer_config_helpers/networks.py:1320).
+
+    apply(params, state, query [B,Q], keys [B,S,K], lengths [B]) ->
+    context [B,K]."""
+
+    def __init__(self, hidden: int, name: Optional[str] = None):
+        self.hidden = hidden
+        self.name = name
+
+    def _init(self, rng, q_spec: ShapeSpec, k_spec: ShapeSpec, *rest,
+              _abstract: bool = False):
+        bq, q = q_spec.shape
+        bk, s, kf = k_spec.shape
+        out = ShapeSpec((bq, kf), q_spec.dtype)
+        if _abstract:
+            return {}, {}, out
+        k1, k2, k3 = jax.random.split(rng, 3)
+        smart = initializers.smart_uniform()
+        return {
+            "w_query": smart(k1, (q, self.hidden)),
+            "w_keys": smart(k2, (kf, self.hidden)),
+            "v": smart(k3, (self.hidden, 1)),
+        }, {}, out
+
+    def _apply(self, params, state, query, keys, lengths=None, *,
+               training: bool, rng):
+        from paddle_tpu.ops import linalg
+
+        proj = jnp.tanh(
+            linalg.matmul(query, params["w_query"])[:, None, :]
+            + linalg.matmul(keys, params["w_keys"]))
+        scores = linalg.matmul(proj, params["v"])[..., 0]  # [B, S]
+        if lengths is not None:
+            mask = jnp.arange(keys.shape[1])[None, :] < lengths[:, None]
+            scores = jnp.where(mask, scores, -1e30)
+        weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        ctx = jnp.einsum("bs,bsf->bf", weights, keys.astype(weights.dtype))
+        return ctx.astype(keys.dtype), {}

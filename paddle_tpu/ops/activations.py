@@ -171,3 +171,10 @@ def get(name):
         raise ValueError(
             f"unknown activation {name!r}; known: {sorted(_REGISTRY)}"
         ) from None
+
+
+def prelu(x, alpha):
+    """Parametric ReLU (reference: gserver/layers/PReluLayer /
+    operators/prelu_op.cc): y = x if x > 0 else alpha * x. alpha is a
+    learned per-channel [C] (or scalar) parameter, broadcast over x."""
+    return jnp.where(x > 0, x, alpha * x)

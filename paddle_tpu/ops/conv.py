@@ -327,3 +327,61 @@ def maxout(x, groups: int):
     if c % groups != 0:
         raise ValueError(f"channels {c} not divisible by groups {groups}")
     return x.reshape(*x.shape[:-1], c // groups, groups).max(-1)
+
+
+def block_expand(x, block: IntOr2, *, stride: IntOr2 = None, padding="VALID"):
+    """Image -> sequence of flattened blocks (reference:
+    gserver/layers/BlockExpandLayer.cpp, function/BlockExpandOp.cpp):
+    sweep a block window over [N, H, W, C] and emit one timestep per
+    position. Returns [N, Ho*Wo, bh*bw*C] — feed it to sequence ops/RNNs
+    (the OCR pattern the reference built this for).
+    """
+    bh, bw = _pair(block)
+    s = _pair(stride if stride is not None else block)
+    patches = im2col(x, (bh, bw), stride=s, padding=padding)
+    n, ho, wo, d = patches.shape
+    return patches.reshape(n, ho * wo, d)
+
+
+def bilinear_interp(x, out_hw: Tuple[int, int], *,
+                    align_corners: bool = False):
+    """Bilinear resize of [N, H, W, C] (reference:
+    gserver/layers/BilinearInterpLayer.cpp, operators/bilinear_interp_op).
+    align_corners=False matches the reference's pixel-center ratio
+    convention for upsampling."""
+    import jax.image
+
+    n, h, w, c = x.shape
+    oh, ow = out_hw
+    if align_corners and oh > 1 and ow > 1:
+        # corner-aligned sampling grid via explicit gather weights
+        ys = jnp.linspace(0.0, h - 1.0, oh)
+        xs = jnp.linspace(0.0, w - 1.0, ow)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1 = jnp.minimum(y0 + 1, h - 1)
+        x1 = jnp.minimum(x0 + 1, w - 1)
+        wy = (ys - y0).astype(x.dtype)[None, :, None, None]
+        wx = (xs - x0).astype(x.dtype)[None, None, :, None]
+        top = x[:, y0][:, :, x0] * (1 - wx) + x[:, y0][:, :, x1] * wx
+        bot = x[:, y1][:, :, x0] * (1 - wx) + x[:, y1][:, :, x1] * wx
+        return top * (1 - wy) + bot * wy
+    return jax.image.resize(x, (n, oh, ow, c), method="bilinear")
+
+
+def nearest_interp(x, out_hw: Tuple[int, int]):
+    """Nearest-neighbor resize of [N, H, W, C]."""
+    import jax.image
+
+    n, h, w, c = x.shape
+    return jax.image.resize(x, (n, out_hw[0], out_hw[1], c),
+                            method="nearest")
+
+
+def rotate90(x, *, reverse: bool = False):
+    """Rotate each [H, W] feature map 90 degrees counter-clockwise
+    (reference: gserver/layers/RotateLayer.cpp; reverse=True rotates
+    clockwise, its backward). x: [N, H, W, C] -> [N, W, H, C]."""
+    if reverse:
+        return jnp.flip(jnp.swapaxes(x, 1, 2), axis=2)
+    return jnp.flip(jnp.swapaxes(x, 1, 2), axis=1)

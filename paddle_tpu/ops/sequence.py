@@ -277,3 +277,74 @@ def first_subseq_of_outer(inner_values, outer_of_inner, num_outer: int):
     valid = first_idx < num_inner
     out = inner_values[safe]
     return jnp.where(valid.reshape((-1,) + (1,) * (out.ndim - 1)), out, 0.0)
+
+
+def context_projection(x, lengths, *, context_len: int,
+                       context_start: int = None, padding_weights=None):
+    """Sliding context-window concat over a dense [B, T, F] batch.
+
+    Reference: function/ContextProjectionOp.cpp (ContextProjectionForward)
+    / gserver ContextProjection — output position t concatenates the
+    features at t+context_start .. t+context_start+context_len-1, with
+    out-of-sequence positions zero (or, when `padding_weights`
+    [start_pad + end_pad, F] is given, the reference's trainable padding
+    rows: row i of the starting pad for positions before the sequence,
+    row start_pad + j for positions past its end).
+
+    x: [B, T, F]; lengths: [B] or None. Returns [B, T, context_len * F].
+    """
+    b, t, f = x.shape
+    if context_start is None:
+        context_start = -(context_len // 2)  # the reference's default
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+    start_pad = max(0, -context_start)
+    end_pad = max(0, context_len + context_start - 1)
+    pieces = []
+    pos = jnp.arange(t)
+    for j in range(context_len):
+        off = context_start + j
+        src = pos + off  # source position for each output position
+        valid = (src >= 0) & (src < lengths[:, None])
+        safe = jnp.clip(src, 0, t - 1)
+        piece = jnp.take(x, safe, axis=1)
+        piece = jnp.where(valid[..., None], piece, 0.0)
+        if padding_weights is not None:
+            before = src < 0
+            after = src >= lengths[:, None]
+            if start_pad:
+                # row index into the start-pad block for positions before
+                # the sequence: -src - 1 counts back from the boundary
+                row = jnp.clip(-src - 1, 0, start_pad - 1)
+                pad_vec = jnp.take(padding_weights[:start_pad], row, axis=0)
+                piece = jnp.where(before[..., None],
+                                  jnp.broadcast_to(pad_vec, piece.shape),
+                                  piece)
+            if end_pad:
+                row = jnp.clip(src - lengths[:, None], 0, end_pad - 1)
+                pad_vec = jnp.take(padding_weights[start_pad:start_pad + end_pad],
+                                   row, axis=0)
+                piece = jnp.where(after[..., None],
+                                  jnp.broadcast_to(pad_vec, piece.shape),
+                                  piece)
+        pieces.append(piece)
+    out = jnp.concatenate(pieces, axis=-1)
+    # zero rows past each sequence's end (they are not real positions)
+    tmask = (pos[None, :] < lengths[:, None])[..., None]
+    return out * tmask.astype(out.dtype)
+
+
+def sequence_conv(x, lengths, filt, *, context_len: int,
+                  context_start: int = None, bias=None,
+                  padding_weights=None):
+    """1-D sequence convolution = context projection + linear projection
+    (reference: operators/sequence_conv_op.cc, gserver sequence_conv).
+
+    filt: [context_len * F, out]; returns [B, T, out].
+    """
+    from paddle_tpu.ops import linalg
+
+    ctx = context_projection(x, lengths, context_len=context_len,
+                             context_start=context_start,
+                             padding_weights=padding_weights)
+    return linalg.dense(ctx, filt, bias)

@@ -1,0 +1,234 @@
+"""Round-2 op-surface fills: sequence conv / context projection,
+block expand, PReLU, interpolation, rotate + the one-line nn wrappers
+(reference tests mirrored: gserver/tests/test_LayerGrad.cpp entries for
+context_projection/seq conv/blockExpand/prelu/bilinear_interp)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gradcheck import directional_grad_check
+from paddle_tpu import nn
+from paddle_tpu.nn.module import ShapeSpec
+from paddle_tpu.ops import activations as A
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import sequence as seq_ops
+
+
+@pytest.fixture
+def np_rng():
+    return np.random.RandomState(0)
+
+
+class TestContextProjection:
+    def test_values_centered_window(self, np_rng):
+        x = jnp.asarray(np_rng.randn(2, 5, 3), jnp.float32)
+        lengths = jnp.asarray([5, 3])
+        out = seq_ops.context_projection(x, lengths, context_len=3)
+        assert out.shape == (2, 5, 9)
+        # middle position of seq 0: window [t-1, t, t+1]
+        np.testing.assert_allclose(
+            np.asarray(out[0, 2]),
+            np.concatenate([np.asarray(x[0, 1]), np.asarray(x[0, 2]),
+                            np.asarray(x[0, 3])]), rtol=1e-6)
+        # first position: left context is zero-padded
+        np.testing.assert_allclose(np.asarray(out[0, 0, :3]), 0.0)
+        # sequence 1 (len 3): position 2's right context is beyond end
+        np.testing.assert_allclose(np.asarray(out[1, 2, 6:]), 0.0)
+        # rows past the sequence end are fully zero
+        np.testing.assert_allclose(np.asarray(out[1, 3]), 0.0)
+        np.testing.assert_allclose(np.asarray(out[1, 4]), 0.0)
+
+    def test_trainable_padding_rows(self, np_rng):
+        x = jnp.asarray(np_rng.randn(1, 4, 2), jnp.float32)
+        lengths = jnp.asarray([4])
+        pads = jnp.asarray(np_rng.randn(2, 2), jnp.float32)  # 1 start, 1 end
+        out = seq_ops.context_projection(
+            x, lengths, context_len=3, context_start=-1,
+            padding_weights=pads)
+        # position 0's left slot uses start-pad row 0
+        np.testing.assert_allclose(np.asarray(out[0, 0, :2]),
+                                   np.asarray(pads[0]), rtol=1e-6)
+        # last position's right slot uses end-pad row 0
+        np.testing.assert_allclose(np.asarray(out[0, 3, 4:]),
+                                   np.asarray(pads[1]), rtol=1e-6)
+
+    def test_grad(self, np_rng):
+        x = jnp.asarray(np_rng.randn(2, 4, 3))
+        lengths = jnp.asarray([4, 2])
+        filt = jnp.asarray(np_rng.randn(9, 5))
+
+        def f(p):
+            out = seq_ops.sequence_conv(p["x"], lengths, p["f"],
+                                        context_len=3)
+            return jnp.sum(out ** 2)
+
+        directional_grad_check(f, {"x": x, "f": filt})
+
+
+class TestBlockExpand:
+    def test_shape_and_values(self, np_rng):
+        x = jnp.asarray(np_rng.randn(2, 6, 8, 3), jnp.float32)
+        out = conv_ops.block_expand(x, (2, 2), stride=2)
+        assert out.shape == (2, 3 * 4, 2 * 2 * 3)
+        # first block of first image == the top-left 2x2 patch
+        got = np.asarray(out[0, 0])
+        patch = np.asarray(x[0, :2, :2, :])  # [2,2,3]
+        # im2col emits [C, kh, kw] ordering per conv_general_dilated_patches
+        want = patch.transpose(2, 0, 1).reshape(-1)
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_layer_feeds_sequence_pool(self, np_rng):
+        model = nn.Sequential([
+            nn.BlockExpand((2, 2), name="be"),
+            nn.SequencePool("mean", name="pool"),
+            nn.Dense(4, name="fc"),
+        ])
+        params, state = model.init(jax.random.key(0),
+                                   ShapeSpec((2, 6, 8, 3)))
+        x = jnp.asarray(np_rng.randn(2, 6, 8, 3), jnp.float32)
+        out, _ = model.apply(params, state, x)
+        assert out.shape == (2, 4)
+
+
+class TestPReLU:
+    def test_values(self):
+        x = jnp.asarray([-2.0, -1.0, 0.0, 3.0])
+        y = A.prelu(x, 0.1)
+        np.testing.assert_allclose(np.asarray(y), [-0.2, -0.1, 0.0, 3.0],
+                                   rtol=1e-6)
+
+    def test_layer_learns_alpha(self, np_rng):
+        layer = nn.PReLU()
+        params, _ = layer.init(jax.random.key(0), ShapeSpec((4, 6)))
+        assert params["alpha"].shape == (6,)
+        shared = nn.PReLU(channel_shared=True)
+        sp, _ = shared.init(jax.random.key(0), ShapeSpec((4, 6)))
+        assert sp["alpha"].shape == ()
+
+        x = jnp.asarray(np_rng.randn(4, 6))
+
+        def f(p):
+            out, _ = layer._apply(p, {}, x, training=True, rng=None)
+            return jnp.sum(out ** 2)
+
+        directional_grad_check(f, params)
+
+
+class TestInterp:
+    def test_bilinear_upscale_invariants(self, np_rng):
+        x = jnp.asarray(np_rng.rand(1, 4, 4, 2), jnp.float32)
+        out = conv_ops.bilinear_interp(x, (8, 8))
+        assert out.shape == (1, 8, 8, 2)
+        # bilinear interpolation preserves constants exactly...
+        const = jnp.full((1, 4, 4, 1), 0.7, jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(conv_ops.bilinear_interp(const, (9, 5))), 0.7,
+            rtol=1e-6)
+        # ...and stays within the input's range with ~the same mean
+        assert float(out.min()) >= float(x.min()) - 1e-6
+        assert float(out.max()) <= float(x.max()) + 1e-6
+        np.testing.assert_allclose(float(out.mean()), float(x.mean()),
+                                   atol=0.05)
+
+    def test_align_corners_endpoints(self, np_rng):
+        x = jnp.asarray(np_rng.rand(1, 3, 3, 1), jnp.float32)
+        out = conv_ops.bilinear_interp(x, (5, 5), align_corners=True)
+        np.testing.assert_allclose(float(out[0, 0, 0, 0]),
+                                   float(x[0, 0, 0, 0]), rtol=1e-5)
+        np.testing.assert_allclose(float(out[0, 4, 4, 0]),
+                                   float(x[0, 2, 2, 0]), rtol=1e-5)
+
+    def test_nearest(self):
+        x = jnp.arange(4.0).reshape(1, 2, 2, 1)
+        out = conv_ops.nearest_interp(x, (4, 4))
+        np.testing.assert_allclose(np.asarray(out[0, :, :, 0]),
+                                   [[0, 0, 1, 1], [0, 0, 1, 1],
+                                    [2, 2, 3, 3], [2, 2, 3, 3]])
+
+    def test_rotate_roundtrip(self, np_rng):
+        x = jnp.asarray(np_rng.rand(2, 3, 5, 4), jnp.float32)
+        r = conv_ops.rotate90(x)
+        assert r.shape == (2, 5, 3, 4)
+        back = conv_ops.rotate90(r, reverse=True)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+
+class TestCostWrappers:
+    def test_crf_layer_loss_and_decode(self, np_rng):
+        B, T, K = 3, 5, 4
+        layer = nn.CRF(K)
+        emissions = jnp.asarray(np_rng.randn(B, T, K))
+        tags = jnp.asarray(np_rng.randint(0, K, (B, T)))
+        lengths = jnp.asarray([5, 3, 1])
+        params, _ = layer.init(jax.random.key(0),
+                               ShapeSpec((B, T, K)),
+                               ShapeSpec((B, T), jnp.int32),
+                               ShapeSpec((B,), jnp.int32))
+        loss, _ = layer._apply(params, {}, emissions, tags, lengths,
+                               training=True, rng=None)
+        assert loss.shape == (B,) and bool(jnp.all(loss > 0))
+        dec_tags, scores = layer.decode(params, emissions, lengths)
+        assert dec_tags.shape == (B, T)
+
+        def f(p):
+            l, _ = layer._apply(p, {}, emissions, tags, lengths,
+                                training=True, rng=None)
+            return jnp.sum(l)
+
+        directional_grad_check(f, params)
+
+    def test_ctc_layer(self, np_rng):
+        B, T, V, L = 2, 6, 5, 3
+        layer = nn.CTC(blank=0)
+        logits = jnp.asarray(np_rng.randn(B, T, V))
+        log_probs = jax.nn.log_softmax(logits, axis=-1)
+        labels = jnp.asarray(np_rng.randint(1, V, (B, L)))
+        loss, _ = layer._apply({}, {}, log_probs,
+                               jnp.asarray([6, 4]), labels,
+                               jnp.asarray([3, 2]), training=True, rng=None)
+        assert loss.shape == (B,) and bool(jnp.all(loss > 0))
+
+    def test_nce_layer(self, np_rng):
+        B, D, V = 6, 8, 50
+        layer = nn.NCE(V, num_samples=5)
+        params, _ = layer.init(jax.random.key(0), ShapeSpec((B, D)),
+                               ShapeSpec((B,), jnp.int32))
+        hidden = jnp.asarray(np_rng.randn(B, D), jnp.float32)
+        labels = jnp.asarray(np_rng.randint(0, V, B))
+        loss, _ = layer._apply(params, {}, hidden, labels, training=True,
+                               rng=jax.random.key(1))
+        assert loss.shape == (B,) and np.isfinite(np.asarray(loss)).all()
+
+    def test_additive_attention_layer(self, np_rng):
+        B, S, Q, K = 3, 7, 5, 6
+        layer = nn.AdditiveAttention(hidden=4)
+        params, _ = layer.init(jax.random.key(0), ShapeSpec((B, Q)),
+                               ShapeSpec((B, S, K)))
+        q = jnp.asarray(np_rng.randn(B, Q), jnp.float32)
+        keys = jnp.asarray(np_rng.randn(B, S, K), jnp.float32)
+        lengths = jnp.asarray([7, 4, 1])
+        ctx, _ = layer._apply(params, {}, q, keys, lengths, training=True,
+                              rng=None)
+        assert ctx.shape == (B, K)
+        # masked positions have no influence: perturb them, same output
+        keys2 = np.array(keys)
+        keys2[1, 4:] += 100.0
+        ctx2, _ = layer._apply(params, {}, q, jnp.asarray(keys2), lengths,
+                               training=True, rng=None)
+        np.testing.assert_allclose(np.asarray(ctx[1]), np.asarray(ctx2[1]),
+                                   rtol=1e-4)
+
+    def test_sequence_conv_layer_grad(self, np_rng):
+        layer = nn.SequenceConv(4, context_len=3, trainable_padding=True)
+        params, _ = layer.init(jax.random.key(0), ShapeSpec((2, 5, 3)))
+        assert "padding" in params
+        x = jnp.asarray(np_rng.randn(2, 5, 3))
+        lengths = jnp.asarray([5, 2])
+
+        def f(p):
+            out, _ = layer._apply(p, {}, x, lengths, training=True, rng=None)
+            return jnp.sum(out ** 2)
+
+        directional_grad_check(f, params)
