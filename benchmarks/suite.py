@@ -131,6 +131,126 @@ def bench_lstm(hidden: int, batch: int, *, seq_len: int = 100,
     return (time.perf_counter() - t0) / iters
 
 
+def bench_seq2seq(batch: int = 64, *, src_len: int = 30, tgt_len: int = 30,
+                  hidden: int = 512, embed: int = 256, vocab: int = 30000,
+                  iters: int = 20):
+    """Seq2seq-attention NMT training throughput in target tokens/sec —
+    the BASELINE.json north star the round-1 suite never measured
+    (reference driver analog: benchmark/paddle/rnn/run.sh). Variable-
+    length batches: lengths drawn uniformly from [len/2, len] with the
+    dense batch padded to the max (the training pipeline's bucketed
+    shape). MFU comes from XLA's own flop count for the compiled step.
+    """
+    from paddle_tpu.models import seq2seq_attn
+    from paddle_tpu import optim
+
+    rng = np.random.RandomState(0)
+    params = seq2seq_attn.init_params(
+        jax.random.key(0), vocab, vocab, embed_dim=embed, hidden=hidden)
+    opt = optim.adam(1e-3)
+    opt_state = opt.init(params)
+
+    src = jnp.asarray(rng.randint(2, vocab, (batch, src_len)), jnp.int32)
+    tgt = jnp.asarray(rng.randint(2, vocab, (batch, tgt_len)), jnp.int32)
+    src_lens = jnp.asarray(rng.randint(src_len // 2, src_len + 1, batch))
+    tgt_lens = jnp.asarray(rng.randint(tgt_len // 2, tgt_len + 1, batch))
+
+    @jax.jit
+    def step(params, opt_state, src, src_lens, tgt, tgt_lens):
+        def loss_fn(p):
+            return seq2seq_attn.loss(p, src, src_lens, tgt, tgt_lens)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_opt = opt.update(grads, opt_state, params,
+                                         jnp.zeros((), jnp.int32))
+        return new_params, new_opt, loss
+
+    flops = None
+    try:
+        cost = step.lower(params, opt_state, src, src_lens, tgt,
+                          tgt_lens).compile().cost_analysis()
+        if cost and "flops" in cost:
+            flops = float(cost["flops"])
+    except Exception:
+        pass
+
+    params, opt_state, loss = step(params, opt_state, src, src_lens, tgt,
+                                   tgt_lens)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, src, src_lens,
+                                       tgt, tgt_lens)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    tokens = float(jnp.sum(tgt_lens))
+    rec = {
+        "bench": "seq2seq_attn", "batch": batch,
+        "hidden": hidden, "src_len": src_len, "tgt_len": tgt_len,
+        "ms_per_batch": round(1000 * dt, 2),
+        "tgt_tokens_per_sec": round(tokens / dt, 1),
+    }
+    if flops:
+        rec["mfu_pct"] = round(100 * (flops / dt) / (V5E_PEAK_TFLOPS * 1e12),
+                               1)
+    return rec
+
+
+def bench_ctr_sparse(batch: int = 4096, *, slots: int = 32,
+                     vocab: int = 1_000_000, dim: int = 64,
+                     iters: int = 20):
+    """CTR sparse-embedding training throughput — the second unmeasured
+    north star (BASELINE.json: 'sparse-embedding throughput via ICI
+    all-to-all'). Reports rows exchanged/sec through one full train step
+    (lookup + backward push on deep[dim]+wide[1] tables) and the
+    effective row-gather bandwidth vs the chip's HBM peak.
+
+    Runs on a model-axis mesh over ALL local devices (1 on a single
+    chip — the exchange is then local; on a pod slice the same code
+    measures the ICI path).
+    """
+    from paddle_tpu.core import mesh as mesh_lib
+    from paddle_tpu.models.ctr import CTRModel
+    from paddle_tpu import optim
+
+    n_dev = len(jax.devices())
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=1, model=n_dev))
+    model = CTRModel(vocab=vocab, embed_dim=dim, mesh=mesh)
+    rng = np.random.RandomState(0)
+    params, mlp_state = model.init(jax.random.key(0), batch, slots)
+    opt = optim.adam(1e-3)
+    opt_state = opt.init(params["mlp"])
+    step = model.make_train_step(opt, mlp_state)
+
+    ids = jnp.asarray(rng.randint(0, vocab, (batch, slots)), jnp.int32)
+    labels = jnp.asarray(rng.randint(0, 2, batch), jnp.int32)
+    lr = jnp.asarray(0.05, jnp.float32)
+    step_i = jnp.zeros((), jnp.int32)
+
+    params, opt_state, loss = step(params, opt_state, ids, labels, lr,
+                                   step_i, jax.random.key(1))
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, ids, labels, lr,
+                                       step_i, jax.random.key(1))
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    # rows moved per step: deep + wide lookups AND their grad pushes
+    rows = batch * slots * 2 * 2
+    row_bytes = batch * slots * 2 * (dim + 1) * 4  # f32 vectors each way
+    hbm_peak = 819e9  # v5e HBM GB/s
+    return {
+        "bench": "ctr_sparse", "batch": batch, "slots": slots,
+        "vocab": vocab, "dim": dim, "n_devices": n_dev,
+        "ms_per_batch": round(1000 * dt, 2),
+        "rows_per_sec": round(rows / dt, 1),
+        "examples_per_sec": round(batch / dt, 1),
+        "row_exchange_gbps": round(row_bytes / dt / 1e9, 2),
+        "hbm_util_pct": round(100 * (row_bytes / dt) / hbm_peak, 2),
+    }
+
+
 def bench_trainer_loop(name: str, batch: int, *, hw: int = 224,
                        iters: int = 20):
     """Same model/step as bench_image but THROUGH the Trainer event loop
@@ -211,6 +331,21 @@ def main():
         if not quick and name in FWD_GFLOPS:
             tflops = (batch / dt) * 3 * FWD_GFLOPS[name] / 1000
             rec["mfu_pct"] = round(100 * tflops / V5E_PEAK_TFLOPS, 1)
+        print(json.dumps(rec))
+
+    if not only or "seq2seq" in only:
+        rec = bench_seq2seq(
+            batch=16 if quick else 64,
+            src_len=8 if quick else 30, tgt_len=8 if quick else 30,
+            hidden=32 if quick else 512, embed=16 if quick else 256,
+            vocab=500 if quick else 30000, iters=iters)
+        print(json.dumps(rec))
+
+    if not only or "ctr" in only:
+        rec = bench_ctr_sparse(
+            batch=256 if quick else 4096, slots=8 if quick else 32,
+            vocab=10_000 if quick else 1_000_000,
+            dim=16 if quick else 64, iters=iters)
         print(json.dumps(rec))
 
     if not only or "trainer_loop" in only:
