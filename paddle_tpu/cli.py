@@ -243,6 +243,20 @@ def cmd_bench(_args) -> int:
     return 0
 
 
+def cmd_make_diagram(args) -> int:
+    from paddle_tpu.utils.diagram import model_to_dot
+
+    cfg = _load_config(args.config)
+    dot = model_to_dot(cfg["model"], name=cfg.get("name", "model"))
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(dot + "\n")
+        print(f"wrote {args.output} (render: dot -Tpng {args.output})")
+    else:
+        print(dot)
+    return 0
+
+
 def cmd_launch(args) -> int:
     from paddle_tpu.parallel import launch as launch_mod
 
@@ -315,6 +329,14 @@ def build_parser() -> argparse.ArgumentParser:
     ms.set_defaults(fn=cmd_master)
 
     sub.add_parser("bench").set_defaults(fn=cmd_bench)
+
+    md = sub.add_parser(
+        "make-diagram",
+        help="emit a graphviz dot topology diagram (reference: "
+             "make_model_diagram.py)")
+    md.add_argument("--config", required=True)
+    md.add_argument("--output", default=None)
+    md.set_defaults(fn=cmd_make_diagram)
 
     l = sub.add_parser(
         "launch",

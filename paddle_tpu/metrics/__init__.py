@@ -28,6 +28,12 @@ from paddle_tpu.metrics.editdist import (
     edit_distance,
 )
 from paddle_tpu.metrics.detection import DetectionMAPEvaluator
+from paddle_tpu.metrics.printer import (
+    SeqTextPrinter,
+    ValuePrinter,
+    format_parameter_stats,
+    parameter_stats,
+)
 
 __all__ = [
     "Evaluator",

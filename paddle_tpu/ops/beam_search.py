@@ -5,10 +5,12 @@ per step with user-control callbacks (reference:
 gserver/gradientmachines/RecurrentGradientMachine.cpp:1439 beamSearch,
 :1233 beamExpand, :1259 beamShrink, callbacks RecurrentGradientMachine.h:
 71-177; Fluid ops operators/beam_search_op.cc, beam_search_decode_op.cc)
-— with a masked fixed-beam lax.while_loop-free scan: every step scores
-B*K*V candidates, takes top-K, tracks backpointers, and finished beams
-absorb EOS with zero incremental score. Static shapes throughout (XLA
-requirement); max_len bounds the unroll via lax.scan + early-exit masking.
+— with a masked fixed-beam loop: every step scores B*K*V candidates,
+takes top-K, tracks backpointers, and finished beams absorb EOS with
+zero incremental score. Static shapes throughout (XLA requirement);
+max_len bounds a lax.while_loop that EXITS EARLY once every beam in the
+batch has emitted EOS (the reference's beamShrink drop-finished
+semantics), so short decodes don't pay max_len cost.
 
 User hooks: `modify_logits_fn(step, logits, state) -> logits` gives the
 equivalent of the reference's per-step user callbacks (e.g. constrained
@@ -120,8 +122,12 @@ def beam_search(
         )
         return (new_state, new_token.reshape(b * k)), top_scores
 
-    (final, _), step_scores = jax.lax.scan(
-        body, (state0, prev_tokens0), None, length=max_len
+    def cond(carry):
+        state, _ = carry
+        return (state.step < max_len) & ~jnp.all(state.finished)
+
+    final, _ = jax.lax.while_loop(
+        cond, lambda carry: body(carry, None)[0], (state0, prev_tokens0)
     )
 
     lengths = jnp.sum((final.tokens != eos_id).astype(jnp.int32), axis=-1)
@@ -153,21 +159,28 @@ def greedy_search(
     reference: RecurrentGradientMachine.cpp:1037). Returns
     (tokens [B, max_len], lengths [B])."""
 
-    def body(carry, _):
-        prev, state, finished = carry
+    def body(carry):
+        prev, state, finished, toks, t = carry
         logits, new_state = step_fn(prev, state)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         nxt = jnp.where(finished, eos_id, nxt)
         new_finished = finished | (nxt == eos_id)
-        return (nxt, new_state, new_finished), nxt
+        toks = jax.lax.dynamic_update_slice(
+            toks, nxt[:, None], (jnp.zeros((), jnp.int32), t))
+        return (nxt, new_state, new_finished, toks, t + 1)
+
+    def cond(carry):
+        _, _, finished, _, t = carry
+        return (t < max_len) & ~jnp.all(finished)
 
     init = (
         jnp.full((batch_size,), bos_id, jnp.int32),
         init_decoder_state,
         jnp.zeros((batch_size,), bool),
+        jnp.full((batch_size, max_len), eos_id, jnp.int32),
+        jnp.zeros((), jnp.int32),
     )
-    _, tokens = jax.lax.scan(body, init, None, length=max_len)
-    tokens = jnp.swapaxes(tokens, 0, 1)  # [B, L]
+    *_, tokens, _ = jax.lax.while_loop(cond, body, init)
     lengths = jnp.sum((tokens != eos_id).astype(jnp.int32), axis=-1)
     any_eos = jnp.any(tokens == eos_id, axis=-1)
     lengths = jnp.minimum(lengths + any_eos.astype(jnp.int32), max_len)

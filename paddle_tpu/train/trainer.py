@@ -275,11 +275,15 @@ class Trainer:
         test_iter_factory: Optional[Callable[[], Iterable]] = None,
         checkpoint_manager=None,
         checkpoint_every_n_batches: Optional[int] = None,
+        parameter_stats_period: Optional[int] = None,
     ) -> TrainState:
         """checkpoint_manager: train.CheckpointManager; saves every pass
         end, plus every checkpoint_every_n_batches batches if set
         (reference: save_dir + saving_period flags,
-        trainer/Trainer.cpp:60-89)."""
+        trainer/Trainer.cpp:60-89).
+        parameter_stats_period: print per-parameter magnitude dumps every
+        N batches (reference: show_parameter_stats_period,
+        trainer/TrainerInternal.cpp:186 showParameterStats)."""
         handler = event_handler or (lambda ev: None)
         for pass_id in range(num_passes):
             handler(E.BeginPass(pass_id))
@@ -295,6 +299,15 @@ class Trainer:
                 # hot loop keeps dispatching asynchronously
                 handler(E.EndIteration(pass_id, batch_id, cost=loss,
                                        metrics=metrics))
+                if (parameter_stats_period
+                        and (batch_id + 1) % parameter_stats_period == 0):
+                    from paddle_tpu.metrics.printer import (
+                        format_parameter_stats, parameter_stats)
+
+                    print(f"--- parameter stats (pass {pass_id} batch "
+                          f"{batch_id}) ---")
+                    print(format_parameter_stats(
+                        parameter_stats(state.params)))
                 if (checkpoint_manager is not None
                         and checkpoint_every_n_batches
                         and (batch_id + 1) % checkpoint_every_n_batches == 0):

@@ -8,3 +8,5 @@ from paddle_tpu.utils.profiler import (
     stop_trace,
     trace,
 )
+from paddle_tpu.utils.plot import CostCurve
+from paddle_tpu.utils.diagram import model_to_dot

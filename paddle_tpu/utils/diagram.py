@@ -1,0 +1,74 @@
+"""Model topology diagrams (reference:
+python/paddle/utils/make_model_diagram.py — graphviz dot from a model
+config; `paddle make_diagram` CLI verb in scripts/submit_local.sh.in).
+
+Walks the Layer tree (Sequential / composites / wrapped groups) and
+emits graphviz dot text; render with `dot -Tpng` if graphviz is
+installed, or view the text directly.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from paddle_tpu.nn.module import Layer, Sequential
+
+
+def _label(layer: Layer) -> str:
+    cls = type(layer).__name__
+    name = getattr(layer, "name", None)
+    bits = [cls]
+    for attr in ("features", "hidden", "kernel_size", "stride", "rate",
+                 "num_tags", "vocab_size", "mode", "context_len"):
+        v = getattr(layer, attr, None)
+        if v is not None and not callable(v):
+            bits.append(f"{attr}={v}")
+    head = name or cls.lower()
+    return f"{head}\\n{' '.join(bits)}"
+
+
+def _walk(layer: Layer, nodes: List[Tuple[str, str]],
+          edges: List[Tuple[str, str]], parent: Optional[str],
+          prefix: str) -> str:
+    """Add this layer (and sublayers) to the graph; returns the id of the
+    layer's output node so the caller can chain."""
+    nid = f"n{len(nodes)}"
+    nodes.append((nid, _label(layer)))
+    if parent is not None:
+        edges.append((parent, nid))
+
+    children = []
+    if isinstance(layer, Sequential):
+        children = list(layer.layers)
+    else:
+        for attr in ("main", "shortcut", "mlp"):
+            sub = getattr(layer, attr, None)
+            if isinstance(sub, Layer):
+                children.append(sub)
+        branches = getattr(layer, "branches", None)
+        if isinstance(branches, (list, tuple)):
+            children.extend(b for b in branches if isinstance(b, Layer))
+        networks = getattr(layer, "networks", None)
+        if isinstance(networks, list):
+            children.extend(n for _, n in networks)
+
+    last = nid
+    for child in children:
+        last = _walk(child, nodes, edges, last, prefix)
+    return last
+
+
+def model_to_dot(model: Layer, *, name: str = "model") -> str:
+    """Emit graphviz dot text for a Layer tree."""
+    nodes: List[Tuple[str, str]] = []
+    edges: List[Tuple[str, str]] = []
+    _walk(model, nodes, edges, None, "")
+    lines = [f'digraph "{name}" {{',
+             "  rankdir=TB;",
+             '  node [shape=box, fontname="monospace", fontsize=10];']
+    for nid, label in nodes:
+        lines.append(f'  {nid} [label="{label}"];')
+    for a, b in edges:
+        lines.append(f"  {a} -> {b};")
+    lines.append("}")
+    return "\n".join(lines)

@@ -1,0 +1,75 @@
+"""Training-curve collection/plotting (reference:
+python/paddle/v2/plot/plot.py Ploter + python/paddle/utils/plotcurve.py).
+
+`CostCurve` is an event handler that records (step, cost[, metrics])
+without forcing a device sync beyond its sampling period, then renders a
+matplotlib PNG (Agg backend, works headless) or dumps CSV.
+"""
+
+from __future__ import annotations
+
+import csv
+from typing import Dict, List, Optional
+
+from paddle_tpu.train import events as E
+
+
+class CostCurve:
+    """Use as (or from) an event handler:
+
+        curve = CostCurve(period=10)
+        trainer.train(state, batches, event_handler=curve)
+        curve.save_png("cost.png")   # or curve.save_csv("cost.csv")
+
+    period: record every Nth batch (each record syncs the device once).
+    Extra series can be added manually via add(name, step, value).
+    """
+
+    def __init__(self, period: int = 10):
+        self.period = max(1, period)
+        self.series: Dict[str, List] = {"cost": []}
+        self._step = 0
+
+    def __call__(self, ev) -> None:
+        if isinstance(ev, E.EndIteration):
+            if self._step % self.period == 0:
+                self.series["cost"].append((self._step, ev.cost))
+                for k, v in ev.metrics.items():
+                    self.series.setdefault(k, []).append((self._step, v))
+            self._step += 1
+        elif isinstance(ev, E.TestResult):
+            self.series.setdefault("test_cost", []).append(
+                (self._step, ev.cost))
+
+    def add(self, name: str, step: int, value: float) -> None:
+        self.series.setdefault(name, []).append((step, float(value)))
+
+    def save_csv(self, path: str) -> None:
+        with open(path, "w", newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["series", "step", "value"])
+            for name, pts in self.series.items():
+                for step, val in pts:
+                    w.writerow([name, step, val])
+
+    def save_png(self, path: str, *, title: Optional[str] = None) -> None:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(figsize=(8, 4.5))
+        for name, pts in self.series.items():
+            if not pts:
+                continue
+            xs, ys = zip(*pts)
+            ax.plot(xs, ys, label=name)
+        ax.set_xlabel("batch")
+        ax.set_ylabel("value")
+        if title:
+            ax.set_title(title)
+        ax.legend()
+        ax.grid(True, alpha=0.3)
+        fig.tight_layout()
+        fig.savefig(path, dpi=120)
+        plt.close(fig)

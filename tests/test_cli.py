@@ -140,3 +140,15 @@ def test_launch_requires_command():
 
     with pytest.raises(SystemExit):
         main(["launch", "--hosts", "a,b"])
+
+
+def test_make_diagram(config_file, tmp_path, capsys):
+    rc = main(["make-diagram", "--config", config_file])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert out.startswith("digraph")
+    dot_file = str(tmp_path / "m.dot")
+    rc = main(["make-diagram", "--config", config_file,
+               "--output", dot_file])
+    assert rc == 0
+    assert open(dot_file).read().startswith("digraph")

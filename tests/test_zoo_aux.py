@@ -9,7 +9,7 @@ from paddle_tpu.data import dataset_zoo as Z
 from paddle_tpu.models import gan as gan_mod, vae as vae_mod
 from paddle_tpu.nn.module import ShapeSpec
 from paddle_tpu.ops import losses
-from paddle_tpu.train import Trainer
+from paddle_tpu.train import Trainer, events as E
 from paddle_tpu.utils import Stat, global_stat, named_scope, timer
 
 
@@ -251,3 +251,82 @@ def test_trainer_checkgrad_multi_output():
              jnp.asarray(rng.randint(0, 2, 8)))
     err = tr.check_gradients(state, batch, eps=1e-4)
     assert err < 1e-4, err
+
+
+def test_printer_evaluators_and_param_stats():
+    import io
+
+    from paddle_tpu.metrics import (SeqTextPrinter, ValuePrinter,
+                                    format_parameter_stats, parameter_stats)
+
+    buf = io.StringIO()
+    vp = ValuePrinter(stream=buf)
+    vp.update(np.arange(6.0).reshape(2, 3), scores=np.ones((2,)))
+    assert "shape=(2, 3)" in buf.getvalue()
+    assert "scores" in buf.getvalue()
+
+    buf = io.StringIO()
+    sp = SeqTextPrinter({0: "<eos>", 1: "hello", 2: "world"}, eos_id=0,
+                        stream=buf)
+    sp.update(np.asarray([[1, 2, 0, 2], [2, 1, 1, 1]]))
+    out = buf.getvalue()
+    assert "hello world <eos>" in out
+    assert "world hello hello hello" in out
+
+    params = {"fc": {"kernel": np.ones((3, 4)), "bias": np.zeros(4)}}
+    grads = {"fc": {"kernel": np.full((3, 4), 0.5), "bias": np.ones(4)}}
+    stats = parameter_stats(params, grads)
+    assert stats["fc/kernel"]["abs_mean"] == 1.0
+    assert stats["fc/kernel"]["grad_abs_mean"] == 0.5
+    text = format_parameter_stats(stats)
+    assert "fc/kernel" in text and "fc/bias" in text
+
+
+def test_cost_curve_collects_and_saves(tmp_path):
+    from paddle_tpu.utils import CostCurve
+
+    curve = CostCurve(period=2)
+    for i in range(6):
+        curve(E.EndIteration(0, i, cost=jnp.asarray(float(10 - i)),
+                             metrics={"acc": jnp.asarray(0.1 * i)}))
+    assert len(curve.series["cost"]) == 3  # every 2nd batch
+    csv_path = tmp_path / "c.csv"
+    curve.save_csv(str(csv_path))
+    assert "cost" in csv_path.read_text()
+    png_path = tmp_path / "c.png"
+    curve.save_png(str(png_path), title="t")
+    assert png_path.exists() and png_path.stat().st_size > 0
+
+
+def test_model_diagram_dot():
+    from paddle_tpu.utils import model_to_dot
+
+    model = nn.Sequential([
+        nn.Dense(8, name="fc1", activation="relu"),
+        nn.Residual(nn.Sequential([nn.Dense(8, name="inner")]),
+                    name="res"),
+        nn.Dense(2, name="out"),
+    ])
+    dot = model_to_dot(model, name="m")
+    assert dot.startswith('digraph "m"')
+    assert "fc1" in dot and "inner" in dot and "->" in dot
+
+
+def test_trainer_parameter_stats_period(capsys):
+    model = nn.Sequential([nn.Dense(4, name="fc")])
+    tr = Trainer(model,
+                 loss_fn=lambda lo, la: jnp.mean(
+                     losses.softmax_cross_entropy(lo, la)),
+                 optimizer=optim.sgd(0.1), seed=0)
+    state = tr.init_state(ShapeSpec((4, 3)))
+    rng = np.random.RandomState(0)
+    batch = (jnp.asarray(rng.rand(4, 3), jnp.float32),
+             jnp.asarray(rng.randint(0, 4, 4)))
+
+    def batches():
+        for _ in range(4):
+            yield batch
+
+    tr.train(state, batches, parameter_stats_period=2)
+    out = capsys.readouterr().out
+    assert "parameter stats" in out and "fc/kernel" in out
