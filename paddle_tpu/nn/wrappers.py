@@ -314,3 +314,264 @@ class AdditiveAttention(Layer):
         weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
         ctx = jnp.einsum("bs,bsf->bf", weights, keys.astype(weights.dtype))
         return ctx.astype(keys.dtype), {}
+
+
+class Maxout(Layer):
+    """Maxout over channel groups (reference: MaxOutLayer.cpp)."""
+
+    def __init__(self, groups: int, name: Optional[str] = None):
+        self.groups = groups
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        c = spec.shape[-1]
+        enforce(c % self.groups == 0, "channels %d %% groups %d != 0",
+                c, self.groups)
+        return {}, {}, ShapeSpec(spec.shape[:-1] + (c // self.groups,),
+                                 spec.dtype)
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return conv_ops.maxout(x, self.groups), {}
+
+
+class SPP(Layer):
+    """Spatial pyramid pooling (reference: SpatialPyramidPoolLayer.cpp).
+    [N,H,W,C] -> [N, sum_l 4^l * C]."""
+
+    def __init__(self, pyramid_height: int = 3, *, pool_type: str = "max",
+                 name: Optional[str] = None):
+        self.pyramid_height = pyramid_height
+        self.pool_type = pool_type
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        n, h, w, c = spec.shape
+        bins = sum(4 ** l for l in range(self.pyramid_height))
+        return {}, {}, ShapeSpec((n, bins * c), spec.dtype)
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return conv_ops.spp(x, self.pyramid_height, self.pool_type), {}
+
+
+class ROIPool(Layer):
+    """ROI max pooling (reference: ROIPoolLayer.cpp). apply(x, rois)."""
+
+    def __init__(self, output_size, *, spatial_scale: float = 1.0,
+                 name: Optional[str] = None):
+        self.output_size = conv_ops._pair(output_size)
+        self.spatial_scale = spatial_scale
+        self.name = name
+
+    def _init(self, rng, x_spec: ShapeSpec, roi_spec: ShapeSpec = None,
+              _abstract: bool = False):
+        n_rois = roi_spec.shape[0] if roi_spec is not None else 1
+        oh, ow = self.output_size
+        return {}, {}, ShapeSpec(
+            (n_rois, oh, ow, x_spec.shape[-1]), x_spec.dtype)
+
+    def _apply(self, params, state, x, rois, *, training: bool, rng):
+        return conv_ops.roi_pool(x, rois, self.output_size,
+                                 self.spatial_scale), {}
+
+
+class CosSim(Layer):
+    """Cosine similarity of two inputs (reference: CosSimLayer.cpp,
+    function/CosSimOp.cpp). apply(a [B,F], b [B,F]) -> [B]."""
+
+    def __init__(self, scale: float = 1.0, name: Optional[str] = None):
+        self.scale = scale
+        self.name = name
+
+    def _init(self, rng, a_spec: ShapeSpec, b_spec: ShapeSpec = None,
+              _abstract: bool = False):
+        return {}, {}, ShapeSpec((a_spec.shape[0],), a_spec.dtype)
+
+    def _apply(self, params, state, a, b, *, training: bool, rng):
+        from paddle_tpu.ops.losses import cos_sim
+
+        return cos_sim(a, b, self.scale), {}
+
+
+class Conv3D(Layer):
+    """3-D conv, NDHWC (reference: gserver/layers/Conv3DLayer.cpp)."""
+
+    def __init__(self, features: int, kernel_size=3, *, stride=1,
+                 padding="SAME", activation=None, use_bias: bool = True,
+                 kernel_init="msra", name: Optional[str] = None):
+        self.features = features
+        k = kernel_size
+        self.kernel_size = (k,) * 3 if isinstance(k, int) else tuple(k)
+        self.stride = (stride,) * 3 if isinstance(stride, int) \
+            else tuple(stride)
+        self.padding = padding
+        self.activation = A.get(activation)
+        self.use_bias = use_bias
+        self.kernel_init = initializers.get(kernel_init)
+        self.name = name
+
+    def _out_dhw(self, d, h, w):
+        kd, kh, kw = self.kernel_size
+        sd, sh, sw = self.stride
+        if self.padding == "SAME":
+            return -(-d // sd), -(-h // sh), -(-w // sw)
+        return ((d - kd) // sd + 1, (h - kh) // sh + 1, (w - kw) // sw + 1)
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        n, d, h, w, c = spec.shape
+        od, oh, ow = self._out_dhw(d, h, w)
+        out = ShapeSpec((n, od, oh, ow, self.features), spec.dtype)
+        if _abstract:
+            return {}, {}, out
+        kr, br = jax.random.split(rng)
+        params = {"kernel": self.kernel_init(
+            kr, (*self.kernel_size, c, self.features))}
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.features,))
+        return params, {}, out
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        y = conv_ops.conv3d(x, params["kernel"], stride=self.stride,
+                            padding=self.padding, bias=params.get("bias"))
+        return self.activation(y), {}
+
+
+class MaxPool3D(Layer):
+    """3-D max pooling, NDHWC (reference: Pool3DLayer.cpp)."""
+
+    def __init__(self, window=2, *, stride=None, padding="VALID",
+                 name: Optional[str] = None):
+        self.window = (window,) * 3 if isinstance(window, int) \
+            else tuple(window)
+        s = stride if stride is not None else window
+        self.stride = (s,) * 3 if isinstance(s, int) else tuple(s)
+        self.padding = padding
+        self.name = name
+
+    def _out(self, d, h, w):
+        kd, kh, kw = self.window
+        sd, sh, sw = self.stride
+        if self.padding == "SAME":
+            return -(-d // sd), -(-h // sh), -(-w // sw)
+        return ((d - kd) // sd + 1, (h - kh) // sh + 1, (w - kw) // sw + 1)
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        n, d, h, w, c = spec.shape
+        return {}, {}, ShapeSpec((n, *self._out(d, h, w), c), spec.dtype)
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return conv_ops.max_pool3d(x, self.window, stride=self.stride,
+                                   padding=self.padding), {}
+
+
+class AvgPool3D(MaxPool3D):
+    def _apply(self, params, state, x, *, training: bool, rng):
+        return conv_ops.avg_pool3d(x, self.window, stride=self.stride,
+                                   padding=self.padding), {}
+
+
+class Concat(Layer):
+    """Concatenate multiple inputs on the last axis (reference:
+    ConcatenateLayer.cpp / concat_layer)."""
+
+    def __init__(self, axis: int = -1, name: Optional[str] = None):
+        self.axis = axis
+        self.name = name
+
+    def _init(self, rng, *specs, _abstract: bool = False):
+        shapes = [list(s.shape) for s in specs]
+        out = list(shapes[0])
+        ax = self.axis if self.axis >= 0 else len(out) + self.axis
+        out[ax] = sum(s[ax] for s in shapes)
+        return {}, {}, ShapeSpec(tuple(out), specs[0].dtype)
+
+    def _apply(self, params, state, *inputs, training: bool, rng):
+        return jnp.concatenate(inputs, axis=self.axis), {}
+
+
+class Slice(Layer):
+    """Slice the channel axis (reference: SliceProjection /
+    slice_projection)."""
+
+    def __init__(self, begin: int, end: int, *, axis: int = -1,
+                 name: Optional[str] = None):
+        self.begin, self.end, self.axis = begin, end, axis
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        out = list(spec.shape)
+        ax = self.axis if self.axis >= 0 else len(out) + self.axis
+        out[ax] = self.end - self.begin
+        return {}, {}, ShapeSpec(tuple(out), spec.dtype)
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        sl = [slice(None)] * x.ndim
+        sl[self.axis] = slice(self.begin, self.end)
+        return x[tuple(sl)], {}
+
+
+class Scaling(Layer):
+    """Learned scalar scale + shift (reference: ScalingLayer.cpp /
+    SlopeInterceptLayer.cpp)."""
+
+    def __init__(self, *, use_bias: bool = True,
+                 name: Optional[str] = None):
+        self.use_bias = use_bias
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        if _abstract:
+            return {}, {}, spec
+        params = {"scale": jnp.ones(())}
+        if self.use_bias:
+            params["shift"] = jnp.zeros(())
+        return params, {}, spec
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        y = x * params["scale"].astype(x.dtype)
+        if self.use_bias:
+            y = y + params["shift"].astype(x.dtype)
+        return y, {}
+
+
+class FeatureMapExpand(Layer):
+    """Expand a [B, C] vector across spatial positions of a feature map
+    (reference: FeatureMapExpandLayer.cpp). apply(vec, like) -> like's
+    spatial shape with vec broadcast."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+
+    def _init(self, rng, vec_spec: ShapeSpec, like_spec: ShapeSpec,
+              _abstract: bool = False):
+        n, h, w, _ = like_spec.shape
+        return {}, {}, ShapeSpec((n, h, w, vec_spec.shape[-1]),
+                                 vec_spec.dtype)
+
+    def _apply(self, params, state, vec, like, *, training: bool, rng):
+        n, h, w, _ = like.shape
+        return jnp.broadcast_to(vec[:, None, None, :],
+                                (n, h, w, vec.shape[-1])), {}
+
+
+class SubSequence(Layer):
+    """Extract a per-sequence [offset, offset+size) window (reference:
+    SubSequenceLayer.cpp). apply(x [B,T,F], offsets [B], sizes [B]) ->
+    ([B, max_size, F], sizes); max_size is static."""
+
+    def __init__(self, max_size: int, name: Optional[str] = None):
+        self.max_size = max_size
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, *rest, _abstract: bool = False):
+        b, t, f = spec.shape
+        return {}, {}, ShapeSpec((b, self.max_size, f), spec.dtype)
+
+    def _apply(self, params, state, x, offsets, sizes, *, training: bool,
+               rng):
+        b, t, f = x.shape
+        pos = jnp.arange(self.max_size)[None, :] + offsets[:, None]
+        valid = (jnp.arange(self.max_size)[None, :] < sizes[:, None]) & \
+            (pos < t)
+        safe = jnp.clip(pos, 0, t - 1)
+        out = jnp.take_along_axis(x, safe[..., None], axis=1)
+        return out * valid[..., None].astype(out.dtype), {}
