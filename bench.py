@@ -1,26 +1,104 @@
-"""Benchmark driver: prints ONE JSON line with the headline metric.
+"""Benchmark driver: one JSON line per north-star metric, headline LAST.
 
-Flagship: ResNet-50 train-step throughput (imgs/sec) on one TPU chip,
-bf16 compute / f32 params — BASELINE.json's headline config
-("ResNet-50 imgs/sec/chip").
+The driver parses the final JSON line (BENCH_r*.json "parsed") and keeps
+the whole tail, so this prints:
 
-vs_baseline: the reference's best published ResNet-50 training number is
-84.1 imgs/sec on 2x Xeon Gold 6148 with MKL-DNN (reference:
-benchmark/IntelOptimizedPaddle.md:42-48 — its K40m GPU table has no
-ResNet-50 entry, so the CPU number is the reference's own headline).
+  1. seq2seq-attention target tokens/sec/chip   (BASELINE.json north star)
+  2. CTR wide&deep sparse rows/sec              (BASELINE.json north star)
+  3. ResNet-50 train imgs/sec/chip              (headline, parsed)
+
+The seq2seq/CTR lines run `benchmarks/suite.py --only ...` in a
+subprocess with a hard timeout so a pathological compile can never
+starve the headline metric (VERDICT r2 weak #2/#3: those benches had
+never produced a driver-visible number).
+
+vs_baseline sources:
+  - resnet50: 84.1 imgs/sec, the reference's best published ResNet-50
+    number (2x Xeon Gold 6148 + MKL-DNN, reference:
+    benchmark/IntelOptimizedPaddle.md:42-48 — its K40m GPU table has no
+    ResNet-50 entry, so the CPU number is the reference's own headline).
+  - seq2seq: the reference's closest published RNN training number —
+    LSTM hidden 512, batch 64, seqlen 100 at 184 ms/batch (reference:
+    benchmark/README.md:115-126, driver benchmark/paddle/rnn/run.sh)
+    = 34,783 processed tokens/sec. The reference has no seq2seq bench;
+    this is its RNN-throughput analog.
+  - ctr_sparse: the reference publishes no sparse-throughput number
+    (vs_baseline: null).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import jax
+
+# the TPU plugin force-selects its platform at config level, outranking
+# JAX_PLATFORMS — mirror a cpu request into the config so a cpu smoke
+# run never claims the chip (same pattern as benchmarks/suite.py)
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    jax.config.update("jax_platforms", "cpu")
+
 import jax.numpy as jnp
 import numpy as np
 
+SUITE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "benchmarks", "suite.py")
 
-def main():
+
+def log(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr,
+          flush=True)
+
+
+def run_suite_only(name: str, timeout_s: int):
+    """Run `suite.py --only <name>` in a subprocess; return its JSON
+    records (empty on timeout/failure — never raises).
+
+    On timeout the child gets SIGTERM and a 60s grace period before
+    SIGKILL: the TPU sits behind a single-claim relay and a hard-killed
+    claimant can wedge the chip for every later process (including the
+    headline resnet bench in THIS process)."""
+    proc = subprocess.Popen(
+        [sys.executable, SUITE, "--only", name],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        log(f"{name}: TIMED OUT after {timeout_s}s — terminating gently")
+        proc.terminate()
+        try:
+            out, err = proc.communicate(timeout=60)
+        except subprocess.TimeoutExpired:
+            log(f"{name}: did not exit on SIGTERM; killing")
+            proc.kill()
+            proc.communicate()
+        return []
+    if proc.returncode != 0:
+        tail = err.strip().splitlines()[-3:]
+        log(f"{name}: failed rc={proc.returncode}: {tail}")
+        return []
+    recs = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return recs
+
+
+def emit(metric: str, value, unit: str, vs_baseline) -> None:
+    print(json.dumps({
+        "metric": metric, "value": value, "unit": unit,
+        "vs_baseline": vs_baseline}), flush=True)
+
+
+def bench_resnet() -> None:
     from paddle_tpu import models, optim
     from paddle_tpu.core import dtypes
     from paddle_tpu.nn.module import ShapeSpec
@@ -50,10 +128,12 @@ def main():
 
     # warmup / compile; the scalar fetch (not block_until_ready) is what
     # actually syncs through the axon tunnel
+    log(f"resnet50: warmup/compile (batch={batch} hw={hw})")
     state, loss, _ = step(state, rng, (x,), (y,))
     float(loss)
 
     iters = 50 if on_tpu else 3
+    log(f"resnet50: timing {iters} steps")
     t0 = time.perf_counter()
     for _ in range(iters):
         state, loss, _ = step(state, rng, (x,), (y,))
@@ -62,16 +142,30 @@ def main():
 
     imgs_per_sec = batch * iters / dt
     baseline = 84.1  # reference ResNet-50 imgs/sec (IntelOptimizedPaddle.md)
-    print(
-        json.dumps(
-            {
-                "metric": "resnet50_train_imgs_per_sec_per_chip",
-                "value": round(imgs_per_sec, 1),
-                "unit": "imgs/sec",
-                "vs_baseline": round(imgs_per_sec / baseline, 2),
-            }
-        )
-    )
+    emit("resnet50_train_imgs_per_sec_per_chip", round(imgs_per_sec, 1),
+         "imgs/sec", round(imgs_per_sec / baseline, 2))
+
+
+def main():
+    # decide the timeout WITHOUT initializing the backend here: the chip
+    # is behind a single-claim relay, and claiming it in this parent
+    # would lock the suite.py subprocesses out of it
+    on_cpu = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
+    timeout = 300 if on_cpu else 1200
+
+    for rec in run_suite_only("seq2seq", timeout):
+        if rec.get("bench") == "seq2seq_attn":
+            v = rec["tgt_tokens_per_sec"]
+            # reference RNN analog: 64 seqs * 100 tokens / 0.184 s
+            emit("seq2seq_attn_tgt_tokens_per_sec_per_chip", v,
+                 "tokens/sec", round(v / 34783.0, 2))
+
+    for rec in run_suite_only("ctr", timeout):
+        if rec.get("bench") == "ctr_sparse":
+            emit("ctr_sparse_rows_per_sec", rec["rows_per_sec"],
+                 "rows/sec", None)
+
+    bench_resnet()
 
 
 if __name__ == "__main__":

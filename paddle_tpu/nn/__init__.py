@@ -40,3 +40,23 @@ from paddle_tpu.nn.recurrent_group import (
     lstm_group,
     scan_subsequences,
 )
+from paddle_tpu.nn.mixed import (
+    Mixed,
+    Projection,
+    Operator,
+    FullMatrixProjection,
+    TransposedFullMatrixProjection,
+    TableProjection,
+    IdentityProjection,
+    IdentityOffsetProjection,
+    SliceProjection,
+    ScalingProjection,
+    DotMulProjection,
+    ContextProjectionBranch,
+    ConvProjection,
+    ConvTransProjection,
+    PoolProjection,
+    DotMulOperator,
+    ConvOperator,
+    ConvTransOperator,
+)

@@ -1,0 +1,507 @@
+"""MixedLayer composition: projections + operators summed into one output.
+
+The reference's second layer-composition paradigm (beyond whole Layers):
+a MixedLayer's output is the SUM of per-input projection outputs (each
+projection may own a parameter) and parameter-free multi-input operator
+outputs, then bias + activation (reference:
+gserver/layers/MixedLayer.cpp, Projection.h:38 "A projection takes one
+Argument as input, calculate the result and add it to output",
+Operator.h:35 "Operator like Projection, but takes more than one
+Arguments as input ... can't have parameters"; user API
+trainer_config_helpers/layers.py mixed_layer + *_projection helpers).
+
+TPU-native shape convention: branches operate on the LAST axis (the
+feature axis); any leading batch/sequence axes pass through, so the same
+projection works on [B, F] and [B, T, F]. Conv/pool branches accept NHWC
+inputs and flatten their output to [B, oh*ow*oc] (the reference's mixed
+space is the flat row), so they can sum with flat branches.
+
+Registered parity list (REGISTER_PROJECTION / REGISTER_OPERATOR sites):
+projections fc, trans_fc, table, identity, identity_offset, scaling,
+dot_mul, context, conv, convt, pool, slice; operators dot_mul, conv,
+convt.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import default_policy
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.nn import initializers
+from paddle_tpu.nn.module import Layer, ShapeSpec
+from paddle_tpu.ops import activations as A
+from paddle_tpu.ops import conv as conv_ops
+from paddle_tpu.ops import linalg
+from paddle_tpu.ops import sequence as seq_ops
+
+
+class Projection:
+    """One input -> one additive contribution; may own parameters.
+
+    Subclasses implement `_init(rng, spec, abstract) -> (params, out_spec)`
+    and `_apply(params, x)`. `input` selects which of the Mixed layer's
+    inputs this projection reads (default 0).
+    """
+
+    def __init__(self, *, input: int = 0, name: Optional[str] = None):
+        self.input = input
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, abstract: bool):
+        raise NotImplementedError
+
+    def _apply(self, params, x):
+        raise NotImplementedError
+
+
+class Operator:
+    """Several inputs -> one additive contribution; NO parameters
+    (reference: Operator.h:35)."""
+
+    def __init__(self, *, inputs: Sequence[int] = (0, 1),
+                 name: Optional[str] = None):
+        self.inputs = tuple(inputs)
+        self.name = name
+
+    def _out_spec(self, *specs: ShapeSpec) -> ShapeSpec:
+        raise NotImplementedError
+
+    def _apply(self, *xs):
+        raise NotImplementedError
+
+
+# --------------------------------------------------------------------
+# projections
+# --------------------------------------------------------------------
+
+
+class FullMatrixProjection(Projection):
+    """out += x @ W (reference: FullMatrixProjection.cpp, helper
+    full_matrix_projection)."""
+
+    def __init__(self, size: int, *, kernel_init="smart", **kw):
+        super().__init__(**kw)
+        self.size = size
+        self.kernel_init = initializers.get(kernel_init)
+
+    def _init(self, rng, spec, abstract):
+        out = ShapeSpec(spec.shape[:-1] + (self.size,), spec.dtype)
+        if abstract:
+            return {}, out
+        return {"kernel": self.kernel_init(rng, (spec.shape[-1], self.size))}, out
+
+    def _apply(self, params, x):
+        return linalg.matmul(x, params["kernel"])
+
+
+class TransposedFullMatrixProjection(Projection):
+    """out += x @ W^T with W stored [size, in] (reference:
+    TransposedFullMatrixProjection.cpp — shares W with a tied fc going
+    the other way, helper trans_full_matrix_projection)."""
+
+    def __init__(self, size: int, *, kernel_init="smart", **kw):
+        super().__init__(**kw)
+        self.size = size
+        self.kernel_init = initializers.get(kernel_init)
+
+    def _init(self, rng, spec, abstract):
+        out = ShapeSpec(spec.shape[:-1] + (self.size,), spec.dtype)
+        if abstract:
+            return {}, out
+        return {"kernel": self.kernel_init(rng, (self.size, spec.shape[-1]))}, out
+
+    def _apply(self, params, x):
+        return linalg.matmul(x, params["kernel"].T)
+
+
+class TableProjection(Projection):
+    """Integer ids -> summed table rows (reference: TableProjection.cpp
+    selectRows; helper table_projection)."""
+
+    def __init__(self, vocab: int, size: int, *, init="normal005", **kw):
+        super().__init__(**kw)
+        self.vocab = vocab
+        self.size = size
+        self.init = (initializers.normal(0.05) if init == "normal005"
+                     else initializers.get(init))
+
+    def _init(self, rng, spec, abstract):
+        out = ShapeSpec(spec.shape + (self.size,), jnp.float32)
+        if abstract:
+            return {}, out
+        return {"table": self.init(rng, (self.vocab, self.size))}, out
+
+    def _apply(self, params, x):
+        return jnp.take(params["table"], x, axis=0)
+
+
+class IdentityProjection(Projection):
+    """out += x, no parameters (reference: IdentityProjection.cpp)."""
+
+    def _init(self, rng, spec, abstract):
+        return {}, spec
+
+    def _apply(self, params, x):
+        return x
+
+
+class IdentityOffsetProjection(Projection):
+    """out[j] += x[j + offset] — selects [offset, offset+size) of the
+    input (reference: IdentityProjection.cpp:60 IdentityOffsetProjection,
+    helper identity_projection(offset=...))."""
+
+    def __init__(self, size: int, *, offset: int, **kw):
+        super().__init__(**kw)
+        self.size = size
+        self.offset = offset
+
+    def _init(self, rng, spec, abstract):
+        enforce(self.offset + self.size <= spec.shape[-1],
+                "identity_offset out of range")
+        return {}, ShapeSpec(spec.shape[:-1] + (self.size,), spec.dtype)
+
+    def _apply(self, params, x):
+        return jax.lax.slice_in_dim(x, self.offset, self.offset + self.size,
+                                    axis=-1)
+
+
+class SliceProjection(Projection):
+    """Concat selected column ranges of the input (reference:
+    SliceProjection.cpp, helper slice_projection)."""
+
+    def __init__(self, slices: Sequence[Tuple[int, int]], **kw):
+        super().__init__(**kw)
+        enforce(len(slices) >= 1, "need at least one slice")
+        start = 0
+        for s, e in slices:
+            enforce(s >= start and e >= s, "slices must be ordered")
+            start = e
+        self.slices = [(int(s), int(e)) for s, e in slices]
+
+    def _init(self, rng, spec, abstract):
+        enforce(self.slices[-1][1] <= spec.shape[-1], "slice out of range")
+        size = sum(e - s for s, e in self.slices)
+        return {}, ShapeSpec(spec.shape[:-1] + (size,), spec.dtype)
+
+    def _apply(self, params, x):
+        parts = [jax.lax.slice_in_dim(x, s, e, axis=-1)
+                 for s, e in self.slices]
+        return jnp.concatenate(parts, axis=-1)
+
+
+class ScalingProjection(Projection):
+    """out += w * x with a single learned scalar (reference:
+    ScalingProjection.cpp, helper scaling_projection)."""
+
+    def _init(self, rng, spec, abstract):
+        if abstract:
+            return {}, spec
+        return {"w": jnp.ones((1,), jnp.float32)}, spec
+
+    def _apply(self, params, x):
+        return params["w"] * x
+
+
+class DotMulProjection(Projection):
+    """out += w ⊙ x with a learned per-feature weight (reference:
+    DotMulProjection.cpp, helper dotmul_projection)."""
+
+    def __init__(self, *, init="ones", **kw):
+        super().__init__(**kw)
+        self.init = initializers.get(init)
+
+    def _init(self, rng, spec, abstract):
+        if abstract:
+            return {}, spec
+        return {"w": self.init(rng, (spec.shape[-1],))}, spec
+
+    def _apply(self, params, x):
+        return params["w"] * x
+
+
+class ContextProjectionBranch(Projection):
+    """Sliding context-window concat over [B, T, F] with optional
+    trainable padding rows (reference: ContextProjection.cpp, helper
+    context_projection). Output [B, T, context_len*F]."""
+
+    def __init__(self, context_len: int, *, context_start: Optional[int] = None,
+                 trainable_padding: bool = False, lengths_input: Optional[int] = None,
+                 **kw):
+        super().__init__(**kw)
+        self.context_len = context_len
+        self.context_start = (-(context_len // 2) if context_start is None
+                              else context_start)
+        self.trainable_padding = trainable_padding
+        self.lengths_input = lengths_input  # optional Mixed input index of [B] lengths
+
+    def _init(self, rng, spec, abstract):
+        b, t, f = spec.shape
+        out = ShapeSpec((b, t, self.context_len * f), spec.dtype)
+        if abstract or not self.trainable_padding:
+            return {}, out
+        start_pad = max(0, -self.context_start)
+        end_pad = max(0, self.context_len + self.context_start - 1)
+        return {"padding": jnp.zeros((start_pad + end_pad, f), jnp.float32)}, out
+
+    def _apply(self, params, x, lengths=None):
+        return seq_ops.context_projection(
+            x, lengths, context_len=self.context_len,
+            context_start=self.context_start,
+            padding_weights=params.get("padding"))
+
+
+class ConvProjection(Projection):
+    """Conv on an NHWC input, flattened into the mixed space (reference:
+    ConvProjection.cpp, helper conv_projection). The filter is this
+    projection's parameter."""
+
+    def __init__(self, channels: int, kernel: Union[int, Tuple[int, int]],
+                 *, stride: Union[int, Tuple[int, int]] = 1, padding="SAME",
+                 kernel_init="msra", flatten: bool = True, **kw):
+        super().__init__(**kw)
+        self.channels = channels
+        self.kernel = conv_ops._pair(kernel)
+        self.stride = conv_ops._pair(stride)
+        self.padding = padding
+        self.kernel_init = initializers.get(kernel_init)
+        self.flatten = flatten
+
+    def _out_hw(self, h, w):
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        if self.padding == "SAME":
+            return -(-h // sh), -(-w // sw)
+        ph, pw = (0, 0) if self.padding == "VALID" else conv_ops._pair(self.padding)
+        return (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
+
+    def _init(self, rng, spec, abstract):
+        n, h, w, c = spec.shape
+        oh, ow = self._out_hw(h, w)
+        shape = ((n, oh * ow * self.channels) if self.flatten
+                 else (n, oh, ow, self.channels))
+        out = ShapeSpec(shape, spec.dtype)
+        if abstract:
+            return {}, out
+        kh, kw = self.kernel
+        return {"kernel": self.kernel_init(rng, (kh, kw, c, self.channels))}, out
+
+    def _conv(self, x, kernel):
+        return conv_ops.conv2d(x, kernel, stride=self.stride,
+                               padding=self.padding)
+
+    def _apply(self, params, x):
+        y = self._conv(x, params["kernel"])
+        return y.reshape(y.shape[0], -1) if self.flatten else y
+
+
+class ConvTransProjection(ConvProjection):
+    """Transposed-conv projection (reference: ConvTransProjection.cpp).
+    Only the output-size rule and the conv kind differ from
+    ConvProjection; init is inherited."""
+
+    def _out_hw(self, h, w):
+        sh, sw = self.stride
+        kh, kw = self.kernel
+        enforce(self.padding in ("SAME", "VALID"),
+                "ConvTransProjection supports SAME/VALID padding only")
+        if self.padding == "SAME":
+            return h * sh, w * sw
+        return (h - 1) * sh + kh, (w - 1) * sw + kw
+
+    def _conv(self, x, kernel):
+        return conv_ops.conv2d_transpose(x, kernel, stride=self.stride,
+                                         padding=self.padding)
+
+
+class PoolProjection(Projection):
+    """Max/avg pool on an NHWC input, flattened (reference:
+    PoolProjection.cpp max/avg variants, PoolProjectionLayer)."""
+
+    def __init__(self, pool_type: str = "max",
+                 window: Union[int, Tuple[int, int]] = 2, *,
+                 stride: Optional[Union[int, Tuple[int, int]]] = None,
+                 padding="VALID", flatten: bool = True, **kw):
+        super().__init__(**kw)
+        enforce(pool_type in ("max", "avg"), "pool_type must be max|avg")
+        self.pool_type = pool_type
+        self.window = conv_ops._pair(window)
+        self.stride = conv_ops._pair(stride if stride is not None else window)
+        self.padding = padding
+        self.flatten = flatten
+
+    def _init(self, rng, spec, abstract):
+        n, h, w, c = spec.shape
+        wh, ww = self.window
+        sh, sw = self.stride
+        if self.padding == "SAME":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            ph, pw = ((0, 0) if self.padding == "VALID"
+                      else conv_ops._pair(self.padding))
+            oh = (h + 2 * ph - wh) // sh + 1
+            ow = (w + 2 * pw - ww) // sw + 1
+        shape = (n, oh * ow * c) if self.flatten else (n, oh, ow, c)
+        return {}, ShapeSpec(shape, spec.dtype)
+
+    def _apply(self, params, x):
+        fn = (conv_ops.max_pool2d if self.pool_type == "max"
+              else conv_ops.avg_pool2d)
+        y = fn(x, self.window, stride=self.stride, padding=self.padding)
+        return y.reshape(y.shape[0], -1) if self.flatten else y
+
+
+# --------------------------------------------------------------------
+# operators (parameter-free, multi-input)
+# --------------------------------------------------------------------
+
+
+class DotMulOperator(Operator):
+    """out += scale * (a ⊙ b) (reference: DotMulOperator.cpp, helper
+    dotmul_operator)."""
+
+    def __init__(self, scale: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.scale = scale
+
+    def _out_spec(self, a: ShapeSpec, b: ShapeSpec) -> ShapeSpec:
+        enforce(a.shape == b.shape, "dot_mul operands must match")
+        return a
+
+    def _apply(self, a, b):
+        return self.scale * a * b
+
+
+class ConvOperator(Operator):
+    """Per-sample convolution where the FILTER is the second input —
+    a layer output, not a parameter (reference: ConvOperator.cpp:59-75
+    offsets the weight pointer per batch row; helper conv_operator).
+    Maps to vmap over a per-sample conv on TPU. Inputs: NHWC image,
+    [B, kh*kw*cin*cout] filters. Output flat [B, oh*ow*cout]."""
+
+    def __init__(self, channels: int, kernel: Union[int, Tuple[int, int]],
+                 *, stride: Union[int, Tuple[int, int]] = 1,
+                 padding="SAME", **kw):
+        super().__init__(**kw)
+        self.channels = channels
+        self.kernel = conv_ops._pair(kernel)
+        self.stride = conv_ops._pair(stride)
+        self.padding = padding
+
+    def _out_hw(self, h, w):
+        sh, sw = self.stride
+        kh, kw = self.kernel
+        if self.padding == "SAME":
+            return -(-h // sh), -(-w // sw)
+        return (h - kh) // sh + 1, (w - kw) // sw + 1
+
+    def _out_spec(self, img: ShapeSpec, flt: ShapeSpec) -> ShapeSpec:
+        n, h, w, c = img.shape
+        kh, kw = self.kernel
+        enforce(flt.shape == (n, kh * kw * c * self.channels),
+                f"filter input must be [B, {kh*kw*c*self.channels}], "
+                f"got {flt.shape}")
+        oh, ow = self._out_hw(h, w)
+        return ShapeSpec((n, oh * ow * self.channels), img.dtype)
+
+    def _conv_one(self, img, kernel):
+        return conv_ops.conv2d(img[None], kernel, stride=self.stride,
+                               padding=self.padding)[0]
+
+    def _apply(self, img, flt):
+        n, h, w, c = img.shape
+        kh, kw = self.kernel
+        kernels = flt.reshape(n, kh, kw, c, self.channels)
+        y = jax.vmap(self._conv_one)(img, kernels)
+        return y.reshape(n, -1)
+
+
+class ConvTransOperator(ConvOperator):
+    """Per-sample transposed conv with input-supplied filters
+    (reference: ConvTransOperator.cpp)."""
+
+    def _out_hw(self, h, w):
+        sh, sw = self.stride
+        kh, kw = self.kernel
+        if self.padding == "SAME":
+            return h * sh, w * sw
+        return (h - 1) * sh + kh, (w - 1) * sw + kw
+
+    def _conv_one(self, img, kernel):
+        return conv_ops.conv2d_transpose(
+            img[None], kernel, stride=self.stride, padding=self.padding)[0]
+
+
+# --------------------------------------------------------------------
+# the Mixed layer
+# --------------------------------------------------------------------
+
+
+class Mixed(Layer):
+    """Sum of projection/operator branch outputs + bias + activation
+    (reference: gserver/layers/MixedLayer.cpp forward: each projection
+    accumulates into output->value, then bias and activation; user API
+    mixed_layer in trainer_config_helpers/layers.py).
+
+    branches: Projection/Operator objects; each Projection reads
+    Mixed input[p.input], each Operator reads inputs[i] for its indices.
+    All branch outputs must agree in shape.
+    """
+
+    def __init__(self, branches: Sequence[Union[Projection, Operator]], *,
+                 activation=None, use_bias: bool = False,
+                 bias_init="zeros", name: Optional[str] = None):
+        enforce(len(branches) >= 1, "Mixed needs at least one branch")
+        self.branches = list(branches)
+        self.activation = A.get(activation)
+        self.use_bias = use_bias
+        self.bias_init = initializers.get(bias_init)
+        self.name = name
+
+    def _branch_key(self, i: int, b) -> str:
+        return b.name or f"b{i}_{type(b).__name__}"
+
+    def _init(self, rng, *specs, _abstract: bool = False):
+        params, out_spec = {}, None
+        for i, b in enumerate(self.branches):
+            key = self._branch_key(i, b)
+            enforce(key not in params, f"duplicate branch name {key}")
+            if isinstance(b, Operator):
+                o = b._out_spec(*(specs[j] for j in b.inputs))
+                sub = {}
+            else:
+                if _abstract:
+                    sub, o = b._init(None, specs[b.input], True)
+                else:
+                    rng, sr = jax.random.split(rng)
+                    sub, o = b._init(sr, specs[b.input], False)
+            if out_spec is None:
+                out_spec = o
+            else:
+                enforce(o.shape == out_spec.shape,
+                        f"branch {key} shape {o.shape} != {out_spec.shape}")
+            if sub:
+                params[key] = sub
+        if self.use_bias and not _abstract:
+            rng, br = jax.random.split(rng)
+            params["bias"] = self.bias_init(br, (out_spec.shape[-1],))
+        return params, {}, out_spec
+
+    def _apply(self, params, state, *inputs, training: bool, rng):
+        out = None
+        for i, b in enumerate(self.branches):
+            key = self._branch_key(i, b)
+            if isinstance(b, Operator):
+                y = b._apply(*(inputs[j] for j in b.inputs))
+            elif isinstance(b, ContextProjectionBranch) and b.lengths_input is not None:
+                y = b._apply(params.get(key, {}), inputs[b.input],
+                             inputs[b.lengths_input])
+            else:
+                y = b._apply(params.get(key, {}), inputs[b.input])
+            out = y if out is None else out + y
+        if self.use_bias:
+            out = out + params["bias"]
+        return self.activation(out), {}

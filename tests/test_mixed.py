@@ -1,0 +1,196 @@
+"""MixedLayer composition tests — projections + operators summed
+(reference: gserver/layers/MixedLayer.cpp; grad coverage mirrors
+gserver/tests/test_LayerGrad.cpp's mixed/projection cases)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gradcheck import directional_grad_check
+from paddle_tpu import nn
+from paddle_tpu.nn import mixed as M
+from paddle_tpu.nn.module import ShapeSpec
+
+
+def _apply_sum(layer, params, *inputs):
+    out, _ = layer.apply(params, {}, *inputs, training=True, rng=None)
+    return jnp.sum(out ** 2)
+
+
+def test_mixed_fc_identity_dotmul_sum():
+    """fc + identity + dot_mul projections over two inputs sum into one
+    output; matches manual computation."""
+    layer = M.Mixed([
+        M.FullMatrixProjection(8, input=0, name="fc"),
+        M.IdentityProjection(input=1, name="id"),
+        M.DotMulProjection(input=1, name="dm"),
+    ], use_bias=True)
+    x0 = jnp.asarray(np.random.RandomState(0).randn(4, 6), jnp.float32)
+    x1 = jnp.asarray(np.random.RandomState(1).randn(4, 8), jnp.float32)
+    params, _ = layer.init(jax.random.key(0), ShapeSpec((4, 6)),
+                           ShapeSpec((4, 8)))
+    out, _ = layer.apply(params, {}, x0, x1)
+    expect = (x0 @ params["fc"]["kernel"] + x1 + params["dm"]["w"] * x1
+              + params["bias"])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mixed_projection_grads():
+    """Numeric-vs-analytic grads through a mixed stack of parameterized
+    projections (the test_LayerGrad.cpp discipline)."""
+    layer = M.Mixed([
+        M.FullMatrixProjection(5, input=0),
+        M.TransposedFullMatrixProjection(5, input=0),
+        M.ScalingProjection(input=1),
+        M.DotMulProjection(input=1),
+        M.IdentityOffsetProjection(5, offset=2, input=2),
+    ])
+    specs = (ShapeSpec((3, 4)), ShapeSpec((3, 5)), ShapeSpec((3, 9)))
+    params, _ = layer.init(jax.random.key(0), *specs)
+    xs = tuple(jnp.asarray(np.random.RandomState(i).randn(*s.shape),
+                           jnp.float32) for i, s in enumerate(specs))
+    directional_grad_check(lambda p: _apply_sum(layer, p, *xs), params)
+
+
+def test_identity_offset_selects_window():
+    layer = M.Mixed([M.IdentityOffsetProjection(3, offset=2)])
+    x = jnp.arange(24, dtype=jnp.float32).reshape(2, 12)
+    params, _ = layer.init(jax.random.key(0), ShapeSpec((2, 12)))
+    out, _ = layer.apply(params, {}, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x[:, 2:5]))
+
+
+def test_slice_projection_concats_ranges():
+    layer = M.Mixed([M.SliceProjection([(0, 2), (5, 8)])])
+    x = jnp.arange(20, dtype=jnp.float32).reshape(2, 10)
+    params, _ = layer.init(jax.random.key(0), ShapeSpec((2, 10)))
+    out, _ = layer.apply(params, {}, x)
+    expect = np.concatenate([np.asarray(x[:, 0:2]), np.asarray(x[:, 5:8])], 1)
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_table_projection_lookup_grad():
+    layer = M.Mixed([M.TableProjection(vocab=11, size=4)])
+    ids = jnp.asarray([[1, 5], [9, 0]], jnp.int32)
+    params, _ = layer.init(jax.random.key(0), ShapeSpec((2, 2), jnp.int32))
+    out, _ = layer.apply(params, {}, ids)
+    assert out.shape == (2, 2, 4)
+    np.testing.assert_allclose(np.asarray(out[0, 1]),
+                               np.asarray(params["b0_TableProjection"]["table"][5]))
+    directional_grad_check(lambda p: _apply_sum(layer, p, ids), params)
+
+
+def test_context_projection_branch_matches_op():
+    from paddle_tpu.ops import sequence as seq_ops
+
+    layer = M.Mixed([M.ContextProjectionBranch(3, context_start=-1)])
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 5, 3), jnp.float32)
+    params, _ = layer.init(jax.random.key(0), ShapeSpec((2, 5, 3)))
+    out, _ = layer.apply(params, {}, x)
+    expect = seq_ops.context_projection(x, None, context_len=3,
+                                        context_start=-1)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expect))
+
+
+def test_context_projection_trainable_padding_grad():
+    layer = M.Mixed([M.ContextProjectionBranch(
+        3, context_start=-1, trainable_padding=True)])
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 4, 3), jnp.float32)
+    params, _ = layer.init(jax.random.key(0), ShapeSpec((2, 4, 3)))
+    assert "padding" in params["b0_ContextProjectionBranch"]
+    directional_grad_check(lambda p: _apply_sum(layer, p, x), params)
+
+
+def test_conv_projection_flattens_and_sums_with_fc():
+    """conv projection output (flattened) sums with an fc projection over
+    a second flat input — the reference's mixed image+flat pattern."""
+    img = jnp.asarray(np.random.RandomState(0).randn(2, 8, 8, 3), jnp.float32)
+    flat = jnp.asarray(np.random.RandomState(1).randn(2, 10), jnp.float32)
+    layer = M.Mixed([
+        M.ConvProjection(4, 3, stride=2, input=0),
+        M.FullMatrixProjection(4 * 4 * 4, input=1),
+    ])
+    params, _ = layer.init(jax.random.key(0), ShapeSpec((2, 8, 8, 3)),
+                           ShapeSpec((2, 10)))
+    out, _ = layer.apply(params, {}, img, flat)
+    assert out.shape == (2, 64)
+    directional_grad_check(lambda p: _apply_sum(layer, p, img, flat), params)
+
+
+def test_pool_projection_max_and_avg():
+    img = jnp.asarray(np.random.RandomState(0).randn(2, 4, 4, 3), jnp.float32)
+    for kind in ("max", "avg"):
+        layer = M.Mixed([M.PoolProjection(kind, 2)])
+        params, _ = layer.init(jax.random.key(0), ShapeSpec((2, 4, 4, 3)))
+        out, _ = layer.apply(params, {}, img)
+        assert out.shape == (2, 2 * 2 * 3)
+
+
+def test_dotmul_operator_two_inputs():
+    a = jnp.asarray(np.random.RandomState(0).randn(3, 7), jnp.float32)
+    b = jnp.asarray(np.random.RandomState(1).randn(3, 7), jnp.float32)
+    layer = M.Mixed([M.DotMulOperator(scale=2.0, inputs=(0, 1))])
+    params, _ = layer.init(jax.random.key(0), ShapeSpec((3, 7)),
+                           ShapeSpec((3, 7)))
+    out, _ = layer.apply(params, {}, a, b)
+    np.testing.assert_allclose(np.asarray(out), 2.0 * np.asarray(a * b),
+                               rtol=1e-6)
+    assert not params  # operators own no parameters (Operator.h:36)
+
+
+def test_conv_operator_per_sample_filters():
+    """The filter comes from the second INPUT, one filter set per batch
+    row (ConvOperator.cpp offsets weights by batchId)."""
+    n, h, w, c, oc, k = 2, 5, 5, 3, 4, 3
+    img = jnp.asarray(np.random.RandomState(0).randn(n, h, w, c), jnp.float32)
+    flt = jnp.asarray(np.random.RandomState(1).randn(n, k * k * c * oc),
+                      jnp.float32)
+    op = M.ConvOperator(oc, k, padding="VALID", inputs=(0, 1))
+    layer = M.Mixed([op])
+    params, _ = layer.init(jax.random.key(0), ShapeSpec((n, h, w, c)),
+                           ShapeSpec((n, k * k * c * oc)))
+    out, _ = layer.apply(params, {}, img, flt)
+    assert out.shape == (n, 3 * 3 * oc)
+    # per-sample check: row 0's output only depends on row 0's filter
+    flt2 = flt.at[1].set(0.0)
+    out2, _ = layer.apply(params, {}, img, flt2)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(out2[0]),
+                               rtol=1e-5)
+    assert float(jnp.abs(out2[1]).max()) == 0.0
+
+
+def test_conv_trans_operator_shape():
+    n, h, w, c, oc, k = 2, 3, 3, 2, 3, 2
+    img = jnp.asarray(np.random.RandomState(0).randn(n, h, w, c), jnp.float32)
+    flt = jnp.asarray(np.random.RandomState(1).randn(n, k * k * c * oc),
+                      jnp.float32)
+    layer = M.Mixed([M.ConvTransOperator(oc, k, stride=2, inputs=(0, 1))])
+    params, _ = layer.init(jax.random.key(0), ShapeSpec((n, h, w, c)),
+                           ShapeSpec((n, k * k * c * oc)))
+    out, _ = layer.apply(params, {}, img, flt)
+    assert out.shape == (n, 6 * 6 * oc)
+
+
+def test_mixed_shape_mismatch_raises():
+    with pytest.raises(Exception):
+        layer = M.Mixed([
+            M.FullMatrixProjection(5, input=0),
+            M.FullMatrixProjection(6, input=0),
+        ])
+        layer.init(jax.random.key(0), ShapeSpec((2, 3)))
+
+
+def test_mixed_in_sequential_pipeline():
+    """Mixed as an ordinary Layer inside Sequential (single input)."""
+    net = nn.Sequential([
+        M.Mixed([M.FullMatrixProjection(16),
+                 M.IdentityOffsetProjection(16, offset=0)],
+                use_bias=True, activation="relu", name="mix"),
+        nn.Dense(4, name="out"),
+    ])
+    params, state = net.init(jax.random.key(0), ShapeSpec((2, 20)))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 20), jnp.float32)
+    out, _ = net.apply(params, state, x)
+    assert out.shape == (2, 4)

@@ -82,18 +82,7 @@ def _real_mnist_present() -> bool:
         os.path.exists(p) for p in _mnist_files("test"))
 
 
-def test_lenet_convergence_parity():
-    """The BASELINE 'MNIST LeNet convergence parity' target (reference:
-    v1_api_demo/mnist/api_train.py trains LeNet to ~99% / the book test
-    test_recognize_digits_mlp.py asserts >90% in a few passes).
-
-    With real MNIST under PADDLE_TPU_DATA_HOME (idx .gz files, see
-    README), asserts the reference demo's bar: >= 0.95 test accuracy
-    after 2 passes on 10k examples. Without it, the same pipeline runs
-    on the synthetic surrogate with a >= 0.9 bar so CI still exercises
-    the full path.
-    """
-    real = _real_mnist_present()
+def _run_lenet_convergence(real: bool):
     n = 10_000 if real else 1024
     model = models.lenet.lenet(10, with_bn=False)
     trainer = Trainer(
@@ -121,6 +110,31 @@ def test_lenet_convergence_parity():
     assert res.metrics["acc"] >= bar, (
         f"{'real' if real else 'synthetic'} MNIST LeNet accuracy "
         f"{res.metrics['acc']:.4f} below bar {bar}")
+
+
+def test_lenet_convergence_parity():
+    """The BASELINE 'MNIST LeNet convergence parity' target (reference:
+    v1_api_demo/mnist/api_train.py trains LeNet to ~99% / the book test
+    test_recognize_digits_mlp.py asserts >90% in a few passes).
+
+    Requires real MNIST idx .gz files under PADDLE_TPU_DATA_HOME (see
+    README "Real datasets"); SKIPS — loudly, not a lowered-bar pass —
+    when they are absent. The always-on synthetic counterpart is
+    test_lenet_convergence_synthetic below.
+    """
+    import pytest
+
+    if not _real_mnist_present():
+        pytest.skip(
+            "real MNIST idx files not under PADDLE_TPU_DATA_HOME — "
+            "parity vs the reference demo needs real data (zero-egress "
+            "env cannot download it); see README 'Real datasets'")
+    _run_lenet_convergence(real=True)
+
+
+def test_lenet_convergence_synthetic():
+    """Same pipeline on the synthetic surrogate (always runs; bar 0.9)."""
+    _run_lenet_convergence(real=False)
 
 
 def _named(tree, prefix=""):
