@@ -61,25 +61,28 @@ def run_suite_only(name: str, timeout_s: int):
     On timeout the child gets SIGTERM and a 60s grace period before
     SIGKILL: the TPU sits behind a single-claim relay and a hard-killed
     claimant can wedge the chip for every later process (including the
-    headline resnet bench in THIS process)."""
+    headline resnet bench in THIS process).
+
+    The child's stderr is INHERITED (not piped) so suite.py's per-stage
+    progress lines stream live — a stalled run shows exactly which
+    stage (lowering/compiling/timing) wedged."""
     proc = subprocess.Popen(
         [sys.executable, SUITE, "--only", name],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+        stdout=subprocess.PIPE, stderr=None, text=True)
     try:
-        out, err = proc.communicate(timeout=timeout_s)
+        out, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         log(f"{name}: TIMED OUT after {timeout_s}s — terminating gently")
         proc.terminate()
         try:
-            out, err = proc.communicate(timeout=60)
+            proc.communicate(timeout=60)
         except subprocess.TimeoutExpired:
             log(f"{name}: did not exit on SIGTERM; killing")
             proc.kill()
             proc.communicate()
         return []
     if proc.returncode != 0:
-        tail = err.strip().splitlines()[-3:]
-        log(f"{name}: failed rc={proc.returncode}: {tail}")
+        log(f"{name}: failed rc={proc.returncode} (see stderr above)")
         return []
     recs = []
     for line in out.splitlines():

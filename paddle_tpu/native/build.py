@@ -94,3 +94,52 @@ def ensure_capi_built(force: bool = False) -> str:
                   *_python_config("--includes"), _CAPI_SRC,
                   *_python_config("--ldflags")], _CAPI_LIB)
         return _CAPI_LIB
+
+
+_INFER_SRC = os.path.join(_SRC, "infer.cc")
+_INFER_LIB = os.path.join(_DIR, "libpaddle_tpu_infer.so")
+
+
+def ensure_infer_built(force: bool = False) -> str:
+    """Compile the Python-FREE native inference engine (infer.cc).
+
+    Unlike ensure_capi_built, this links against nothing but
+    libc/libm/OpenMP — the artifact consumer needs no interpreter
+    (the reference capi's serving contract, capi/gradient_machine.h:36).
+    """
+    with _lock, _file_lock(_INFER_LIB + ".lock"):
+        if not force and _fresh(_INFER_LIB, [_INFER_SRC]):
+            return _INFER_LIB
+        _compile(["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-Wall",
+                  "-fopenmp", _INFER_SRC], _INFER_LIB)
+        return _INFER_LIB
+
+
+_PJRT_SRC = os.path.join(_SRC, "pjrt_serve.cc")
+_PJRT_LIB = os.path.join(_DIR, "libpaddle_tpu_pjrt.so")
+
+
+def _pjrt_include_dir():
+    """xla/pjrt/c/pjrt_c_api.h ships in the tensorflow wheel's include
+    tree (no other copy exists in this image). Located WITHOUT importing
+    tensorflow — the module spec is enough."""
+    import importlib.util
+
+    spec = importlib.util.find_spec("tensorflow")
+    if spec is None or not spec.submodule_search_locations:
+        raise RuntimeError(
+            "pjrt_c_api.h not found: the tensorflow package (which "
+            "vendors the XLA PJRT headers) is not installed")
+    return os.path.join(spec.submodule_search_locations[0], "include")
+
+
+def ensure_pjrt_built(force: bool = False) -> str:
+    """Compile the PJRT-C serving library (Python-free TPU inference:
+    dlopens the platform plugin, e.g. libtpu.so, at runtime)."""
+    with _lock, _file_lock(_PJRT_LIB + ".lock"):
+        if not force and _fresh(_PJRT_LIB, [_PJRT_SRC]):
+            return _PJRT_LIB
+        _compile(["g++", "-O2", "-std=c++17", "-fPIC", "-shared", "-Wall",
+                  f"-I{_pjrt_include_dir()}", _PJRT_SRC, "-ldl"],
+                 _PJRT_LIB)
+        return _PJRT_LIB

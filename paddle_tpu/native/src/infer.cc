@@ -1,0 +1,621 @@
+// Python-free native inference engine (CPU).
+//
+// The reference's deployment surface is a C API over a C++ engine that
+// needs NO Python at serve time (reference: capi/gradient_machine.h:36
+// paddle_gradient_machine_create_for_inference_with_parameters; mobile
+// builds guard PADDLE_MOBILE_INFERENCE — CPU-only serving was its
+// production mode). This is the TPU-native rebuild's equivalent for the
+// same niche: a self-contained layer-graph executor over the .ptni
+// artifact exported by paddle_tpu.serve.native_export (JSON graph +
+// raw f32 tensors), with zero dependencies beyond libc/libm/pthread.
+//
+// Threading contract (reference: capi/gradient_machine.h:62
+// paddle_gradient_machine_create_shared_param — N serving threads share
+// one parameter set): a loaded model is immutable; ptn_forward is
+// re-entrant and allocates per-call activation buffers, so any number of
+// threads may drive ONE model handle concurrently.
+//
+// TPU serving proper goes through the PJRT-C path (pjrt_serve.cc); this
+// engine is the portable CPU fallback, like the reference's CPU stubs.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_error;
+
+// ------------------------------------------------------------------
+// minimal JSON (objects/arrays/strings/numbers/bool/null) — enough for
+// the artifact header; no external deps by design.
+// ------------------------------------------------------------------
+
+struct JValue {
+  enum Kind { kNull, kBool, kNum, kStr, kArr, kObj } kind = kNull;
+  bool b = false;
+  double num = 0;
+  std::string str;
+  std::vector<JValue> arr;
+  std::map<std::string, JValue> obj;
+
+  bool has(const std::string& k) const { return obj.count(k) != 0; }
+  const JValue& at(const std::string& k) const {
+    auto it = obj.find(k);
+    if (it == obj.end()) throw std::runtime_error("missing key: " + k);
+    return it->second;
+  }
+  long long asInt() const { return static_cast<long long>(num); }
+};
+
+class JParser {
+ public:
+  explicit JParser(const std::string& s) : s_(s) {}
+
+  JValue parse() {
+    JValue v = value();
+    ws();
+    if (pos_ != s_.size()) fail("trailing data");
+    return v;
+  }
+
+ private:
+  const std::string& s_;
+  size_t pos_ = 0;
+
+  [[noreturn]] void fail(const std::string& m) {
+    throw std::runtime_error("json: " + m + " at " + std::to_string(pos_));
+  }
+  void ws() {
+    while (pos_ < s_.size() && (s_[pos_] == ' ' || s_[pos_] == '\n' ||
+                                s_[pos_] == '\t' || s_[pos_] == '\r'))
+      pos_++;
+  }
+  char peek() {
+    if (pos_ >= s_.size()) fail("eof");
+    return s_[pos_];
+  }
+  void expect(char c) {
+    if (peek() != c) fail(std::string("expected ") + c);
+    pos_++;
+  }
+  JValue value() {
+    ws();
+    char c = peek();
+    if (c == '{') return object();
+    if (c == '[') return array();
+    if (c == '"') {
+      JValue v;
+      v.kind = JValue::kStr;
+      v.str = string();
+      return v;
+    }
+    if (c == 't' || c == 'f') return boolean();
+    if (c == 'n') {
+      literal("null");
+      return JValue{};
+    }
+    return number();
+  }
+  void literal(const char* lit) {
+    size_t n = strlen(lit);
+    if (s_.compare(pos_, n, lit) != 0) fail("bad literal");
+    pos_ += n;
+  }
+  JValue boolean() {
+    JValue v;
+    v.kind = JValue::kBool;
+    if (peek() == 't') {
+      literal("true");
+      v.b = true;
+    } else {
+      literal("false");
+    }
+    return v;
+  }
+  JValue number() {
+    size_t start = pos_;
+    while (pos_ < s_.size() &&
+           (isdigit(static_cast<unsigned char>(s_[pos_])) || s_[pos_] == '-' ||
+            s_[pos_] == '+' || s_[pos_] == '.' || s_[pos_] == 'e' ||
+            s_[pos_] == 'E'))
+      pos_++;
+    if (start == pos_) fail("bad number");
+    JValue v;
+    v.kind = JValue::kNum;
+    v.num = strtod(s_.substr(start, pos_ - start).c_str(), nullptr);
+    return v;
+  }
+  std::string string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      if (pos_ >= s_.size()) fail("unterminated string");
+      char c = s_[pos_++];
+      if (c == '"') break;
+      if (c == '\\') {
+        if (pos_ >= s_.size()) fail("bad escape");
+        char e = s_[pos_++];
+        switch (e) {
+          case 'n': out += '\n'; break;
+          case 't': out += '\t'; break;
+          case 'r': out += '\r'; break;
+          case '"': out += '"'; break;
+          case '\\': out += '\\'; break;
+          case '/': out += '/'; break;
+          case 'u': {  // exporter emits ascii; accept + keep low byte
+            if (pos_ + 4 > s_.size()) fail("bad \\u");
+            out += static_cast<char>(
+                strtol(s_.substr(pos_, 4).c_str(), nullptr, 16));
+            pos_ += 4;
+            break;
+          }
+          default: fail("bad escape");
+        }
+      } else {
+        out += c;
+      }
+    }
+    return out;
+  }
+  JValue array() {
+    expect('[');
+    JValue v;
+    v.kind = JValue::kArr;
+    ws();
+    if (peek() == ']') {
+      pos_++;
+      return v;
+    }
+    while (true) {
+      v.arr.push_back(value());
+      ws();
+      if (peek() == ',') {
+        pos_++;
+        continue;
+      }
+      expect(']');
+      break;
+    }
+    return v;
+  }
+  JValue object() {
+    expect('{');
+    JValue v;
+    v.kind = JValue::kObj;
+    ws();
+    if (peek() == '}') {
+      pos_++;
+      return v;
+    }
+    while (true) {
+      ws();
+      std::string k = string();
+      ws();
+      expect(':');
+      v.obj[k] = value();
+      ws();
+      if (peek() == ',') {
+        pos_++;
+        continue;
+      }
+      expect('}');
+      break;
+    }
+    return v;
+  }
+};
+
+// ------------------------------------------------------------------
+// tensors & graph
+// ------------------------------------------------------------------
+
+struct Tensor {
+  std::vector<long long> shape;
+  std::vector<float> data;
+
+  long long numel() const {
+    long long n = 1;
+    for (auto d : shape) n *= d;
+    return n;
+  }
+};
+
+struct Node {
+  std::string name, op, act;
+  std::vector<std::string> in;
+  // conv/pool attrs
+  int sh = 1, sw = 1, ph0 = 0, ph1 = 0, pw0 = 0, pw1 = 0;
+  int wh = 0, ww = 0, groups = 1;
+  bool count_include_pad = true;
+  double eps = 1e-5;
+  double alpha = 0.01;  // leaky_relu
+  // parameter tensor indices (-1 = absent)
+  int kernel = -1, bias = -1, scale = -1, offset = -1, mean = -1, var = -1;
+};
+
+struct Model {
+  std::vector<long long> input_shape;  // batch dim = -1 (dynamic)
+  std::vector<Node> nodes;
+  std::string output;
+  std::vector<Tensor> weights;
+  long long output_dim = 0;  // features per sample of the output
+};
+
+int attr_or(const JValue& o, const char* k, int dflt) {
+  return o.has(k) ? static_cast<int>(o.at(k).asInt()) : dflt;
+}
+
+Model* load_model(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) throw std::runtime_error("cannot open " + path);
+  std::unique_ptr<FILE, int (*)(FILE*)> guard(f, fclose);
+  char magic[8];
+  if (fread(magic, 1, 8, f) != 8 || memcmp(magic, "PTNI0001", 8) != 0)
+    throw std::runtime_error("bad magic (not a .ptni artifact)");
+  uint64_t jlen = 0;
+  if (fread(&jlen, 8, 1, f) != 1) throw std::runtime_error("truncated header");
+  std::string json(jlen, '\0');
+  if (fread(json.data(), 1, jlen, f) != jlen)
+    throw std::runtime_error("truncated json");
+  JValue root = JParser(json).parse();
+
+  auto m = std::make_unique<Model>();
+  for (const auto& d : root.at("input_shape").arr)
+    m->input_shape.push_back(d.asInt());
+  for (const auto& t : root.at("tensors").arr) {
+    Tensor w;
+    for (const auto& d : t.arr) w.shape.push_back(d.asInt());
+    w.data.resize(w.numel());
+    if (fread(w.data.data(), 4, w.data.size(), f) != w.data.size())
+      throw std::runtime_error("truncated tensor data");
+    m->weights.push_back(std::move(w));
+  }
+  for (const auto& jn : root.at("nodes").arr) {
+    Node n;
+    n.name = jn.at("name").str;
+    n.op = jn.at("op").str;
+    for (const auto& i : jn.at("in").arr) n.in.push_back(i.str);
+    if (jn.has("act")) n.act = jn.at("act").str;
+    n.sh = attr_or(jn, "sh", 1);
+    n.sw = attr_or(jn, "sw", 1);
+    n.ph0 = attr_or(jn, "ph0", 0);
+    n.ph1 = attr_or(jn, "ph1", 0);
+    n.pw0 = attr_or(jn, "pw0", 0);
+    n.pw1 = attr_or(jn, "pw1", 0);
+    n.wh = attr_or(jn, "wh", 0);
+    n.ww = attr_or(jn, "ww", 0);
+    n.groups = attr_or(jn, "groups", 1);
+    n.count_include_pad = attr_or(jn, "count_include_pad", 1) != 0;
+    if (jn.has("eps")) n.eps = jn.at("eps").num;
+    if (jn.has("alpha")) n.alpha = jn.at("alpha").num;
+    n.kernel = attr_or(jn, "kernel", -1);
+    n.bias = attr_or(jn, "bias", -1);
+    n.scale = attr_or(jn, "scale", -1);
+    n.offset = attr_or(jn, "offset", -1);
+    n.mean = attr_or(jn, "mean", -1);
+    n.var = attr_or(jn, "var", -1);
+    m->nodes.push_back(std::move(n));
+  }
+  m->output = root.at("output").str;
+  m->output_dim = root.at("output_dim").asInt();
+  return m.release();
+}
+
+// ------------------------------------------------------------------
+// ops (NHWC, f32)
+// ------------------------------------------------------------------
+
+void act_inplace(const std::string& kind, double alpha, Tensor& t) {
+  float* p = t.data.data();
+  long long n = t.numel();
+  if (kind.empty() || kind == "identity" || kind == "linear") return;
+  if (kind == "relu") {
+    for (long long i = 0; i < n; i++) p[i] = p[i] > 0 ? p[i] : 0;
+  } else if (kind == "sigmoid") {
+    for (long long i = 0; i < n; i++) p[i] = 1.0f / (1.0f + expf(-p[i]));
+  } else if (kind == "tanh") {
+    for (long long i = 0; i < n; i++) p[i] = tanhf(p[i]);
+  } else if (kind == "brelu") {
+    for (long long i = 0; i < n; i++)
+      p[i] = p[i] < 0 ? 0 : (p[i] > 24.f ? 24.f : p[i]);
+  } else if (kind == "relu6") {
+    for (long long i = 0; i < n; i++)
+      p[i] = p[i] < 0 ? 0 : (p[i] > 6.f ? 6.f : p[i]);
+  } else if (kind == "leaky_relu") {
+    for (long long i = 0; i < n; i++)
+      p[i] = p[i] >= 0 ? p[i] : static_cast<float>(alpha) * p[i];
+  } else if (kind == "elu") {
+    for (long long i = 0; i < n; i++)
+      p[i] = p[i] >= 0 ? p[i] : expm1f(p[i]);
+  } else if (kind == "softmax") {
+    long long d = t.shape.back(), rows = n / d;
+    for (long long r = 0; r < rows; r++) {
+      float* row = p + r * d;
+      float mx = row[0];
+      for (long long i = 1; i < d; i++) mx = std::max(mx, row[i]);
+      float sum = 0;
+      for (long long i = 0; i < d; i++) {
+        row[i] = expf(row[i] - mx);
+        sum += row[i];
+      }
+      for (long long i = 0; i < d; i++) row[i] /= sum;
+    }
+  } else if (kind == "exponential") {
+    for (long long i = 0; i < n; i++) p[i] = expf(p[i]);
+  } else if (kind == "log") {
+    for (long long i = 0; i < n; i++) p[i] = logf(p[i]);
+  } else if (kind == "abs") {
+    for (long long i = 0; i < n; i++) p[i] = fabsf(p[i]);
+  } else if (kind == "square") {
+    for (long long i = 0; i < n; i++) p[i] = p[i] * p[i];
+  } else if (kind == "softrelu") {
+    // input clipped to [-40, 40] like the Python op (expf overflows
+    // f32 past ~88 — without the clip large logits serve as inf)
+    for (long long i = 0; i < n; i++) {
+      float v = p[i] < -40.f ? -40.f : (p[i] > 40.f ? 40.f : p[i]);
+      p[i] = log1pf(expf(v));
+    }
+  } else if (kind == "stanh") {
+    for (long long i = 0; i < n; i++)
+      p[i] = 1.7159f * tanhf(0.67f * p[i]);
+  } else {
+    throw std::runtime_error("unsupported activation: " + kind);
+  }
+}
+
+// dense: x [rows, I] @ w [I, O] + b
+Tensor dense(const Tensor& x, const Tensor& w, const Tensor* b) {
+  long long in = w.shape[0], out = w.shape[1];
+  long long rows = x.numel() / in;
+  Tensor y;
+  y.shape = x.shape;
+  y.shape.back() = out;
+  y.data.assign(rows * out, 0.f);
+#pragma omp parallel for schedule(static)
+  for (long long r = 0; r < rows; r++) {
+    const float* xp = x.data.data() + r * in;
+    float* yp = y.data.data() + r * out;
+    if (b) memcpy(yp, b->data.data(), out * sizeof(float));
+    for (long long i = 0; i < in; i++) {
+      float xv = xp[i];
+      if (xv == 0.f) continue;
+      const float* wp = w.data.data() + i * out;
+      for (long long o = 0; o < out; o++) yp[o] += xv * wp[o];
+    }
+  }
+  return y;
+}
+
+// conv2d: x [N,H,W,C], k [kh,kw,C/groups,OC]
+Tensor conv2d(const Tensor& x, const Tensor& k, const Tensor* b,
+              const Node& nd) {
+  long long N = x.shape[0], H = x.shape[1], W = x.shape[2], C = x.shape[3];
+  long long kh = k.shape[0], kw = k.shape[1], cg = k.shape[2],
+            OC = k.shape[3];
+  long long OH = (H + nd.ph0 + nd.ph1 - kh) / nd.sh + 1;
+  long long OW = (W + nd.pw0 + nd.pw1 - kw) / nd.sw + 1;
+  long long ocg = OC / nd.groups;
+  Tensor y;
+  y.shape = {N, OH, OW, OC};
+  y.data.assign(N * OH * OW * OC, 0.f);
+#pragma omp parallel for collapse(2) schedule(static)
+  for (long long n = 0; n < N; n++) {
+    for (long long oh = 0; oh < OH; oh++) {
+      for (long long ow = 0; ow < OW; ow++) {
+        float* yp = y.data.data() + ((n * OH + oh) * OW + ow) * OC;
+        if (b) memcpy(yp, b->data.data(), OC * sizeof(float));
+        for (long long r = 0; r < kh; r++) {
+          long long ih = oh * nd.sh - nd.ph0 + r;
+          if (ih < 0 || ih >= H) continue;
+          for (long long s = 0; s < kw; s++) {
+            long long iw = ow * nd.sw - nd.pw0 + s;
+            if (iw < 0 || iw >= W) continue;
+            const float* xp =
+                x.data.data() + ((n * H + ih) * W + iw) * C;
+            const float* kp = k.data.data() + (r * kw + s) * cg * OC;
+            for (int g = 0; g < nd.groups; g++) {
+              for (long long ci = 0; ci < cg; ci++) {
+                float xv = xp[g * cg + ci];
+                if (xv == 0.f) continue;
+                const float* krow = kp + ci * OC + g * ocg;
+                float* yg = yp + g * ocg;
+                for (long long oc = 0; oc < ocg; oc++)
+                  yg[oc] += xv * krow[oc];
+              }
+            }
+          }
+        }
+      }
+    }
+  }
+  return y;
+}
+
+Tensor pool2d(const Tensor& x, const Node& nd, bool is_max) {
+  long long N = x.shape[0], H = x.shape[1], W = x.shape[2], C = x.shape[3];
+  long long OH = (H + nd.ph0 + nd.ph1 - nd.wh) / nd.sh + 1;
+  long long OW = (W + nd.pw0 + nd.pw1 - nd.ww) / nd.sw + 1;
+  Tensor y;
+  y.shape = {N, OH, OW, C};
+  y.data.assign(N * OH * OW * C, 0.f);
+#pragma omp parallel for collapse(2) schedule(static)
+  for (long long n = 0; n < N; n++) {
+    for (long long oh = 0; oh < OH; oh++) {
+      for (long long ow = 0; ow < OW; ow++) {
+        float* yp = y.data.data() + ((n * OH + oh) * OW + ow) * C;
+        for (long long c = 0; c < C; c++) {
+          float acc = is_max ? -3.4e38f : 0.f;
+          int cnt = 0;
+          for (int r = 0; r < nd.wh; r++) {
+            long long ih = oh * nd.sh - nd.ph0 + r;
+            if (ih < 0 || ih >= H) continue;
+            for (int s = 0; s < nd.ww; s++) {
+              long long iw = ow * nd.sw - nd.pw0 + s;
+              if (iw < 0 || iw >= W) continue;
+              float v = x.data[((n * H + ih) * W + iw) * C + c];
+              if (is_max)
+                acc = std::max(acc, v);
+              else
+                acc += v;
+              cnt++;
+            }
+          }
+          if (is_max)
+            yp[c] = acc;
+          else
+            yp[c] = acc / (nd.count_include_pad ? nd.wh * nd.ww
+                                                : std::max(cnt, 1));
+        }
+      }
+    }
+  }
+  return y;
+}
+
+Tensor run_graph(const Model& m, const Tensor& input) {
+  std::map<std::string, Tensor> env;
+  std::map<std::string, int> uses;  // free intermediates when exhausted
+  uses["__input__"] = 0;
+  for (const auto& n : m.nodes)
+    for (const auto& i : n.in) uses[i]++;
+  uses[m.output]++;
+  env["__input__"] = input;
+
+  auto get = [&](const std::string& name) -> const Tensor& {
+    auto it = env.find(name);
+    if (it == env.end())
+      throw std::runtime_error("dangling graph input: " + name);
+    return it->second;
+  };
+  auto wt = [&](int idx) -> const Tensor* {
+    return idx < 0 ? nullptr : &m.weights[idx];
+  };
+
+  for (const auto& nd : m.nodes) {
+    Tensor out;
+    if (nd.op == "conv2d") {
+      out = conv2d(get(nd.in[0]), *wt(nd.kernel), wt(nd.bias), nd);
+    } else if (nd.op == "dense") {
+      out = dense(get(nd.in[0]), *wt(nd.kernel), wt(nd.bias));
+    } else if (nd.op == "bn") {
+      const Tensor& x = get(nd.in[0]);
+      const Tensor &sc = *wt(nd.scale), &of = *wt(nd.offset),
+                   &mu = *wt(nd.mean), &va = *wt(nd.var);
+      long long C = x.shape.back(), rows = x.numel() / C;
+      out.shape = x.shape;
+      out.data.resize(x.numel());
+      std::vector<float> a(C), c(C);
+      for (long long i = 0; i < C; i++) {
+        a[i] = sc.data[i] / sqrtf(va.data[i] + static_cast<float>(nd.eps));
+        c[i] = of.data[i] - mu.data[i] * a[i];
+      }
+#pragma omp parallel for schedule(static)
+      for (long long r = 0; r < rows; r++)
+        for (long long i = 0; i < C; i++)
+          out.data[r * C + i] = x.data[r * C + i] * a[i] + c[i];
+    } else if (nd.op == "act") {
+      out = get(nd.in[0]);
+      act_inplace(nd.act, nd.alpha, out);
+    } else if (nd.op == "maxpool") {
+      out = pool2d(get(nd.in[0]), nd, true);
+    } else if (nd.op == "avgpool") {
+      out = pool2d(get(nd.in[0]), nd, false);
+    } else if (nd.op == "gap") {
+      const Tensor& x = get(nd.in[0]);
+      long long N = x.shape[0], HW = x.shape[1] * x.shape[2],
+                C = x.shape[3];
+      out.shape = {N, C};
+      out.data.assign(N * C, 0.f);
+      for (long long n = 0; n < N; n++) {
+        for (long long i = 0; i < HW; i++)
+          for (long long c = 0; c < C; c++)
+            out.data[n * C + c] += x.data[(n * HW + i) * C + c];
+        for (long long c = 0; c < C; c++) out.data[n * C + c] /= HW;
+      }
+    } else if (nd.op == "flatten") {
+      out = get(nd.in[0]);
+      long long N = out.shape[0], rest = out.numel() / N;
+      out.shape = {N, rest};
+    } else if (nd.op == "add") {
+      const Tensor &a = get(nd.in[0]), &b = get(nd.in[1]);
+      if (a.numel() != b.numel())
+        throw std::runtime_error("add: operand size mismatch");
+      out = a;
+      for (long long i = 0; i < out.numel(); i++) out.data[i] += b.data[i];
+    } else {
+      throw std::runtime_error("unsupported op: " + nd.op);
+    }
+    if (!nd.act.empty() && nd.op != "act") act_inplace(nd.act, nd.alpha, out);
+    env[nd.name] = std::move(out);
+    for (const auto& i : nd.in) {
+      if (--uses[i] == 0) env.erase(i);
+    }
+  }
+  return env.at(m.output);
+}
+
+}  // namespace
+
+// ------------------------------------------------------------------
+// C ABI (mirrors capi/gradient_machine.h roles; ptn_ = paddle-tpu-native)
+// ------------------------------------------------------------------
+
+extern "C" {
+
+const char* ptn_last_error() { return g_error.c_str(); }
+
+void* ptn_load(const char* path) {
+  try {
+    return load_model(path);
+  } catch (const std::exception& e) {
+    g_error = e.what();
+    return nullptr;
+  }
+}
+
+void ptn_free(void* model) { delete static_cast<Model*>(model); }
+
+// input spec: rank then dims (batch reported as -1)
+int ptn_input_rank(void* model) {
+  return static_cast<int>(static_cast<Model*>(model)->input_shape.size());
+}
+
+long long ptn_input_dim(void* model, int i) {
+  return static_cast<Model*>(model)->input_shape[i];
+}
+
+long long ptn_output_dim(void* model) {
+  return static_cast<Model*>(model)->output_dim;
+}
+
+// Run a forward pass: in is [batch, ...input_shape[1:]] f32, out must
+// hold batch*output_dim floats. Thread-safe: any number of threads may
+// call this on one model concurrently (weights are read-only; all
+// activation buffers are per-call).
+int ptn_forward(void* model, const float* in, long long batch, float* out) {
+  try {
+    Model* m = static_cast<Model*>(model);
+    Tensor x;
+    x.shape = m->input_shape;
+    x.shape[0] = batch;
+    x.data.assign(in, in + x.numel());
+    Tensor y = run_graph(*m, x);
+    if (y.numel() != batch * m->output_dim)
+      throw std::runtime_error("output size mismatch");
+    memcpy(out, y.data.data(), y.numel() * sizeof(float));
+    return 0;
+  } catch (const std::exception& e) {
+    g_error = e.what();
+    return 1;
+  }
+}
+
+}  // extern "C"

@@ -97,16 +97,8 @@ class Conv2D(Layer):
         self.policy = policy
 
     def _out_hw(self, h, w):
-        kh, kw = self.kernel_size
-        sh, sw = self.stride
-        dh, dw = self.dilation
-        ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
-        if self.padding == "SAME":
-            return -(-h // sh), -(-w // sw)
-        if self.padding == "VALID":
-            return (h - ekh) // sh + 1, (w - ekw) // sw + 1
-        ph, pw = conv_ops._pair(self.padding)
-        return (h + 2 * ph - ekh) // sh + 1, (w + 2 * pw - ekw) // sw + 1
+        return conv_ops.out_hw(h, w, self.kernel_size, self.stride,
+                               self.padding, self.dilation)
 
     def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
         n, h, w, c = spec.shape
@@ -146,14 +138,7 @@ class MaxPool2D(Layer):
         self.name = name
 
     def _out_hw(self, h, w):
-        wh, ww = self.window
-        sh, sw = self.stride
-        if self.padding == "SAME":
-            return -(-h // sh), -(-w // sw)
-        if self.padding == "VALID":
-            return (h - wh) // sh + 1, (w - ww) // sw + 1
-        ph, pw = conv_ops._pair(self.padding)
-        return (h + 2 * ph - wh) // sh + 1, (w + 2 * pw - ww) // sw + 1
+        return conv_ops.out_hw(h, w, self.window, self.stride, self.padding)
 
     def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
         n, h, w, c = spec.shape

@@ -271,12 +271,7 @@ class ConvProjection(Projection):
         self.flatten = flatten
 
     def _out_hw(self, h, w):
-        kh, kw = self.kernel
-        sh, sw = self.stride
-        if self.padding == "SAME":
-            return -(-h // sh), -(-w // sw)
-        ph, pw = (0, 0) if self.padding == "VALID" else conv_ops._pair(self.padding)
-        return (h + 2 * ph - kh) // sh + 1, (w + 2 * pw - kw) // sw + 1
+        return conv_ops.out_hw(h, w, self.kernel, self.stride, self.padding)
 
     def _init(self, rng, spec, abstract):
         n, h, w, c = spec.shape
@@ -335,15 +330,8 @@ class PoolProjection(Projection):
 
     def _init(self, rng, spec, abstract):
         n, h, w, c = spec.shape
-        wh, ww = self.window
-        sh, sw = self.stride
-        if self.padding == "SAME":
-            oh, ow = -(-h // sh), -(-w // sw)
-        else:
-            ph, pw = ((0, 0) if self.padding == "VALID"
-                      else conv_ops._pair(self.padding))
-            oh = (h + 2 * ph - wh) // sh + 1
-            ow = (w + 2 * pw - ww) // sw + 1
+        oh, ow = conv_ops.out_hw(h, w, self.window, self.stride,
+                                 self.padding)
         shape = (n, oh * ow * c) if self.flatten else (n, oh, ow, c)
         return {}, ShapeSpec(shape, spec.dtype)
 
@@ -392,11 +380,7 @@ class ConvOperator(Operator):
         self.padding = padding
 
     def _out_hw(self, h, w):
-        sh, sw = self.stride
-        kh, kw = self.kernel
-        if self.padding == "SAME":
-            return -(-h // sh), -(-w // sw)
-        return (h - kh) // sh + 1, (w - kw) // sw + 1
+        return conv_ops.out_hw(h, w, self.kernel, self.stride, self.padding)
 
     def _out_spec(self, img: ShapeSpec, flt: ShapeSpec) -> ShapeSpec:
         n, h, w, c = img.shape
@@ -469,6 +453,8 @@ class Mixed(Layer):
         for i, b in enumerate(self.branches):
             key = self._branch_key(i, b)
             enforce(key not in params, f"duplicate branch name {key}")
+            enforce(key != "bias",
+                    "'bias' is reserved for the Mixed layer bias")
             if isinstance(b, Operator):
                 o = b._out_spec(*(specs[j] for j in b.inputs))
                 sub = {}

@@ -36,6 +36,25 @@ def _padding(padding, kernel: Tuple[int, int]):
     return ((ph, ph), (pw, pw))
 
 
+def out_hw(h: int, w: int, window: IntOr2, stride: IntOr2, padding,
+           dilation: IntOr2 = 1) -> Tuple[int, int]:
+    """Static output (H, W) of a conv/pool window — the ONE place this
+    arithmetic lives (shape inference in nn.layers and nn.mixed reuses
+    it; keep in sync with what lax.conv/reduce_window actually produce).
+    """
+    kh, kw = _pair(window)
+    sh, sw = _pair(stride)
+    dh, dw = _pair(dilation)
+    ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+    if padding == "SAME":
+        return -(-h // sh), -(-w // sw)
+    if padding == "VALID":
+        ph = pw = 0
+    else:
+        ph, pw = _pair(padding)
+    return (h + 2 * ph - ekh) // sh + 1, (w + 2 * pw - ekw) // sw + 1
+
+
 def conv2d(
     x,
     kernel,

@@ -23,6 +23,11 @@ import numpy as np
 
 _META_NAME = "meta.json"
 _PROGRAM_NAME = "program.stablehlo"
+# raw StableHLO module text — the form PJRT_Client_Compile accepts as
+# format "mlir", consumed by the Python-free PJRT-C server
+# (native/src/pjrt_serve.cc); program.stablehlo is the jax.export
+# serialization (richer, but only jax can load it)
+_MLIR_NAME = "program.mlir"
 
 FORMAT_VERSION = 1
 
@@ -83,6 +88,8 @@ def export_compiled_model(
     if extra_meta:
         meta.update(extra_meta)
 
+    mlir_text = exported.mlir_module().encode()
+
     with tarfile.open(path, "w") as tar:
         mb = json.dumps(meta, indent=1).encode()
         info = tarfile.TarInfo(_META_NAME)
@@ -91,6 +98,26 @@ def export_compiled_model(
         info = tarfile.TarInfo(_PROGRAM_NAME)
         info.size = len(program)
         tar.addfile(info, io.BytesIO(program))
+        info = tarfile.TarInfo(_MLIR_NAME)
+        info.size = len(mlir_text)
+        tar.addfile(info, io.BytesIO(mlir_text))
+
+
+def extract_mlir(path: str, out_path: str) -> dict:
+    """Pull the raw StableHLO module text out of an artifact for the
+    PJRT-C server; returns the artifact meta."""
+    with tarfile.open(path, "r") as tar:
+        meta = json.loads(tar.extractfile(_META_NAME).read().decode())
+        try:
+            blob = tar.extractfile(_MLIR_NAME).read()
+        except KeyError:
+            raise ValueError(
+                f"{path} has no {_MLIR_NAME} member — it was exported "
+                "before PJRT-C serving existed; re-export it with the "
+                "current export_compiled_model") from None
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return meta
 
 
 def load_compiled_model(path: str) -> CompiledModel:

@@ -1,0 +1,205 @@
+"""Export a trained model to the .ptni native-inference artifact.
+
+The reference deploys by merging config + weights into one file consumed
+by the Python-free C API engine (reference: trainer/MergeModel.cpp,
+capi/gradient_machine.h:36 create_for_inference_with_parameters). The
+TPU-native equivalent: walk the nn.Layer tree, emit a flat SSA graph of
+inference ops (BN folded to its inference affine form, dropout dropped)
+plus the f32 weights, into one binary file:
+
+    "PTNI0001" | u64 json_len | json header | raw f32 tensor blobs
+
+executed by native/src/infer.cc with zero Python. TPU serving instead
+uses the StableHLO artifact (serve/artifact.py) through PJRT-C
+(native/src/pjrt_serve.cc); this path is the portable CPU engine filling
+the reference capi's mobile/CPU serving role.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from paddle_tpu import nn
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.nn.module import Layer, Sequential, ShapeSpec
+from paddle_tpu.ops import activations as A
+
+
+# the op vocabulary infer.cc's act_inplace actually implements — validate
+# at EXPORT time, not at Python-free serve time where no fix is possible
+NATIVE_ACTS = frozenset({
+    "identity", "relu", "sigmoid", "tanh", "brelu", "relu6", "leaky_relu",
+    "elu", "softmax", "exponential", "log", "abs", "square", "softrelu",
+    "stanh",
+})
+
+
+def _act_name(fn) -> Optional[str]:
+    """Reverse-map a resolved activation function to its registry name."""
+    if fn is None or fn is A.identity:
+        return None
+    for name, f in A._REGISTRY.items():
+        if f is fn:
+            name = "identity" if name == "linear" else name
+            if name not in NATIVE_ACTS:
+                raise ValueError(
+                    f"activation '{name}' is not implemented by the "
+                    f"native engine (infer.cc); native-servable: "
+                    f"{sorted(NATIVE_ACTS)}")
+            return name
+    raise ValueError(
+        f"activation {fn} is not exportable (not in the activation "
+        f"registry); supported: {sorted(A._REGISTRY)}")
+
+
+class _Builder:
+    def __init__(self):
+        self.nodes: List[Dict[str, Any]] = []
+        self.tensors: List[np.ndarray] = []
+        self.counter = 0
+
+    def tensor(self, arr) -> int:
+        self.tensors.append(np.asarray(arr, np.float32))
+        return len(self.tensors) - 1
+
+    def node(self, op: str, inputs: List[str], **attrs) -> str:
+        name = f"n{self.counter}"
+        self.counter += 1
+        rec = {"name": name, "op": op, "in": inputs}
+        rec.update({k: v for k, v in attrs.items() if v is not None})
+        self.nodes.append(rec)
+        return name
+
+
+def _pads(padding, kernel: Tuple[int, int], stride: Tuple[int, int],
+          hw: Tuple[int, int]) -> Tuple[int, int, int, int]:
+    """Resolve SAME/VALID/numeric padding to explicit (ph0,ph1,pw0,pw1)
+    — SAME needs the input H/W because its padding is asymmetric."""
+    kh, kw = kernel
+    sh, sw = stride
+    h, w = hw
+    if padding == "VALID":
+        return 0, 0, 0, 0
+    if padding == "SAME":
+        th = max((-(-h // sh) - 1) * sh + kh - h, 0)
+        tw = max((-(-w // sw) - 1) * sw + kw - w, 0)
+        return th // 2, th - th // 2, tw // 2, tw - tw // 2
+    ph, pw = (padding, padding) if isinstance(padding, int) else padding
+    return ph, ph, pw, pw
+
+
+def _export_layer(layer: Layer, params, state, b: _Builder, x: str,
+                  spec: ShapeSpec) -> Tuple[str, ShapeSpec]:
+    """Emit nodes for one layer; returns (output ssa name, out spec)."""
+    out_spec = layer.out_spec(spec)
+
+    if isinstance(layer, Sequential):
+        cur, cspec = x, spec
+        for i, sub in enumerate(layer.layers):
+            key = sub.name or f"layer{i}"
+            cur, cspec = _export_layer(sub, params.get(key, {}),
+                                       state.get(key, {}), b, cur, cspec)
+        return cur, cspec
+
+    if isinstance(layer, nn.Conv2D):
+        enforce(layer.dilation == (1, 1),
+                "native export: dilated conv not supported")
+        ph0, ph1, pw0, pw1 = _pads(layer.padding, layer.kernel_size,
+                                   layer.stride, spec.shape[1:3])
+        out = b.node(
+            "conv2d", [x], sh=layer.stride[0], sw=layer.stride[1],
+            ph0=ph0, ph1=ph1, pw0=pw0, pw1=pw1, groups=layer.groups,
+            kernel=b.tensor(params["kernel"]),
+            bias=(b.tensor(params["bias"]) if "bias" in params else None),
+            act=_act_name(layer.activation))
+        return out, out_spec
+
+    if isinstance(layer, nn.Dense):
+        out = b.node(
+            "dense", [x], kernel=b.tensor(params["kernel"]),
+            bias=(b.tensor(params["bias"]) if "bias" in params else None),
+            act=_act_name(layer.activation))
+        return out, out_spec
+
+    if isinstance(layer, nn.BatchNorm):
+        out = b.node(
+            "bn", [x], eps=layer.epsilon,
+            scale=b.tensor(params["scale"]),
+            offset=b.tensor(params["offset"]),
+            mean=b.tensor(state["mean"]), var=b.tensor(state["var"]),
+            act=_act_name(layer.activation))
+        return out, out_spec
+
+    if isinstance(layer, nn.MaxPool2D) or isinstance(layer, nn.AvgPool2D):
+        ph0, ph1, pw0, pw1 = _pads(layer.padding, layer.window,
+                                   layer.stride, spec.shape[1:3])
+        op = "avgpool" if isinstance(layer, nn.AvgPool2D) else "maxpool"
+        out = b.node(op, [x], wh=layer.window[0], ww=layer.window[1],
+                     sh=layer.stride[0], sw=layer.stride[1],
+                     ph0=ph0, ph1=ph1, pw0=pw0, pw1=pw1,
+                     count_include_pad=1)
+        return out, out_spec
+
+    if isinstance(layer, nn.GlobalAvgPool2D):
+        return b.node("gap", [x]), out_spec
+
+    if isinstance(layer, nn.Flatten):
+        return b.node("flatten", [x]), out_spec
+
+    if isinstance(layer, nn.Activation):
+        return b.node("act", [x], act=_act_name(layer.fn) or "identity"), out_spec
+
+    if isinstance(layer, nn.Dropout):
+        return x, out_spec  # identity at inference
+
+    if isinstance(layer, nn.Residual):
+        main, _ = _export_layer(layer.main, params.get("main", {}),
+                                state.get("main", {}), b, x, spec)
+        if layer.shortcut is not None:
+            sc, _ = _export_layer(layer.shortcut, params.get("shortcut", {}),
+                                  state.get("shortcut", {}), b, x, spec)
+        else:
+            sc = x
+        out = b.node("add", [main, sc], act=_act_name(layer.activation))
+        return out, out_spec
+
+    if isinstance(layer, nn.LayerNorm):
+        raise ValueError("native export: LayerNorm not yet supported")
+    raise ValueError(
+        f"native export: unsupported layer type {type(layer).__name__} — "
+        "supported: Sequential, Conv2D, Dense, BatchNorm, Max/AvgPool2D, "
+        "GlobalAvgPool2D, Flatten, Activation, Dropout, Residual")
+
+
+def export_native(model: Layer, params, state, input_spec: ShapeSpec,
+                  path: str) -> None:
+    """Write the .ptni artifact for `model` at inference time.
+
+    input_spec fixes everything but the batch dim (stored as -1,
+    dynamic at serve time).
+    """
+    b = _Builder()
+    out_name, out_spec = _export_layer(model, params, state, b,
+                                       "__input__", input_spec)
+    enforce(len(out_spec.shape) == 2,
+            f"native export expects a [batch, features] output, got "
+            f"{out_spec.shape}")
+    header = {
+        "version": 1,
+        "input_shape": [-1] + [int(d) for d in input_spec.shape[1:]],
+        "nodes": b.nodes,
+        "output": out_name,
+        "output_dim": int(out_spec.shape[-1]),
+        "tensors": [list(t.shape) for t in b.tensors],
+    }
+    blob = json.dumps(header).encode("utf-8")
+    with open(path, "wb") as f:
+        f.write(b"PTNI0001")
+        f.write(struct.pack("<Q", len(blob)))
+        f.write(blob)
+        for t in b.tensors:
+            f.write(np.ascontiguousarray(t, np.float32).tobytes())

@@ -1,0 +1,155 @@
+/* Pure-C driver for the Python-free native inference engine.
+ *
+ * Compiled WITHOUT any Python flags (see test_native_infer.py: the link
+ * line is just -lpaddle_tpu_infer -lm -lpthread) — the proof the serving
+ * path needs no interpreter, matching the reference's C inference API
+ * (reference: capi/gradient_machine.h:36, examples/model_inference).
+ *
+ * Also exercises the reference's multi-thread serving pattern
+ * (capi/gradient_machine.h:62 create_shared_param: N threads share one
+ * parameter set): T threads run forwards CONCURRENTLY on one model
+ * handle and every thread must reproduce the golden outputs.
+ *
+ * usage: driver model.ptni input.f32 golden.f32 batch n_threads
+ */
+
+#include <math.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+extern void* ptn_load(const char* path);
+extern void ptn_free(void* model);
+extern int ptn_input_rank(void* model);
+extern long long ptn_input_dim(void* model, int i);
+extern long long ptn_output_dim(void* model);
+extern int ptn_forward(void* model, const float* in, long long batch,
+                       float* out);
+extern const char* ptn_last_error(void);
+
+static float* read_f32(const char* path, long* count) {
+  FILE* f = fopen(path, "rb");
+  if (!f) {
+    fprintf(stderr, "cannot open %s\n", path);
+    exit(2);
+  }
+  fseek(f, 0, SEEK_END);
+  long bytes = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  float* buf = (float*)malloc(bytes);
+  if (fread(buf, 1, bytes, f) != (size_t)bytes) {
+    fprintf(stderr, "short read %s\n", path);
+    exit(2);
+  }
+  fclose(f);
+  *count = bytes / 4;
+  return buf;
+}
+
+struct job {
+  void* model;
+  const float* in;
+  const float* golden;
+  long long batch;
+  long long out_per;
+  int id;
+  int failed;
+};
+
+static void* worker(void* arg) {
+  struct job* j = (struct job*)arg;
+  long long n = j->batch * j->out_per;
+  float* out = (float*)malloc(n * sizeof(float));
+  int rounds;
+  for (rounds = 0; rounds < 3; rounds++) {
+    memset(out, 0, n * sizeof(float));
+    if (ptn_forward(j->model, j->in, j->batch, out) != 0) {
+      fprintf(stderr, "thread %d: forward failed: %s\n", j->id,
+              ptn_last_error());
+      j->failed = 1;
+      break;
+    }
+    long long i;
+    for (i = 0; i < n; i++) {
+      float diff = fabsf(out[i] - j->golden[i]);
+      float tol = 1e-4f + 1e-4f * fabsf(j->golden[i]);
+      if (diff > tol) {
+        fprintf(stderr, "thread %d round %d: out[%lld]=%g golden=%g\n",
+                j->id, rounds, i, out[i], j->golden[i]);
+        j->failed = 1;
+        break;
+      }
+    }
+    if (j->failed) break;
+  }
+  free(out);
+  return NULL;
+}
+
+int main(int argc, char** argv) {
+  if (argc != 6) {
+    fprintf(stderr,
+            "usage: %s model.ptni input.f32 golden.f32 batch n_threads\n",
+            argv[0]);
+    return 2;
+  }
+  long long batch = atoll(argv[4]);
+  int n_threads = atoi(argv[5]);
+
+  void* model = ptn_load(argv[1]);
+  if (!model) {
+    fprintf(stderr, "load failed: %s\n", ptn_last_error());
+    return 1;
+  }
+
+  long in_count, golden_count;
+  float* in = read_f32(argv[2], &in_count);
+  float* golden = read_f32(argv[3], &golden_count);
+
+  /* sanity: input element count must match batch x input dims */
+  long long expect_in = batch;
+  int r, rank = ptn_input_rank(model);
+  for (r = 1; r < rank; r++) expect_in *= ptn_input_dim(model, r);
+  if (expect_in != in_count) {
+    fprintf(stderr, "input count %ld != expected %lld\n", in_count,
+            expect_in);
+    return 2;
+  }
+  long long out_per = ptn_output_dim(model);
+  if (batch * out_per != golden_count) {
+    fprintf(stderr, "golden count %ld != %lld\n", golden_count,
+            batch * out_per);
+    return 2;
+  }
+
+  /* single-shot correctness */
+  struct job j0 = {model, in, golden, batch, out_per, 0, 0};
+  worker(&j0);
+  if (j0.failed) return 1;
+  printf("single-thread forward matches golden (%lld x %lld)\n", batch,
+         out_per);
+
+  /* concurrent serving: N threads share ONE model handle */
+  pthread_t threads[64];
+  struct job jobs[64];
+  int t;
+  if (n_threads > 64) n_threads = 64;
+  for (t = 0; t < n_threads; t++) {
+    jobs[t] = j0;
+    jobs[t].id = t + 1;
+    pthread_create(&threads[t], NULL, worker, &jobs[t]);
+  }
+  int failed = 0;
+  for (t = 0; t < n_threads; t++) {
+    pthread_join(threads[t], NULL);
+    failed |= jobs[t].failed;
+  }
+  if (failed) return 1;
+  printf("%d concurrent threads x 3 rounds all match golden\n", n_threads);
+
+  ptn_free(model);
+  free(in);
+  free(golden);
+  return 0;
+}

@@ -340,3 +340,69 @@ def test_record_streaming_end_to_end(tmp_path):
         got = sorted(json.loads(r)["i"] for r in reader())
         assert got == list(range(60))
         cli.close()
+
+
+def test_ha_master_restart_recovers(tmp_path):
+    """The master-HA contract (reference: go/master/etcd_client.go stores
+    snapshots in etcd so an elected replacement resumes the queue): a
+    master writing snapshots to a shared directory dies; a NEW master
+    pointed at the same directory recovers the queue — done tasks stay
+    done, leased tasks return to todo (with a bumped lease epoch), and
+    the save-model election still works."""
+    from paddle_tpu.native.taskqueue import HAMaster, TaskStatus
+
+    snap_dir = str(tmp_path / "shared-fs")
+
+    # master #1: three tasks, one finished, one still leased at "death"
+    m1 = HAMaster(snap_dir, interval_s=0)  # snapshot manually
+    for i in range(3):
+        m1.queue.add_task(f"task-{i}".encode())
+    m1.queue.start()
+    st, tid, _ = m1.queue.get_task()
+    assert st == TaskStatus.OK
+    m1.queue.finish_task(tid)
+    st2, tid2, _ = m1.queue.get_task()   # leased, never finished
+    assert st2 == TaskStatus.OK
+    m1.checkpoint()
+    m1.stop(final_snapshot=False)  # simulate crash AFTER the snapshot
+
+    # master #2 on another "host", same shared dir
+    m2 = HAMaster(snap_dir, interval_s=0)
+    assert m2.recovered_from is not None
+    c = m2.queue.counts()
+    assert c["done"] == 1
+    # the leased-but-unfinished task is back in todo
+    assert c["todo"] == 2 and c["pending"] == 0
+    # pre-crash lease handle is stale: the recovered task's epoch was
+    # bumped, so finishing through the old handle is a tolerated NO-OP
+    # (taskqueue.cc tq_finish_task: superseded lease → rc 1)
+    m2.queue.finish_task(tid2)
+    assert m2.queue.counts()["done"] == 1
+    assert m2.queue.counts()["todo"] == 2
+    # both remaining tasks still servable to completion
+    m2.queue.start()
+    for _ in range(2):
+        st, tid, _ = m2.queue.get_task()
+        assert st == TaskStatus.OK
+        m2.queue.finish_task(tid)
+    assert m2.queue.counts()["done"] == 3
+    assert m2.queue.request_save_model(trainer_id=0)
+    m2.stop()
+
+
+def test_ha_master_snapshot_rotation(tmp_path):
+    from paddle_tpu.native.taskqueue import HAMaster
+
+    snap_dir = str(tmp_path / "snaps")
+    m = HAMaster(snap_dir, interval_s=0, keep=2)
+    m.queue.add_task(b"t")
+    m.queue.start()
+    paths = [m.checkpoint() for _ in range(4)]
+    kept = sorted(os.listdir(snap_dir))
+    assert len(kept) == 2
+    assert os.path.basename(paths[-1]) in kept
+    # a fresh master picks the NEWEST snapshot and continues numbering
+    m.stop(final_snapshot=False)
+    m2 = HAMaster(snap_dir, interval_s=0, keep=2)
+    assert m2.recovered_from.endswith(os.path.basename(paths[-1]))
+    m2.stop(final_snapshot=False)
