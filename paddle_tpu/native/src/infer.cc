@@ -18,9 +18,12 @@
 // TPU serving proper goes through the PJRT-C path (pjrt_serve.cc); this
 // engine is the portable CPU fallback, like the reference's CPU stubs.
 
+#include <algorithm>
+#include <cctype>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 
 #include <map>

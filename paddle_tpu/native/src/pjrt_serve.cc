@@ -71,6 +71,10 @@ std::string error_message(const PJRT_Api* api, PJRT_Error* err) {
 
 // default CompileOptionsProto: executable_build_options {
 //   device_ordinal: -1  num_replicas: 1  num_partitions: 1 }
+// compile_portable_executable: true
+// Portable matters: pts_forward passes execute_device, and PJRT routes
+// that to ExecutePortable, which rejects executables that hold a
+// compile-time device assignment.
 std::string default_compile_options() {
   std::string inner;
   inner += '\x08';  // field 1 varint (device_ordinal)
@@ -84,6 +88,8 @@ std::string default_compile_options() {
   outer += '\x1a';  // field 3, length-delimited
   outer += static_cast<char>(inner.size());
   outer += inner;
+  outer += '\x20';  // field 4 varint (compile_portable_executable)
+  outer += '\x01';
   return outer;
 }
 

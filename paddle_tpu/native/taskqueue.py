@@ -386,13 +386,20 @@ class HAMaster:
             name = f"snap-{self._seq:08d}.tq"
             fd, local_tmp = tempfile.mkstemp(prefix="ptq-snap-")
             os.close(fd)
+            shared_tmp = os.path.join(self.dir, f".{name}.tmp.{os.getpid()}")
             try:
                 self.queue.snapshot(local_tmp)  # fast: local disk
-                shared_tmp = os.path.join(
-                    self.dir, f".{name}.tmp.{os.getpid()}")
                 shutil.copyfile(local_tmp, shared_tmp)  # slow: off-lock
                 final = os.path.join(self.dir, name)
                 os.replace(shared_tmp, final)
+            except BaseException:
+                # don't leak a partial in the shared dir (a quota-full
+                # dir of dead .tmp files would keep snapshots failing)
+                try:
+                    os.unlink(shared_tmp)
+                except OSError:
+                    pass
+                raise
             finally:
                 try:
                     os.unlink(local_tmp)
@@ -401,6 +408,14 @@ class HAMaster:
             self._seq += 1
             self.last_snapshot_error = None
             self.last_snapshot_time = _time.time()
+            for n in os.listdir(self.dir):
+                full = os.path.join(self.dir, n)
+                is_stale_tmp = n.startswith(".snap-") and ".tmp." in n
+                try:
+                    if is_stale_tmp:  # crashed writer's leftovers
+                        os.unlink(full)
+                except OSError:
+                    pass
             names = sorted(n for n in os.listdir(self.dir)
                            if self.SNAP_RE.match(n))
             for stale in names[:-self.keep]:

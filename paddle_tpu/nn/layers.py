@@ -183,11 +183,13 @@ class BatchNorm(Layer):
         momentum: float = 0.9,
         epsilon: float = 1e-5,
         activation=None,
+        fast_variance: bool = True,
         name: Optional[str] = None,
     ):
         self.momentum = momentum
         self.epsilon = epsilon
         self.activation = A.get(activation)
+        self.fast_variance = fast_variance
         self.name = name
 
     def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
@@ -214,6 +216,7 @@ class BatchNorm(Layer):
             training=training,
             momentum=self.momentum,
             epsilon=self.epsilon,
+            fast_variance=self.fast_variance,
         )
         return self.activation(y), {"mean": new_mean, "var": new_var}
 

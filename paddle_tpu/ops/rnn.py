@@ -34,17 +34,18 @@ class LSTMState(NamedTuple):
     c: jnp.ndarray
 
 
-def lstm_step(params, x_t, state: LSTMState, *, activation=jnp.tanh,
-              gate_activation=jax.nn.sigmoid):
-    """One LSTM step. params: {w_ih [F,4H], w_hh [H,4H], b [4H]}.
+def lstm_step_from_proj(params, x_proj_t, state: LSTMState, *,
+                        activation=jnp.tanh,
+                        gate_activation=jax.nn.sigmoid):
+    """One LSTM step given the PRE-PROJECTED input x@W_ih + b [.., 4H].
 
-    Gate order i,f,g,o (reference gate math: operators/math/detail/
-    lstm_kernel.h; we use the standard non-peephole variant — the
-    reference's peephole connections are an option below).
+    The full-sequence runners hoist the input projection out of the scan
+    (one [B*T, F]x[F, 4H] MXU-sized matmul instead of T small ones — the
+    cuDNN-style layout the reference gets from its fused kernels,
+    cuda/src/hl_cuda_lstm.cu); only the h@W_hh recurrence stays serial.
     """
     h, c = state
-    gates = linalg.matmul(x_t, params["w_ih"]) + linalg.matmul(h, params["w_hh"])
-    gates = gates + params["b"]
+    gates = x_proj_t + linalg.matmul(h, params["w_hh"])
     i, f, g, o = jnp.split(gates, 4, axis=-1)
     i = gate_activation(i)
     f = gate_activation(f)
@@ -55,6 +56,33 @@ def lstm_step(params, x_t, state: LSTMState, *, activation=jnp.tanh,
     return LSTMState(new_h, new_c)
 
 
+def lstm_step(params, x_t, state: LSTMState, *, activation=jnp.tanh,
+              gate_activation=jax.nn.sigmoid):
+    """One LSTM step. params: {w_ih [F,4H], w_hh [H,4H], b [4H]}.
+
+    Gate order i,f,g,o (reference gate math: operators/math/detail/
+    lstm_kernel.h; we use the standard non-peephole variant — the
+    reference's peephole connections are an option below).
+    """
+    x_proj = linalg.matmul(x_t, params["w_ih"]) + params["b"]
+    return lstm_step_from_proj(params, x_proj, state,
+                               activation=activation,
+                               gate_activation=gate_activation)
+
+
+def gru_step_from_proj(params, x_proj_t, h, *, activation=jnp.tanh,
+                       gate_activation=jax.nn.sigmoid):
+    """One GRU step given the pre-projected input x@W_ih + b [.., 3H]
+    (see lstm_step_from_proj for why the runners hoist this)."""
+    h_proj = linalg.matmul(h, params["w_hh"])
+    xr, xz, xn = jnp.split(x_proj_t, 3, axis=-1)
+    hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
+    r = gate_activation(xr + hr)
+    z = gate_activation(xz + hz)
+    n = activation(xn + r * hn)
+    return (1.0 - z) * n + z * h
+
+
 def gru_step(params, x_t, h, *, activation=jnp.tanh,
              gate_activation=jax.nn.sigmoid):
     """One GRU step. params: {w_ih [F,3H], w_hh [H,3H], b [3H]}.
@@ -63,13 +91,8 @@ def gru_step(params, x_t, h, *, activation=jnp.tanh,
     gserver/layers/GatedRecurrentLayer.cpp).
     """
     x_proj = linalg.matmul(x_t, params["w_ih"]) + params["b"]
-    h_proj = linalg.matmul(h, params["w_hh"])
-    xr, xz, xn = jnp.split(x_proj, 3, axis=-1)
-    hr, hz, hn = jnp.split(h_proj, 3, axis=-1)
-    r = gate_activation(xr + hr)
-    z = gate_activation(xz + hz)
-    n = activation(xn + r * hn)
-    return (1.0 - z) * n + z * h
+    return gru_step_from_proj(params, x_proj, h, activation=activation,
+                              gate_activation=gate_activation)
 
 
 def _carry_dtype():
@@ -120,11 +143,14 @@ def lstm(params, x, lengths=None, *, initial_state: Optional[LSTMState] = None,
     else:
         mask = jnp.arange(t)[None, :] < lengths[:, None]
 
-    xs = jnp.swapaxes(x, 0, 1)  # [T, B, F]
+    # hoist the input projection: ONE [B*T, F]x[F, 4H] matmul feeding the
+    # MXU at full tilt; the scan then only carries the h@W_hh recurrence
+    x_proj = linalg.matmul(x, params["w_ih"]) + params["b"]  # [B, T, 4H]
+    xs = jnp.swapaxes(x_proj, 0, 1)  # [T, B, 4H]
     ms = jnp.swapaxes(mask, 0, 1)
 
-    def step(state, x_t):
-        return lstm_step(params, x_t, state)
+    def step(state, xp_t):
+        return lstm_step_from_proj(params, xp_t, state)
 
     final, ys = _masked_scan(step, initial_state, xs, ms, reverse, unroll)
     outputs = jnp.swapaxes(ys.h, 0, 1)  # [B, T, H]
@@ -144,11 +170,12 @@ def gru(params, x, lengths=None, *, initial_state=None, reverse: bool = False,
         mask = jnp.ones((b, t), bool)
     else:
         mask = jnp.arange(t)[None, :] < lengths[:, None]
-    xs = jnp.swapaxes(x, 0, 1)
+    x_proj = linalg.matmul(x, params["w_ih"]) + params["b"]  # hoisted
+    xs = jnp.swapaxes(x_proj, 0, 1)
     ms = jnp.swapaxes(mask, 0, 1)
 
-    def step(h, x_t):
-        return gru_step(params, x_t, h)
+    def step(h, xp_t):
+        return gru_step_from_proj(params, xp_t, h)
 
     final, ys = _masked_scan(step, initial_state, xs, ms, reverse, unroll)
     outputs = jnp.swapaxes(ys, 0, 1)
@@ -167,14 +194,12 @@ def simple_rnn(params, x, lengths=None, *, activation=jnp.tanh,
         mask = jnp.ones((b, t), bool)
     else:
         mask = jnp.arange(t)[None, :] < lengths[:, None]
-    xs = jnp.swapaxes(x, 0, 1)
+    x_proj = linalg.matmul(x, params["w_ih"]) + params["b"]  # hoisted
+    xs = jnp.swapaxes(x_proj, 0, 1)
     ms = jnp.swapaxes(mask, 0, 1)
 
-    def step(h, x_t):
-        return activation(
-            linalg.matmul(x_t, params["w_ih"]) + linalg.matmul(h, params["w_hh"])
-            + params["b"]
-        )
+    def step(h, xp_t):
+        return activation(xp_t + linalg.matmul(h, params["w_hh"]))
 
     final, ys = _masked_scan(step, h0, xs, ms, reverse)
     outputs = jnp.swapaxes(ys, 0, 1)
