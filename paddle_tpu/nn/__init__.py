@@ -25,11 +25,18 @@ from paddle_tpu.nn.wrappers import (
     NCE,
     AdditiveAttention,
     BlockExpand,
+    DetectionOutput,
+    HSigmoid,
     Interpolate,
+    MultiBoxLoss,
     PReLU,
+    PriorBox,
     Rotate,
+    SequenceConcat,
     SequenceConv,
     SequencePool,
+    SequenceReshape,
+    SequenceSlice,
 )
 from paddle_tpu.nn.recurrent_group import (
     FnStep,

@@ -22,6 +22,7 @@ from paddle_tpu.ops import activations as A
 from paddle_tpu.ops import conv as conv_ops
 from paddle_tpu.ops import crf as crf_ops
 from paddle_tpu.ops import ctc as ctc_ops
+from paddle_tpu.ops import detection as detection_ops
 from paddle_tpu.ops import sampling as sampling_ops
 from paddle_tpu.ops import sequence as seq_ops
 
@@ -553,6 +554,18 @@ class FeatureMapExpand(Layer):
                                 (n, h, w, vec.shape[-1])), {}
 
 
+def _gather_window(x, starts, sizes, k: int):
+    """Gather a [start, start+size) window (capped at k) from each row of
+    a dense ragged batch, zero-masked beyond size and the batch's T.
+    Shared by SubSequence and SequenceSlice."""
+    b, t, f = x.shape
+    pos = jnp.arange(k)[None, :] + starts[:, None]
+    valid = (jnp.arange(k)[None, :] < sizes[:, None]) & (pos < t)
+    safe = jnp.clip(pos, 0, t - 1)
+    out = jnp.take_along_axis(x, safe[..., None], axis=1)
+    return out * valid[..., None].astype(out.dtype)
+
+
 class SubSequence(Layer):
     """Extract a per-sequence [offset, offset+size) window (reference:
     SubSequenceLayer.cpp). apply(x [B,T,F], offsets [B], sizes [B]) ->
@@ -568,10 +581,249 @@ class SubSequence(Layer):
 
     def _apply(self, params, state, x, offsets, sizes, *, training: bool,
                rng):
+        return _gather_window(x, offsets, sizes, self.max_size), {}
+
+
+class PriorBox(Layer):
+    """SSD anchor-grid layer over an NHWC feature map (reference:
+    gserver/layers/PriorBox.cpp, REGISTER_LAYER(priorbox)). Priors are
+    static per config; apply returns them broadcast-free as [N_priors,4]
+    (corner form, normalized)."""
+
+    def __init__(self, image_hw, min_sizes, max_sizes=(), aspect_ratios=(2.0,),
+                 *, flip: bool = True, clip: bool = True,
+                 name: Optional[str] = None):
+        self.image_hw = tuple(image_hw)
+        self.min_sizes = tuple(min_sizes)
+        self.max_sizes = tuple(max_sizes)
+        self.aspect_ratios = tuple(aspect_ratios)
+        self.flip = flip
+        self.clip = clip
+        self.name = name
+        self._cache = {}
+
+    def _priors(self, h, w):
+        # memoized: the grid is static per (h, w) and the generator is a
+        # pure-Python loop — eager evaluation loops must not re-run it
+        if (h, w) not in self._cache:
+            self._cache[(h, w)] = detection_ops.prior_boxes(
+                (h, w), self.image_hw, self.min_sizes, self.max_sizes,
+                self.aspect_ratios, flip=self.flip, clip=self.clip)
+        return self._cache[(h, w)]
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        _, h, w, _ = spec.shape
+        n = self._priors(h, w).shape[0]
+        return {}, {}, ShapeSpec((n, 4), jnp.float32)
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        _, h, w, _ = x.shape
+        return jnp.asarray(self._priors(h, w)), {}
+
+
+class MultiBoxLoss(Layer):
+    """SSD matching loss layer (reference:
+    gserver/layers/MultiBoxLossLayer.cpp). apply(loc_preds [B,N,4],
+    conf_logits [B,N,C], priors [N,4], gt_boxes [B,M,4], gt_labels [B,M],
+    gt_valid [B,M]) -> per-image loss [B] (vmapped single-image op)."""
+
+    def __init__(self, *, overlap_threshold: float = 0.5,
+                 neg_pos_ratio: float = 3.0, background_id: int = 0,
+                 name: Optional[str] = None):
+        self.overlap_threshold = overlap_threshold
+        self.neg_pos_ratio = neg_pos_ratio
+        self.background_id = background_id
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, *rest, _abstract: bool = False):
+        return {}, {}, ShapeSpec((spec.shape[0],), jnp.float32)
+
+    def _apply(self, params, state, loc_preds, conf_logits, priors,
+               gt_boxes, gt_labels, gt_valid, *, training: bool, rng):
+        loss = jax.vmap(
+            lambda lp, cl, gb, gl, gv: detection_ops.multibox_loss(
+                lp, cl, priors, gb, gl, gv,
+                overlap_threshold=self.overlap_threshold,
+                neg_pos_ratio=self.neg_pos_ratio,
+                background_id=self.background_id)
+        )(loc_preds, conf_logits, gt_boxes, gt_labels, gt_valid)
+        return loss, {}
+
+
+class DetectionOutput(Layer):
+    """SSD decode + per-class NMS layer (reference:
+    gserver/layers/DetectionOutputLayer.cpp). apply(loc_preds [B,N,4],
+    conf_logits [B,N,C], priors [N,4]) -> (classes [B,K], scores [B,K],
+    boxes [B,K,4]), score-0 padded, K=top_k static."""
+
+    def __init__(self, num_classes: int, *, background_id: int = 0,
+                 score_threshold: float = 0.01, iou_threshold: float = 0.45,
+                 top_k: int = 100, pre_nms_top_k: int = 200,
+                 name: Optional[str] = None):
+        self.num_classes = num_classes
+        self.background_id = background_id
+        self.score_threshold = score_threshold
+        self.iou_threshold = iou_threshold
+        self.top_k = top_k
+        self.pre_nms_top_k = pre_nms_top_k
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, *rest, _abstract: bool = False):
+        b, k = spec.shape[0], self.top_k
+        return {}, {}, (ShapeSpec((b, k), jnp.int32),
+                        ShapeSpec((b, k), jnp.float32),
+                        ShapeSpec((b, k, 4), jnp.float32))
+
+    def _apply(self, params, state, loc_preds, conf_logits, priors, *,
+               training: bool, rng):
+        out = jax.vmap(
+            lambda lp, cl: detection_ops.detection_output(
+                lp, cl, priors, num_classes=self.num_classes,
+                background_id=self.background_id,
+                score_threshold=self.score_threshold,
+                iou_threshold=self.iou_threshold, top_k=self.top_k,
+                pre_nms_top_k=self.pre_nms_top_k)
+        )(loc_preds, conf_logits)
+        return out, {}
+
+
+class HSigmoid(Layer):
+    """Hierarchical-sigmoid cost layer over an implicit complete binary
+    tree (reference: gserver/layers/HierarchicalSigmoidLayer.cpp,
+    REGISTER_LAYER(hsigmoid)). Owns [V-1, D] internal-node weights;
+    apply(hidden [B,D], labels [B]) -> per-example loss [B]."""
+
+    def __init__(self, num_classes: int, name: Optional[str] = None):
+        self.num_classes = num_classes
+        node_ids, signs = sampling_ops.build_binary_tree_codes(num_classes)
+        self._node_ids, self._signs = node_ids, signs
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, *rest, _abstract: bool = False):
+        b, d = spec.shape
+        out = ShapeSpec((b,), jnp.float32)
+        if _abstract:
+            return {}, {}, out
+        return {
+            "weights": initializers.smart_uniform()(
+                rng, (self.num_classes - 1, d)),
+            "bias": jnp.zeros((self.num_classes - 1,)),
+        }, {}, out
+
+    def _apply(self, params, state, hidden, labels, *, training: bool, rng):
+        return sampling_ops.hsigmoid_loss(
+            params["weights"], params["bias"], hidden, labels,
+            self._node_ids, self._signs), {}
+
+    def predict_logprob(self, params, hidden, labels):
+        """Log-prob of given labels (for scoring at inference)."""
+        return -sampling_ops.hsigmoid_loss(
+            params["weights"], params["bias"], hidden, labels,
+            self._node_ids, self._signs)
+
+
+class SequenceReshape(Layer):
+    """Reinterpret each sequence's tokens at a new feature width
+    (reference: gserver/layers/SequenceReshapeLayer.cpp — T*F elements
+    regrouped to T'*F'). Dense form: [B, T, F] -> [B, T*F//new_dim,
+    new_dim]; lengths scale by F/new_dim."""
+
+    def __init__(self, new_dim: int, name: Optional[str] = None):
+        self.new_dim = new_dim
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, *rest, _abstract: bool = False):
+        b, t, f = spec.shape
+        enforce((t * f) % self.new_dim == 0,
+                f"T*F={t*f} not divisible by new_dim={self.new_dim}")
+        enforce(f % self.new_dim == 0 or self.new_dim % f == 0,
+                f"feature dim {f} and new_dim {self.new_dim} must divide "
+                "one another. Splitting (new_dim divides f) is always "
+                "exact per sequence; merging (f divides new_dim) floors "
+                "ragged tails — the partial trailing token is dropped "
+                "AND zeroed (the reference layer CHECK-fails on uneven "
+                "division at runtime; inside jit we mask instead)")
+        out = ShapeSpec((b, t * f // self.new_dim, self.new_dim),
+                        spec.dtype)
+        if rest:  # lengths passed -> output is (values, new_lengths)
+            return {}, {}, (out, ShapeSpec((b,), jnp.int32))
+        return {}, {}, out
+
+    def _apply(self, params, state, x, lengths=None, *, training: bool,
+               rng):
         b, t, f = x.shape
-        pos = jnp.arange(self.max_size)[None, :] + offsets[:, None]
-        valid = (jnp.arange(self.max_size)[None, :] < sizes[:, None]) & \
-            (pos < t)
-        safe = jnp.clip(pos, 0, t - 1)
-        out = jnp.take_along_axis(x, safe[..., None], axis=1)
-        return out * valid[..., None].astype(out.dtype), {}
+        t_new = t * f // self.new_dim
+        out = x.reshape(b, t_new, self.new_dim)
+        if lengths is None:
+            return out, {}
+        if f % self.new_dim == 0:
+            new_lengths = lengths * (f // self.new_dim)  # always exact
+        else:
+            new_lengths = lengths * f // self.new_dim
+        # zero everything past each sequence's new length so no stale
+        # token data leaks to consumers that ignore lengths
+        valid = jnp.arange(t_new)[None, :] < new_lengths[:, None]
+        return (out * valid[..., None].astype(out.dtype), new_lengths), {}
+
+
+class SequenceConcat(Layer):
+    """Concatenate two dense ragged batches along time (reference:
+    gserver/layers/SequenceConcatLayer.cpp): sequence i of the output is
+    a's tokens then b's tokens. apply(a [B,Ta,F], la, b [B,Tb,F], lb) ->
+    ([B, Ta+Tb, F], la+lb)."""
+
+    def __init__(self, name: Optional[str] = None):
+        self.name = name
+
+    def _init(self, rng, a_spec: ShapeSpec, la=None, b_spec=None, lb=None,
+              _abstract: bool = False):
+        enforce(b_spec is not None, "SequenceConcat takes (a, la, b, lb)")
+        b, ta, f = a_spec.shape
+        tb = b_spec.shape[1]
+        return {}, {}, (ShapeSpec((b, ta + tb, f), a_spec.dtype),
+                        ShapeSpec((b,), jnp.int32))
+
+    def _apply(self, params, state, a, la, b, lb, *, training: bool, rng):
+        bsz, ta, f = a.shape
+        tb = b.shape[1]
+        t_out = ta + tb
+        pos = jnp.arange(t_out)[None, :]                    # [1, T]
+        from_a = pos < la[:, None]
+        b_idx = jnp.clip(pos - la[:, None], 0, tb - 1)
+        a_idx = jnp.clip(pos, 0, ta - 1)
+        gathered_a = jnp.take_along_axis(a, a_idx[..., None], axis=1)
+        gathered_b = jnp.take_along_axis(b, b_idx[..., None], axis=1)
+        out = jnp.where(from_a[..., None], gathered_a, gathered_b)
+        valid = pos < (la + lb)[:, None]
+        return (out * valid[..., None].astype(out.dtype), la + lb), {}
+
+
+class SequenceSlice(Layer):
+    """Keep the first/last k tokens of each sequence (reference:
+    gserver/layers/SequenceSliceLayer.cpp; seq_slice in config DSL).
+    apply(x [B,T,F], lengths) -> ([B, k, F], new_lengths)."""
+
+    def __init__(self, k: int, *, from_end: bool = False,
+                 name: Optional[str] = None):
+        self.k = k
+        self.from_end = from_end
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, *rest, _abstract: bool = False):
+        b, t, f = spec.shape
+        out = ShapeSpec((b, self.k, f), spec.dtype)
+        if rest:  # lengths passed -> output is (values, new_lengths)
+            return {}, {}, (out, ShapeSpec((b,), jnp.int32))
+        return {}, {}, out
+
+    def _apply(self, params, state, x, lengths=None, *, training: bool,
+               rng):
+        b, t, f = x.shape
+        if lengths is None:
+            lengths = jnp.full((b,), t, jnp.int32)
+        new_len = jnp.minimum(lengths, self.k)
+        if self.from_end:
+            start = jnp.maximum(lengths - self.k, 0)
+        else:
+            start = jnp.zeros_like(lengths)
+        return (_gather_window(x, start, new_len, self.k), new_len), {}
