@@ -19,6 +19,7 @@ from typing import NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 
+from paddle_tpu.core.errors import enforce
 from paddle_tpu.nn import initializers
 from paddle_tpu.nn.recurrent_group import FnStep, Memory, RecurrentGroup
 from paddle_tpu.ops import beam_search as bs
@@ -75,39 +76,74 @@ def encode(params, src_tokens, src_lengths):
     return enc_out, h0
 
 
-def attention(params, dec_h, enc_out, enc_mask):
-    """Additive attention (reference: networks.py:1320 simple_attention).
+def attention_from_proj(params, dec_h, enc_proj, enc_out, enc_mask):
+    """Additive attention given the PRE-PROJECTED encoder states
+    enc_proj = enc_out @ w_enc [B,S,H] (reference: networks.py:1320
+    simple_attention). enc_proj is constant across decoder steps, so the
+    runners compute it ONCE outside the scan — inside, each step was
+    re-multiplying the full [B,S,2H] encoder bank every timestep.
 
-    dec_h [B,H], enc_out [B,S,2H], enc_mask [B,S] -> context [B,2H]."""
+    dec_h [B,H] -> context [B,2H]."""
     a = params["attn"]
     proj = jnp.tanh(
-        linalg.matmul(dec_h, a["w_dec"])[:, None, :]
-        + linalg.matmul(enc_out, a["w_enc"])
-    )  # [B, S, H]
+        linalg.matmul(dec_h, a["w_dec"])[:, None, :] + enc_proj)  # [B,S,H]
     scores = linalg.matmul(proj, a["v"])[..., 0]  # [B, S]
     scores = jnp.where(enc_mask, scores, -1e30)
     weights = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bs,bsf->bf", weights, enc_out.astype(weights.dtype))
 
 
-def _dec_step_apply(params, mems, x_emb, enc_out, enc_mask):
-    """The decoder step sub-network (attention + GRU + output proj) in
-    recurrent-group form: x_emb is the embedded input token (teacher-
-    forced at train time, GeneratedInput at decode time); enc_out/enc_mask
-    are statics; 'h' is the single memory link."""
-    ctx = attention(params, mems["h"], enc_out, enc_mask)
+def project_encoder(params, enc_out):
+    """enc_out @ w_enc — the step-invariant half of the additive score,
+    computed once per sequence batch (all decode paths share this)."""
+    return linalg.matmul(enc_out, params["attn"]["w_enc"])
+
+
+def attention(params, dec_h, enc_out, enc_mask):
+    """Single-shot attention (projects the encoder bank itself)."""
+    return attention_from_proj(params, dec_h, project_encoder(params, enc_out),
+                               enc_out, enc_mask)
+
+
+def _dec_cell(params, mems, x_emb, enc_out, enc_proj, enc_mask):
+    """Shared decoder cell: attention + GRU; returns the new hidden."""
+    ctx = attention_from_proj(params, mems["h"], enc_proj, enc_out,
+                              enc_mask)
     inp = jnp.concatenate([x_emb, ctx.astype(x_emb.dtype)], axis=-1)
-    new_h = rnn_ops.gru_step(params["dec_gru"], inp, mems["h"])
+    return rnn_ops.gru_step(params["dec_gru"], inp, mems["h"])
+
+
+def _dec_step_apply(params, mems, x_emb, enc_out, enc_proj, enc_mask):
+    """Decoder step emitting LOGITS — the generation-time step (beam
+    search consumes per-step distributions; x_emb is the GeneratedInput;
+    enc_out/enc_proj/enc_mask are statics; 'h' is the memory link)."""
+    new_h = _dec_cell(params, mems, x_emb, enc_out, enc_proj, enc_mask)
     logits = linalg.dense(new_h, params["out"]["kernel"], params["out"]["bias"])
     return logits, {"h": new_h}
 
 
-def decoder_group(hidden: int) -> RecurrentGroup:
+def _dec_hidden_apply(params, mems, x_emb, enc_out, enc_proj, enc_mask):
+    """Decoder step emitting the HIDDEN state — the training-time step.
+    Teacher forcing knows every input up front, so the hidden->vocab
+    projection hoists out of the scan: one [B*T, H] x [H, V] matmul over
+    the collected states instead of T per-step [B, H] x [H, V] matmuls
+    (V=30k dominates the decoder FLOPs; small per-step matmuls starve
+    the MXU)."""
+    new_h = _dec_cell(params, mems, x_emb, enc_out, enc_proj, enc_mask)
+    return new_h, {"h": new_h}
+
+
+def decoder_group(hidden: int, *, emit: str = "logits") -> RecurrentGroup:
     """The decoder as a RecurrentGroup (reference: recurrent_group with
-    simple_attention, trainer_config_helpers/networks.py:1320; the same
-    definition drives training and generation)."""
+    simple_attention, trainer_config_helpers/networks.py:1320). The SAME
+    cell drives training and generation; emit picks the step output
+    ('logits' for generation/beam search, 'hidden' for the hoisted
+    teacher-forced path)."""
+    enforce(emit in ("logits", "hidden"),
+            f"emit must be 'logits' or 'hidden', got {emit!r}")
+    step = _dec_step_apply if emit == "logits" else _dec_hidden_apply
     return RecurrentGroup(
-        FnStep(lambda rng, mem_specs, x_specs: {}, _dec_step_apply),
+        FnStep(lambda rng, mem_specs, x_specs: {}, step),
         {"h": Memory(hidden, boot="extern", dtype=jnp.float32)},
         out_ignore_mask=True,
     )
@@ -118,11 +154,14 @@ def teacher_forced_logits(params, src_tokens, src_lengths, tgt_in):
     [B, T, V] via the recurrent-group scan path."""
     b, s = src_tokens.shape
     enc_out, h0 = encode(params, src_tokens, src_lengths)
+    enc_proj = project_encoder(params, enc_out)  # hoisted
     enc_mask = jnp.arange(s)[None, :] < src_lengths[:, None]
     emb = jnp.take(params["tgt_embed"], tgt_in, axis=0)  # [B, T, E]
-    logits, _ = decoder_group(h0.shape[-1]).run(
-        params, emb, boots={"h": h0}, statics=(enc_out, enc_mask))
-    return logits
+    hs, _ = decoder_group(h0.shape[-1], emit="hidden").run(
+        params, emb, boots={"h": h0},
+        statics=(enc_out, enc_proj, enc_mask))
+    # hoisted output projection: one big [B*T, H] x [H, V] matmul
+    return linalg.dense(hs, params["out"]["kernel"], params["out"]["bias"])
 
 
 def loss(params, src_tokens, src_lengths, tgt_tokens, tgt_lengths, *,
@@ -145,6 +184,7 @@ def generate(params, src_tokens, src_lengths, *, beam_size: int = 4,
     """Beam-search generation (reference: generateSequence/beamSearch)."""
     b, s = src_tokens.shape
     enc_out, h0 = encode(params, src_tokens, src_lengths)
+    enc_proj = project_encoder(params, enc_out)
     enc_mask = jnp.arange(s)[None, :] < src_lengths[:, None]
     vocab = params["out"]["kernel"].shape[1]
     return decoder_group(h0.shape[-1]).generate(
@@ -157,7 +197,7 @@ def generate(params, src_tokens, src_lengths, *, beam_size: int = 4,
         eos_id=eos_id,
         beam_size=beam_size,
         boots={"h": h0},
-        statics=(enc_out, enc_mask),
+        statics=(enc_out, enc_proj, enc_mask),
         length_penalty=length_penalty,
         greedy=False,  # beam-shaped return contract even at beam_size=1
     )
@@ -168,6 +208,7 @@ def greedy_generate(params, src_tokens, src_lengths, *, max_len: int = 20,
     """Greedy decode (reference: oneWaySearch)."""
     b, s = src_tokens.shape
     enc_out, h0 = encode(params, src_tokens, src_lengths)
+    enc_proj = project_encoder(params, enc_out)
     enc_mask = jnp.arange(s)[None, :] < src_lengths[:, None]
     return decoder_group(h0.shape[-1]).generate(
         params,
@@ -179,5 +220,5 @@ def greedy_generate(params, src_tokens, src_lengths, *, max_len: int = 20,
         eos_id=eos_id,
         beam_size=1,
         boots={"h": h0},
-        statics=(enc_out, enc_mask),
+        statics=(enc_out, enc_proj, enc_mask),
     )
