@@ -16,3 +16,4 @@ from paddle_tpu.models import seq2seq_attn
 from paddle_tpu.models import gan
 from paddle_tpu.models import vae
 from paddle_tpu.models import ctr
+from paddle_tpu.models import quick_start

@@ -1,0 +1,163 @@
+"""Quick-start text-classification family (sentiment-style binary cls).
+
+Parity target: the reference's quick_start demo configs (reference:
+v1_api_demo/quick_start/trainer_config.lr.py — bag-of-words logistic
+regression; trainer_config.cnn.py — embedding + sequence_conv_pool;
+trainer_config.bidi-lstm.py; trainer_config.db-lstm.py — 8 alternating
+fc+lstm levels with reversed directions). The lstm variant lives in
+models.text_lstm.
+
+All models consume dense padded [B, T] int32 token batches + lengths and
+return logits [B, num_classes]; bow_lr consumes multi-hot count vectors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializers
+from paddle_tpu.ops import linalg
+from paddle_tpu.ops import rnn as rnn_ops
+from paddle_tpu.ops import sequence as seq_ops
+
+
+# ---- trainer_config.lr.py: bag-of-words logistic regression ----------
+
+
+def init_bow_lr(rng, vocab_size: int, num_classes: int = 2):
+    return {
+        "fc": {
+            "kernel": initializers.smart_uniform()(
+                rng, (vocab_size, num_classes)),
+            "bias": jnp.zeros((num_classes,)),
+        }
+    }
+
+
+def bow_lr(params, bow):
+    """bow: [B, V] multi-hot/count vector -> logits [B, C] (reference:
+    trainer_config.lr.py fc_layer over the sparse word vector; the
+    dataprovider's bag-of-words becomes a dense count vector here —
+    sparse inputs ride the embedding-sum path below instead)."""
+    return linalg.dense(bow, params["fc"]["kernel"], params["fc"]["bias"])
+
+
+def bow_lr_from_tokens(params, tokens, lengths):
+    """Same model fed [B, T] token ids: sums the per-token weight ROWS
+    (identical math to multiplying the multi-hot vector, but O(B*T)
+    instead of O(B*V) — the TPU-native form of the reference's sparse
+    bow input)."""
+    b, t = tokens.shape
+    rows = jnp.take(params["fc"]["kernel"], tokens, axis=0)  # [B, T, C]
+    mask = (jnp.arange(t)[None, :] < lengths[:, None])[..., None]
+    return jnp.sum(jnp.where(mask, rows, 0.0), axis=1) + params["fc"]["bias"]
+
+
+# ---- trainer_config.cnn.py: embedding -> sequence conv -> max pool ---
+
+
+def init_text_cnn(rng, vocab_size: int, num_classes: int = 2, *,
+                  embed_dim: int = 128, context_len: int = 3,
+                  hidden: int = 512):
+    k1, k2, k3 = jax.random.split(rng, 3)
+    return {
+        "embed": initializers.normal(0.05)(k1, (vocab_size, embed_dim)),
+        "conv": {
+            "filter": initializers.smart_uniform()(
+                k2, (context_len * embed_dim, hidden)),
+            "bias": jnp.zeros((hidden,)),
+        },
+        "fc": {
+            "kernel": initializers.smart_uniform()(k3, (hidden, num_classes)),
+            "bias": jnp.zeros((num_classes,)),
+        },
+    }
+
+
+def text_cnn(params, tokens, lengths, *, context_len: int = 3):
+    """reference: trainer_config.cnn.py sequence_conv_pool(context_len=3,
+    hidden_size=512) — context-window conv + max pool over time."""
+    x = jnp.take(params["embed"], tokens, axis=0)          # [B, T, E]
+    h = seq_ops.sequence_conv(
+        x, lengths, params["conv"]["filter"], context_len=context_len)
+    h = jax.nn.relu(h + params["conv"]["bias"])
+    pooled = seq_ops.dense_sequence_pool(h, lengths, "max")
+    return linalg.dense(pooled, params["fc"]["kernel"], params["fc"]["bias"])
+
+
+# ---- trainer_config.bidi-lstm.py -------------------------------------
+
+
+def init_bidi_lstm(rng, vocab_size: int, num_classes: int = 2, *,
+                   embed_dim: int = 128, hidden: int = 128):
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+    return {
+        "embed": initializers.normal(0.05)(k1, (vocab_size, embed_dim)),
+        "fwd": rnn_ops.init_lstm_params(k2, embed_dim, hidden),
+        "bwd": rnn_ops.init_lstm_params(k3, embed_dim, hidden),
+        "fc": {
+            "kernel": initializers.smart_uniform()(
+                k4, (2 * hidden, num_classes)),
+            "bias": jnp.zeros((num_classes,)),
+        },
+    }
+
+
+def bidi_lstm(params, tokens, lengths):
+    x = jnp.take(params["embed"], tokens, axis=0)
+    out, _ = rnn_ops.bidirectional(
+        rnn_ops.lstm, params["fwd"], params["bwd"], x, lengths)
+    pooled = seq_ops.dense_sequence_pool(out, lengths, "max")
+    return linalg.dense(pooled, params["fc"]["kernel"], params["fc"]["bias"])
+
+
+# ---- trainer_config.db-lstm.py: deep alternating fc+lstm stack -------
+
+
+def init_db_lstm(rng, vocab_size: int, num_classes: int = 2, *,
+                 embed_dim: int = 128, hidden: int = 128, depth: int = 8):
+    """depth matches the reference's 8 levels (level 0 = fc+lstm, then
+    7 alternating-direction levels)."""
+    keys = jax.random.split(rng, 2 * depth + 2)
+    params = {
+        "embed": initializers.normal(0.05)(keys[0], (vocab_size, embed_dim)),
+        "fc0": {
+            "kernel": initializers.smart_uniform()(
+                keys[1], (embed_dim, hidden)),
+            "bias": jnp.zeros((hidden,)),
+        },
+        "lstm0": rnn_ops.init_lstm_params(keys[2], hidden, hidden),
+    }
+    for i in range(1, depth):
+        params[f"fc{i}"] = {
+            "kernel": initializers.smart_uniform()(
+                keys[2 * i + 1], (2 * hidden, hidden)),
+            "bias": jnp.zeros((hidden,)),
+        }
+        params[f"lstm{i}"] = rnn_ops.init_lstm_params(
+            keys[2 * i + 2], hidden, hidden)
+    params["out"] = {
+        "kernel": initializers.smart_uniform()(
+            keys[2 * depth + 1], (hidden, num_classes)),
+        "bias": jnp.zeros((num_classes,)),
+    }
+    return params
+
+
+def db_lstm(params, tokens, lengths, *, depth: int = 8):
+    """reference: trainer_config.db-lstm.py — fc_i takes [fc_{i-1},
+    lstm_{i-1}] concatenated, lstm_i alternates scan direction; final
+    max-pool over the last lstm's outputs."""
+    x = jnp.take(params["embed"], tokens, axis=0)
+    fc = jax.nn.relu(linalg.dense(
+        x, params["fc0"]["kernel"], params["fc0"]["bias"]))
+    lstm_out, _ = rnn_ops.lstm(params["lstm0"], fc, lengths)
+    for i in range(1, depth):
+        inp = jnp.concatenate([fc, lstm_out], axis=-1)
+        fc = jax.nn.relu(linalg.dense(
+            inp, params[f"fc{i}"]["kernel"], params[f"fc{i}"]["bias"]))
+        lstm_out, _ = rnn_ops.lstm(
+            params[f"lstm{i}"], fc, lengths, reverse=(i % 2) == 1)
+    pooled = seq_ops.dense_sequence_pool(lstm_out, lengths, "max")
+    return linalg.dense(pooled, params["out"]["kernel"], params["out"]["bias"])

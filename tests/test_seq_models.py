@@ -222,3 +222,117 @@ class TestSeq2Seq:
             lambda p, s, sl: seq2seq_attn.greedy_generate(p, s, sl, max_len=10)
         )(params, src[:4], src_lens[:4])
         assert gt.shape == (4, 10)
+
+
+# ---- quick_start family (reference: v1_api_demo/quick_start configs) --
+
+
+def _toy_text(n=256, vocab=200, t=12, seed=0):
+    """Separable synthetic task: class = whether tokens from the upper
+    half of the vocab dominate."""
+    r = np.random.RandomState(seed)
+    lengths = r.randint(4, t + 1, n)
+    tokens = r.randint(0, vocab, (n, t))
+    labels = np.zeros(n, np.int64)
+    for i in range(n):
+        lo = (tokens[i, :lengths[i]] < vocab // 2).sum()
+        labels[i] = int(lo * 2 < lengths[i])
+        tokens[i, lengths[i]:] = 0
+    return (jnp.asarray(tokens, jnp.int32), jnp.asarray(lengths),
+            jnp.asarray(labels))
+
+
+def _train_text_model(init_fn, apply_fn, *, steps=60, lr=5e-2, seed=0):
+    from paddle_tpu import optim
+    from paddle_tpu.ops import losses
+
+    vocab = 200
+    tokens, lengths, labels = _toy_text(vocab=vocab, seed=seed)
+    params = init_fn(jax.random.key(0), vocab)
+    opt = optim.adam(lr)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, i):
+        def loss_fn(p):
+            logits = apply_fn(p, tokens, lengths)
+            return jnp.mean(losses.softmax_cross_entropy(logits, labels))
+        loss, g = jax.value_and_grad(loss_fn)(params)
+        new_p, new_o = opt.update(g, opt_state, params, i)
+        return new_p, new_o, loss
+
+    first = None
+    for i in range(steps):
+        params, opt_state, loss = step(params, opt_state,
+                                       jnp.asarray(i, jnp.int32))
+        if first is None:
+            first = float(loss)
+    logits = apply_fn(params, tokens, lengths)
+    from paddle_tpu.ops import metrics as M
+    acc = float(M.accuracy(logits, labels))
+    return first, float(loss), acc
+
+
+def test_quick_start_bow_lr_learns():
+    from paddle_tpu.models import quick_start as qs
+
+    first, last, acc = _train_text_model(
+        qs.init_bow_lr, qs.bow_lr_from_tokens)
+    assert last < first * 0.6 and acc > 0.9, (first, last, acc)
+
+
+def test_quick_start_bow_dense_equals_token_path():
+    from paddle_tpu.models import quick_start as qs
+
+    vocab = 50
+    tokens, lengths, _ = _toy_text(n=8, vocab=vocab, t=6, seed=1)
+    params = qs.init_bow_lr(jax.random.key(0), vocab)
+    # build the dense count vector and compare the two input forms
+    counts = np.zeros((8, vocab), np.float32)
+    for i in range(8):
+        for tkn in np.asarray(tokens[i, : int(lengths[i])]):
+            counts[i, tkn] += 1
+    dense = qs.bow_lr(params, jnp.asarray(counts))
+    sparse = qs.bow_lr_from_tokens(params, tokens, lengths)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(sparse),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quick_start_text_cnn_learns():
+    from paddle_tpu.models import quick_start as qs
+
+    first, last, acc = _train_text_model(
+        lambda rng, v: qs.init_text_cnn(rng, v, embed_dim=16, hidden=32),
+        qs.text_cnn, steps=80)
+    assert last < first * 0.6 and acc > 0.9, (first, last, acc)
+
+
+def test_quick_start_bidi_lstm_shapes_and_grad():
+    from paddle_tpu.models import quick_start as qs
+
+    vocab = 60
+    tokens, lengths, labels = _toy_text(n=8, vocab=vocab, t=6, seed=2)
+    params = qs.init_bidi_lstm(jax.random.key(0), vocab, embed_dim=8,
+                               hidden=12)
+    logits = qs.bidi_lstm(params, tokens, lengths)
+    assert logits.shape == (8, 2)
+    g = jax.grad(lambda p: jnp.sum(qs.bidi_lstm(p, tokens, lengths) ** 2))(
+        params)
+    assert float(jnp.abs(g["fwd"]["w_ih"]).sum()) > 0
+    assert float(jnp.abs(g["bwd"]["w_ih"]).sum()) > 0
+
+
+def test_quick_start_db_lstm_depth_and_direction():
+    from paddle_tpu.models import quick_start as qs
+
+    vocab, depth = 40, 3
+    tokens, lengths, _ = _toy_text(n=4, vocab=vocab, t=5, seed=3)
+    params = qs.init_db_lstm(jax.random.key(0), vocab, embed_dim=8,
+                             hidden=10, depth=depth)
+    logits = qs.db_lstm(params, tokens, lengths, depth=depth)
+    assert logits.shape == (4, 2)
+    # every level's parameters participate
+    g = jax.grad(lambda p: jnp.sum(
+        qs.db_lstm(p, tokens, lengths, depth=depth) ** 2))(params)
+    for i in range(depth):
+        assert float(jnp.abs(g[f"lstm{i}"]["w_hh"]).sum()) > 0, i
