@@ -145,10 +145,13 @@ def init_db_lstm(rng, vocab_size: int, num_classes: int = 2, *,
     return params
 
 
-def db_lstm(params, tokens, lengths, *, depth: int = 8):
+def db_lstm(params, tokens, lengths):
     """reference: trainer_config.db-lstm.py — fc_i takes [fc_{i-1},
     lstm_{i-1}] concatenated, lstm_i alternates scan direction; final
-    max-pool over the last lstm's outputs."""
+    max-pool over the last lstm's outputs. Depth is derived from the
+    params (count of lstm* levels), so it can't silently disagree with
+    what init_db_lstm built."""
+    depth = sum(1 for k in params if k.startswith("lstm"))
     x = jnp.take(params["embed"], tokens, axis=0)
     fc = jax.nn.relu(linalg.dense(
         x, params["fc0"]["kernel"], params["fc0"]["bias"]))

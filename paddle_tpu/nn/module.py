@@ -130,13 +130,18 @@ class Sequential(Layer):
             sub_rng = None
             if rng is not None:
                 rng, sub_rng = jax.random.split(rng)
-            out, sub_state = layer._apply(
-                params.get(key, {}),
-                state.get(key, {}),
-                *cur,
-                training=training,
-                rng=sub_rng,
-            )
+            # named_scope labels every op with its layer name — the
+            # profiler/crash-trace analog of the reference's per-layer
+            # timers and CustomStackTrace (NeuralNetwork.cpp:256-263:
+            # layer names pushed around forward; utils/CustomStackTrace.h)
+            with jax.named_scope(key):
+                out, sub_state = layer._apply(
+                    params.get(key, {}),
+                    state.get(key, {}),
+                    *cur,
+                    training=training,
+                    rng=sub_rng,
+                )
             if sub_state:
                 new_state[key] = sub_state
             cur = out if isinstance(out, tuple) else (out,)

@@ -20,6 +20,7 @@ from paddle_tpu.parallel.ring_attention import (
     ulysses_attention,
 )
 from paddle_tpu.parallel.sparse import (
+    HostOffloadEmbedding,
     ShardedEmbedding,
     alltoall_lookup,
     alltoall_push_row_grads,

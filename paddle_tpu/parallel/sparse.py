@@ -25,6 +25,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.mesh import MODEL_AXIS
@@ -227,6 +228,18 @@ def sharded_embedding_bag(table, ids, segment_ids, num_segments: int,
                         table.dtype)
 
 
+def masked_row_delta(num_rows: int, dtype, ids, row_grads, lr):
+    """(safe_ids, -lr*masked_grads): THE home of the padding-id rule —
+    out-of-range ids (e.g. -1 padding) contribute ZERO and are clipped
+    in-bounds so a scatter-add can't wrap them to the last row. Shared
+    by rowwise_sgd_update and HostOffloadEmbedding."""
+    in_range = (ids >= 0) & (ids < num_rows)
+    safe = jnp.clip(ids, 0, num_rows - 1)
+    contrib = jnp.where(in_range[:, None], row_grads, 0)
+    return safe, (-lr * contrib).astype(dtype)
+
+
+
 def rowwise_sgd_update(table, ids, row_grads, lr, mesh: Optional[Mesh] = None,
                        *, axis: str = MODEL_AXIS):
     """Apply SGD to ONLY the touched rows (SelectedRows-style update;
@@ -239,12 +252,9 @@ def rowwise_sgd_update(table, ids, row_grads, lr, mesh: Optional[Mesh] = None,
     touches its local rows and no dense [V, D] gradient ever exists.
     """
     if mesh is None:
-        # mask out-of-range (e.g. -1 padding) ids so both paths agree:
-        # jnp's default scatter would wrap negative ids to the last row
-        in_range = (ids >= 0) & (ids < table.shape[0])
-        safe = jnp.clip(ids, 0, table.shape[0] - 1)
-        contrib = jnp.where(in_range[:, None], row_grads, 0)
-        return table.at[safe].add(-lr * contrib.astype(table.dtype))
+        safe, delta = masked_row_delta(table.shape[0], table.dtype, ids,
+                                       row_grads, lr)
+        return table.at[safe].add(delta)
 
     n = mesh.shape[axis]
     rows_per_shard = table.shape[0] // n
@@ -336,3 +346,132 @@ class ShardedEmbedding:
     def apply_row_grads(self, table, ids, row_grads, lr):
         return rowwise_sgd_update(
             table, ids, row_grads, lr, self.mesh, axis=self.axis)
+
+
+# ---------------------------------------------------------------------
+# host-offloaded tables (> HBM capacity)
+# ---------------------------------------------------------------------
+
+
+class HostOffloadEmbedding:
+    """Embedding table stored in HOST memory, touched rows DMA'd to the
+    device per step.
+
+    The reference holds giant sparse tables in pserver host RAM and
+    trainers pull only the touched rows over the network
+    (reference: math/SparseRowMatrix.h:206 SparsePrefetchRowCpuMatrix,
+    pserver/ParameterServer2.h:510 getParameterSparse). The single-host
+    TPU analog (SURVEY §7 hard part: "possibly host offload for >HBM
+    tables"): the table lives in pinned_host memory, the gather runs on
+    the host CPU under compute_on('device_host'), and only [K, D]
+    touched rows cross PCIe — the HBM never sees the [V, D] table. The
+    row-sparse SGD update scatters back on the host the same way.
+
+    Same call surface as ShardedEmbedding's local path (init / lookup /
+    apply_row_grads), single-process; combine with ShardedEmbedding when
+    the table also spans hosts.
+    """
+
+    def __init__(self, vocab: int, dim: int, *, init_scale: float = 0.01,
+                 name: str = "host_embedding"):
+        self.vocab, self.dim = vocab, dim
+        self.init_scale = init_scale
+        self.name = name
+
+    def _host_sharding(self):
+        from jax.sharding import SingleDeviceSharding
+
+        return SingleDeviceSharding(jax.devices()[0],
+                                    memory_kind="pinned_host")
+
+    def init(self, rng):
+        table = jax.random.normal(
+            rng, (self.vocab, self.dim), jnp.float32) * self.init_scale
+        return jax.device_put(table, self._host_sharding())
+
+    def lookup(self, table, ids):
+        """ids [K] -> rows [K, D] on DEVICE; the gather itself runs on
+        host so only K*D floats move to HBM. Out-of-range ids (e.g. -1
+        padding) return ZERO vectors — the same contract as
+        sharded_lookup."""
+        from jax.experimental.compute_on import compute_on
+        from jax.sharding import SingleDeviceSharding
+
+        host_sh = self._host_sharding()
+        in_range = (ids >= 0) & (ids < self.vocab)
+        ids_h = jax.device_put(jnp.clip(ids, 0, self.vocab - 1), host_sh)
+        with compute_on("device_host"):
+            dnums = lax.GatherDimensionNumbers(
+                offset_dims=(1,), collapsed_slice_dims=(0,),
+                start_index_map=(0,))
+            rows = lax.gather(
+                table, ids_h[:, None], dnums,
+                slice_sizes=(1, table.shape[1]),
+                mode=lax.GatherScatterMode.PROMISE_IN_BOUNDS)
+        dev_sh = SingleDeviceSharding(jax.devices()[0],
+                                      memory_kind="device")
+        rows_d = jax.device_put(rows, dev_sh)
+        return jnp.where(in_range[:, None], rows_d, 0.0)
+
+    def apply_row_grads(self, table, ids, row_grads, lr):
+        """Row-sparse SGD on the host copy: [K, D] grads cross PCIe,
+        the scatter-add runs host-side, HBM never holds the table.
+        The padding-id masking happens on DEVICE via masked_row_delta
+        (the ONE home of that rule, shared with rowwise_sgd_update) —
+        the host region must stay free of fresh broadcast constants,
+        which land in device memory space and fail to mix."""
+        from jax.experimental.compute_on import compute_on
+
+        host_sh = self._host_sharding()
+        safe, delta = masked_row_delta(self.vocab, table.dtype, ids,
+                                       row_grads, lr)
+        safe_h = jax.device_put(safe, host_sh)
+        delta_h = jax.device_put(delta, host_sh)
+        with compute_on("device_host"):
+            dnums = lax.ScatterDimensionNumbers(
+                update_window_dims=(1,), inserted_window_dims=(0,),
+                scatter_dims_to_operand_dims=(0,))
+            new_table = lax.scatter_add(
+                table, safe_h[:, None], delta_h, dnums,
+                mode=lax.GatherScatterMode.PROMISE_IN_BOUNDS)
+        # NOTE: a top-level jit defaults its OUTPUT memory to device
+        # HBM — use .update() below, or pass out_shardings with
+        # memory_kind='pinned_host' for the table output of your own
+        # jit. (No in-trace placement annotation here: the result of the
+        # host scatter already lives in host space, and an extra
+        # annotate_device_placement inside the host region has no
+        # registered lowering on some backends.)
+        return new_table
+
+    def update(self, table, ids, row_grads, lr):
+        """Jitted row-sparse update whose output table STAYS pinned in
+        host memory — the form to call between steps at top level.
+
+        On TPU the pinning rides jit out_shardings (zero extra copies,
+        old table donated). Backends whose compiler can't annotate host
+        placement in-program (XLA:CPU — 'annotate_device_placement for
+        Host' has no registered lowering) fall back to re-pinning the
+        result outside the trace; that emulation round-trips the table
+        once, which is fine for tests and irrelevant on TPU."""
+        if not hasattr(self, "_jit_update"):
+            host_sh = self._host_sharding()
+            fn = jax.jit(self.apply_row_grads,
+                         out_shardings=host_sh,
+                         donate_argnums=0)
+            try:
+                # probe on THROWAWAY buffers (XLA:CPU rejects the host
+                # placement only at RUNTIME — 'no registered
+                # implementation for annotate_device_placement' — so a
+                # compile-only probe would pass and the real call would
+                # then fail AFTER donating the caller's table)
+                probe_t = jax.device_put(
+                    jnp.zeros(table.shape, table.dtype), host_sh)
+                jax.block_until_ready(fn(probe_t, ids, row_grads, lr))
+                self._jit_update = fn
+            except Exception:
+                # no donation here either: donating a pinned_host input
+                # crashes XLA:CPU outright (hard abort, not an exception)
+                plain = jax.jit(self.apply_row_grads)
+                self._jit_update = lambda *a: jax.device_put(
+                    plain(*a), host_sh)
+        return self._jit_update(table, ids, row_grads, lr)

@@ -329,10 +329,10 @@ def test_quick_start_db_lstm_depth_and_direction():
     tokens, lengths, _ = _toy_text(n=4, vocab=vocab, t=5, seed=3)
     params = qs.init_db_lstm(jax.random.key(0), vocab, embed_dim=8,
                              hidden=10, depth=depth)
-    logits = qs.db_lstm(params, tokens, lengths, depth=depth)
+    logits = qs.db_lstm(params, tokens, lengths)
     assert logits.shape == (4, 2)
     # every level's parameters participate
     g = jax.grad(lambda p: jnp.sum(
-        qs.db_lstm(p, tokens, lengths, depth=depth) ** 2))(params)
+        qs.db_lstm(p, tokens, lengths) ** 2))(params)
     for i in range(depth):
         assert float(jnp.abs(g[f"lstm{i}"]["w_hh"]).sum()) > 0, i
