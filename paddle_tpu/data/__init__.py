@@ -13,3 +13,4 @@ from paddle_tpu.data.batch import (
     stack_columns,
 )
 from paddle_tpu.data.feeder import DataFeeder, prefetch_to_device
+from paddle_tpu.data import image

@@ -25,6 +25,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -378,15 +379,38 @@ class HostOffloadEmbedding:
         self.init_scale = init_scale
         self.name = name
 
-    def _host_sharding(self):
+    def _host_sharding(self, table=None):
+        """pinned_host sharding on the table's device (falls back to
+        device 0 only when there is no table yet, i.e. at init)."""
         from jax.sharding import SingleDeviceSharding
 
-        return SingleDeviceSharding(jax.devices()[0],
+        return SingleDeviceSharding(self._table_device(table),
                                     memory_kind="pinned_host")
 
+    @staticmethod
+    def _table_device(table):
+        """The table's device when known; tracers (inside jit, where
+        concrete placement is the enclosing computation's business) and
+        absent tables fall back to device 0."""
+        try:
+            return next(iter(table.sharding.device_set))
+        except Exception:
+            return jax.devices()[0]
+
+    def _dev_sharding(self, table):
+        from jax.sharding import SingleDeviceSharding
+
+        return SingleDeviceSharding(self._table_device(table),
+                                    memory_kind="device")
+
     def init(self, rng):
-        table = jax.random.normal(
-            rng, (self.vocab, self.dim), jnp.float32) * self.init_scale
+        """Generate the table ON HOST (numpy seeded from the jax key):
+        a >HBM table must never materialize in device memory, which
+        jax.random.normal on the default device would do."""
+        seed = np.asarray(jax.random.key_data(rng)).ravel()
+        host_rng = np.random.default_rng([int(s) for s in seed])
+        table = (host_rng.standard_normal(
+            (self.vocab, self.dim), np.float32) * self.init_scale)
         return jax.device_put(table, self._host_sharding())
 
     def lookup(self, table, ids):
@@ -395,9 +419,8 @@ class HostOffloadEmbedding:
         padding) return ZERO vectors — the same contract as
         sharded_lookup."""
         from jax.experimental.compute_on import compute_on
-        from jax.sharding import SingleDeviceSharding
 
-        host_sh = self._host_sharding()
+        host_sh = self._host_sharding(table)
         in_range = (ids >= 0) & (ids < self.vocab)
         ids_h = jax.device_put(jnp.clip(ids, 0, self.vocab - 1), host_sh)
         with compute_on("device_host"):
@@ -408,9 +431,7 @@ class HostOffloadEmbedding:
                 table, ids_h[:, None], dnums,
                 slice_sizes=(1, table.shape[1]),
                 mode=lax.GatherScatterMode.PROMISE_IN_BOUNDS)
-        dev_sh = SingleDeviceSharding(jax.devices()[0],
-                                      memory_kind="device")
-        rows_d = jax.device_put(rows, dev_sh)
+        rows_d = jax.device_put(rows, self._dev_sharding(table))
         return jnp.where(in_range[:, None], rows_d, 0.0)
 
     def apply_row_grads(self, table, ids, row_grads, lr):
@@ -422,7 +443,7 @@ class HostOffloadEmbedding:
         which land in device memory space and fail to mix."""
         from jax.experimental.compute_on import compute_on
 
-        host_sh = self._host_sharding()
+        host_sh = self._host_sharding(table)
         safe, delta = masked_row_delta(self.vocab, table.dtype, ids,
                                        row_grads, lr)
         safe_h = jax.device_put(safe, host_sh)
@@ -454,7 +475,7 @@ class HostOffloadEmbedding:
         result outside the trace; that emulation round-trips the table
         once, which is fine for tests and irrelevant on TPU."""
         if not hasattr(self, "_jit_update"):
-            host_sh = self._host_sharding()
+            host_sh = self._host_sharding(table)
             fn = jax.jit(self.apply_row_grads,
                          out_shardings=host_sh,
                          donate_argnums=0)
@@ -463,12 +484,16 @@ class HostOffloadEmbedding:
                 # placement only at RUNTIME — 'no registered
                 # implementation for annotate_device_placement' — so a
                 # compile-only probe would pass and the real call would
-                # then fail AFTER donating the caller's table)
+                # then fail AFTER donating the caller's table). numpy
+                # zeros -> pinned host directly: a >HBM probe must not
+                # pass through device memory
                 probe_t = jax.device_put(
-                    jnp.zeros(table.shape, table.dtype), host_sh)
+                    np.zeros(table.shape, table.dtype), host_sh)
                 jax.block_until_ready(fn(probe_t, ids, row_grads, lr))
                 self._jit_update = fn
-            except Exception:
+            except Exception as e:
+                if "annotate_device_placement" not in str(e):
+                    raise  # a real user error — don't cache a fallback
                 # no donation here either: donating a pinned_host input
                 # crashes XLA:CPU outright (hard abort, not an exception)
                 plain = jax.jit(self.apply_row_grads)

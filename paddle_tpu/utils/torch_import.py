@@ -1,0 +1,168 @@
+"""Import PyTorch weights into paddle_tpu models.
+
+The reference ships a converter from (lua-)torch checkpoints into its
+parameter tar format (reference: python/paddle/utils/torch2paddle.py).
+The modern equivalent: map a PyTorch module / state_dict onto a
+paddle_tpu Layer tree — layout conversions included (torch Linear is
+[out,in] vs our [in,out]; torch Conv2d is OIHW vs our HWIO; torch
+BatchNorm's weight/bias/running stats map to scale/offset/mean/var).
+
+Two entry points:
+  convert_module(torch_module) -> params dict for ONE layer type
+  import_into(model, params, state, torch_module) -> (params, state)
+      pairs the torch module's parameterized children (in registration
+      order) with the paddle_tpu tree's parameterized layers (in
+      Sequential order) and copies the weights across.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from paddle_tpu import nn
+from paddle_tpu.core.errors import enforce
+from paddle_tpu.nn.module import Layer, Sequential
+
+
+def _t(x) -> np.ndarray:
+    return np.asarray(x.detach().cpu().numpy(), np.float32)
+
+
+def convert_linear(mod) -> Dict[str, Any]:
+    """torch.nn.Linear [out,in] -> Dense kernel [in,out]."""
+    out = {"kernel": jnp.asarray(_t(mod.weight).T)}
+    if mod.bias is not None:
+        out["bias"] = jnp.asarray(_t(mod.bias))
+    return out
+
+
+def convert_conv2d(mod) -> Dict[str, Any]:
+    """torch.nn.Conv2d OIHW -> Conv2D kernel HWIO."""
+    out = {"kernel": jnp.asarray(_t(mod.weight).transpose(2, 3, 1, 0))}
+    if mod.bias is not None:
+        out["bias"] = jnp.asarray(_t(mod.bias))
+    return out
+
+
+def convert_batchnorm(mod) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+    """torch.nn.BatchNorm2d -> (params{scale,offset}, state{mean,var})."""
+    params = {"scale": jnp.asarray(_t(mod.weight)),
+              "offset": jnp.asarray(_t(mod.bias))}
+    state = {"mean": jnp.asarray(_t(mod.running_mean)),
+             "var": jnp.asarray(_t(mod.running_var))}
+    return params, state
+
+
+def convert_embedding(mod) -> Dict[str, Any]:
+    return {"table": jnp.asarray(_t(mod.weight))}
+
+
+def _torch_leaves(torch_module) -> List[Any]:
+    """Parameterized torch leaves in registration order."""
+    import torch.nn as tnn
+
+    kinds = (tnn.Linear, tnn.Conv2d, tnn.BatchNorm1d, tnn.BatchNorm2d,
+             tnn.Embedding)
+    leaves = []
+    for m in torch_module.modules():
+        if isinstance(m, kinds):
+            leaves.append(m)
+    return leaves
+
+
+def _our_slots(model: Layer, prefix: Tuple[str, ...] = ()):
+    """(path, layer) for parameterized layers, Sequential order."""
+    if isinstance(model, Sequential):
+        for i, sub in enumerate(model.layers):
+            key = sub.name or f"layer{i}"
+            yield from _our_slots(sub, prefix + (key,))
+    elif isinstance(model, nn.Residual):
+        yield from _our_slots(model.main, prefix + ("main",))
+        if model.shortcut is not None:
+            yield from _our_slots(model.shortcut, prefix + ("shortcut",))
+    elif isinstance(model, (nn.Dense, nn.Conv2D, nn.BatchNorm,
+                            nn.Embedding)):
+        yield prefix, model
+
+
+def _set_path(tree: Dict, path: Tuple[str, ...], value) -> None:
+    node = tree
+    for k in path[:-1]:
+        node = node.setdefault(k, {})
+    node[path[-1]] = value
+
+
+def import_into(model: Layer, params, state, torch_module):
+    """Copy a torch module's weights into (params, state) for `model`.
+
+    Pairing is positional over parameterized leaves; layer types must
+    line up (Dense<-Linear, Conv2D<-Conv2d, BatchNorm<-BatchNorm*,
+    Embedding<-Embedding) — the natural correspondence when both sides
+    express the same architecture. Shapes are validated against the
+    existing params. Returns NEW (params, state) pytrees.
+    """
+    import copy
+
+    import torch.nn as tnn
+
+    new_params = copy.deepcopy(jnp_to_mutable(params))
+    new_state = copy.deepcopy(jnp_to_mutable(state))
+    slots = list(_our_slots(model))
+    leaves = _torch_leaves(torch_module)
+    enforce(len(slots) == len(leaves),
+            f"model has {len(slots)} parameterized layers but the torch "
+            f"module has {len(leaves)}")
+    for (path, layer), mod in zip(slots, leaves):
+        if isinstance(layer, nn.Dense):
+            enforce(isinstance(mod, tnn.Linear),
+                    f"{'/'.join(path)}: expected torch Linear, got "
+                    f"{type(mod).__name__}")
+            converted = convert_linear(mod)
+        elif isinstance(layer, nn.Conv2D):
+            enforce(isinstance(mod, tnn.Conv2d),
+                    f"{'/'.join(path)}: expected torch Conv2d, got "
+                    f"{type(mod).__name__}")
+            converted = convert_conv2d(mod)
+        elif isinstance(layer, nn.BatchNorm):
+            enforce(isinstance(mod, (tnn.BatchNorm1d, tnn.BatchNorm2d)),
+                    f"{'/'.join(path)}: expected torch BatchNorm, got "
+                    f"{type(mod).__name__}")
+            converted, bn_state = convert_batchnorm(mod)
+            _check_shapes(path, _get_path(new_state, path), bn_state)
+            for k, v in bn_state.items():
+                _set_path(new_state, path + (k,), v)
+        else:  # nn.Embedding
+            enforce(isinstance(mod, tnn.Embedding),
+                    f"{'/'.join(path)}: expected torch Embedding, got "
+                    f"{type(mod).__name__}")
+            converted = convert_embedding(mod)
+        _check_shapes(path, _get_path(new_params, path), converted)
+        for k, v in converted.items():
+            _set_path(new_params, path + (k,), v)
+    return new_params, new_state
+
+
+def _get_path(tree, path):
+    node = tree
+    for k in path:
+        node = node.get(k, {}) if isinstance(node, dict) else {}
+    return node
+
+
+def _check_shapes(path, existing: Dict, incoming: Dict) -> None:
+    for k, v in incoming.items():
+        if isinstance(existing, dict) and k in existing:
+            enforce(tuple(existing[k].shape) == tuple(v.shape),
+                    f"{'/'.join(path)}/{k}: shape "
+                    f"{tuple(existing[k].shape)} != torch "
+                    f"{tuple(v.shape)}")
+
+
+def jnp_to_mutable(tree):
+    """Deep-copyable plain-dict view of a params pytree."""
+    if isinstance(tree, dict):
+        return {k: jnp_to_mutable(v) for k, v in tree.items()}
+    return tree
