@@ -101,6 +101,12 @@ def emit(metric: str, value, unit: str, vs_baseline) -> None:
         "vs_baseline": vs_baseline}), flush=True)
 
 
+def init_devices_or_die(timeout_s: int = 900):
+    from paddle_tpu.core.devices import init_devices_or_die as impl
+
+    return impl(timeout_s, log)
+
+
 def bench_resnet() -> None:
     from paddle_tpu import models, optim
     from paddle_tpu.core import dtypes
@@ -112,7 +118,7 @@ def bench_resnet() -> None:
     dtypes.set_default_policy(dtypes.bf16_compute_policy())
 
     # the TPU tunnel reports platform "axon"; anything non-cpu is the chip
-    on_tpu = jax.devices()[0].platform != "cpu"
+    on_tpu = init_devices_or_die()[0].platform != "cpu"
     batch = 256 if on_tpu else 16
     hw = 224 if on_tpu else 32
     model = models.resnet.resnet(50, num_classes=1000)

@@ -319,6 +319,12 @@ def bench_trainer_loop(name: str, batch: int, *, hw: int = 224,
     return dt
 
 
+def _init_devices_or_die(timeout_s: int = 600):
+    from paddle_tpu.core.devices import init_devices_or_die as impl
+
+    return impl(timeout_s, progress)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -330,7 +336,7 @@ def main():
     from paddle_tpu.core import dtypes
 
     dtypes.set_default_policy(dtypes.bf16_compute_policy())
-    on_tpu = jax.devices()[0].platform != "cpu"
+    on_tpu = _init_devices_or_die()[0].platform != "cpu"
     quick = args.quick or not on_tpu
     hw = 128 if quick else 224  # stride stacks collapse below ~96px
     iters = 2 if quick else 20

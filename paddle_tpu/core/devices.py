@@ -1,0 +1,40 @@
+"""Device runtime helpers."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional
+
+
+def init_devices_or_die(timeout_s: int = 600,
+                        log: Optional[Callable[[str], None]] = None):
+    """jax.devices() with a watchdog.
+
+    On a wedged single-claim TPU relay the first backend touch hangs
+    indefinitely; benchmarks and drivers need a terminated process with
+    a diagnostic instead of a silent stall. Exits the process with code
+    3 on timeout or backend-init failure.
+    """
+    import jax
+
+    log = log or (lambda m: print(m, flush=True))
+    done = threading.Event()
+    result = {}
+
+    def probe():
+        try:
+            result["devices"] = jax.devices()
+        except BaseException as e:  # backend init error — also fatal
+            result["error"] = e
+        done.set()
+
+    threading.Thread(target=probe, daemon=True).start()
+    if not done.wait(timeout_s):
+        log(f"TPU backend did not initialize within {timeout_s}s — "
+            "the chip claim is wedged; aborting")
+        os._exit(3)
+    if "error" in result:
+        log(f"TPU backend init failed: {result['error']}")
+        os._exit(3)
+    return result["devices"]

@@ -16,6 +16,7 @@ model zoo. PIL is used only for decode/resize when available.
 from __future__ import annotations
 
 import io
+import threading
 from typing import Callable, Optional, Sequence, Tuple
 
 import numpy as np
@@ -84,8 +85,9 @@ def random_flip(img: np.ndarray, rng: np.random.RandomState) -> np.ndarray:
 def normalize(img: np.ndarray, mean=None, std=None) -> np.ndarray:
     """uint8 HWC -> float32 in [0,1], then per-channel (x-mean)/std
     (reference: ImageTransformer.set_mean + scale)."""
+    was_int = np.issubdtype(np.asarray(img).dtype, np.integer)
     out = np.asarray(img, np.float32)
-    if out.max() > 1.5:  # uint8-range input
+    if was_int:  # integer pixels are 0..255 by convention
         out = out / 255.0
     if mean is not None:
         out = out - np.asarray(mean, np.float32)
@@ -112,8 +114,10 @@ class Transformer:
     """Composable preprocess pipeline (reference:
     image_util.ImageTransformer + preprocess_img): short-side resize →
     crop (random at train / center at eval) → random flip (train) →
-    normalize. Deterministic per seed; safe under xmap_readers
-    multiprocess fan-out (each call owns its RandomState)."""
+    normalize. Deterministic per seed when driven single-threaded;
+    under xmap_readers' thread fan-out the draws are LOCK-protected
+    (RandomState is not thread-safe) — state stays valid, but the
+    assignment of draws to samples then depends on thread timing."""
 
     def __init__(self, *, resize: Optional[int] = 256, crop: int = 224,
                  is_train: bool = True, mean=None, std=None,
@@ -124,13 +128,15 @@ class Transformer:
         self.mean = mean
         self.std = std
         self.rng = np.random.RandomState(seed)
+        self._lock = threading.Lock()
 
     def __call__(self, img: np.ndarray) -> np.ndarray:
         if self.resize:
             img = resize_short(img, self.resize)
         if self.is_train:
-            img = random_crop(img, self.crop, self.rng)
-            img = random_flip(img, self.rng)
+            with self._lock:
+                img = random_crop(img, self.crop, self.rng)
+                img = random_flip(img, self.rng)
         else:
             img = center_crop(img, self.crop)
         return normalize(img, self.mean, self.std)

@@ -159,3 +159,30 @@ def cos_sim(a, b, scale: float = 1.0, epsilon: float = 1e-8):
     na = jnp.sqrt(jnp.sum(jnp.square(a32), axis=-1))
     nb = jnp.sqrt(jnp.sum(jnp.square(b32), axis=-1))
     return scale * dot / jnp.maximum(na * nb, epsilon)
+
+
+def modified_huber_loss(logits, labels):
+    """Modified Huber for binary classification with {0,1} labels
+    (reference: operators/modified_huber_loss_op.cc): with y in {-1,+1}
+    and z = y*f, loss = max(0, 1-z)^2 for z >= -1, else -4z."""
+    y = 2.0 * labels.astype(jnp.float32) - 1.0
+    z = y * at_least_f32(logits)
+    return jnp.where(z >= -1.0, jnp.square(jnp.maximum(1.0 - z, 0.0)),
+                     -4.0 * z)
+
+
+def squared_l2_distance(x, y):
+    """Row-wise squared L2 distance (reference:
+    operators/squared_l2_distance_op.cc): sum((x - y)^2) per row."""
+    d = at_least_f32(x - y)
+    return jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim)))
+
+
+def l1_norm(x):
+    """sum |x| (reference: operators/l1_norm_op.cc)."""
+    return jnp.sum(jnp.abs(at_least_f32(x)))
+
+
+def squared_l2_norm(x):
+    """sum x^2 (reference: operators/squared_l2_norm_op.cc)."""
+    return jnp.sum(jnp.square(at_least_f32(x)))

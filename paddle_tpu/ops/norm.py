@@ -74,14 +74,19 @@ def lrn(x, *, size: int = 5, alpha: float = 1e-4, beta: float = 0.75, k: float =
 
     Reference: function/CrossMapNormalOp.cpp (CrossMapNormal),
     paddle/operators/lrn_op.cc. y = x / (k + alpha * sum_window x^2)^beta.
+
+    The channel-window sum is ONE reduce_window pass over the channel
+    axis (a stack of `size` shifted slices would read the tensor `size`
+    times — LRN is purely bandwidth-bound, so that multiplier was the
+    whole cost of AlexNet/GoogLeNet's LRN layers).
     """
-    sq = jnp.square(x)
     half = size // 2
-    # sum over a window of `size` channels centred at each channel
-    padded = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(half, size - 1 - half)])
-    window = jnp.stack(
-        [padded[..., i : i + x.shape[-1]] for i in range(size)], axis=0
-    ).sum(axis=0)
+    window = jax.lax.reduce_window(
+        jnp.square(x), 0.0, jax.lax.add,
+        window_dimensions=(1,) * (x.ndim - 1) + (size,),
+        window_strides=(1,) * x.ndim,
+        padding=[(0, 0)] * (x.ndim - 1) + [(half, size - 1 - half)],
+    )
     return x * jnp.power(k + alpha * window, -beta)
 
 

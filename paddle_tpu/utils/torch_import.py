@@ -154,11 +154,14 @@ def _get_path(tree, path):
 
 def _check_shapes(path, existing: Dict, incoming: Dict) -> None:
     for k, v in incoming.items():
-        if isinstance(existing, dict) and k in existing:
-            enforce(tuple(existing[k].shape) == tuple(v.shape),
-                    f"{'/'.join(path)}/{k}: shape "
-                    f"{tuple(existing[k].shape)} != torch "
-                    f"{tuple(v.shape)}")
+        enforce(isinstance(existing, dict) and k in existing,
+                f"{'/'.join(path)}: torch module provides '{k}' but the "
+                f"layer's init params don't have it (e.g. a use_bias "
+                f"mismatch) — structures must agree")
+        enforce(tuple(existing[k].shape) == tuple(v.shape),
+                f"{'/'.join(path)}/{k}: shape "
+                f"{tuple(existing[k].shape)} != torch "
+                f"{tuple(v.shape)}")
 
 
 def jnp_to_mutable(tree):

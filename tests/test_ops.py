@@ -184,3 +184,46 @@ class TestMetrics:
         logits = jnp.asarray([[0.5, 0.3, 0.2], [0.1, 0.2, 0.7]])
         labels = jnp.asarray([1, 0])
         np.testing.assert_allclose(M.top_k_accuracy(logits, labels, k=2), 0.5)
+
+
+class TestRound3LossGaps:
+    """modified_huber / squared_l2 family (reference:
+    operators/modified_huber_loss_op.cc, squared_l2_distance_op.cc,
+    l1_norm_op.cc, squared_l2_norm_op.cc)."""
+
+    def test_modified_huber_regions(self):
+        from paddle_tpu.ops import losses
+
+        logits = jnp.asarray([2.0, 0.5, -0.5, -2.0])
+        labels = jnp.asarray([1, 1, 1, 1])
+        out = np.asarray(losses.modified_huber_loss(logits, labels))
+        # z = [2, .5, -.5, -2]: quadratic branch for z>=-1, linear else
+        np.testing.assert_allclose(out, [0.0, 0.25, 2.25, 8.0], rtol=1e-6)
+        # label 0 mirrors
+        out0 = np.asarray(losses.modified_huber_loss(-logits,
+                                                     jnp.zeros(4, jnp.int32)))
+        np.testing.assert_allclose(out0, out, rtol=1e-6)
+
+    def test_modified_huber_grad(self, np_rng):
+        from gradcheck import directional_grad_check
+        from paddle_tpu.ops import losses
+
+        x = jnp.asarray(np_rng.randn(6), jnp.float32)
+        labels = jnp.asarray(np_rng.randint(0, 2, 6))
+        directional_grad_check(
+            lambda p: jnp.sum(losses.modified_huber_loss(p, labels)), x)
+
+    def test_squared_l2_family(self, np_rng):
+        from paddle_tpu.ops import losses
+
+        x = jnp.asarray(np_rng.randn(3, 4), jnp.float32)
+        y = jnp.asarray(np_rng.randn(3, 4), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(losses.squared_l2_distance(x, y)),
+            ((np.asarray(x) - np.asarray(y)) ** 2).sum(1), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(losses.l1_norm(x)), np.abs(np.asarray(x)).sum(),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            float(losses.squared_l2_norm(x)),
+            (np.asarray(x) ** 2).sum(), rtol=1e-5)
