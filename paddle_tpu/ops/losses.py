@@ -71,8 +71,9 @@ def multi_binary_label_cross_entropy(logits, labels):
 
 
 def squared_error(pred, target):
-    """Sum-of-squares cost (reference: gserver SumOfSquaresCostLayer,
-    operators/squared_l2_distance_op.cc). Per-example 0.5*||d||^2."""
+    """Sum-of-squares cost (reference: gserver SumOfSquaresCostLayer).
+    Per-example 0.5*||d||^2 (squared_l2_distance below is the Fluid-op
+    variant without the 1/2)."""
     d = at_least_f32((pred - target))
     return 0.5 * jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim)))
 
@@ -161,21 +162,17 @@ def cos_sim(a, b, scale: float = 1.0, epsilon: float = 1e-8):
     return scale * dot / jnp.maximum(na * nb, epsilon)
 
 
-def modified_huber_loss(logits, labels):
-    """Modified Huber for binary classification with {0,1} labels
-    (reference: operators/modified_huber_loss_op.cc): with y in {-1,+1}
-    and z = y*f, loss = max(0, 1-z)^2 for z >= -1, else -4z."""
-    y = 2.0 * labels.astype(jnp.float32) - 1.0
-    z = y * at_least_f32(logits)
-    return jnp.where(z >= -1.0, jnp.square(jnp.maximum(1.0 - z, 0.0)),
-                     -4.0 * z)
+# Fluid's op name for the same formula huber_classification implements
+# (reference: operators/modified_huber_loss_op.cc == gserver
+# HuberTwoClassification) — one implementation, two API names.
+modified_huber_loss = huber_classification
 
 
 def squared_l2_distance(x, y):
-    """Row-wise squared L2 distance (reference:
-    operators/squared_l2_distance_op.cc): sum((x - y)^2) per row."""
-    d = at_least_f32(x - y)
-    return jnp.sum(jnp.square(d), axis=tuple(range(1, d.ndim)))
+    """Row-wise squared L2 distance WITHOUT the 1/2 factor (reference:
+    operators/squared_l2_distance_op.cc; squared_error above is the
+    gserver SumOfSquaresCostLayer variant carrying the 1/2)."""
+    return 2.0 * squared_error(x, y)
 
 
 def l1_norm(x):

@@ -31,3 +31,10 @@ from paddle_tpu.parallel.sparse import (
     unique_rows_grad,
 )
 from paddle_tpu.parallel import distributed
+from paddle_tpu.parallel import pipeline
+from paddle_tpu.parallel.pipeline import (
+    make_pipeline_forward,
+    make_pipeline_train_step,
+    shard_stage_params,
+    stack_stage_params,
+)

@@ -1,0 +1,134 @@
+"""Pipeline parallelism over a `pipe` mesh axis (GPipe-style).
+
+The reference has NO pipeline engine (SURVEY §2.8: interleaved pipeline
+absent — its model parallelism is device-pinned layers,
+ParallelNeuralNetwork.cpp); this is the TPU-native extra that completes
+the mesh-axis family {data, model, seq, PIPE}: S homogeneous stages'
+parameters live stacked on a leading axis sharded over `pipe` (each
+device holds ONE stage), microbatches stream through a lax.scan over
+ticks with lax.ppermute handing activations to the next stage — the
+compiler-friendly pipelining idiom (static shapes, no host control
+flow). Backward is jax autodiff through the scan+ppermute program
+(ppermute's transpose is the reverse permute), giving a GPipe-schedule
+training step without hand-written reverse plumbing.
+
+Constraints (standard for stacked-stage pipelining): all stages share
+one structure/shape (e.g. N identical residual/transformer blocks), and
+the activation shape is constant across stages.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+PIPE_AXIS = "pipe"
+
+
+def stack_stage_params(per_stage_params) -> dict:
+    """Stack a list of S identical-structure param pytrees into one
+    pytree with leading dim S (shard it P('pipe') via
+    shard_stage_params)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def shard_stage_params(stacked, mesh: Mesh, axis: str = PIPE_AXIS):
+    """Place the stacked stage params so each pipe device holds its own
+    stage's slice."""
+    return jax.tree.map(
+        lambda x: jax.device_put(
+            x, NamedSharding(mesh, P(axis, *([None] * (x.ndim - 1))))),
+        stacked)
+
+
+def make_pipeline_forward(stage_fn: Callable, mesh: Mesh, *,
+                          axis: str = PIPE_AXIS):
+    """Build fn(stacked_params, micro_x) -> outputs.
+
+    stage_fn(stage_params, x) -> y with y.shape == x.shape (homogeneous
+    activation). stacked_params: pytree with leading dim S = |pipe|.
+    micro_x: [M, Bm, ...] microbatches. Returns [M, Bm, ...] outputs
+    (replicated over the pipe axis).
+
+    Schedule: M + S - 1 ticks; at tick t stage 0 ingests microbatch t
+    (while t < M), stage s computes on what stage s-1 produced at t-1
+    (ppermute ring shift), and the last stage's outputs from ticks
+    S-1 .. S-2+M are the results, in microbatch order.
+    """
+    n_stage = mesh.shape[axis]
+
+    def body(stacked_local, micro_x):
+        # stacked_local: leading dim 1 (this device's stage)
+        lead = jax.tree.leaves(stacked_local)[0].shape[0]
+        if lead != 1:
+            raise ValueError(
+                f"stacked stage params have {lead * n_stage} stages but "
+                f"the '{axis}' mesh axis has {n_stage} devices — one "
+                "stage per device required")
+        local_params = jax.tree.map(lambda x: x[0], stacked_local)
+        me = lax.axis_index(axis)
+        m = micro_x.shape[0]
+        ticks = m + n_stage - 1
+        # pvary: the carry is device-VARYING over the pipe axis (each
+        # stage holds a different activation), so the initial zeros must
+        # carry that type too or scan rejects the carry
+        act0 = lax.pcast(jnp.zeros_like(micro_x[0]), axis,
+                         to='varying')
+        perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+        def tick(act, t):
+            # activation produced LAST tick moves one stage to the right
+            inbound = lax.ppermute(act, axis, perm)
+            feed = micro_x[jnp.minimum(t, m - 1)]
+            x_in = jnp.where(me == 0, feed, inbound)
+            out = stage_fn(local_params, x_in)
+            return out, out
+
+        _, outs = lax.scan(tick, act0, jnp.arange(ticks))  # [T, Bm, ...]
+        # the last stage's outputs, ticks S-1 .. S-2+M, are the results;
+        # zero elsewhere + psum replicates them to every pipe device
+        results = lax.dynamic_slice_in_dim(outs, n_stage - 1, m, axis=0)
+        results = jnp.where(me == n_stage - 1, results,
+                            jnp.zeros_like(results))
+        return lax.psum(results, axis_name=axis)
+
+    def fwd(stacked_params, micro_x):
+        fn = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(jax.tree.map(lambda _: P(axis), stacked_params),
+                      P()),
+            out_specs=P(),
+        )
+        return fn(stacked_params, micro_x)
+
+    return fwd
+
+
+def make_pipeline_train_step(stage_fn: Callable, loss_fn: Callable,
+                             optimizer, mesh: Mesh, *,
+                             axis: str = PIPE_AXIS):
+    """Jitted pipeline-parallel training step.
+
+    loss_fn(outputs [M, Bm, ...], labels [M, Bm, ...]) -> scalar.
+    Returns step(stacked_params, opt_state, micro_x, micro_y, step_i)
+    -> (new_params, new_opt_state, loss). Gradients flow through the
+    scan+ppermute pipeline by autodiff; the optimizer update runs
+    sharded (each pipe device updates its own stage's slice).
+    """
+    forward = make_pipeline_forward(stage_fn, mesh, axis=axis)
+
+    @jax.jit
+    def step(stacked_params, opt_state, micro_x, micro_y, step_i):
+        def objective(p):
+            return loss_fn(forward(p, micro_x), micro_y)
+
+        loss, grads = jax.value_and_grad(objective)(stacked_params)
+        new_params, new_opt = optimizer.update(grads, opt_state,
+                                               stacked_params, step_i)
+        return new_params, new_opt, loss
+
+    return step
