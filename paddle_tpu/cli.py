@@ -152,19 +152,28 @@ def cmd_train(args) -> int:
     return 0
 
 
-def cmd_merge_model(args) -> int:
+def _init_model_from_config(args):
+    """Load config, init params (seed 0), optionally overlay a params
+    tar — shared by merge-model and export-native."""
     import jax
-    import numpy as np
 
-    from paddle_tpu.serve import export_compiled_model
     from paddle_tpu.train.checkpoint import load_parameters_tar
 
     cfg = _load_config(args.config)
     model = cfg["model"]
     spec = _input_spec(cfg)
     params, mstate = model.init(jax.random.key(0), spec)
-    if args.params:
+    if getattr(args, "params", None):
         params = load_parameters_tar(params, args.params)
+    return cfg, model, spec, params, mstate
+
+
+def cmd_merge_model(args) -> int:
+    import numpy as np
+
+    from paddle_tpu.serve import export_compiled_model
+
+    cfg, model, spec, params, mstate = _init_model_from_config(args)
 
     def forward(x):
         out, _ = model.apply(params, mstate, x, training=False)
@@ -174,6 +183,17 @@ def cmd_merge_model(args) -> int:
     export_compiled_model(forward, [x], args.output,
                           name=cfg.get("name", "model"))
     print(f"wrote compiled artifact {args.output}")
+    return 0
+
+
+def cmd_export_native(args) -> int:
+    """Export a model to the .ptni artifact served by the Python-free
+    native engine (native/src/infer.cc)."""
+    from paddle_tpu.serve.native_export import export_native
+
+    cfg, model, spec, params, mstate = _init_model_from_config(args)
+    export_native(model, params, mstate, spec, args.output)
+    print(f"wrote native artifact {args.output}")
     return 0
 
 
@@ -303,6 +323,14 @@ def build_parser() -> argparse.ArgumentParser:
     d = sub.add_parser("dump-config")
     d.add_argument("--config", required=True)
     d.set_defaults(fn=cmd_dump_config)
+
+    en = sub.add_parser(
+        "export-native",
+        help=".ptni artifact for the Python-free CPU serving engine")
+    en.add_argument("--config", required=True)
+    en.add_argument("--params", default=None)
+    en.add_argument("--output", required=True)
+    en.set_defaults(fn=cmd_export_native)
 
     m = sub.add_parser("merge-model")
     m.add_argument("--config", required=True)

@@ -41,3 +41,37 @@ def multiplex(index, *inputs):
     idx = index.reshape(-1).astype(jnp.int32)
     batch = jnp.arange(stacked.shape[1])
     return stacked[idx, batch]
+
+
+def bilinear_tensor_product(x, y, weight, bias=None):
+    """out[b, k] = x[b] @ W[k] @ y[b] (+ bias[k]) (reference:
+    operators/bilinear_tensor_product_op.cc).
+
+    x: [B, M]; y: [B, N]; weight: [K, M, N]; returns [B, K]. One einsum
+    — XLA maps it onto a single batched matmul chain for the MXU.
+    """
+    out = jnp.einsum("bm,kmn,bn->bk", x, weight, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def conv_shift(x, y):
+    """Circular (cyclic) correlation of each row pair (reference:
+    operators/conv_shift_op.cc — the NTM attention-shift op).
+
+    x: [B, M]; y: [B, N] with N odd and N <= M; out[b, i] =
+    sum_j y[b, j] * x[b, (i + j - N//2) mod M]. Returns [B, M].
+    Expressed as a gather + einsum (static index table, no host loop).
+    """
+    from paddle_tpu.core.errors import enforce
+
+    b, m = x.shape
+    n = y.shape[1]
+    enforce(n % 2 == 1, f"conv_shift kernel width must be odd, got {n}")
+    enforce(n <= m, f"conv_shift kernel width {n} exceeds row width {m}")
+    half = n // 2
+    # idx[i, j] = (i + j - half) mod m — static [M, N] table
+    idx = (jnp.arange(m)[:, None] + jnp.arange(n)[None, :] - half) % m
+    gathered = x[:, idx]                      # [B, M, N]
+    return jnp.einsum("bmn,bn->bm", gathered, y)

@@ -152,3 +152,51 @@ def test_make_diagram(config_file, tmp_path, capsys):
                "--output", dot_file])
     assert rc == 0
     assert open(dot_file).read().startswith("digraph")
+
+
+def test_export_native_and_serve(config_file, tmp_path, capsys):
+    """export-native writes a .ptni the Python-free engine loads and
+    whose output matches the jax forward."""
+    import ctypes
+
+    import jax
+    import jax.numpy as jnp
+
+    out = str(tmp_path / "toy.ptni")
+    assert main(["export-native", "--config", config_file,
+                 "--output", out]) == 0
+    assert os.path.exists(out)
+
+    from paddle_tpu.native import build
+
+    lib = ctypes.CDLL(build.ensure_infer_built())
+    lib.ptn_load.restype = ctypes.c_void_p
+    lib.ptn_load.argtypes = [ctypes.c_char_p]
+    lib.ptn_output_dim.restype = ctypes.c_longlong
+    lib.ptn_output_dim.argtypes = [ctypes.c_void_p]
+    lib.ptn_forward.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.c_longlong, ctypes.POINTER(ctypes.c_float)]
+    m = lib.ptn_load(out.encode())
+    assert m
+    x = np.random.RandomState(0).rand(4, 16).astype(np.float32)
+    got = np.zeros((4, 2), np.float32)
+    assert lib.ptn_forward(
+        m, x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), 4,
+        got.ctypes.data_as(ctypes.POINTER(ctypes.c_float))) == 0
+    lib.ptn_free(ctypes.c_void_p(m))
+
+    # same weights (seed 0 init, no --params) through the jax forward
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("cfg", config_file)
+    cfg_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cfg_mod)
+    cfg = cfg_mod.get_config()
+    from paddle_tpu.nn.module import ShapeSpec
+
+    model = cfg["model"]
+    params, mstate = model.init(jax.random.key(0), ShapeSpec((4, 16)))
+    want, _ = model.apply(params, mstate, jnp.asarray(x), training=False)
+    np.testing.assert_allclose(got, np.asarray(want), rtol=2e-4,
+                               atol=2e-4)

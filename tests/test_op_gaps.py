@@ -232,3 +232,49 @@ class TestCostWrappers:
             return jnp.sum(out ** 2)
 
         directional_grad_check(f, params)
+
+
+class TestBilinearAndConvShift:
+    """reference: operators/bilinear_tensor_product_op.cc,
+    operators/conv_shift_op.cc."""
+
+    def test_bilinear_tensor_product_manual(self, np_rng):
+        from paddle_tpu.ops import linalg
+
+        x = jnp.asarray(np_rng.randn(3, 4), jnp.float32)
+        y = jnp.asarray(np_rng.randn(3, 5), jnp.float32)
+        w = jnp.asarray(np_rng.randn(2, 4, 5), jnp.float32)
+        b = jnp.asarray(np_rng.randn(2), jnp.float32)
+        out = linalg.bilinear_tensor_product(x, y, w, b)
+        assert out.shape == (3, 2)
+        want = np.stack([
+            [np.asarray(x[i]) @ np.asarray(w[k]) @ np.asarray(y[i])
+             + float(b[k]) for k in range(2)]
+            for i in range(3)])
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5)
+
+    def test_bilinear_grad(self, np_rng):
+        from gradcheck import directional_grad_check
+        from paddle_tpu.ops import linalg
+
+        x = jnp.asarray(np_rng.randn(2, 3), jnp.float32)
+        y = jnp.asarray(np_rng.randn(2, 4), jnp.float32)
+        params = {"w": jnp.asarray(np_rng.randn(2, 3, 4), jnp.float32)}
+        directional_grad_check(
+            lambda p: jnp.sum(
+                linalg.bilinear_tensor_product(x, y, p["w"]) ** 2), params)
+
+    def test_conv_shift_matches_naive(self, np_rng):
+        from paddle_tpu.ops import linalg
+
+        b, m, n = 2, 7, 3
+        x = jnp.asarray(np_rng.randn(b, m), jnp.float32)
+        y = jnp.asarray(np_rng.randn(b, n), jnp.float32)
+        out = np.asarray(linalg.conv_shift(x, y))
+        want = np.zeros((b, m), np.float32)
+        for bi in range(b):
+            for i in range(m):
+                for j in range(n):
+                    want[bi, i] += float(y[bi, j]) * float(
+                        x[bi, (i + j - n // 2) % m])
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
