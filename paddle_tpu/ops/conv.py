@@ -404,3 +404,67 @@ def rotate90(x, *, reverse: bool = False):
     if reverse:
         return jnp.flip(jnp.swapaxes(x, 1, 2), axis=2)
     return jnp.flip(jnp.swapaxes(x, 1, 2), axis=1)
+
+
+def max_pool2d_with_index(x, window: IntOr2 = 2, *,
+                          stride: Optional[IntOr2] = None,
+                          padding="VALID"):
+    """Max pooling that also returns each maximum's FLAT spatial index
+    (h*W + w per channel) — the unpooling mask (reference:
+    operators/pool_with_index_op.cc, gserver MaxPoolWithMaskLayer).
+
+    x: [N,H,W,C]. Returns (pooled [N,OH,OW,C], idx int32 [N,OH,OW,C]).
+    Built on im2col (one XLA patches op) + a validity mask so padded
+    cells can never win the argmax — matching max_pool2d's -inf padding.
+    """
+    n, h, w, c = x.shape
+    wh, ww = _pair(window)
+    sh, sw = _pair(stride if stride is not None else window)
+    patches = im2col(x, (wh, ww), stride=(sh, sw), padding=padding)
+    oh, ow = patches.shape[1], patches.shape[2]
+    # im2col flattens channel-major: [..., C * wh * ww]
+    vals = patches.reshape(n, oh, ow, c, wh * ww)
+    valid = im2col(jnp.ones_like(x), (wh, ww), stride=(sh, sw),
+                   padding=padding).reshape(n, oh, ow, c, wh * ww) > 0
+    masked = jnp.where(valid, vals, -jnp.inf)
+    pooled = jnp.max(masked, axis=-1)
+    best = jnp.argmax(masked, axis=-1)                # window-local flat
+    if padding == "SAME":
+        th = max((oh - 1) * sh + wh - h, 0)
+        tw = max((ow - 1) * sw + ww - w, 0)
+        ph0, pw0 = th // 2, tw // 2
+    elif padding == "VALID":
+        ph0 = pw0 = 0
+    else:
+        ph0, pw0 = _pair(padding)
+    r = best // ww
+    s = best % ww
+    oh_idx = jnp.arange(oh)[None, :, None, None]
+    ow_idx = jnp.arange(ow)[None, None, :, None]
+    abs_h = oh_idx * sh - ph0 + r        # in-bounds: argmax is unpadded
+    abs_w = ow_idx * sw - pw0 + s
+    flat = (abs_h * w + abs_w).astype(jnp.int32)
+    return pooled, flat
+
+
+def max_unpool2d(pooled, idx, out_hw: Tuple[int, int]):
+    """Scatter pooled values back to their argmax positions (reference:
+    the unpool consumer of pool_with_index; zeros elsewhere).
+
+    pooled/idx: [N,OH,OW,C] from max_pool2d_with_index; out_hw: (H, W).
+    Returns [N, H, W, C]. Overlapping windows that selected the SAME
+    cell carry the same max — .at[].set writes it once (an .add would
+    multiply it by the number of selecting windows).
+    """
+    n, oh, ow, c = pooled.shape
+    h, w = out_hw
+    flat_vals = pooled.reshape(n, oh * ow, c)
+    flat_idx = idx.reshape(n, oh * ow, c)
+
+    def scatter_one(vals, ids):                     # [K], [K] -> [H*W]
+        return jnp.zeros((h * w,), vals.dtype).at[ids].set(vals)
+
+    out = jax.vmap(                                  # over batch
+        jax.vmap(scatter_one, in_axes=(1, 1), out_axes=1)  # over channel
+    )(flat_vals, flat_idx)                           # [N, H*W, C]
+    return out.reshape(n, h, w, c)

@@ -278,3 +278,73 @@ class TestBilinearAndConvShift:
                     want[bi, i] += float(y[bi, j]) * float(
                         x[bi, (i + j - n // 2) % m])
         np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+class TestPoolWithIndex:
+    """reference: operators/pool_with_index_op.cc + gserver
+    MaxPoolWithMaskLayer; unpool round-trip."""
+
+    def test_matches_max_pool_and_indices_point_at_maxima(self, np_rng):
+        from paddle_tpu.ops import conv as C
+
+        x = jnp.asarray(np_rng.randn(2, 6, 8, 3), jnp.float32)
+        pooled, idx = C.max_pool2d_with_index(x, 2)
+        np.testing.assert_allclose(np.asarray(pooled),
+                                   np.asarray(C.max_pool2d(x, 2)),
+                                   rtol=1e-6)
+        # every index points at a cell holding the pooled value
+        xa = np.asarray(x)
+        pa, ia = np.asarray(pooled), np.asarray(idx)
+        n, oh, ow, c = pa.shape
+        for b in range(n):
+            for i in range(oh):
+                for j in range(ow):
+                    for ch in range(c):
+                        fh, fw = divmod(int(ia[b, i, j, ch]), 8)
+                        assert xa[b, fh, fw, ch] == pa[b, i, j, ch]
+
+    def test_unpool_roundtrip_sparse(self, np_rng):
+        from paddle_tpu.ops import conv as C
+
+        x = jnp.asarray(np_rng.randn(1, 4, 4, 2), jnp.float32)
+        pooled, idx = C.max_pool2d_with_index(x, 2)
+        up = C.max_unpool2d(pooled, idx, (4, 4))
+        assert up.shape == (1, 4, 4, 2)
+        # unpooled holds each max at its original position, zeros elsewhere
+        ua, xa = np.asarray(up), np.asarray(x)
+        nonzero = ua != 0
+        assert nonzero.sum() == 2 * 2 * 2  # one max per window per channel
+        np.testing.assert_allclose(ua[nonzero], xa[nonzero], rtol=1e-6)
+
+    def test_with_index_same_padding(self, np_rng):
+        from paddle_tpu.ops import conv as C
+
+        x = jnp.asarray(np_rng.randn(1, 5, 5, 1), jnp.float32)
+        pooled, idx = C.max_pool2d_with_index(x, 3, stride=2,
+                                              padding="SAME")
+        np.testing.assert_allclose(
+            np.asarray(pooled),
+            np.asarray(C.max_pool2d(x, 3, stride=2, padding="SAME")),
+            rtol=1e-6)
+
+    def test_all_negative_same_padding_no_zero_leak(self):
+        from paddle_tpu.ops import conv as C
+
+        x = jnp.full((1, 5, 5, 1), -1.0, jnp.float32)
+        pooled, idx = C.max_pool2d_with_index(x, 3, stride=2,
+                                              padding="SAME")
+        np.testing.assert_allclose(
+            np.asarray(pooled),
+            np.asarray(C.max_pool2d(x, 3, stride=2, padding="SAME")),
+            rtol=1e-6)
+        assert float(np.asarray(pooled).max()) == -1.0
+        # indices stay inside the real image
+        assert int(np.asarray(idx).max()) < 25
+
+    def test_unpool_overlapping_windows_write_once(self):
+        from paddle_tpu.ops import conv as C
+
+        x = jnp.zeros((1, 3, 3, 1), jnp.float32).at[0, 1, 1, 0].set(5.0)
+        pooled, idx = C.max_pool2d_with_index(x, 2, stride=1)
+        up = C.max_unpool2d(pooled, idx, (3, 3))
+        assert float(up[0, 1, 1, 0]) == 5.0  # once, not 4x
