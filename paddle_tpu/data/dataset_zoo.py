@@ -13,7 +13,7 @@ the reference call shapes (word_dict(), build_dict(), get_dict(), ...).
 from __future__ import annotations
 
 import os
-from typing import Dict, Iterator, List, Tuple
+from typing import Dict, Iterator, List
 
 import numpy as np
 

@@ -15,7 +15,6 @@ import threading
 from typing import Any, Callable, Iterator, Optional
 
 import jax
-import numpy as np
 
 from paddle_tpu.data.batch import stack_columns
 

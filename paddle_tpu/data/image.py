@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import io
 import threading
-from typing import Callable, Optional, Sequence, Tuple
+from typing import Optional
 
 import numpy as np
 

@@ -12,7 +12,7 @@ computed in f32 while conv math can run bf16 via the dtype policy.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 from paddle_tpu import nn
 

@@ -14,15 +14,12 @@ generation via ops.beam_search with the decoder step as step_fn.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
-
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.errors import enforce
 from paddle_tpu.nn import initializers
 from paddle_tpu.nn.recurrent_group import FnStep, Memory, RecurrentGroup
-from paddle_tpu.ops import beam_search as bs
 from paddle_tpu.ops import linalg
 from paddle_tpu.ops import rnn as rnn_ops
 

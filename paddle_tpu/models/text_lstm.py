@@ -8,8 +8,6 @@ padded [B, T] token batches + lengths.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
-
 import jax
 import jax.numpy as jnp
 

@@ -15,7 +15,7 @@ import re
 import socket
 import struct
 import threading
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 from paddle_tpu.native.build import ensure_built
 

@@ -7,7 +7,7 @@ config object with pure init/apply — see nn.module for the contract.
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Sequence, Tuple, Union
+from typing import Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -15,7 +15,7 @@ import jax.numpy as jnp
 from paddle_tpu.core.dtypes import Policy, default_policy
 from paddle_tpu.core.errors import enforce
 from paddle_tpu.nn import initializers
-from paddle_tpu.nn.module import Layer, ShapeSpec, Sequential
+from paddle_tpu.nn.module import Layer, ShapeSpec
 from paddle_tpu.ops import activations as A
 from paddle_tpu.ops import conv as conv_ops
 from paddle_tpu.ops import linalg

@@ -29,7 +29,6 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
-from paddle_tpu.core.dtypes import default_policy
 from paddle_tpu.core.errors import enforce
 from paddle_tpu.nn import initializers
 from paddle_tpu.nn.module import Layer, ShapeSpec

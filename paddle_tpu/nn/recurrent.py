@@ -12,7 +12,6 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 
 from paddle_tpu.nn.module import Layer, ShapeSpec
 from paddle_tpu.ops import rnn as rnn_ops

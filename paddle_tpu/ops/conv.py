@@ -222,7 +222,9 @@ def crop(x, offsets, shape):
 
 
 def im2col(x, window: IntOr2, *, stride: IntOr2 = 1, padding="VALID"):
-    """Extract patches: [N,H,W,C] -> [N,Ho,Wo,kh*kw*C].
+    """Extract patches: [N,H,W,C] -> [N,Ho,Wo,C*kh*kw] (CHANNEL-major:
+    reshape the last dim as (C, kh, kw) — the ordering
+    conv_general_dilated_patches produces).
 
     Reference: function/Im2ColOp.cpp / gserver BlockExpandLayer. On TPU you
     rarely want this (XLA handles conv directly); provided for block_expand
@@ -414,8 +416,10 @@ def max_pool2d_with_index(x, window: IntOr2 = 2, *,
     operators/pool_with_index_op.cc, gserver MaxPoolWithMaskLayer).
 
     x: [N,H,W,C]. Returns (pooled [N,OH,OW,C], idx int32 [N,OH,OW,C]).
-    Built on im2col (one XLA patches op) + a validity mask so padded
-    cells can never win the argmax — matching max_pool2d's -inf padding.
+    Built on im2col (one XLA patches op); out-of-image window cells are
+    masked by INDEX ARITHMETIC (0 <= i*s - pad + r < H) so padded cells
+    can never win the argmax — same semantics as max_pool2d's -inf/int-
+    min padding, preserving integer dtypes.
     """
     n, h, w, c = x.shape
     wh, ww = _pair(window)
@@ -424,11 +428,6 @@ def max_pool2d_with_index(x, window: IntOr2 = 2, *,
     oh, ow = patches.shape[1], patches.shape[2]
     # im2col flattens channel-major: [..., C * wh * ww]
     vals = patches.reshape(n, oh, ow, c, wh * ww)
-    valid = im2col(jnp.ones_like(x), (wh, ww), stride=(sh, sw),
-                   padding=padding).reshape(n, oh, ow, c, wh * ww) > 0
-    masked = jnp.where(valid, vals, -jnp.inf)
-    pooled = jnp.max(masked, axis=-1)
-    best = jnp.argmax(masked, axis=-1)                # window-local flat
     if padding == "SAME":
         th = max((oh - 1) * sh + wh - h, 0)
         tw = max((ow - 1) * sw + ww - w, 0)
@@ -437,13 +436,27 @@ def max_pool2d_with_index(x, window: IntOr2 = 2, *,
         ph0 = pw0 = 0
     else:
         ph0, pw0 = _pair(padding)
-    r = best // ww
-    s = best % ww
-    oh_idx = jnp.arange(oh)[None, :, None, None]
-    ow_idx = jnp.arange(ow)[None, None, :, None]
-    abs_h = oh_idx * sh - ph0 + r        # in-bounds: argmax is unpadded
-    abs_w = ow_idx * sw - pw0 + s
-    flat = (abs_h * w + abs_w).astype(jnp.int32)
+    # absolute source coordinates of every window cell: [OH/OW, wh*ww]
+    r = jnp.arange(wh * ww) // ww
+    s = jnp.arange(wh * ww) % ww
+    abs_h = jnp.arange(oh)[:, None] * sh - ph0 + r[None, :]   # [OH, K]
+    abs_w = jnp.arange(ow)[:, None] * sw - pw0 + s[None, :]   # [OW, K]
+    valid = ((abs_h >= 0) & (abs_h < h))[None, :, None, None, :] & \
+        ((abs_w >= 0) & (abs_w < w))[None, None, :, None, :]
+    fill = (jnp.array(-jnp.inf, x.dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else jnp.array(jnp.iinfo(x.dtype).min, x.dtype))
+    masked = jnp.where(valid, vals, fill)
+    pooled = jnp.max(masked, axis=-1)
+    best = jnp.argmax(masked, axis=-1)                # window-local flat
+    flat = (jnp.take_along_axis(
+        jnp.broadcast_to(abs_h[None, :, None, None, :],
+                         (n, oh, ow, c, wh * ww)),
+        best[..., None], axis=-1)[..., 0] * w +
+        jnp.take_along_axis(
+            jnp.broadcast_to(abs_w[None, None, :, None, :],
+                             (n, oh, ow, c, wh * ww)),
+            best[..., None], axis=-1)[..., 0]).astype(jnp.int32)
     return pooled, flat
 
 

@@ -16,7 +16,7 @@ Boxes are (x1, y1, x2, y2) normalized to [0, 1].
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

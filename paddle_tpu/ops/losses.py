@@ -11,8 +11,6 @@ values; reduce with weights via `reduce_loss`.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 

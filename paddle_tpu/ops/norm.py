@@ -10,8 +10,6 @@ Running statistics are explicit state (functional), not mutable members.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
-
 import jax
 import jax.numpy as jnp
 

@@ -19,13 +19,12 @@ sorted-by-length batch shrinking (SequenceToBatch) without the reorder.
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Optional, Tuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 from paddle_tpu.core.dtypes import default_policy
-from paddle_tpu.ops import activations as A
 from paddle_tpu.ops import linalg
 
 

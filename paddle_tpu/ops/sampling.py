@@ -14,7 +14,7 @@ batched gather + dot rather than a sparse matmul.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,6 @@ whole-array convenience wrapper that builds the shard_map itself.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from paddle_tpu.core.mesh import DATA_AXIS

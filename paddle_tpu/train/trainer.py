@@ -11,7 +11,6 @@ per-layer virtual dispatch + pipelined updater callbacks.
 
 from __future__ import annotations
 
-import time
 from typing import Any, Callable, Dict, Iterable, Optional
 
 import jax

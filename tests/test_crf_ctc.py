@@ -8,7 +8,6 @@ import itertools
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from paddle_tpu.ops import crf as C
 from paddle_tpu.ops import ctc as K

@@ -406,3 +406,46 @@ def test_ha_master_snapshot_rotation(tmp_path):
     m2 = HAMaster(snap_dir, interval_s=0, keep=2)
     assert m2.recovered_from.endswith(os.path.basename(paths[-1]))
     m2.stop(final_snapshot=False)
+
+
+def test_tcp_elastic_task_reassignment(tmp_path):
+    """The Go-runtime elasticity contract over the REAL TCP service
+    (reference: go/master/service.go:341 checkTimeoutFunc — a dead
+    trainer's leased task returns to todo and another trainer completes
+    the pass): worker A takes a task and dies (connection dropped, no
+    finish); after the lease expires, worker B receives the same task
+    and finishes the pass."""
+    import time as _time
+
+    from paddle_tpu.native.taskqueue import (MasterClient, MasterServer,
+                                             TaskQueue, TaskStatus)
+
+    q = TaskQueue(timeout_ms=300, max_retries=3)
+    payloads = {b"alpha", b"beta"}
+    for p in sorted(payloads):
+        q.add_task(p)
+    q.start()
+    with MasterServer(q) as srv:
+        a = MasterClient(port=srv.port)
+        st, tid_a, payload_a = a.get_task()
+        assert st == TaskStatus.OK
+        a.close()  # worker A dies holding the lease
+
+        # worker B alone must complete BOTH tasks — including A's, which
+        # can only come back via lease-timeout requeue (no timing
+        # assumptions on when exactly the lease expires)
+        b = MasterClient(port=srv.port)
+        finished = []
+        deadline = _time.time() + 10.0
+        while len(finished) < 2 and _time.time() < deadline:
+            st, tid, payload = b.get_task()
+            if st == TaskStatus.OK:
+                finished.append(payload)
+                b.finish_task(tid)
+            else:
+                _time.sleep(0.05)
+        assert sorted(finished) == sorted(payloads), finished
+        assert q.counts()["done"] == 2
+        # pass drains even though worker A never reported back
+        assert q.next_pass() == 1
+        b.close()

@@ -4,7 +4,6 @@ the testLayerGrad analogue (reference: gserver/tests/test_LayerGrad.cpp)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from paddle_tpu import nn
 from paddle_tpu.nn.module import ShapeSpec, merge_state

@@ -348,3 +348,14 @@ class TestPoolWithIndex:
         pooled, idx = C.max_pool2d_with_index(x, 2, stride=1)
         up = C.max_unpool2d(pooled, idx, (3, 3))
         assert float(up[0, 1, 1, 0]) == 5.0  # once, not 4x
+
+
+    def test_integer_dtype_preserved(self):
+        from paddle_tpu.ops import conv as C
+
+        x = jnp.asarray([[[[5], [-3]], [[-7], [2]]]], jnp.int32)
+        pooled, idx = C.max_pool2d_with_index(x, 2)
+        assert pooled.dtype == jnp.int32
+        assert int(pooled[0, 0, 0, 0]) == 5
+        np.testing.assert_array_equal(
+            np.asarray(pooled), np.asarray(C.max_pool2d(x, 2)))

@@ -17,7 +17,6 @@ from paddle_tpu.nn.module import ShapeSpec
 from paddle_tpu.nn.recurrent_group import (
     FnStep, Memory, RecurrentGroup, RecurrentGroupLayer, gru_group,
     lstm_group, scan_subsequences)
-from paddle_tpu.ops import beam_search as bs
 from paddle_tpu.ops import linalg
 from paddle_tpu.ops import rnn as rnn_ops
 

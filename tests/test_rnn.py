@@ -6,7 +6,6 @@ gserver/tests/test_CompareTwoNets.cpp)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from paddle_tpu.ops import rnn as R
 from gradcheck import directional_grad_check

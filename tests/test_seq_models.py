@@ -6,7 +6,6 @@ v1_api_demo/sequence_tagging convergence)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from paddle_tpu import optim
 from paddle_tpu.data import batch as B, datasets
