@@ -149,7 +149,11 @@ def max_pool2d(x, window: IntOr2 = 2, *, stride: Optional[IntOr2] = None, paddin
         (_pair(padding)[1],) * 2,
         (0, 0),
     )
-    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    # init must carry x's EXACT dtype: a bare python int promotes to
+    # int64 under x64 and reduce_window rejects the mismatch
+    init = (np.array(-np.inf, x.dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating)
+            else np.array(jnp.iinfo(x.dtype).min, x.dtype))
     return lax.reduce_window(
         x, init, lax.max, (1, wh, ww, 1), (1, sh, sw, 1), pad
     )
