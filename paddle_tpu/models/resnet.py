@@ -17,12 +17,13 @@ from typing import Optional
 from paddle_tpu import nn
 
 
-def conv_bn(features, kernel, stride, *, activation="relu", name):
+def conv_bn(features, kernel, stride, *, activation="relu", name,
+            space_to_depth=False):
     """conv + BN (+act) block (reference: benchmark/paddle/image/resnet.py
     conv_bn_layer)."""
     return [
         nn.Conv2D(features, kernel, stride=stride, padding="SAME", use_bias=False,
-                  name=f"{name}_conv"),
+                  name=f"{name}_conv", space_to_depth=space_to_depth),
         nn.BatchNorm(activation=activation, name=f"{name}_bn"),
     ]
 
@@ -67,13 +68,21 @@ _SPECS = {
 }
 
 
-def resnet(depth: int = 50, num_classes: int = 1000, *, width: int = 64) -> nn.Sequential:
-    """ImageNet-style ResNet (reference: benchmark/paddle/image/resnet.py)."""
+def resnet(depth: int = 50, num_classes: int = 1000, *, width: int = 64,
+           s2d_stem: bool = False) -> nn.Sequential:
+    """ImageNet-style ResNet (reference: benchmark/paddle/image/resnet.py).
+
+    s2d_stem=True computes the 7x7/s2 stem on a 2x2 space-to-depth
+    blocking of the input — same math, same parameters, but the conv
+    streams C_in=12 instead of 3, which the TPU tiles far better
+    (benchmarks/PROFILE_NOTES.md item 3).
+    """
     kind, reps = _SPECS[depth]
     block = basic_block if kind == "basic" else bottleneck_block
     expansion = 1 if kind == "basic" else 4
 
-    layers = conv_bn(width, 7, 2, name="stem") + [nn.MaxPool2D(3, stride=2, padding="SAME", name="stem_pool")]
+    layers = conv_bn(width, 7, 2, name="stem", space_to_depth=s2d_stem) + [
+        nn.MaxPool2D(3, stride=2, padding="SAME", name="stem_pool")]
     in_ch = width
     for stage, n in enumerate(reps):
         out_ch = width * (2 ** stage) * expansion

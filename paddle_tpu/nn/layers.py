@@ -82,6 +82,7 @@ class Conv2D(Layer):
         bias_init="zeros",
         name: Optional[str] = None,
         policy: Optional[Policy] = None,
+        space_to_depth: bool = False,
     ):
         self.features = features
         self.kernel_size = conv_ops._pair(kernel_size)
@@ -95,6 +96,13 @@ class Conv2D(Layer):
         self.bias_init = initializers.get(bias_init)
         self.name = name
         self.policy = policy
+        # compute via a space-to-depth-blocked equivalent conv (same
+        # params, same output; see ops.conv.conv2d_space_to_depth).
+        # Only meaningful when stride > 1; requires groups=1, no dilation.
+        self.space_to_depth = (
+            space_to_depth and groups == 1 and self.dilation == (1, 1)
+            and self.stride != (1, 1)
+        )
 
     def _out_hw(self, h, w):
         return conv_ops.out_hw(h, w, self.kernel_size, self.stride,
@@ -117,25 +125,41 @@ class Conv2D(Layer):
         return params, {}, out_spec
 
     def _apply(self, params, state, x, *, training: bool, rng):
-        y = conv_ops.conv2d(
-            x,
-            params["kernel"],
-            stride=self.stride,
-            padding=self.padding,
-            dilation=self.dilation,
-            groups=self.groups,
-            bias=params.get("bias"),
-            policy=self.policy or default_policy(),
-        )
+        if self.space_to_depth:
+            y = conv_ops.conv2d_space_to_depth(
+                x,
+                params["kernel"],
+                stride=self.stride,
+                padding=self.padding,
+                bias=params.get("bias"),
+                policy=self.policy or default_policy(),
+            )
+        else:
+            y = conv_ops.conv2d(
+                x,
+                params["kernel"],
+                stride=self.stride,
+                padding=self.padding,
+                dilation=self.dilation,
+                groups=self.groups,
+                bias=params.get("bias"),
+                policy=self.policy or default_policy(),
+            )
         return self.activation(y), {}
 
 
 class MaxPool2D(Layer):
-    def __init__(self, window=2, *, stride=None, padding="VALID", name=None):
+    def __init__(self, window=2, *, stride=None, padding="VALID", name=None,
+                 tie_split=True):
         self.window = conv_ops._pair(window)
         self.stride = conv_ops._pair(stride if stride is not None else window)
         self.padding = padding
         self.name = name
+        # tie_split routes grads through the select-and-scatter-free
+        # custom VJP (ops.conv._max_pool2d_ts). Set False if the layer
+        # must be forward-mode differentiable (jvp/jacfwd): custom_vjp
+        # functions reject jvp.
+        self.tie_split = tie_split
 
     def _out_hw(self, h, w):
         return conv_ops.out_hw(h, w, self.window, self.stride, self.padding)
@@ -147,7 +171,8 @@ class MaxPool2D(Layer):
 
     def _apply(self, params, state, x, *, training: bool, rng):
         return (
-            conv_ops.max_pool2d(x, self.window, stride=self.stride, padding=self.padding),
+            conv_ops.max_pool2d(x, self.window, stride=self.stride,
+                                padding=self.padding, tie_split=self.tie_split),
             {},
         )
 

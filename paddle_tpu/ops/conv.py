@@ -10,6 +10,7 @@ Layout is NHWC/HWIO (TPU-preferred), not the reference's NCHW.
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
@@ -32,27 +33,50 @@ def _pair(v: IntOr2) -> Tuple[int, int]:
 def _padding(padding, kernel: Tuple[int, int]):
     if isinstance(padding, str):
         return padding  # 'SAME' / 'VALID'
+    if (
+        isinstance(padding, (tuple, list))
+        and len(padding) == 2
+        and isinstance(padding[0], (tuple, list))
+    ):
+        return tuple((int(a), int(b)) for a, b in padding)  # ((t,b),(l,r))
     ph, pw = _pair(padding)
     return ((ph, ph), (pw, pw))
 
 
+def explicit_pad(h: int, w: int, window: IntOr2, stride: IntOr2,
+                 padding, dilation: IntOr2 = 1,
+                 ) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    """Resolve SAME/VALID/int/((t,b),(l,r)) padding to explicit
+    ((top,bot),(left,right)) for the given static input size — XLA's
+    SAME formula (pad so that out = ceil(in/stride), low half rounded
+    down), using the dilation-effective kernel size."""
+    kh, kw = _pair(window)
+    dh, dw = _pair(dilation)
+    ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+    sh, sw = _pair(stride)
+    if padding == "VALID":
+        return ((0, 0), (0, 0))
+    if padding == "SAME":
+        oh, ow = -(-h // sh), -(-w // sw)
+        th = max((oh - 1) * sh + ekh - h, 0)
+        tw = max((ow - 1) * sw + ekw - w, 0)
+        return ((th // 2, th - th // 2), (tw // 2, tw - tw // 2))
+    pad = _padding(padding, (kh, kw))
+    return (tuple(pad[0]), tuple(pad[1]))
+
+
 def out_hw(h: int, w: int, window: IntOr2, stride: IntOr2, padding,
            dilation: IntOr2 = 1) -> Tuple[int, int]:
-    """Static output (H, W) of a conv/pool window — the ONE place this
-    arithmetic lives (shape inference in nn.layers and nn.mixed reuses
-    it; keep in sync with what lax.conv/reduce_window actually produce).
-    """
+    """Static output (H, W) of a conv/pool window — built on explicit_pad,
+    the ONE place the padding arithmetic lives (shape inference in
+    nn.layers and nn.mixed reuses it; keep in sync with what
+    lax.conv/reduce_window actually produce)."""
     kh, kw = _pair(window)
     sh, sw = _pair(stride)
     dh, dw = _pair(dilation)
     ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
-    if padding == "SAME":
-        return -(-h // sh), -(-w // sw)
-    if padding == "VALID":
-        ph = pw = 0
-    else:
-        ph, pw = _pair(padding)
-    return (h + 2 * ph - ekh) // sh + 1, (w + 2 * pw - ekw) // sw + 1
+    (pt, pb), (pl, pr) = explicit_pad(h, w, window, stride, padding, dilation)
+    return (h + pt + pb - ekh) // sh + 1, (w + pl + pr - ekw) // sw + 1
 
 
 def conv2d(
@@ -84,6 +108,80 @@ def conv2d(
     if bias is not None:
         y = y + bias
     return y
+
+
+def space_to_depth(x, block: IntOr2 = 2):
+    """[N,H,W,C] -> [N,H/b1,W/b2,b1*b2*C]; channel order ((di*b2+dj)*C+c)."""
+    b1, b2 = _pair(block)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // b1, b1, w // b2, b2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // b1, w // b2, b1 * b2 * c)
+
+
+def depth_to_space(x, block: IntOr2 = 2):
+    """Inverse of space_to_depth."""
+    b1, b2 = _pair(block)
+    n, h, w, cc = x.shape
+    c = cc // (b1 * b2)
+    x = x.reshape(n, h, w, b1, b2, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * b1, w * b2, c)
+
+
+def s2d_kernel(kernel, block: IntOr2):
+    """Re-lay a conv kernel [kh,kw,C,O] for a space-to-depth-blocked
+    input: zero-pad kh/kw up to multiples of the block, then fold the
+    intra-block offsets into the input-channel dim (matching
+    space_to_depth's channel order)."""
+    b1, b2 = _pair(block)
+    kh, kw, c, o = kernel.shape
+    bkh, bkw = -(-kh // b1) * b1, -(-kw // b2) * b2
+    kp = jnp.pad(kernel, ((0, bkh - kh), (0, bkw - kw), (0, 0), (0, 0)))
+    kp = kp.reshape(bkh // b1, b1, bkw // b2, b2, c, o)
+    kp = kp.transpose(0, 2, 1, 3, 4, 5)
+    return kp.reshape(bkh // b1, bkw // b2, b1 * b2 * c, o)
+
+
+def conv2d_space_to_depth(
+    x,
+    kernel,
+    *,
+    stride: IntOr2,
+    padding="SAME",
+    bias=None,
+    policy: Optional[Policy] = None,
+):
+    """conv2d with stride == block, computed on the space-to-depth
+    transform of the input — mathematically IDENTICAL output (the
+    kernel is re-laid with s2d_kernel; extra kernel rows are zero).
+
+    Motivation (benchmarks/PROFILE_NOTES.md): a small-C large-spatial
+    conv like ResNet's 7x7/s2 stem on C_in=3 streams mostly padding —
+    the 8-sublane tile is 5/8 zeros and its weight-grad fusion measures
+    406 GiB/s vs ~700 for well-shaped convs. Blocking 2x2 turns
+    [N,224,224,3] into [N,112,112,12] with the same FLOPs. The kernel
+    PARAMETER stays in its original [kh,kw,C,O] layout so checkpoints
+    and the torch importer are unaffected; the re-lay is a tiny
+    device-side reshape fused into the step.
+    """
+    b1, b2 = _pair(stride)
+    kh, kw = kernel.shape[0], kernel.shape[1]
+    n, h, w, _ = x.shape
+    (pt, pb), (pl, pr) = explicit_pad(h, w, (kh, kw), (b1, b2), padding)
+    if h % b1 or w % b2 or pt % b1 or pl % b2:
+        # sizes that don't block evenly: fall back to the direct conv
+        return conv2d(x, kernel, stride=(b1, b2), padding=padding,
+                      bias=bias, policy=policy)
+    oh, ow = out_hw(h, w, (kh, kw), (b1, b2), padding)
+    kb = s2d_kernel(kernel, (b1, b2))
+    xb = space_to_depth(x, (b1, b2))
+    plb, plwb = pt // b1, pl // b2
+    phb = max(0, oh - plb + kb.shape[0] - 1 - h // b1)
+    prb = max(0, ow - plwb + kb.shape[1] - 1 - w // b2)
+    return conv2d(xb, kb, stride=1,
+                  padding=((plb, phb), (plwb, prb)),
+                  bias=bias, policy=policy)
 
 
 def conv2d_transpose(
@@ -138,25 +236,101 @@ def depthwise_conv2d(
     )
 
 
-def max_pool2d(x, window: IntOr2 = 2, *, stride: Optional[IntOr2] = None, padding="VALID"):
-    """Max pooling (reference: gserver/layers/PoolLayer.cpp MaxPooling,
-    paddle/operators/pool_op.cc)."""
-    wh, ww = _pair(window)
-    sh, sw = _pair(stride if stride is not None else window)
-    pad = padding if isinstance(padding, str) else (
-        (0, 0),
-        (_pair(padding)[0],) * 2,
-        (_pair(padding)[1],) * 2,
-        (0, 0),
-    )
+def _max_pool2d_raw(x, window, stride, pad2):
     # init must carry x's EXACT dtype: a bare python int promotes to
     # int64 under x64 and reduce_window rejects the mismatch
     init = (np.array(-np.inf, x.dtype)
             if jnp.issubdtype(x.dtype, jnp.floating)
             else np.array(jnp.iinfo(x.dtype).min, x.dtype))
+    wh, ww = window
+    sh, sw = stride
     return lax.reduce_window(
-        x, init, lax.max, (1, wh, ww, 1), (1, sh, sw, 1), pad
+        x, init, lax.max, (1, wh, ww, 1), (1, sh, sw, 1),
+        ((0, 0), pad2[0], pad2[1], (0, 0))
     )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _max_pool2d_ts(x, window, stride, pad2):
+    """Max pool whose VJP splits gradient equally among tied maxima.
+
+    The default VJP of reduce_window is a select-and-scatter — the
+    slowest op family on TPU (1.74 ms of the ResNet-50 step, see
+    benchmarks/PROFILE_NOTES.md). This formulation expresses the
+    backward as per-offset strided slices + compares + dilated pads,
+    which XLA fuses into plain streaming loops. At ties it divides the
+    cotangent equally among the tied maxima — a symmetric element of
+    the subgradient set, where select-and-scatter picks a single
+    winner. (No choice matches central differences at a >2-way tie;
+    away from ties the two gradients are identical.)
+    """
+    return _max_pool2d_raw(x, window, stride, pad2)
+
+
+def _max_pool2d_ts_fwd(x, window, stride, pad2):
+    y = _max_pool2d_raw(x, window, stride, pad2)
+    return y, (x, y)
+
+
+def _max_pool2d_ts_bwd(window, stride, pad2, res, dy):
+    x, y = res
+    wh, ww = window
+    sh, sw = stride
+    (pt, pb), (pl, pr) = pad2
+    n, h, w, c = x.shape
+    oh, ow = y.shape[1], y.shape[2]
+    neg = np.array(-np.inf, x.dtype)
+    xp = (jnp.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)), constant_values=neg)
+          if (pt or pb or pl or pr) else x)
+    hp, wp = h + pt + pb, w + pl + pr
+    # the k-th element of every window, as a y-shaped strided slice
+    masks = []
+    for i in range(wh):
+        for j in range(ww):
+            xk = lax.slice(
+                xp, (0, i, j, 0),
+                (n, i + (oh - 1) * sh + 1, j + (ow - 1) * sw + 1, c),
+                (1, sh, sw, 1))
+            masks.append(xk == y)
+    dty = dy.dtype
+    cnt = sum(m.astype(dty) for m in masks)
+    # cnt==0 only when the window max is NaN (NaN != NaN): drop that
+    # window's gradient instead of spreading dy/0 = inf around it
+    g = dy / jnp.maximum(cnt, np.array(1, dty))
+    zero = np.array(0, dty)
+    acc = None
+    for (i, j), m in zip(((i, j) for i in range(wh) for j in range(ww)), masks):
+        t = m.astype(dty) * g
+        # place t[a,b] at padded-x position (i + a*sh, j + b*sw)
+        spread = lax.pad(t, zero, (
+            (0, 0, 0),
+            (i, hp - i - (oh - 1) * sh - 1, sh - 1),
+            (j, wp - j - (ow - 1) * sw - 1, sw - 1),
+            (0, 0, 0)))
+        acc = spread if acc is None else acc + spread
+    dx = acc[:, pt:pt + h, pl:pl + w, :] if (pt or pb or pl or pr) else acc
+    return (dx.astype(x.dtype),)
+
+
+_max_pool2d_ts.defvjp(_max_pool2d_ts_fwd, _max_pool2d_ts_bwd)
+
+
+def max_pool2d(x, window: IntOr2 = 2, *, stride: Optional[IntOr2] = None,
+               padding="VALID", tie_split: bool = True):
+    """Max pooling (reference: gserver/layers/PoolLayer.cpp MaxPooling,
+    paddle/operators/pool_op.cc).
+
+    tie_split=True (floats only) routes the gradient through the
+    select-and-scatter-free custom VJP above; tie_split=False keeps
+    XLA's native pick-first semantics AND forward-mode (jvp/jacfwd)
+    differentiability, which custom_vjp functions reject.
+    """
+    win = _pair(window)
+    strd = _pair(stride if stride is not None else window)
+    pad2 = explicit_pad(x.shape[1], x.shape[2], win, strd, padding)
+    if tie_split and jnp.issubdtype(x.dtype, jnp.floating):
+        return _max_pool2d_ts(x, win, strd, pad2)
+    return _max_pool2d_raw(x, win, strd, pad2)
 
 
 def avg_pool2d(
@@ -432,14 +606,7 @@ def max_pool2d_with_index(x, window: IntOr2 = 2, *,
     oh, ow = patches.shape[1], patches.shape[2]
     # im2col flattens channel-major: [..., C * wh * ww]
     vals = patches.reshape(n, oh, ow, c, wh * ww)
-    if padding == "SAME":
-        th = max((oh - 1) * sh + wh - h, 0)
-        tw = max((ow - 1) * sw + ww - w, 0)
-        ph0, pw0 = th // 2, tw // 2
-    elif padding == "VALID":
-        ph0 = pw0 = 0
-    else:
-        ph0, pw0 = _pair(padding)
+    (ph0, _), (pw0, _) = explicit_pad(h, w, (wh, ww), (sh, sw), padding)
     # absolute source coordinates of every window cell: [OH/OW, wh*ww]
     r = jnp.arange(wh * ww) // ww
     s = jnp.arange(wh * ww) % ww

@@ -80,6 +80,96 @@ class TestConv:
             lambda p: jnp.sum(jnp.square(C.conv2d(x, p["k"]))), {"k": k}
         )
 
+    @pytest.mark.parametrize(
+        "window,stride,padding",
+        [(2, 2, "VALID"), (3, 2, "SAME"), (3, 1, "SAME"), ((2, 3), (2, 1), "VALID"),
+         (3, 2, 1)],
+    )
+    def test_max_pool_tie_split_matches_native(self, window, stride, padding,
+                                               np_rng):
+        # away from ties the custom VJP must equal select-and-scatter's
+        x = jnp.asarray(np_rng.randn(2, 9, 11, 3), jnp.float32)
+        w = jnp.asarray(np_rng.randn(
+            *C.max_pool2d(x, window, stride=stride, padding=padding).shape),
+            jnp.float32)
+
+        def f(x, tie_split):
+            y = C.max_pool2d(x, window, stride=stride, padding=padding,
+                             tie_split=tie_split)
+            return jnp.sum(y * w)
+
+        np.testing.assert_allclose(f(x, True), f(x, False), rtol=1e-6)
+        g_ts = jax.grad(lambda x: f(x, True))(x)
+        g_raw = jax.grad(lambda x: f(x, False))(x)
+        np.testing.assert_allclose(g_ts, g_raw, rtol=1e-5, atol=1e-6)
+
+    def test_max_pool_tie_split_shares_gradient(self):
+        # a 4-way tie gets dy/4 each (XLA native would give one element 1)
+        x = jnp.ones((1, 2, 2, 1), jnp.float32)
+        g = jax.grad(lambda x: jnp.sum(C.max_pool2d(x, 2)))(x)
+        np.testing.assert_allclose(g, np.full((1, 2, 2, 1), 0.25))
+        # gradient mass is conserved either way
+        assert float(jnp.sum(g)) == pytest.approx(1.0)
+
+    def test_max_pool_nan_window_stays_finite_elsewhere(self):
+        # a NaN window max means cnt==0 (NaN != NaN); the guard drops
+        # that window's grad instead of spreading inf/NaN around it
+        x = np.random.RandomState(0).randn(1, 8, 8, 1).astype(np.float32)
+        x[0, 2, 2, 0] = np.nan
+        g = jax.grad(lambda x: jnp.nansum(C.max_pool2d(x, 2)))(jnp.asarray(x))
+        # positions outside the NaN window keep finite gradients
+        mask = np.ones((1, 8, 8, 1), bool)
+        mask[0, 2:4, 2:4, 0] = False
+        assert bool(jnp.all(jnp.isfinite(g[mask])))
+
+    def test_max_pool_jvp_via_tie_split_off(self, np_rng):
+        # forward-mode needs the native path (custom_vjp rejects jvp)
+        x = jnp.asarray(np_rng.randn(1, 4, 4, 2), jnp.float32)
+        _, t = jax.jvp(
+            lambda x: C.max_pool2d(x, 2, tie_split=False), (x,), (x,))
+        assert t.shape == (1, 2, 2, 2)
+
+    def test_out_hw_explicit_asymmetric_padding(self):
+        assert C.out_hw(8, 8, 3, 2, ((1, 2), (0, 1))) == (5, 4)
+        # and the s2d conv accepts the nested form end-to-end
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 8, 8, 3),
+                        jnp.float32)
+        k = jnp.asarray(np.random.RandomState(1).randn(4, 4, 3, 4) * 0.2,
+                        jnp.float32)
+        y0 = C.conv2d(x, k, stride=2, padding=((2, 2), (2, 2)))
+        y1 = C.conv2d_space_to_depth(x, k, stride=2, padding=((2, 2), (2, 2)))
+        np.testing.assert_allclose(y0, y1, rtol=1e-4, atol=1e-4)
+
+    def test_space_to_depth_roundtrip(self, np_rng):
+        x = jnp.asarray(np_rng.randn(2, 6, 8, 5), jnp.float32)
+        np.testing.assert_array_equal(
+            C.depth_to_space(C.space_to_depth(x, (3, 2)), (3, 2)), x)
+
+    @pytest.mark.parametrize(
+        "hw,kernel,stride,padding",
+        [(16, 7, 2, "SAME"),     # the ResNet stem shape (pad 2/3 -> blocks)
+         (16, 4, 2, "VALID"),
+         (15, 5, 3, "VALID"),    # block 3, kernel padded 5->6
+         (16, 4, 2, "SAME"),     # pad (1,1): odd low pad -> fallback path
+         (18, 3, 3, "SAME")],
+    )
+    def test_conv_space_to_depth_equivalence(self, hw, kernel, stride,
+                                             padding, np_rng):
+        x = jnp.asarray(np_rng.randn(2, hw, hw, 3), jnp.float32)
+        k = jnp.asarray(np_rng.randn(kernel, kernel, 3, 8) * 0.2, jnp.float32)
+        y0 = C.conv2d(x, k, stride=stride, padding=padding)
+        y1 = C.conv2d_space_to_depth(x, k, stride=stride, padding=padding)
+        assert y0.shape == y1.shape
+        np.testing.assert_allclose(y0, y1, rtol=1e-4, atol=1e-4)
+        # gradients agree too (wrt input and kernel)
+        g0 = jax.grad(lambda x, k: jnp.sum(jnp.square(
+            C.conv2d(x, k, stride=stride, padding=padding))), (0, 1))(x, k)
+        g1 = jax.grad(lambda x, k: jnp.sum(jnp.square(
+            C.conv2d_space_to_depth(x, k, stride=stride, padding=padding))),
+            (0, 1))(x, k)
+        np.testing.assert_allclose(g0[0], g1[0], rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(g0[1], g1[1], rtol=1e-3, atol=1e-3)
+
     def test_im2col_shape(self, np_rng):
         x = jnp.asarray(np_rng.randn(2, 6, 6, 3), jnp.float32)
         p = C.im2col(x, 3, stride=1, padding="VALID")
