@@ -50,11 +50,14 @@ REF = {
     ("resnet50", 256): 256 / 84.1 * 1000,
     # LSTM text classification, bs 64, hidden 256/512 (README.md:115-119)
     ("lstm_h256", 64): 83.0, ("lstm_h512", 64): 184.0,
+    # SmallNet CIFAR-quick, 32x32 (README.md:54-58)
+    ("smallnet", 64): 10.463, ("smallnet", 128): 18.184,
+    ("smallnet", 256): 33.113, ("smallnet", 512): 63.039,
 }
 
 # analytic fwd GFLOPs per image at 224x224 (2*MACs), for MFU reporting
-FWD_GFLOPS = {"resnet50": 8.2, "vgg19": 39.0, "alexnet": 1.4,
-              "googlenet": 3.0}
+FWD_GFLOPS = {"resnet50": 8.2, "resnet50_s2d": 8.2, "vgg19": 39.0,
+              "alexnet": 1.4, "googlenet": 3.0}
 V5E_PEAK_TFLOPS = 197.0
 
 
@@ -69,6 +72,11 @@ def _image_model(name):
         return models.vgg.vgg(19, num_classes=1000)
     if name == "resnet50":
         return models.resnet.resnet(50, num_classes=1000)
+    if name == "resnet50_s2d":
+        # math-identical stem on a 2x2 space-to-depth blocking
+        return models.resnet.resnet(50, num_classes=1000, s2d_stem=True)
+    if name == "smallnet":
+        return models.smallnet.smallnet(num_classes=10)
     raise ValueError(name)
 
 
@@ -89,7 +97,8 @@ def bench_image(name: str, batch: int, *, hw: int = 224, iters: int = 20):
         opt, donate=True)
     x = jnp.asarray(np.random.RandomState(0).rand(batch, hw, hw, 3),
                     jnp.float32)
-    y = jnp.asarray(np.random.RandomState(1).randint(0, 1000, batch))
+    n_classes = 10 if name == "smallnet" else 1000
+    y = jnp.asarray(np.random.RandomState(1).randint(0, n_classes, batch))
     progress(f"image/{name}: warmup/compile (batch={batch} hw={hw})")
     state, loss, _ = step(state, rng, (x,), (y,))
     float(loss)
@@ -342,15 +351,19 @@ def main():
     iters = 2 if quick else 20
 
     image_cfgs = [(n, b) for n in ("alexnet", "googlenet", "vgg19",
-                                   "resnet50")
+                                   "resnet50", "resnet50_s2d")
                   for b in ((64,) if quick else (64, 128, 256))]
+    # SmallNet runs at its native 32x32 (the reference table's config)
+    image_cfgs += [("smallnet", b)
+                   for b in ((64,) if quick else (64, 128, 256, 512))]
     lstm_cfgs = [("lstm_h256", 256, 64), ("lstm_h512", 512, 64)]
     only = set(args.only.split(",")) if args.only else None
 
     for name, batch in image_cfgs:
         if only and name not in only:
             continue
-        dt = bench_image(name, batch, hw=hw, iters=iters)
+        dt = bench_image(name, batch, hw=32 if name == "smallnet" else hw,
+                         iters=iters)
         rec = {
             "bench": name, "batch": batch,
             "ms_per_batch": round(1000 * dt, 2),

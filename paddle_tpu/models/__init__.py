@@ -17,3 +17,5 @@ from paddle_tpu.models import gan
 from paddle_tpu.models import vae
 from paddle_tpu.models import ctr
 from paddle_tpu.models import quick_start
+from paddle_tpu.models import smallnet
+from paddle_tpu.models import transformer

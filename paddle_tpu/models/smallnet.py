@@ -1,0 +1,28 @@
+"""SmallNet — the CIFAR-quick benchmark net, NHWC.
+
+Parity target: reference benchmark/paddle/image/smallnet_mnist_cifar.py
+(3 convs with alternating max/avg 3x3/s2 pools, fc64+fc10; the
+"SmallNet" row of benchmark/README.md's published table — 10.5/18.2/
+33.1/63.0 ms/batch at batch 64/128/256/512 on 1x K40m).
+"""
+
+from __future__ import annotations
+
+from paddle_tpu import nn
+
+
+def smallnet(num_classes: int = 10) -> nn.Sequential:
+    return nn.Sequential(
+        [
+            nn.Conv2D(32, 5, padding=2, activation="relu", name="conv1"),
+            nn.MaxPool2D(3, stride=2, padding=1, name="pool1"),
+            nn.Conv2D(32, 5, padding=2, activation="relu", name="conv2"),
+            nn.AvgPool2D(3, stride=2, padding=1, name="pool2"),
+            nn.Conv2D(64, 3, padding=1, activation="relu", name="conv3"),
+            nn.AvgPool2D(3, stride=2, padding=1, name="pool3"),
+            nn.Flatten(name="flatten"),
+            nn.Dense(64, activation="relu", name="fc1"),
+            nn.Dense(num_classes, name="logits"),
+        ],
+        name="smallnet",
+    )

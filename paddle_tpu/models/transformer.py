@@ -1,0 +1,254 @@
+"""Decoder-only transformer LM — the framework's modern long-context
+flagship.
+
+No reference counterpart: the reference predates transformers (SURVEY
+§2.8 notes PP/TP/CP/ring have no analog there), but a TPU-native
+framework needs one. TPU-first choices:
+
+- pre-LN blocks, fused QKV projection (one [B*T,D]x[D,3D] matmul for
+  the MXU instead of three),
+- rotary positions (no learned position table to shard or resize),
+- Pallas flash attention (`ops.flash_attention`) when requested /
+  on TPU, exact dense fallback elsewhere — O(T·block) memory makes
+  32k+ contexts feasible on one chip,
+- optional `jax.checkpoint` over each block (remat trades FLOPs for
+  HBM on long sequences),
+- parameter names line up with `parallel.sharding.MEGATRON_RULES`
+  (qkv/fc1 shard output features, proj/fc2 shard input features) so
+  the same pytree drives dp x tp through
+  `parallel.train_step.make_sharded_train_step`; `TP_RULES` below adds
+  the vocab-sharded LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import at_least_f32, default_policy
+from paddle_tpu.nn import initializers
+from paddle_tpu.ops import linalg
+from paddle_tpu.ops import norm as norm_ops
+from paddle_tpu.ops.flash_attention import flash_attention
+from paddle_tpu.parallel.sharding import MEGATRON_RULES, MODEL_AXIS
+
+from jax.sharding import PartitionSpec as P
+
+# tensor-parallel rules for this family: megatron MLP/attention splits
+# plus the LM head sharded over the vocab dim
+TP_RULES = list(MEGATRON_RULES) + [(r"lm_head/kernel$", P(None, MODEL_AXIS))]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int
+    dim: int = 256
+    n_layers: int = 4
+    n_heads: int = 4
+    mlp_ratio: int = 4
+    rope_base: float = 10000.0
+    # "flash" = Pallas kernel, "dense" = materialized scores,
+    # "auto" = flash where the kernel compiles natively (TPU), dense
+    # elsewhere (interpret-mode flash would be slower than dense)
+    attn_impl: str = "auto"
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+def init_params(rng, cfg: TransformerConfig):
+    smart = initializers.smart_uniform()
+    d, h = cfg.dim, cfg.mlp_ratio * cfg.dim
+    ks = iter(jax.random.split(rng, 4 + 4 * cfg.n_layers))
+
+    def block_params(k1, k2, k3, k4):
+        return {
+            "ln1": {"scale": jnp.ones((d,)), "offset": jnp.zeros((d,))},
+            "qkv": {"kernel": smart(k1, (d, 3 * d)),
+                    "bias": jnp.zeros((3 * d,))},
+            "proj": {"kernel": smart(k2, (d, d)), "bias": jnp.zeros((d,))},
+            "ln2": {"scale": jnp.ones((d,)), "offset": jnp.zeros((d,))},
+            "fc1": {"kernel": smart(k3, (d, h)), "bias": jnp.zeros((h,))},
+            "fc2": {"kernel": smart(k4, (h, d)), "bias": jnp.zeros((d,))},
+        }
+
+    return {
+        "embed": {"table": initializers.normal(0.02)(next(ks),
+                                                     (cfg.vocab, d))},
+        "blocks": [block_params(next(ks), next(ks), next(ks), next(ks))
+                   for _ in range(cfg.n_layers)],
+        "ln_f": {"scale": jnp.ones((d,)), "offset": jnp.zeros((d,))},
+        "lm_head": {"kernel": smart(next(ks), (d, cfg.vocab))},
+    }
+
+
+def _rope(x, positions, base: float):
+    """Rotary embedding. x: [B,T,H,Dh] (Dh even), positions: [B,T]."""
+    dh = x.shape[-1]
+    freqs = base ** (-jnp.arange(0, dh, 2, dtype=jnp.float32) / dh)
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,Dh/2]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape)
+
+
+def _dense_attention(q, k, v, causal: bool):
+    """Exact reference attention; [B,T,H,Dh] in/out, f32 scores."""
+    dh = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(dh, q.dtype))
+    scores = at_least_f32(scores)
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        scores = jnp.where(mask, scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v)
+
+
+def _attention(cfg: TransformerConfig, q, k, v, causal: bool):
+    impl = cfg.attn_impl
+    if impl == "auto":
+        # flash ONLY where the Pallas kernel compiles natively — the
+        # same condition ops.flash_attention uses for interpret mode;
+        # anywhere else interpret-mode emulation would be far slower
+        # than the dense fallback
+        impl = "flash" if jax.default_backend() == "tpu" else "dense"
+    if impl == "flash":
+        return flash_attention(q, k, v, causal=causal)
+    return _dense_attention(q, k, v, causal)
+
+
+def _block_parts(cfg: TransformerConfig, p, x, positions, attn_fn):
+    """One pre-LN block with a pluggable attention: attn_fn(q, k, v) ->
+    [B,T,H,Dh]. The ONE definition of the block body — apply(), the
+    decode prefill and the KV-cache step all run THIS code, so a model
+    change cannot silently diverge between train and decode. Returns
+    (x_out, k, v) so cache builders can keep the rotated K/V."""
+    b, t, d = x.shape
+    h, dh = cfg.n_heads, cfg.head_dim
+    y = norm_ops.layer_norm(x, p["ln1"]["scale"], p["ln1"]["offset"])
+    qkv = linalg.dense(y, p["qkv"]["kernel"], p["qkv"]["bias"])
+    q, k, v = [a.reshape(b, t, h, dh)
+               for a in jnp.split(qkv, 3, axis=-1)]
+    q = _rope(q, positions, cfg.rope_base)
+    k = _rope(k, positions, cfg.rope_base)
+    a = attn_fn(q, k, v).reshape(b, t, d)
+    x = x + linalg.dense(a, p["proj"]["kernel"], p["proj"]["bias"])
+    y = norm_ops.layer_norm(x, p["ln2"]["scale"], p["ln2"]["offset"])
+    y = jax.nn.gelu(linalg.dense(y, p["fc1"]["kernel"], p["fc1"]["bias"]))
+    return x + linalg.dense(y, p["fc2"]["kernel"], p["fc2"]["bias"]), k, v
+
+
+def _block(cfg: TransformerConfig, p, x, positions):
+    out, _, _ = _block_parts(
+        cfg, p, x, positions,
+        lambda q, k, v: _attention(cfg, q, k, v, causal=True))
+    return out
+
+
+def apply(params, cfg: TransformerConfig, tokens, positions=None):
+    """tokens [B,T] int32 -> logits [B,T,V]."""
+    policy = default_policy()
+    x = jnp.take(params["embed"]["table"], tokens, axis=0)
+    x = x.astype(policy.compute_dtype)
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1]), tokens.shape)
+    blk = _block
+    if cfg.remat:
+        blk = jax.checkpoint(_block, static_argnums=(0,))
+    for p in params["blocks"]:
+        x = blk(cfg, p, x, positions)
+    x = norm_ops.layer_norm(x, params["ln_f"]["scale"],
+                            params["ln_f"]["offset"])
+    return linalg.matmul(x, params["lm_head"]["kernel"])
+
+
+def loss(params, cfg: TransformerConfig, tokens, lengths=None):
+    """Next-token cross entropy; positions >= lengths are masked out."""
+    logits = apply(params, cfg, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    lse = jax.nn.logsumexp(at_least_f32(logits), axis=-1)
+    gold = jnp.take_along_axis(
+        at_least_f32(logits), targets[..., None], axis=-1)[..., 0]
+    nll = lse - gold
+    if lengths is None:
+        return jnp.mean(nll)
+    mask = jnp.arange(1, tokens.shape[1])[None, :] < lengths[:, None]
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+
+def generate(params, cfg: TransformerConfig, prompt, steps: int):
+    """Greedy decode with a KV cache carried through lax.scan.
+
+    prompt [B,T0] int32 -> [B, T0+steps]. The cache holds K/V per layer
+    at full T0+steps length (static shapes for XLA); each scan step
+    attends over the valid prefix via an explicit position mask.
+    """
+    b, t0 = prompt.shape
+    total = t0 + steps
+    h, dh = cfg.n_heads, cfg.head_dim
+    policy = default_policy()
+
+    def final_logits(x):
+        x = norm_ops.layer_norm(x, params["ln_f"]["scale"],
+                                params["ln_f"]["offset"])
+        return linalg.matmul(x[:, -1], params["lm_head"]["kernel"])
+
+    # prefill: the same _block_parts body as apply() (cfg.attn_impl
+    # decides flash vs dense — a 32k prompt needs the flash path), with
+    # each layer's rotated K/V captured into fixed-size cache buffers
+    x = jnp.take(params["embed"]["table"], prompt, axis=0)
+    x = x.astype(policy.compute_dtype)
+    pos = jnp.broadcast_to(jnp.arange(t0), (b, t0))
+    caches = []
+    for p in params["blocks"]:
+        x, k, v = _block_parts(
+            cfg, p, x, pos,
+            lambda q, k, v: _attention(cfg, q, k, v, causal=True))
+        k_buf = jnp.zeros((b, total, h, dh), k.dtype).at[:, :t0].set(k)
+        v_buf = jnp.zeros((b, total, h, dh), v.dtype).at[:, :t0].set(v)
+        caches.append((k_buf, v_buf))
+    first = jnp.argmax(final_logits(x), axis=-1).astype(prompt.dtype)
+
+    def step(carry, _):
+        tok, t, caches = carry  # tok [B], t scalar, caches per layer
+        x = jnp.take(params["embed"]["table"], tok[:, None], axis=0)
+        x = x.astype(policy.compute_dtype)
+        pos = jnp.broadcast_to(t[None, None], (b, 1))
+        new_caches = []
+        for p, (k_buf, v_buf) in zip(params["blocks"], caches):
+
+            def cached_attn(q, k, v, k_buf=k_buf, v_buf=v_buf):
+                # single-position attention over the updated cache; the
+                # update is captured via new_caches (traced normally)
+                k_buf = jax.lax.dynamic_update_slice_in_dim(
+                    k_buf, k, t, axis=1)
+                v_buf = jax.lax.dynamic_update_slice_in_dim(
+                    v_buf, v, t, axis=1)
+                new_caches.append((k_buf, v_buf))
+                scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_buf) / jnp.sqrt(
+                    jnp.asarray(dh, q.dtype))
+                scores = at_least_f32(scores)
+                valid = (jnp.arange(total) <= t)[None, None, None, :]
+                scores = jnp.where(valid, scores, -1e30)
+                w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+                return jnp.einsum("bhqk,bkhd->bqhd", w, v_buf)
+
+            x, _, _ = _block_parts(cfg, p, x, pos, cached_attn)
+        nxt = jnp.argmax(final_logits(x), axis=-1).astype(tok.dtype)
+        return (nxt, t + 1, new_caches), tok
+
+    _, toks = jax.lax.scan(
+        step, (first, jnp.asarray(t0, jnp.int32), caches), None,
+        length=steps)
+    # emitted = [first, t1, ..., t_{steps-1}]: exactly the new tokens
+    return jnp.concatenate([prompt, toks.transpose(1, 0)], axis=1)

@@ -70,6 +70,14 @@ def test_resnet_s2d_stem_equivalent(rng):
         np.testing.assert_allclose(a, b, rtol=1e-2, atol=2e-2)
 
 
+def test_smallnet(rng):
+    """The CIFAR-quick benchmark net (reference:
+    benchmark/paddle/image/smallnet_mnist_cifar.py)."""
+    model = models.smallnet.smallnet(num_classes=10)
+    params, state, x, y = _forward_check(model, (2, 32, 32, 3), 10, rng)
+    assert y.shape == (2, 10)
+
+
 def test_resnet_cifar(rng):
     model = models.resnet.resnet_cifar(20, num_classes=10, width=8)
     _forward_check(model, (2, 32, 32, 3), 10, rng)

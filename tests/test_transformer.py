@@ -1,0 +1,136 @@
+"""Transformer LM tests: causality, training signal, KV-cache decode
+consistency, and dp x tp sharded-step equivalence on the 8-CPU mesh.
+
+The model has no reference counterpart (the reference predates
+transformers); these tests follow the same strategies SURVEY §4 lists —
+impl-vs-impl equivalence (KV-cache decode vs teacher forcing, sharded vs
+single-device) and gradient checks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models import transformer as T
+
+
+CFG = T.TransformerConfig(vocab=61, dim=32, n_layers=2, n_heads=4,
+                          attn_impl="dense")
+
+
+@pytest.fixture
+def params():
+    return T.init_params(jax.random.key(0), CFG)
+
+
+def test_shapes_and_finite(params):
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 61, (3, 12)))
+    logits = T.apply(params, CFG, toks)
+    assert logits.shape == (3, 12, 61)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_causality(params):
+    """Logits at position t must not depend on tokens after t."""
+    rs = np.random.RandomState(1)
+    a = rs.randint(0, 61, (1, 10))
+    b = a.copy()
+    b[0, 7:] = (b[0, 7:] + 5) % 61  # perturb the future
+    la = T.apply(params, CFG, jnp.asarray(a))
+    lb = T.apply(params, CFG, jnp.asarray(b))
+    np.testing.assert_allclose(la[0, :7], lb[0, :7], rtol=1e-5, atol=1e-5)
+    assert float(jnp.max(jnp.abs(la[0, 7:] - lb[0, 7:]))) > 1e-4
+
+
+def test_loss_mask(params):
+    toks = jnp.asarray(np.random.RandomState(2).randint(0, 61, (2, 9)))
+    full = T.loss(params, CFG, toks)
+    # masking to zero-length-ish keeps it finite and different
+    short = T.loss(params, CFG, toks, lengths=jnp.asarray([3, 4]))
+    assert np.isfinite(float(full)) and np.isfinite(float(short))
+
+
+def test_overfits_tiny_batch(params):
+    """A few adam steps on one repeated batch must cut the loss — the
+    training-signal smoke the book tests use (SURVEY §4 e2e row)."""
+    from paddle_tpu import optim
+
+    toks = jnp.asarray(np.random.RandomState(3).randint(0, 61, (4, 16)))
+    opt = optim.adam(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        l, g = jax.value_and_grad(lambda p: T.loss(p, CFG, toks))(p)
+        p2, s2 = opt.update(g, s, p, jnp.zeros((), jnp.int32))
+        return p2, s2, l
+
+    first = None
+    for _ in range(30):
+        params, opt_state, l = step(params, opt_state)
+        if first is None:
+            first = float(l)
+    assert float(l) < first * 0.7, (first, float(l))
+
+
+def test_generate_matches_teacher_forcing(params):
+    """KV-cache greedy decode == argmax over apply() at every step (the
+    cache path and the full forward are different codepaths)."""
+    prompt = jnp.asarray(np.random.RandomState(4).randint(0, 61, (2, 5)))
+    steps = 6
+    out = T.generate(params, CFG, prompt, steps)
+    assert out.shape == (2, 5 + steps)
+    np.testing.assert_array_equal(out[:, :5], prompt)
+    cur = prompt
+    for _ in range(steps):
+        logits = T.apply(params, CFG, cur)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+        cur = jnp.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out, cur)
+
+
+def test_remat_matches(params):
+    toks = jnp.asarray(np.random.RandomState(5).randint(0, 61, (2, 8)))
+    cfg_r = T.TransformerConfig(vocab=61, dim=32, n_layers=2, n_heads=4,
+                                attn_impl="dense", remat=True)
+    np.testing.assert_allclose(T.loss(params, CFG, toks),
+                               T.loss(params, cfg_r, toks), rtol=1e-6)
+    g0 = jax.grad(lambda p: T.loss(p, CFG, toks))(params)
+    g1 = jax.grad(lambda p: T.loss(p, cfg_r, toks))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_tp_sharded_loss_matches(params):
+    """dp x tp over the 8-CPU mesh computes the same loss/grads as one
+    device (GSPMD inserts the collectives; TP_RULES shard qkv/fc1 by
+    output, proj/fc2 by input, lm_head by vocab)."""
+    from paddle_tpu.core import mesh as mesh_lib
+    from paddle_tpu.parallel import sharding as shard_lib
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=2, model=4))
+    toks = jnp.asarray(np.random.RandomState(6).randint(0, 61, (4, 12)))
+
+    ref_loss = T.loss(params, CFG, toks)
+    ref_grad = jax.grad(lambda p: T.loss(p, CFG, toks))(params)
+
+    sh = shard_lib.make_param_shardings(params, mesh, T.TP_RULES)
+    p_sharded = jax.device_put(params, sh)
+    # at least one leaf actually sharded over the model axis
+    specs = jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(lambda s: s.spec, sh,
+                               is_leaf=lambda x: hasattr(x, "spec")))
+    assert any("model" in str(s) for s in specs)
+
+    # no ambient mesh needed: the sharded params carry NamedShardings
+    # and GSPMD propagates/inserts collectives
+    l = jax.jit(lambda p: T.loss(p, CFG, toks))(p_sharded)
+    g = jax.jit(jax.grad(lambda p: T.loss(p, CFG, toks)))(p_sharded)
+    np.testing.assert_allclose(l, ref_loss, rtol=2e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(ref_grad),
+                    jax.tree_util.tree_leaves(g)):
+        np.testing.assert_allclose(a, np.asarray(b), rtol=1e-4, atol=1e-5)
