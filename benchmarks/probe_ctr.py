@@ -1,0 +1,114 @@
+"""Stage-isolated timing of the CTR sparse train step (north star #2).
+
+The round-3 chip measurement gave 772 ms/batch at bs4096 x 32 slots
+(679k rows/sec). This breaks the step into stages so the dominant cost
+(lookup gather vs MLP fwd/bwd vs row-grad merge vs scatter-add update)
+is attributable.
+
+Usage: python benchmarks/probe_ctr.py [--batch 4096]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1000
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=4096)
+    ap.add_argument("--slots", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=1_000_000)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=20)
+    args = ap.parse_args()
+
+    from paddle_tpu import optim
+    from paddle_tpu.core import mesh as mesh_lib
+    from paddle_tpu.models.ctr import CTRModel
+
+    n_dev = len(jax.devices())
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=1, model=n_dev))
+    model = CTRModel(vocab=args.vocab, embed_dim=args.dim, mesh=mesh)
+    r = np.random.RandomState(0)
+    params, mlp_state = model.init(jax.random.key(0), args.batch, args.slots)
+    ids = jnp.asarray(r.randint(0, args.vocab, (args.batch, args.slots)),
+                      jnp.int32)
+    labels = jnp.asarray(r.randint(0, 2, args.batch), jnp.int32)
+    flat = ids.reshape(-1)
+    lr = jnp.asarray(0.05, jnp.float32)
+
+    # stage 1: gather only
+    @jax.jit
+    def lookup(table, flat):
+        return model._lookup(model.table, table, flat)
+
+    ms = timeit(lookup, params["deep"], flat, iters=args.iters)
+    print(f"lookup(deep) [K={flat.shape[0]} D={args.dim}]: {ms:8.2f} ms",
+          flush=True)
+
+    # stage 2: full forward
+    @jax.jit
+    def fwd(params, ids):
+        return model.apply(params, mlp_state, ids)
+
+    ms = timeit(fwd, params, ids, iters=args.iters)
+    print(f"forward:                                {ms:8.2f} ms", flush=True)
+
+    # stage 3: scatter-add update only (row grads precomputed)
+    row_g = jnp.asarray(r.randn(flat.shape[0], args.dim) * 0.01,
+                        jnp.float32)
+
+    @jax.jit
+    def push(table, flat, row_g):
+        if model._use_alltoall(flat.shape[0]):
+            return model.table.alltoall_push_row_grads(table, flat, row_g, lr)
+        return model.table.apply_row_grads(table, flat, row_g, lr)
+
+    ms = timeit(push, params["deep"], flat, row_g, iters=args.iters)
+    print(f"row-grad push(deep):                    {ms:8.2f} ms", flush=True)
+
+    # stage 4: the full train step (the bench's number)
+    opt = optim.adam(1e-3)
+    opt_state = opt.init(params["mlp"])
+    step = model.make_train_step(opt, mlp_state)
+
+    def full(params, opt_state):
+        p, o, loss = step(params, opt_state, ids, labels, lr,
+                          jnp.zeros((), jnp.int32), jax.random.key(1))
+        return p, o, loss
+
+    out = full(params, opt_state)
+    jax.block_until_ready(out[0])
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        out = full(*out[:2])
+    jax.block_until_ready(out[0])
+    ms = (time.perf_counter() - t0) / args.iters * 1000
+    print(f"full train step:                        {ms:8.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

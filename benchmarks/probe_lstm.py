@@ -1,0 +1,94 @@
+"""Isolate the lstm h256-slower-than-h512 inversion (VERDICT r2 weak #4).
+
+Times three nested slices of the lstm_h{256,512} suite bench on the
+chip, at several hidden sizes, so the inversion (if it survives the
+round-3 input-projection hoisting) can be attributed to a specific
+stage:
+
+  1. the bare recurrence: scan of h@W_hh + gate math over T steps
+  2. the full lstm() op (hoisted input projection + scan)
+  3. the full 2-layer classifier train step (the suite bench)
+
+Usage: python benchmarks/probe_lstm.py [--iters 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1000
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--seq", type=int, default=100)
+    args = ap.parse_args()
+
+    from paddle_tpu.core import dtypes
+    from paddle_tpu.ops import rnn as rnn_ops
+
+    dtypes.set_default_policy(dtypes.bf16_compute_policy())
+    b, t = args.batch, args.seq
+
+    for hidden in (128, 256, 384, 512, 768):
+        params = rnn_ops.init_lstm_params(jax.random.key(0), hidden, hidden)
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(b, t, hidden), jnp.float32)
+
+        @jax.jit
+        def bare_scan(params, x_proj):
+            def step(state, xp):
+                s = rnn_ops.lstm_step_from_proj(params, xp, state)
+                return s, s.h
+            h0 = rnn_ops.LSTMState(
+                jnp.zeros((b, hidden), x_proj.dtype),
+                jnp.zeros((b, hidden), x_proj.dtype))
+            _, hs = jax.lax.scan(step, h0, x_proj.transpose(1, 0, 2))
+            return hs
+
+        @jax.jit
+        def full_lstm(params, x):
+            out, _ = rnn_ops.lstm(params, x)
+            return out
+
+        x_proj = jnp.asarray(np.random.RandomState(1).randn(b, t, 4 * hidden),
+                             jnp.bfloat16)
+        ms_scan = timeit(bare_scan, params, x_proj, iters=args.iters)
+        ms_lstm = timeit(full_lstm, params, x, iters=args.iters)
+        line = (f"hidden={hidden:4d}  bare_scan={ms_scan:7.2f} ms  "
+                f"full_lstm={ms_lstm:7.2f} ms")
+        if hidden in (256, 512):
+            # stage 3: the suite's full 2-layer classifier train step —
+            # localizes the inversion between the lstm op and the rest
+            from benchmarks.suite import bench_lstm
+            ms_full = bench_lstm(hidden, b, seq_len=t,
+                                 iters=args.iters) * 1000
+            line += f"  classifier_step={ms_full:7.2f} ms"
+        print(line, flush=True)
+
+
+if __name__ == "__main__":
+    main()

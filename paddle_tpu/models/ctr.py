@@ -19,7 +19,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh
+from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu import nn
 from paddle_tpu.nn.module import ShapeSpec
@@ -58,6 +58,14 @@ class CTRModel:
         wide = self.wide.init(r2)
         mlp_p, mlp_s = self.mlp.init(
             r3, ShapeSpec((batch, self.embed_dim)))
+        # place the MLP on the mesh (replicated) UP FRONT: the train step
+        # runs under the mesh's sharding context, so its outputs carry
+        # mesh-tagged avals — un-placed inputs would make the SECOND step
+        # a guaranteed tracing-cache miss and silently double compile
+        # time (this poisoned the round-3 CTR chip benchmark: 772 ms/batch
+        # recorded where steady state is an order of magnitude faster)
+        mlp_p = jax.device_put(
+            mlp_p, jax.sharding.NamedSharding(self.mesh, P()))
         return {"deep": deep, "wide": wide, "mlp": mlp_p}, mlp_s
 
     def _forward_from_rows(self, mlp_params, mlp_state, deep_rows,

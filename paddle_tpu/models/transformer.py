@@ -217,7 +217,8 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int):
         k_buf = jnp.zeros((b, total, h, dh), k.dtype).at[:, :t0].set(k)
         v_buf = jnp.zeros((b, total, h, dh), v.dtype).at[:, :t0].set(v)
         caches.append((k_buf, v_buf))
-    first = jnp.argmax(final_logits(x), axis=-1).astype(prompt.dtype)
+    # only the last position's logits matter — don't LN/project all T0
+    first = jnp.argmax(final_logits(x[:, -1:]), axis=-1).astype(prompt.dtype)
 
     def step(carry, _):
         tok, t, caches = carry  # tok [B], t scalar, caches per layer

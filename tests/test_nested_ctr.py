@@ -97,3 +97,25 @@ def test_ctr_model_trains_and_updates_only_touched_rows():
     np.testing.assert_array_equal(deep_after[untouched],
                                   deep_before[untouched])
     assert not np.allclose(deep_after[touched], deep_before[touched])
+
+
+def test_ctr_step_compiles_once():
+    """The second step must HIT the tracing cache: init places the MLP
+    on the mesh so step outputs round-trip with identical avals (a miss
+    here silently doubles compile time and poisoned the round-3 chip
+    benchmark)."""
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=4, model=2))
+    model = CTRModel(vocab=128, embed_dim=8, mesh=mesh, hidden=(16,))
+    params, mlp_state = model.init(jax.random.key(0), 16, 4)
+    opt = optim.adam(1e-2)
+    opt_state = opt.init(params["mlp"])
+    step = model.make_train_step(opt, mlp_state)
+    ids = jnp.asarray(np.random.RandomState(0).randint(0, 128, (16, 4)),
+                      jnp.int32)
+    labels = jnp.asarray(np.random.RandomState(1).randint(0, 2, 16),
+                         jnp.float32)
+    for i in range(3):
+        params, opt_state, loss = step(
+            params, opt_state, ids, labels, jnp.float32(0.1),
+            jnp.asarray(i, jnp.int32), jax.random.key(i))
+    assert step._cache_size() == 1, step._cache_size()

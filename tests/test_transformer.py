@@ -46,9 +46,10 @@ def test_causality(params):
 def test_loss_mask(params):
     toks = jnp.asarray(np.random.RandomState(2).randint(0, 61, (2, 9)))
     full = T.loss(params, CFG, toks)
-    # masking to zero-length-ish keeps it finite and different
     short = T.loss(params, CFG, toks, lengths=jnp.asarray([3, 4]))
     assert np.isfinite(float(full)) and np.isfinite(float(short))
+    # a loss() that ignores lengths would return the same value
+    assert not np.isclose(float(full), float(short))
 
 
 def test_overfits_tiny_batch(params):
