@@ -25,15 +25,7 @@ if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
 import jax.numpy as jnp
 import numpy as np
 
-
-def timeit(fn, *args, iters=20):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1000
+from benchmarks.suite import timeit
 
 
 def main():
@@ -100,7 +92,11 @@ def main():
                           jnp.zeros((), jnp.int32), jax.random.key(1))
         return p, o, loss
 
+    # TWO warmups (as suite.bench_ctr_sparse): if the aval-mismatch
+    # recompile this probe exists to diagnose regresses, it must land
+    # BEFORE timing so the stage numbers stay attributable
     out = full(params, opt_state)
+    out = full(*out[:2])
     jax.block_until_ready(out[0])
     t0 = time.perf_counter()
     for _ in range(args.iters):

@@ -29,15 +29,7 @@ if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
 import jax.numpy as jnp
 import numpy as np
 
-
-def timeit(fn, *args, iters):
-    out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters * 1000
+from benchmarks.suite import timeit
 
 
 def main():

@@ -19,6 +19,22 @@ import sys
 import time
 
 
+def timeit(fn, *args, iters=20, warmup=2):
+    """Shared bench timing: warm up (TWICE by default — the second call
+    catches input-vs-output aval-mismatch recompiles, see
+    bench_ctr_sparse), then average iters synced calls."""
+    import jax as _jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    _jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1000
+
+
 def progress(msg: str) -> None:
     """Per-stage progress to stderr (stdout stays JSON-only) so a stalled
     run is diagnosable — VERDICT r2 weak #2: the benches printed nothing

@@ -18,4 +18,5 @@ from paddle_tpu.models import vae
 from paddle_tpu.models import ctr
 from paddle_tpu.models import quick_start
 from paddle_tpu.models import smallnet
+from paddle_tpu.models import traffic
 from paddle_tpu.models import transformer

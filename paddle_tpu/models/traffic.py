@@ -1,0 +1,57 @@
+"""Traffic-speed multi-task forecaster.
+
+Parity target: reference v1_api_demo/traffic_prediction/trainer_config.py
+— a link-encode vector through ONE shared fc (ParamAttr '_link_vec.w'
+reused across all tasks), then FORECASTING_NUM independent 4-class
+softmax heads trained jointly (multi-task classification_cost per
+horizon, outputs() of all costs).
+
+TPU-first shape: the 24 per-task [emb,4] heads are one stacked [T,emb,4]
+tensor applied with a single einsum — one MXU matmul instead of 24
+vector-sized ones; the multi-task sum is a mean over the task axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializers
+from paddle_tpu.ops import losses
+
+
+def init_params(rng, *, term_num: int = 24, forecasting_num: int = 24,
+                emb_size: int = 16, num_classes: int = 4):
+    k1, k2 = jax.random.split(rng)
+    # reference inits _link_vec.w uniform in [-1, 1]
+    return {
+        "link_vec": {
+            "kernel": jax.random.uniform(
+                k1, (term_num, emb_size), minval=-1.0, maxval=1.0),
+            "bias": jnp.zeros((emb_size,)),
+        },
+        "heads": {
+            "kernel": initializers.smart_uniform()(
+                k2, (forecasting_num, emb_size, num_classes)),
+            "bias": jnp.zeros((forecasting_num, num_classes)),
+        },
+    }
+
+
+def apply(params, x):
+    """x: [B, term_num] speed-history encode -> logits [B, tasks, 4]."""
+    link = x @ params["link_vec"]["kernel"] + params["link_vec"]["bias"]
+    return (jnp.einsum("be,tec->btc", link, params["heads"]["kernel"])
+            + params["heads"]["bias"])
+
+
+def loss(params, x, labels):
+    """Joint multi-task loss; labels [B, tasks] int class per horizon."""
+    logits = apply(params, x)
+    per_task = losses.softmax_cross_entropy(logits, labels)  # [B, tasks]
+    return jnp.mean(per_task)
+
+
+def predict(params, x):
+    """Per-horizon argmax class (reference: maxid_layer in predict mode)."""
+    return jnp.argmax(apply(params, x), axis=-1)

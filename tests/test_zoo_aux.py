@@ -481,3 +481,45 @@ def test_sequence_slice_layer_first_and_last():
     last = nn.SequenceSlice(2, from_end=True)
     (out, lens), _ = last.apply(params, state, x, lengths)
     np.testing.assert_allclose(np.asarray(out[:, :, 0]), [[3, 4], [6, 7]])
+
+
+class TestTraffic:
+    """Multi-task traffic forecaster (reference:
+    v1_api_demo/traffic_prediction/trainer_config.py)."""
+
+    def test_shapes_and_predict(self):
+        from paddle_tpu.models import traffic
+
+        params = traffic.init_params(jax.random.key(0))
+        x = jnp.asarray(np.random.RandomState(0).rand(8, 24), jnp.float32)
+        logits = traffic.apply(params, x)
+        assert logits.shape == (8, 24, 4)
+        pred = traffic.predict(params, x)
+        assert pred.shape == (8, 24) and int(pred.max()) < 4
+
+    def test_multitask_learns(self):
+        from paddle_tpu import optim
+        from paddle_tpu.models import traffic
+
+        params = traffic.init_params(jax.random.key(1))
+        rs = np.random.RandomState(0)
+        x = jnp.asarray(rs.rand(64, 24), jnp.float32)
+        # learnable rule: class for horizon t depends on mean speed
+        y = jnp.asarray(
+            (np.clip(np.asarray(x).mean(1, keepdims=True) * 4, 0, 3.99)
+             ).astype(np.int32).repeat(24, 1))
+        opt = optim.rmsprop(5e-3)
+        ost = opt.init(params)
+
+        @jax.jit
+        def step(p, s):
+            l, g = jax.value_and_grad(
+                lambda p: traffic.loss(p, x, y))(p)
+            p2, s2 = opt.update(g, s, p, jnp.zeros((), jnp.int32))
+            return p2, s2, l
+
+        first = None
+        for _ in range(60):
+            params, ost, l = step(params, ost)
+            first = first if first is not None else float(l)
+        assert float(l) < first * 0.6, (first, float(l))
