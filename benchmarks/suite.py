@@ -25,7 +25,7 @@ def timeit(fn, *args, iters=20, warmup=2):
     bench_ctr_sparse), then average iters synced calls."""
     import jax as _jax
 
-    for _ in range(warmup):
+    for _ in range(max(warmup, 1)):  # at least once: `out` must exist
         out = fn(*args)
     _jax.block_until_ready(out)
     t0 = time.perf_counter()

@@ -25,7 +25,9 @@ from paddle_tpu.nn.wrappers import (
     NCE,
     AdditiveAttention,
     BlockExpand,
+    DataNorm,
     DetectionOutput,
+    RowConv,
     HSigmoid,
     Interpolate,
     MultiBoxLoss,

@@ -827,3 +827,55 @@ class SequenceSlice(Layer):
         else:
             start = jnp.zeros_like(lengths)
         return (_gather_window(x, start, new_len, self.k), new_len), {}
+
+
+class DataNorm(Layer):
+    """Feature normalization from precomputed dataset statistics
+    (reference: gserver/layers/DataNormLayer.cpp). The stats are
+    non-trainable model STATE, set from the dataset before training."""
+
+    def __init__(self, stats, *, mode: str = "z-score",
+                 name: Optional[str] = None):
+        from paddle_tpu.ops import misc as misc_ops
+
+        enforce(bool(stats), "DataNorm needs at least one stats array")
+        self.stats = {k: jnp.asarray(v) for k, v in stats.items()}
+        width = next(iter(self.stats.values())).shape[0]
+        # validate mode/keys eagerly, against the converted arrays
+        misc_ops.data_norm(jnp.zeros((1, width)), self.stats, mode=mode)
+        self.mode = mode
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        if _abstract:
+            return {}, {}, spec
+        return {}, dict(self.stats), spec
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        from paddle_tpu.ops import misc as misc_ops
+
+        return misc_ops.data_norm(x, state, mode=self.mode), state
+
+
+class RowConv(Layer):
+    """Lookahead row convolution (reference: gserver/layers/
+    RowConvLayer.cpp, operators/row_conv_op.cc). Input [B, T, D]
+    (+ optional lengths as a second input)."""
+
+    def __init__(self, context: int, *, name: Optional[str] = None):
+        self.context = context
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, *rest, _abstract: bool = False):
+        if _abstract:
+            return {}, {}, spec
+        d = spec.shape[-1]
+        params = {"weight": initializers.smart_uniform()(
+            rng, (self.context, d))}
+        return params, {}, spec
+
+    def _apply(self, params, state, x, *lengths, training: bool, rng):
+        from paddle_tpu.ops import misc as misc_ops
+
+        lens = lengths[0] if lengths else None
+        return misc_ops.row_conv(x, params["weight"], lens), {}

@@ -19,6 +19,7 @@ from paddle_tpu.ops import flash_attention
 from paddle_tpu.ops import linalg
 from paddle_tpu.ops import losses
 from paddle_tpu.ops import metrics
+from paddle_tpu.ops import misc
 from paddle_tpu.ops import norm
 from paddle_tpu.ops import rnn
 from paddle_tpu.ops import sampling

@@ -185,3 +185,52 @@ def greedy_search(
     any_eos = jnp.any(tokens == eos_id, axis=-1)
     lengths = jnp.minimum(lengths + any_eos.astype(jnp.int32), max_len)
     return tokens, lengths
+
+
+def cross_entropy_over_beam(step_scores, parents, gold_pos):
+    """Globally-normalized cross entropy over beam-search paths
+    (reference: gserver/layers/CrossEntropyOverBeam.cpp + its harness
+    test_CrossEntropyOverBeamGrad.cpp — "beam search optimization":
+    softmax over ALL candidate paths of the final expansion, with the
+    gold path appended as an extra candidate when pruning dropped it).
+
+    Static-shape formulation over E expansion steps with beam width K:
+
+      step_scores: [E, B, K] per-step candidate scores (NEG_INF pads
+        invalid slots);
+      parents:     [E, B, K] int index of each candidate's parent in the
+        previous step's beam (step 0 parents are ignored);
+      gold_pos:    [E, B] int position of the gold candidate in each
+        step's beam, or -1 from the step where gold fell off.
+
+    A path's total score is the sum of its per-step candidate scores up
+    its ancestry chain. Returns per-sequence loss [B] =
+    logsumexp(paths + gold-extra) - gold_path_score.
+    """
+    e, b, k = step_scores.shape
+    barange = jnp.arange(b)
+
+    # final-step paths: accumulate ancestry scores (E is static/small)
+    acc = step_scores[-1]
+    par = parents[-1]
+    for step in range(e - 2, -1, -1):
+        acc = acc + jnp.take_along_axis(step_scores[step], par, axis=1)
+        par = jnp.take_along_axis(parents[step], par, axis=1)
+
+    # gold path score: sum of its per-step scores while it survives
+    in_beam = gold_pos >= 0                                  # [E, B]
+    safe_pos = jnp.maximum(gold_pos, 0)
+    gold_step = step_scores[jnp.arange(e)[:, None], barange[None, :],
+                            safe_pos]                        # [E, B]
+    gold_score = jnp.sum(jnp.where(in_beam, gold_step, 0.0), axis=0)
+
+    survived = in_beam[-1]                                   # [B]
+    # extra path column: the gold total where pruned, else -inf pad
+    extra = jnp.where(survived, NEG_INF, gold_score)[:, None]
+    all_scores = jnp.concatenate([acc, extra], axis=1)       # [B, K+1]
+    gold_idx = jnp.where(survived, safe_pos[-1], k)
+
+    lse = jax.nn.logsumexp(all_scores, axis=1)
+    gold_total = jnp.where(
+        survived, all_scores[barange, gold_idx], gold_score)
+    return lse - gold_total

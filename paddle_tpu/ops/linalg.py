@@ -75,3 +75,42 @@ def conv_shift(x, y):
     idx = (jnp.arange(m)[:, None] + jnp.arange(n)[None, :] - half) % m
     gathered = x[:, idx]                      # [B, M, N]
     return jnp.einsum("bmn,bn->bm", gathered, y)
+
+
+def dot_prod(a, b):
+    """Row-wise dot product (reference: gserver/layers/DotProdLayer.cpp):
+    a, b [B, D] -> [B, 1]."""
+    return jnp.sum(a * b, axis=-1, keepdims=True)
+
+
+def out_prod(a, b):
+    """Row-wise outer product (reference: gserver/layers/OuterProdLayer.cpp):
+    a [B, M], b [B, N] -> [B, M*N]."""
+    return (a[:, :, None] * b[:, None, :]).reshape(a.shape[0], -1)
+
+
+def convex_comb(weights, x):
+    """Per-row convex/linear combination of K vectors (reference:
+    gserver/layers/ConvexCombinationLayer.cpp): weights [B, K],
+    x [B, K*D] -> [B, D] = sum_k weights[b,k] * x[b, k*D:(k+1)*D]."""
+    b, k = weights.shape
+    d = x.shape[1] // k
+    return jnp.einsum("bk,bkd->bd", weights, x.reshape(b, k, d))
+
+
+def selective_fc(x, kernel, bias, selected):
+    """Fully-connected output computed ONLY at selected columns
+    (reference: gserver/layers/SelectiveFullyConnectedLayer.cpp — used
+    when the output width is huge but each sample needs few columns,
+    e.g. candidate scoring).
+
+    x [B, In]; kernel [In, Out]; selected [B, K] int column ids ->
+    [B, K] where out[b, j] = x[b] @ kernel[:, selected[b, j]]
+    (+ bias[selected[b, j]]). The gather moves K*In weights instead of
+    computing the full [B, Out] product.
+    """
+    w_cols = jnp.take(kernel, selected, axis=1)        # [In, B, K]
+    out = jnp.einsum("bi,ibk->bk", x, w_cols)
+    if bias is not None:
+        out = out + jnp.take(bias, selected)
+    return out

@@ -1,0 +1,152 @@
+"""Small structural/elementwise ops closing the reference layer-type
+list (reference: gserver/layers REGISTER_LAYER inventory — power,
+slope_intercept, sum_to_one_norm, switch_order, trans, resize, maxid,
+scale_shift, scale_sub_region, data_norm, row_conv).
+
+Each is a pure function; nn.Mixed / nn.Lambda wrap them where a Layer
+form is wanted. Deliberately out of scope (documented, not stubbed):
+mdlstmemory — a 2-D recurrence scans poorly on TPU and the transformer
+family (models/transformer.py) is the modern replacement for its use
+case; get_output — tapping intermediate activations falls out of the
+functional API for free.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.errors import enforce
+
+
+def power(x, p):
+    """y[b, i] = x[b, i] ** p[b] (reference: gserver/layers/PowerLayer.cpp
+    — per-sample exponent from a side input [B] or [B,1])."""
+    p = p.reshape(p.shape[0], *([1] * (x.ndim - 1)))
+    return jnp.power(x, p)
+
+
+def slope_intercept(x, slope: float = 1.0, intercept: float = 0.0):
+    """y = slope * x + intercept with config constants (reference:
+    gserver/layers/SlopeInterceptLayer.cpp)."""
+    return x * slope + intercept
+
+
+def sum_to_one_norm(x, *, epsilon: float = 1e-12):
+    """Normalize each row to sum to one (reference:
+    gserver/layers/SumToOneNormLayer.cpp)."""
+    s = jnp.sum(x, axis=-1, keepdims=True)
+    return x / jnp.where(jnp.abs(s) < epsilon, 1.0, s)
+
+
+def switch_order(x, perm=(0, 3, 1, 2), reshape=None):
+    """Permute tensor dims, optionally reshaping after (reference:
+    gserver/layers/SwitchOrderLayer.cpp — NHWC<->NCHW bridging)."""
+    y = jnp.transpose(x, perm)
+    if reshape is not None:
+        y = y.reshape(reshape)
+    return y
+
+
+def trans(x):
+    """Matrix transpose of the per-batch trailing dims or a 2-D input
+    (reference: gserver/layers/TransLayer.cpp)."""
+    enforce(x.ndim >= 2, "trans expects >= 2 dims")
+    return jnp.swapaxes(x, -1, -2)
+
+
+def resize(x, size: int):
+    """Reshape rows to width `size`, letting the batch dim absorb the
+    rest (reference: gserver/layers/ResizeLayer.cpp)."""
+    return x.reshape(-1, size)
+
+
+def maxid(x, *, beam: int = 1):
+    """Top-`beam` ids (and values) per row (reference:
+    gserver/layers/MaxIdLayer.cpp — argmax output for prediction)."""
+    vals, ids = jax.lax.top_k(x, beam)
+    return (ids[:, 0], vals[:, 0]) if beam == 1 else (ids, vals)
+
+
+def sampling_id(rng, probs):
+    """Sample one id per row from a probability distribution (reference:
+    gserver/layers/SamplingIdLayer.cpp)."""
+    return jax.random.categorical(rng, jnp.log(jnp.maximum(probs, 1e-30)),
+                                  axis=-1)
+
+
+def scale_shift(x, scale, shift=None):
+    """y = scale * x (+ shift) with LEARNED scalars (reference:
+    gserver/layers/ScaleShiftLayer.cpp — nn.ScaleShift owns the
+    params)."""
+    y = x * scale
+    if shift is not None:
+        y = y + shift
+    return y
+
+
+def scale_sub_region(x, boxes, value: float):
+    """Scale a per-sample sub-region of an NHWC feature map by `value`
+    (reference: gserver/layers/ScaleSubRegionLayer.cpp; its indices are
+    1-based inclusive [cStart,cEnd,hStart,hEnd,wStart,wEnd] per sample).
+
+    x: [N,H,W,C]; boxes: [N, 6] int (same 1-based convention). The
+    dynamic per-sample box becomes three arange masks — jit-safe, no
+    gather/scatter.
+    """
+    n, h, w, c = x.shape
+    b = boxes.astype(jnp.int32)
+    cs, ce = b[:, 0] - 1, b[:, 1] - 1
+    hs, he = b[:, 2] - 1, b[:, 3] - 1
+    ws, we = b[:, 4] - 1, b[:, 5] - 1
+
+    def rng_mask(lo, hi, size):
+        r = jnp.arange(size)
+        return (r[None, :] >= lo[:, None]) & (r[None, :] <= hi[:, None])
+
+    mask = (rng_mask(hs, he, h)[:, :, None, None]
+            & rng_mask(ws, we, w)[:, None, :, None]
+            & rng_mask(cs, ce, c)[:, None, None, :])
+    return jnp.where(mask, x * value, x)
+
+
+def data_norm(x, stats, *, mode: str = "z-score"):
+    """Feature normalization from PRE-COMPUTED dataset statistics
+    (reference: gserver/layers/DataNormLayer.cpp — z-score / min-max /
+    decimal-scaling strategies, stats carried as a non-trainable
+    parameter).
+
+    stats: {"mean","std","min","max","decimal_scale"} arrays [D] (only
+    the keys the chosen mode needs).
+    """
+    if mode == "z-score":
+        return (x - stats["mean"]) / jnp.maximum(stats["std"], 1e-12)
+    if mode == "min-max":
+        span = jnp.maximum(stats["max"] - stats["min"], 1e-12)
+        return (x - stats["min"]) / span
+    if mode == "decimal-scaling":
+        return x / stats["decimal_scale"]
+    raise ValueError(f"unknown data_norm mode: {mode!r}")
+
+
+def row_conv(x, weight, lengths=None):
+    """Lookahead (row) convolution over time (reference:
+    gserver/layers/RowConvLayer.cpp, operators/row_conv_op.cc — the
+    DeepSpeech2 streaming op): y[b,t] = sum_{i<ctx} w[i] * x[b,t+i],
+    future frames beyond the sequence end contribute zero.
+
+    x: [B, T, D]; weight: [ctx, D]; lengths: [B] optional. The ctx-term
+    sum unrolls to shifted adds (ctx is small and static) — one fused
+    elementwise pass, no gather.
+    """
+    bsz, t, d = x.shape
+    ctx = weight.shape[0]
+    if lengths is not None:
+        tmask = jnp.arange(t)[None, :] < lengths[:, None]
+        x = x * tmask[..., None]
+    out = jnp.zeros_like(x)
+    for i in range(ctx):
+        shifted = x[:, i:, :]
+        pad = jnp.zeros((bsz, i, d), x.dtype)
+        out = out + jnp.concatenate([shifted, pad], axis=1) * weight[i]
+    return out

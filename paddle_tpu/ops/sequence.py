@@ -348,3 +348,22 @@ def sequence_conv(x, lengths, filt, *, context_len: int,
                              context_start=context_start,
                              padding_weights=padding_weights)
     return linalg.dense(ctx, filt, bias)
+
+
+def kmax_seq_score(scores, lengths, k: int):
+    """Top-k score POSITIONS per padded sequence (reference:
+    gserver/layers/KmaxSeqScoreLayer.cpp — beam pruning for seq scoring).
+
+    scores: [B, T]; lengths: [B]. Returns int32 [B, k] positions sorted
+    by descending score; padding positions can never win (masked to
+    -inf). Positions past a sequence's length when len < k are filled
+    with the best valid position (reference pads with 0).
+    """
+    t = scores.shape[1]
+    valid = jnp.arange(t)[None, :] < lengths[:, None]
+    masked = jnp.where(valid, scores, -jnp.inf)
+    _, ids = jax.lax.top_k(masked, k)
+    # where a sequence has < k valid entries, repeat its argmax
+    have = jnp.minimum(lengths, k)[:, None]
+    best = ids[:, :1]
+    return jnp.where(jnp.arange(k)[None, :] < have, ids, best)

@@ -359,3 +359,179 @@ class TestPoolWithIndex:
         assert int(pooled[0, 0, 0, 0]) == 5
         np.testing.assert_array_equal(
             np.asarray(pooled), np.asarray(C.max_pool2d(x, 2)))
+
+
+class TestMiscLayerOps:
+    """The remaining small layer types from the reference REGISTER_LAYER
+    inventory (gserver/layers): power, sum_to_one, switch_order, trans,
+    resize, maxid, sampling_id, scale_sub_region, data_norm, row_conv,
+    dot_prod, out_prod, convex_comb, selective_fc, kmax_seq_score."""
+
+    def test_dot_out_prod(self, np_rng):
+        from paddle_tpu.ops import linalg as L2
+
+        a = jnp.asarray(np_rng.randn(4, 5), jnp.float32)
+        b = jnp.asarray(np_rng.randn(4, 3), jnp.float32)
+        d = L2.dot_prod(a, a)
+        np.testing.assert_allclose(d[:, 0], jnp.sum(a * a, -1), rtol=1e-6)
+        o = L2.out_prod(a, b)
+        assert o.shape == (4, 15)
+        np.testing.assert_allclose(o[1].reshape(5, 3),
+                                   np.outer(a[1], b[1]), rtol=1e-6)
+
+    def test_convex_comb(self, np_rng):
+        from paddle_tpu.ops import linalg as L2
+
+        w = jnp.asarray(np_rng.rand(2, 3), jnp.float32)
+        x = jnp.asarray(np_rng.randn(2, 12), jnp.float32)
+        y = L2.convex_comb(w, x)
+        manual = sum(w[:, k:k + 1] * x[:, 4 * k:4 * (k + 1)]
+                     for k in range(3))
+        np.testing.assert_allclose(y, manual, rtol=1e-5)
+
+    def test_selective_fc(self, np_rng):
+        from paddle_tpu.ops import linalg as L2
+
+        x = jnp.asarray(np_rng.randn(3, 6), jnp.float32)
+        k = jnp.asarray(np_rng.randn(6, 20), jnp.float32)
+        b = jnp.asarray(np_rng.randn(20), jnp.float32)
+        sel = jnp.asarray([[0, 5, 19], [1, 1, 2], [7, 3, 11]])
+        out = L2.selective_fc(x, k, b, sel)
+        full = x @ k + b
+        for i in range(3):
+            np.testing.assert_allclose(
+                out[i], full[i, np.asarray(sel)[i]], rtol=1e-5)
+
+    def test_power_slope_sum_norm(self, np_rng):
+        from paddle_tpu.ops import misc as M2
+
+        x = jnp.asarray(np_rng.rand(3, 4) + 0.5, jnp.float32)
+        p = jnp.asarray([1.0, 2.0, 0.5])
+        np.testing.assert_allclose(M2.power(x, p)[1], np.asarray(x)[1] ** 2,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(M2.slope_intercept(x, 2.0, 1.0),
+                                   np.asarray(x) * 2 + 1, rtol=1e-6)
+        s = M2.sum_to_one_norm(x)
+        np.testing.assert_allclose(jnp.sum(s, -1), np.ones(3), rtol=1e-5)
+
+    def test_switch_trans_resize_maxid(self, np_rng):
+        from paddle_tpu.ops import misc as M2
+
+        x = jnp.asarray(np_rng.randn(2, 4, 5, 3), jnp.float32)
+        assert M2.switch_order(x).shape == (2, 3, 4, 5)
+        m = jnp.asarray(np_rng.randn(3, 7), jnp.float32)
+        np.testing.assert_array_equal(M2.trans(m), np.asarray(m).T)
+        assert M2.resize(m, 21).shape == (1, 21)
+        ids, vals = M2.maxid(m)
+        np.testing.assert_array_equal(ids, np.argmax(np.asarray(m), -1))
+
+    def test_sampling_id_distribution(self):
+        from paddle_tpu.ops import misc as M2
+
+        probs = jnp.asarray([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+        ids = M2.sampling_id(jax.random.key(0), probs)
+        np.testing.assert_array_equal(ids, [1, 0])
+
+    def test_scale_sub_region(self):
+        from paddle_tpu.ops import misc as M2
+
+        x = jnp.ones((1, 4, 4, 2))
+        boxes = jnp.asarray([[1, 1, 2, 3, 2, 4]])  # c=1, h=2..3, w=2..4
+        y = M2.scale_sub_region(x, boxes, 10.0)
+        assert float(y[0, 1, 1, 0]) == 10.0
+        assert float(y[0, 1, 1, 1]) == 1.0  # channel 2 untouched
+        assert float(y[0, 0, 1, 0]) == 1.0  # row before region untouched
+        assert float(jnp.sum(y)) == 32 - 6 + 60  # 6 cells scaled
+
+    def test_data_norm_modes(self, np_rng):
+        from paddle_tpu.ops import misc as M2
+
+        x = jnp.asarray(np_rng.randn(16, 3) * 4 + 2, jnp.float32)
+        stats = {"mean": jnp.mean(x, 0), "std": jnp.std(x, 0),
+                 "min": jnp.min(x, 0), "max": jnp.max(x, 0),
+                 "decimal_scale": jnp.asarray([10.0, 10.0, 10.0])}
+        z = M2.data_norm(x, stats)
+        np.testing.assert_allclose(jnp.mean(z, 0), np.zeros(3), atol=1e-5)
+        mm = M2.data_norm(x, stats, mode="min-max")
+        assert float(jnp.min(mm)) >= 0 and float(jnp.max(mm)) <= 1
+
+    def test_row_conv_lookahead(self):
+        from paddle_tpu.ops import misc as M2
+
+        x = jnp.asarray(np.arange(12, dtype=np.float32).reshape(1, 6, 2))
+        w = jnp.asarray([[1.0, 1.0], [1.0, 1.0]])  # ctx 2, sum of 2 frames
+        y = M2.row_conv(x, w)
+        np.testing.assert_allclose(y[0, 0], x[0, 0] + x[0, 1])
+        np.testing.assert_allclose(y[0, 5], x[0, 5])  # last: no lookahead
+        # grad check
+        directional_grad_check(
+            lambda p: jnp.sum(jnp.square(M2.row_conv(x, p["w"]))), {"w": w})
+
+    def test_kmax_seq_score(self):
+        from paddle_tpu.ops import sequence as S2
+
+        scores = jnp.asarray([[0.1, 0.9, 0.5, 0.7],
+                              [0.8, 0.2, 0.0, 0.0]])
+        lengths = jnp.asarray([4, 2])
+        ids = S2.kmax_seq_score(scores, lengths, 3)
+        np.testing.assert_array_equal(ids[0], [1, 3, 2])
+        # seq 1 has only 2 valid: third slot repeats the argmax
+        np.testing.assert_array_equal(ids[1], [0, 1, 0])
+
+    def test_data_norm_layer_and_row_conv_layer(self, np_rng):
+        from paddle_tpu import nn
+
+        x = jnp.asarray(np_rng.randn(8, 3), jnp.float32)
+        layer = nn.DataNorm({"mean": np.zeros(3), "std": np.ones(3)})
+        params, state = layer.init(jax.random.key(0), ShapeSpec((8, 3)))
+        y, _ = layer.apply(params, state, x, training=False)
+        np.testing.assert_allclose(y, x, rtol=1e-6)
+
+        seq = jnp.asarray(np_rng.randn(2, 5, 4), jnp.float32)
+        rc = nn.RowConv(3)
+        params, state = rc.init(jax.random.key(1), ShapeSpec((2, 5, 4)))
+        y, _ = rc.apply(params, state, seq, training=False)
+        assert y.shape == (2, 5, 4)
+
+
+class TestCrossEntropyOverBeam:
+    """Globally-normalized beam CE (reference:
+    gserver/tests/test_CrossEntropyOverBeamGrad.cpp)."""
+
+    def _data(self):
+        from paddle_tpu.ops.beam_search import NEG_INF
+
+        # E=2 steps, B=2 sequences, K=2 beam
+        step_scores = jnp.asarray([
+            [[1.0, 0.5], [0.4, 0.6]],
+            [[0.2, 0.3], [0.1, 0.7]],
+        ], jnp.float32)
+        parents = jnp.asarray([
+            [[0, 0], [0, 0]],
+            [[0, 1], [1, 0]],
+        ], jnp.int32)
+        # seq0: gold survives at pos 0; seq1: gold (pos 1) pruned at step 1
+        gold_pos = jnp.asarray([[0, 1], [0, -1]], jnp.int32)
+        return step_scores, parents, gold_pos
+
+    def test_matches_manual(self):
+        from paddle_tpu.ops import beam_search as BS
+
+        step_scores, parents, gold_pos = self._data()
+        loss = BS.cross_entropy_over_beam(step_scores, parents, gold_pos)
+        # seq0 paths: p0 = 0.2 + s0[0] = 1.2 ; p1 = 0.3 + s0[1] = 0.8
+        # gold = path 0
+        l0 = np.log(np.exp(1.2) + np.exp(0.8)) - 1.2
+        # seq1 paths: p0 = 0.1 + s0[1] = 0.7 ; p1 = 0.7 + s0[0] = 1.1
+        # gold pruned -> extra path with score s0[1] = 0.6
+        l1 = np.log(np.exp(0.7) + np.exp(1.1) + np.exp(0.6)) - 0.6
+        np.testing.assert_allclose(loss, [l0, l1], rtol=1e-5)
+
+    def test_grad(self):
+        from paddle_tpu.ops import beam_search as BS
+
+        step_scores, parents, gold_pos = self._data()
+        directional_grad_check(
+            lambda p: jnp.sum(BS.cross_entropy_over_beam(
+                p["s"], parents, gold_pos)),
+            {"s": step_scores})
