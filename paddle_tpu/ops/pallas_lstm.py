@@ -1,0 +1,231 @@
+"""Fused LSTM time loop as a single Pallas TPU kernel.
+
+Why: the XLA `lax.scan` LSTM round-trips the (h, c) carry and the gate
+tensors through HBM every step and pays while-loop overhead per
+iteration — the round-1 chip trace showed ~97 us/step where the
+recurrence FLOPs justify ~0.1 us (benchmarks/results_v5e1.md lstm rows,
+the reference's published RNN benchmark, benchmark/paddle/rnn/run.sh).
+This kernel runs the WHOLE time loop in one pallas_call: W_hh stays
+resident in VMEM, (h, c) live in VMEM scratch across grid steps (the
+TPU grid is sequential), and only x_proj / hs / cs stream from/to HBM.
+
+Backward is a second time-reversed kernel using the same residency
+trick: it recomputes the gates from the saved (h, c) streams (cheap —
+one small matmul) and accumulates dW_hh in VMEM, using its own output
+refs as the carry accumulators.
+
+Shapes: x_proj [T, B, 4H] (the hoisted input projection — see
+ops.rnn.lstm), w_hh [H, 4H], h0/c0 [B, H]. Gate order i, f, g, o
+(matches ops.rnn.lstm_step_from_proj). Sized for VMEM (see fits_vmem):
+h=512 fits at B<=64, h=256 at B<=256; the auto path falls back to the
+scan for bigger shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # same guard as ops.flash_attention
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pl = None
+    pltpu = None
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def _fwd_kernel(xp_ref, whh_ref, h0_ref, c0_ref, hs_ref, cs_ref,
+                h_scr, c_scr, *, hidden: int):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[...] = h0_ref[...].astype(jnp.float32)
+        c_scr[...] = c0_ref[...].astype(jnp.float32)
+
+    h = h_scr[...]
+    gates = xp_ref[0].astype(jnp.float32) + lax.dot(
+        h.astype(whh_ref.dtype), whh_ref[...],
+        preferred_element_type=jnp.float32)
+    i = _sigmoid(gates[:, :hidden])
+    f = _sigmoid(gates[:, hidden:2 * hidden])
+    g = jnp.tanh(gates[:, 2 * hidden:3 * hidden])
+    o = _sigmoid(gates[:, 3 * hidden:])
+    c = f * c_scr[...] + i * g
+    nh = o * jnp.tanh(c)
+    h_scr[...] = nh
+    c_scr[...] = c
+    hs_ref[0] = nh.astype(hs_ref.dtype)
+    cs_ref[0] = c
+
+
+def _bwd_kernel(xp_ref, whh_ref, whht_ref, hsp_ref, csp_ref, cs_ref,
+                dhs_ref, h0_ref, c0_ref, dhL_ref, dcL_ref,
+                dxp_ref, dwhh_ref, dh0_ref, dc0_ref, *,
+                hidden: int, steps: int):
+    r = pl.program_id(0)  # r-th reversed step; original t = steps-1-r
+
+    @pl.when(r == 0)
+    def _():
+        # the output refs double as the reverse-time carry accumulators
+        dh0_ref[...] = dhL_ref[...].astype(jnp.float32)
+        dc0_ref[...] = dcL_ref[...].astype(jnp.float32)
+        dwhh_ref[...] = jnp.zeros_like(dwhh_ref)
+
+    # hsp/csp blocks are hs/cs at t-1 (index map clamps t-1 to 0, so at
+    # the first original step the loaded block is garbage and the
+    # initial state is selected instead)
+    at_t0 = r == steps - 1
+    hprev = jnp.where(at_t0, h0_ref[...].astype(jnp.float32),
+                      hsp_ref[0].astype(jnp.float32))
+    cprev = jnp.where(at_t0, c0_ref[...].astype(jnp.float32), csp_ref[0])
+    ct = cs_ref[0]
+    gates = xp_ref[0].astype(jnp.float32) + lax.dot(
+        hprev.astype(whh_ref.dtype), whh_ref[...],
+        preferred_element_type=jnp.float32)
+    i = _sigmoid(gates[:, :hidden])
+    f = _sigmoid(gates[:, hidden:2 * hidden])
+    g = jnp.tanh(gates[:, 2 * hidden:3 * hidden])
+    o = _sigmoid(gates[:, 3 * hidden:])
+    tanh_c = jnp.tanh(ct)
+
+    dh = dhs_ref[0].astype(jnp.float32) + dh0_ref[...]
+    do = dh * tanh_c * o * (1.0 - o)
+    dc = dc0_ref[...] + dh * o * (1.0 - tanh_c * tanh_c)
+    di = dc * g * i * (1.0 - i)
+    df = dc * cprev * f * (1.0 - f)
+    dg = dc * i * (1.0 - g * g)
+    dgates = jnp.concatenate([di, df, dg, do], axis=-1)  # [B, 4H] f32
+
+    dxp_ref[0] = dgates.astype(dxp_ref.dtype)
+    dgates_c = dgates.astype(whht_ref.dtype)
+    dh0_ref[...] = lax.dot(dgates_c, whht_ref[...],
+                           preferred_element_type=jnp.float32)
+    dc0_ref[...] = dc * f
+    # dW_hh += hprev^T @ dgates (contract the batch dim)
+    dwhh_ref[...] += lax.dot_general(
+        hprev.astype(whh_ref.dtype), dgates_c,
+        (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def _specs(block, index_map, interpret):
+    kwargs = {} if (pltpu is None or interpret) else dict(
+        memory_space=pltpu.VMEM)
+    return pl.BlockSpec(block, index_map, **kwargs)
+
+
+def _fwd(x_proj, w_hh, h0, c0, interpret):
+    t, b, g4 = x_proj.shape
+    h = g4 // 4
+    grid = (t,)
+    hs, cs = pl.pallas_call(
+        functools.partial(_fwd_kernel, hidden=h),
+        grid=grid,
+        in_specs=[
+            _specs((1, b, g4), lambda i: (i, 0, 0), interpret),
+            _specs((h, g4), lambda i: (0, 0), interpret),
+            _specs((b, h), lambda i: (0, 0), interpret),
+            _specs((b, h), lambda i: (0, 0), interpret),
+        ],
+        out_specs=[
+            _specs((1, b, h), lambda i: (i, 0, 0), interpret),
+            _specs((1, b, h), lambda i: (i, 0, 0), interpret),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, h), x_proj.dtype),
+            jax.ShapeDtypeStruct((t, b, h), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, h), jnp.float32),
+            pltpu.VMEM((b, h), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x_proj, w_hh, h0, c0)
+    return hs, cs
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def fused_lstm(x_proj, w_hh, h0, c0):
+    """Fused scan: returns (hs [T,B,H], h_last [B,H], c_last [B,H])."""
+    interpret = jax.default_backend() != "tpu"
+    hs, cs = _fwd(x_proj, w_hh, h0, c0, interpret)
+    return hs, hs[-1], cs[-1].astype(c0.dtype)
+
+
+def _fused_fwd(x_proj, w_hh, h0, c0):
+    interpret = jax.default_backend() != "tpu"
+    hs, cs = _fwd(x_proj, w_hh, h0, c0, interpret)
+    return ((hs, hs[-1], cs[-1].astype(c0.dtype)),
+            (x_proj, w_hh, h0, c0, hs, cs))
+
+
+def _fused_bwd(res, cts):
+    x_proj, w_hh, h0, c0, hs, cs = res
+    dhs, dh_last, dc_last = cts
+    interpret = jax.default_backend() != "tpu"
+    t, b, g4 = x_proj.shape
+    h = g4 // 4
+    f32 = jnp.float32
+    w_hh_t = w_hh.T
+
+    rev = lambda i: (t - 1 - i, 0, 0)
+    # the SAME hs/cs arrays shifted one step back — no concat copies;
+    # the t-1 index clamps to 0 at the first original step, where the
+    # kernel selects h0/c0 instead (see _bwd_kernel)
+    rev_prev = lambda i: (jnp.maximum(t - 2 - i, 0), 0, 0)
+    const2 = lambda i: (0, 0)
+    dxp, dwhh, dh0, dc0 = pl.pallas_call(
+        functools.partial(_bwd_kernel, hidden=h, steps=t),
+        grid=(t,),
+        in_specs=[
+            _specs((1, b, g4), rev, interpret),          # x_proj
+            _specs((h, g4), const2, interpret),          # w_hh
+            _specs((g4, h), const2, interpret),          # w_hh^T
+            _specs((1, b, h), rev_prev, interpret),      # hs at t-1
+            _specs((1, b, h), rev_prev, interpret),      # cs at t-1
+            _specs((1, b, h), rev, interpret),           # cs
+            _specs((1, b, h), rev, interpret),           # dhs
+            _specs((b, h), const2, interpret),           # h0
+            _specs((b, h), const2, interpret),           # c0
+            _specs((b, h), const2, interpret),           # dh_last
+            _specs((b, h), const2, interpret),           # dc_last
+        ],
+        out_specs=[
+            _specs((1, b, g4), rev, interpret),
+            _specs((h, g4), const2, interpret),
+            _specs((b, h), const2, interpret),
+            _specs((b, h), const2, interpret),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, b, g4), x_proj.dtype),
+            jax.ShapeDtypeStruct((h, g4), f32),
+            jax.ShapeDtypeStruct((b, h), f32),
+            jax.ShapeDtypeStruct((b, h), f32),
+        ],
+        interpret=interpret,
+    )(x_proj, w_hh, w_hh_t, hs, cs, cs, dhs, h0, c0,
+      jnp.asarray(dh_last), jnp.asarray(dc_last))
+    return (dxp, dwhh.astype(w_hh.dtype), dh0.astype(h0.dtype),
+            dc0.astype(c0.dtype))
+
+
+fused_lstm.defvjp(_fused_fwd, _fused_bwd)
+
+
+def fits_vmem(b: int, hidden: int) -> bool:
+    """Conservative residency check for the WORST pass (backward):
+    W_hh (bf16) + W_hh^T (bf16) + dW accumulator (f32) stay resident,
+    plus a handful of [B,4H] f32 gate tiles and [B,H] f32 carries,
+    against a ~12 MB budget of the ~16 MB VMEM. h=512 fits at B<=64;
+    h=256 at B<=256."""
+    whh_bytes = hidden * 4 * hidden * (2 + 2 + 4)
+    tiles = 4 * (b * 4 * hidden) * 4 + 8 * (b * hidden) * 4
+    return whh_bytes + tiles < 12 * 1024 * 1024

@@ -122,11 +122,17 @@ def _masked_scan(step_fn, init_state, xs, mask, reverse: bool, unroll: int = 1):
 
 
 def lstm(params, x, lengths=None, *, initial_state: Optional[LSTMState] = None,
-         reverse: bool = False, unroll: int = 1):
+         reverse: bool = False, unroll: int = 1, impl: str = "auto"):
     """Run an LSTM over [B, T, F]; returns (outputs [B,T,H], final LSTMState).
 
     reverse=True scans right-to-left (for bidirectional stacks) while still
     respecting per-sequence lengths via masking.
+
+    impl: "auto" uses the fused Pallas time-loop kernel
+    (ops.pallas_lstm — W_hh and the carries stay VMEM-resident across
+    steps instead of round-tripping HBM per step) on TPU when the shape
+    fits and there is no length masking; "pallas" forces it (interpret
+    mode off-TPU, for tests); "xla" forces the lax.scan.
     """
     b, t, _ = x.shape
     hdim = params["w_hh"].shape[0]
@@ -146,6 +152,34 @@ def lstm(params, x, lengths=None, *, initial_state: Optional[LSTMState] = None,
     # MXU at full tilt; the scan then only carries the h@W_hh recurrence
     x_proj = linalg.matmul(x, params["w_ih"]) + params["b"]  # [B, T, 4H]
     xs = jnp.swapaxes(x_proj, 0, 1)  # [T, B, 4H]
+
+    from paddle_tpu.core.errors import enforce
+    from paddle_tpu.ops import pallas_lstm as PL
+
+    enforce(impl in ("auto", "pallas", "xla"),
+            f"lstm impl must be auto|pallas|xla, got {impl!r}")
+    if impl == "pallas":  # forced: fail loudly rather than fall back
+        enforce(PL.pl is not None,
+                "impl='pallas' but Pallas is unavailable in this jax build")
+        enforce(lengths is None,
+                "the fused Pallas lstm does not support length masking")
+        enforce(PL.fits_vmem(b, hdim),
+                f"lstm shape B={b} H={hdim} exceeds the fused kernel's "
+                "VMEM budget")
+        use_fused = True
+    else:
+        use_fused = (
+            impl == "auto" and lengths is None and PL.pl is not None
+            and PL.fits_vmem(b, hdim)
+            and jax.default_backend() == "tpu")
+    if use_fused:
+        xs_f = jnp.flip(xs, axis=0) if reverse else xs
+        hs, h_last, c_last = PL.fused_lstm(
+            xs_f, params["w_hh"], initial_state.h, initial_state.c)
+        if reverse:
+            hs = jnp.flip(hs, axis=0)
+        return jnp.swapaxes(hs, 0, 1), LSTMState(h_last, c_last)
+
     ms = jnp.swapaxes(mask, 0, 1)
 
     def step(state, xp_t):
