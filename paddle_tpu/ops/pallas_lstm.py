@@ -9,16 +9,23 @@ This kernel runs the WHOLE time loop in one pallas_call: W_hh stays
 resident in VMEM, (h, c) live in VMEM scratch across grid steps (the
 TPU grid is sequential), and only x_proj / hs / cs stream from/to HBM.
 
+Variable-length batches are handled in-kernel: a per-row [start, end)
+step window (the runner derives it from `lengths`, reversed scans get
+[T-len, T)) selects carry-through semantics exactly like the runner's
+masked scan, so the fused path serves the ragged batches real models
+feed it.
+
 Backward is a second time-reversed kernel using the same residency
 trick: it recomputes the gates from the saved (h, c) streams (cheap —
 one small matmul) and accumulates dW_hh in VMEM, using its own output
-refs as the carry accumulators.
+refs as the carry accumulators; the t-1 streams arrive via clamped
+index maps (no shifted copies).
 
 Shapes: x_proj [T, B, 4H] (the hoisted input projection — see
-ops.rnn.lstm), w_hh [H, 4H], h0/c0 [B, H]. Gate order i, f, g, o
-(matches ops.rnn.lstm_step_from_proj). Sized for VMEM (see fits_vmem):
-h=512 fits at B<=64, h=256 at B<=256; the auto path falls back to the
-scan for bigger shapes.
+ops.rnn.lstm), w_hh [H, 4H], h0/c0 [B, H], bounds [B, 2] i32. Gate
+order i, f, g, o (matches ops.rnn.lstm_step_from_proj). Sized for VMEM
+(see fits_vmem): h=512 fits at B<=64, h=256 at B<=256; the auto path
+falls back to the scan for bigger shapes.
 """
 
 from __future__ import annotations
@@ -41,8 +48,15 @@ def _sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
-def _fwd_kernel(xp_ref, whh_ref, h0_ref, c0_ref, hs_ref, cs_ref,
-                h_scr, c_scr, *, hidden: int):
+def _step_mask(bounds_ref, t):
+    """[B, 1] bool: is step t inside this row's [start, end) window."""
+    start = bounds_ref[:, :1]
+    end = bounds_ref[:, 1:2]
+    return (start <= t) & (t < end)
+
+
+def _fwd_kernel(xp_ref, whh_ref, h0_ref, c0_ref, bounds_ref,
+                hs_ref, cs_ref, h_scr, c_scr, *, hidden: int):
     t = pl.program_id(0)
 
     @pl.when(t == 0)
@@ -60,6 +74,9 @@ def _fwd_kernel(xp_ref, whh_ref, h0_ref, c0_ref, hs_ref, cs_ref,
     o = _sigmoid(gates[:, 3 * hidden:])
     c = f * c_scr[...] + i * g
     nh = o * jnp.tanh(c)
+    m = _step_mask(bounds_ref, t)
+    nh = jnp.where(m, nh, h)            # masked steps carry through
+    c = jnp.where(m, c, c_scr[...])
     h_scr[...] = nh
     c_scr[...] = c
     hs_ref[0] = nh.astype(hs_ref.dtype)
@@ -67,10 +84,11 @@ def _fwd_kernel(xp_ref, whh_ref, h0_ref, c0_ref, hs_ref, cs_ref,
 
 
 def _bwd_kernel(xp_ref, whh_ref, whht_ref, hsp_ref, csp_ref, cs_ref,
-                dhs_ref, h0_ref, c0_ref, dhL_ref, dcL_ref,
+                dhs_ref, h0_ref, c0_ref, bounds_ref, dhL_ref, dcL_ref,
                 dxp_ref, dwhh_ref, dh0_ref, dc0_ref, *,
                 hidden: int, steps: int):
     r = pl.program_id(0)  # r-th reversed step; original t = steps-1-r
+    t = steps - 1 - r
 
     @pl.when(r == 0)
     def _():
@@ -103,12 +121,16 @@ def _bwd_kernel(xp_ref, whh_ref, whht_ref, hsp_ref, csp_ref, cs_ref,
     df = dc * cprev * f * (1.0 - f)
     dg = dc * i * (1.0 - g * g)
     dgates = jnp.concatenate([di, df, dg, do], axis=-1)  # [B, 4H] f32
+    m = _step_mask(bounds_ref, t)
+    dgates = jnp.where(m, dgates, 0.0)
 
     dxp_ref[0] = dgates.astype(dxp_ref.dtype)
     dgates_c = dgates.astype(whht_ref.dtype)
-    dh0_ref[...] = lax.dot(dgates_c, whht_ref[...],
-                           preferred_element_type=jnp.float32)
-    dc0_ref[...] = dc * f
+    # masked steps are identity: the whole cotangent passes through
+    dh_back = lax.dot(dgates_c, whht_ref[...],
+                      preferred_element_type=jnp.float32)
+    dh0_ref[...] = jnp.where(m, dh_back, dh)
+    dc0_ref[...] = jnp.where(m, dc * f, dc0_ref[...])
     # dW_hh += hprev^T @ dgates (contract the batch dim)
     dwhh_ref[...] += lax.dot_general(
         hprev.astype(whh_ref.dtype), dgates_c,
@@ -122,18 +144,18 @@ def _specs(block, index_map, interpret):
     return pl.BlockSpec(block, index_map, **kwargs)
 
 
-def _fwd(x_proj, w_hh, h0, c0, interpret):
+def _fwd(x_proj, w_hh, h0, c0, bounds, interpret):
     t, b, g4 = x_proj.shape
     h = g4 // 4
-    grid = (t,)
     hs, cs = pl.pallas_call(
         functools.partial(_fwd_kernel, hidden=h),
-        grid=grid,
+        grid=(t,),
         in_specs=[
             _specs((1, b, g4), lambda i: (i, 0, 0), interpret),
             _specs((h, g4), lambda i: (0, 0), interpret),
             _specs((b, h), lambda i: (0, 0), interpret),
             _specs((b, h), lambda i: (0, 0), interpret),
+            _specs((b, 2), lambda i: (0, 0), interpret),
         ],
         out_specs=[
             _specs((1, b, h), lambda i: (i, 0, 0), interpret),
@@ -148,27 +170,27 @@ def _fwd(x_proj, w_hh, h0, c0, interpret):
             pltpu.VMEM((b, h), jnp.float32),
         ],
         interpret=interpret,
-    )(x_proj, w_hh, h0, c0)
+    )(x_proj, w_hh, h0, c0, bounds)
     return hs, cs
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=())
-def fused_lstm(x_proj, w_hh, h0, c0):
+@jax.custom_vjp
+def fused_lstm(x_proj, w_hh, h0, c0, bounds):
     """Fused scan: returns (hs [T,B,H], h_last [B,H], c_last [B,H])."""
     interpret = jax.default_backend() != "tpu"
-    hs, cs = _fwd(x_proj, w_hh, h0, c0, interpret)
+    hs, cs = _fwd(x_proj, w_hh, h0, c0, bounds, interpret)
     return hs, hs[-1], cs[-1].astype(c0.dtype)
 
 
-def _fused_fwd(x_proj, w_hh, h0, c0):
+def _fused_fwd(x_proj, w_hh, h0, c0, bounds):
     interpret = jax.default_backend() != "tpu"
-    hs, cs = _fwd(x_proj, w_hh, h0, c0, interpret)
+    hs, cs = _fwd(x_proj, w_hh, h0, c0, bounds, interpret)
     return ((hs, hs[-1], cs[-1].astype(c0.dtype)),
-            (x_proj, w_hh, h0, c0, hs, cs))
+            (x_proj, w_hh, h0, c0, bounds, hs, cs))
 
 
 def _fused_bwd(res, cts):
-    x_proj, w_hh, h0, c0, hs, cs = res
+    x_proj, w_hh, h0, c0, bounds, hs, cs = res
     dhs, dh_last, dc_last = cts
     interpret = jax.default_backend() != "tpu"
     t, b, g4 = x_proj.shape
@@ -195,6 +217,7 @@ def _fused_bwd(res, cts):
             _specs((1, b, h), rev, interpret),           # dhs
             _specs((b, h), const2, interpret),           # h0
             _specs((b, h), const2, interpret),           # c0
+            _specs((b, 2), const2, interpret),           # bounds
             _specs((b, h), const2, interpret),           # dh_last
             _specs((b, h), const2, interpret),           # dc_last
         ],
@@ -211,13 +234,26 @@ def _fused_bwd(res, cts):
             jax.ShapeDtypeStruct((b, h), f32),
         ],
         interpret=interpret,
-    )(x_proj, w_hh, w_hh_t, hs, cs, cs, dhs, h0, c0,
+    )(x_proj, w_hh, w_hh_t, hs, cs, cs, dhs, h0, c0, bounds,
       jnp.asarray(dh_last), jnp.asarray(dc_last))
     return (dxp, dwhh.astype(w_hh.dtype), dh0.astype(h0.dtype),
-            dc0.astype(c0.dtype))
+            dc0.astype(c0.dtype), None)
 
 
 fused_lstm.defvjp(_fused_fwd, _fused_bwd)
+
+
+def make_bounds(b: int, t: int, lengths, reverse: bool):
+    """Per-row [start, end) step window: forward sequences occupy
+    [0, len); time-flipped ones occupy [T-len, T)."""
+    if lengths is None:
+        lo = jnp.zeros((b, 1), jnp.int32)
+        hi = jnp.full((b, 1), t, jnp.int32)
+    else:
+        ln = lengths.astype(jnp.int32)[:, None]
+        lo = (t - ln) if reverse else jnp.zeros((b, 1), jnp.int32)
+        hi = jnp.full((b, 1), t, jnp.int32) if reverse else ln
+    return jnp.concatenate([lo, hi], axis=1)
 
 
 def fits_vmem(b: int, hidden: int) -> bool:

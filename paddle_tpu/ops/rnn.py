@@ -100,6 +100,26 @@ def _carry_dtype():
     return jnp.promote_types(default_policy().accum_dtype, jnp.float32)
 
 
+def _use_fused_kernel(impl: str, name: str, mod, b: int, hdim: int) -> bool:
+    """Shared impl dispatch for lstm()/gru(): 'pallas' forces the fused
+    kernel and fails loudly when it can't apply; 'auto' takes it on TPU
+    when the shape fits the kernel's VMEM budget; 'xla' keeps the scan."""
+    from paddle_tpu.core.errors import enforce
+
+    enforce(impl in ("auto", "pallas", "xla"),
+            f"{name} impl must be auto|pallas|xla, got {impl!r}")
+    if impl == "pallas":
+        enforce(mod.pl is not None,
+                "impl='pallas' but Pallas is unavailable in this jax build")
+        enforce(mod.fits_vmem(b, hdim),
+                f"{name} shape B={b} H={hdim} exceeds the fused kernel's "
+                "VMEM budget")
+        return True
+    return (impl == "auto" and mod.pl is not None
+            and mod.fits_vmem(b, hdim)
+            and jax.default_backend() == "tpu")
+
+
 def _masked_scan(step_fn, init_state, xs, mask, reverse: bool, unroll: int = 1):
     """Scan over time with per-step carry masking for ragged batches."""
 
@@ -153,32 +173,19 @@ def lstm(params, x, lengths=None, *, initial_state: Optional[LSTMState] = None,
     x_proj = linalg.matmul(x, params["w_ih"]) + params["b"]  # [B, T, 4H]
     xs = jnp.swapaxes(x_proj, 0, 1)  # [T, B, 4H]
 
-    from paddle_tpu.core.errors import enforce
     from paddle_tpu.ops import pallas_lstm as PL
 
-    enforce(impl in ("auto", "pallas", "xla"),
-            f"lstm impl must be auto|pallas|xla, got {impl!r}")
-    if impl == "pallas":  # forced: fail loudly rather than fall back
-        enforce(PL.pl is not None,
-                "impl='pallas' but Pallas is unavailable in this jax build")
-        enforce(lengths is None,
-                "the fused Pallas lstm does not support length masking")
-        enforce(PL.fits_vmem(b, hdim),
-                f"lstm shape B={b} H={hdim} exceeds the fused kernel's "
-                "VMEM budget")
-        use_fused = True
-    else:
-        use_fused = (
-            impl == "auto" and lengths is None and PL.pl is not None
-            and PL.fits_vmem(b, hdim)
-            and jax.default_backend() == "tpu")
-    if use_fused:
+    if _use_fused_kernel(impl, "lstm", PL, b, hdim):
         xs_f = jnp.flip(xs, axis=0) if reverse else xs
+        bounds = PL.make_bounds(b, t, lengths, reverse)
         hs, h_last, c_last = PL.fused_lstm(
-            xs_f, params["w_hh"], initial_state.h, initial_state.c)
+            xs_f, params["w_hh"], initial_state.h, initial_state.c, bounds)
         if reverse:
             hs = jnp.flip(hs, axis=0)
-        return jnp.swapaxes(hs, 0, 1), LSTMState(h_last, c_last)
+        outputs = jnp.swapaxes(hs, 0, 1)
+        if lengths is not None:
+            outputs = outputs * mask[..., None].astype(outputs.dtype)
+        return outputs, LSTMState(h_last, c_last)
 
     ms = jnp.swapaxes(mask, 0, 1)
 
@@ -193,8 +200,11 @@ def lstm(params, x, lengths=None, *, initial_state: Optional[LSTMState] = None,
 
 
 def gru(params, x, lengths=None, *, initial_state=None, reverse: bool = False,
-        unroll: int = 1):
-    """Run a GRU over [B, T, F]; returns (outputs [B,T,H], final h)."""
+        unroll: int = 1, impl: str = "auto"):
+    """Run a GRU over [B, T, F]; returns (outputs [B,T,H], final h).
+
+    impl: as ops.rnn.lstm — "auto" takes the fused Pallas time-loop
+    kernel (ops.pallas_gru) on TPU when the shape fits VMEM."""
     b, t, _ = x.shape
     hdim = params["w_hh"].shape[0]
     if initial_state is None:
@@ -205,6 +215,25 @@ def gru(params, x, lengths=None, *, initial_state=None, reverse: bool = False,
         mask = jnp.arange(t)[None, :] < lengths[:, None]
     x_proj = linalg.matmul(x, params["w_ih"]) + params["b"]  # hoisted
     xs = jnp.swapaxes(x_proj, 0, 1)
+
+    from paddle_tpu.ops import pallas_gru as PG
+    from paddle_tpu.ops import pallas_lstm as PL
+
+    if _use_fused_kernel(impl, "gru", PG, b, hdim):
+        xs_f = jnp.flip(xs, axis=0) if reverse else xs
+        bounds = PL.make_bounds(b, t, lengths, reverse)
+        carry_dtype = initial_state.dtype
+        hs, h_last = PG.fused_gru(
+            xs_f, params["w_hh"],
+            initial_state.astype(jnp.float32), bounds)
+        if reverse:
+            hs = jnp.flip(hs, axis=0)
+        # match the scan path's dtype contract (carry dtype throughout)
+        outputs = jnp.swapaxes(hs, 0, 1).astype(carry_dtype)
+        if lengths is not None:
+            outputs = outputs * mask[..., None].astype(outputs.dtype)
+        return outputs, h_last.astype(carry_dtype)
+
     ms = jnp.swapaxes(mask, 0, 1)
 
     def step(h, xp_t):

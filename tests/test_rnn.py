@@ -3,6 +3,7 @@ impl-equivalence against a plain python step loop (the reference's
 topology-equivalence style, e.g. recurrent_group vs fused LstmLayer,
 gserver/tests/test_CompareTwoNets.cpp)."""
 
+import functools
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -167,12 +168,29 @@ class TestFusedPallasLstm:
                         jax.tree_util.tree_leaves(g_pl)):
             np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
 
-    def test_lengths_fall_back_to_scan(self):
+    @pytest.mark.parametrize("reverse", [False, True])
+    def test_lengths_match_scan(self, reverse):
+        """Variable-length batches run through the fused kernel's
+        in-kernel [start, end) windows and must match the masked scan —
+        outputs, final state AND gradients."""
         params, x = self._setup()
         lens = jnp.asarray([9, 4, 1, 7])
-        # masked path must still work (fused path requires no lengths)
-        o, st = R.lstm(params, x, lens, impl="auto")
-        assert float(jnp.abs(o[1, 4:]).sum()) == 0.0
+        o_xla, st_xla = R.lstm(params, x, lens, impl="xla", reverse=reverse)
+        o_pl, st_pl = R.lstm(params, x, lens, impl="pallas", reverse=reverse)
+        np.testing.assert_allclose(o_pl, o_xla, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(st_pl.h, st_xla.h, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(st_pl.c, st_xla.c, rtol=1e-5, atol=1e-6)
+        assert float(jnp.abs(o_pl[1, 4:]).sum()) == 0.0  # masked zeroed
+
+        def loss(params, impl):
+            o, st = R.lstm(params, x, lens, impl=impl, reverse=reverse)
+            return jnp.sum(o * o) + jnp.sum(st.c ** 2) + jnp.sum(st.h ** 2)
+
+        g_xla = jax.grad(loss)(params, "xla")
+        g_pl = jax.grad(loss)(params, "pallas")
+        for a, b in zip(jax.tree_util.tree_leaves(g_xla),
+                        jax.tree_util.tree_leaves(g_pl)):
+            np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
 
     def test_initial_state_carries(self):
         params, x = self._setup()
@@ -188,10 +206,48 @@ class TestFusedPallasLstm:
 
         params, x = self._setup()
         with pytest.raises(PaddleTpuError):
-            R.lstm(params, x, jnp.asarray([2, 3, 4, 5]), impl="pallas")
-        with pytest.raises(PaddleTpuError):
             R.lstm(params, x, impl="fused")  # unknown impl string
         big = R.init_lstm_params(jax.random.key(1), 8, 1024)
         xb = jnp.zeros((64, 4, 8), jnp.float32)
         with pytest.raises(PaddleTpuError):
             R.lstm(big, xb, impl="pallas")  # exceeds VMEM budget
+
+
+class TestFusedPallasGru:
+    """ops/pallas_gru.py vs the masked lax.scan (interpret mode)."""
+
+    def _setup(self, b=4, t=9, f=12, h=16):
+        rs = np.random.RandomState(3)
+        params = R.init_gru_params(jax.random.key(0), f, h)
+        x = jnp.asarray(rs.randn(b, t, f), jnp.float32)
+        return params, x
+
+    @pytest.mark.parametrize("reverse", [False, True])
+    @pytest.mark.parametrize("with_lengths", [False, True])
+    def test_matches_scan(self, reverse, with_lengths):
+        params, x = self._setup()
+        lens = jnp.asarray([9, 4, 1, 7]) if with_lengths else None
+        o_xla, h_xla = R.gru(params, x, lens, impl="xla", reverse=reverse)
+        o_pl, h_pl = R.gru(params, x, lens, impl="pallas", reverse=reverse)
+        np.testing.assert_allclose(o_pl, o_xla, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(h_pl, h_xla, rtol=1e-5, atol=1e-6)
+
+        def loss(params, impl):
+            o, h = R.gru(params, x, lens, impl=impl, reverse=reverse)
+            return jnp.sum(o * o) + jnp.sum(h ** 2)
+
+        g_xla = jax.grad(loss)(params, "xla")
+        g_pl = jax.grad(loss)(params, "pallas")
+        for a, b in zip(jax.tree_util.tree_leaves(g_xla),
+                        jax.tree_util.tree_leaves(g_pl)):
+            np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
+
+    def test_bidirectional_through_fused(self):
+        params, x = self._setup()
+        params2 = R.init_gru_params(jax.random.key(5), 12, 16)
+        lens = jnp.asarray([9, 7, 5, 3])
+        o_xla, _ = R.bidirectional(
+            functools.partial(R.gru, impl="xla"), params, params2, x, lens)
+        o_pl, _ = R.bidirectional(
+            functools.partial(R.gru, impl="pallas"), params, params2, x, lens)
+        np.testing.assert_allclose(o_pl, o_xla, rtol=1e-5, atol=1e-6)
