@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-3 chip measurement campaign — run the moment the TPU answers.
+# Each stage is subprocess-isolated with a timeout (a pathological
+# compile must not take the whole campaign down) and logs to
+# benchmarks/r3_logs/. Order: cheap probes first, the big suite last,
+# so partial chip time still yields the highest-value numbers.
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/r3_logs
+
+run() {  # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ($(date +%H:%M:%S)) ==="
+  timeout "$tmo" "$@" > "benchmarks/r3_logs/$name.out" 2> "benchmarks/r3_logs/$name.err"
+  local rc=$?
+  echo "    rc=$rc  (tail of out:)"; tail -3 "benchmarks/r3_logs/$name.out" | sed 's/^/    /'
+}
+
+# 0. liveness
+run probe 180 python -c "import jax, jax.numpy as jnp; print((jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16))[0,0])"
+
+# 1. the north stars, driver-format (fixed CTR, fused-GRU seq2seq)
+run bench 2400 python bench.py
+
+# 2. resnet50 plain vs s2d stem (the profile-driven fix)
+run suite_resnet 1800 python benchmarks/suite.py --only resnet50,resnet50_s2d
+
+# 3. lstm benches (now on the fused kernel) + inversion probe
+run suite_lstm 1200 python benchmarks/suite.py --only lstm_h256,lstm_h512
+run probe_lstm 1200 python benchmarks/probe_lstm.py
+
+# 4. CTR stage probe (steady-state attribution after the recompile fix)
+run probe_ctr 1200 python benchmarks/probe_ctr.py
+
+# 5. the rest of the published-config suite
+run suite_images 3600 python benchmarks/suite.py --only alexnet,googlenet,vgg19,smallnet
+run suite_misc 2400 python benchmarks/suite.py --only seq2seq,ctr,transformer,trainer_loop
+
+# 6. refreshed profile trace for PROFILE_NOTES
+run profile 1200 python benchmarks/profile_step.py --batch 256 --iters 10
+
+echo "=== done ($(date +%H:%M:%S)) — logs in benchmarks/r3_logs/ ==="

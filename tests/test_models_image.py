@@ -109,3 +109,21 @@ def test_resnet50_bn_state_updates(rng):
     before = jax.tree_util.tree_leaves(state)
     after = jax.tree_util.tree_leaves(new_state)
     assert any(not np.allclose(b, a) for b, a in zip(before, after))
+
+
+def test_inception_fused_equivalent(rng):
+    """The fused-1x1 Inception layer == the plain Branches expression
+    with the SAME params (the param trees are identical by design)."""
+    from paddle_tpu.models import googlenet as G
+
+    fused = G.Inception(8, 6, 12, 4, 8, 6, name="i")
+    plain = G._inception_branches("i", 8, 6, 12, 4, 8, 6)
+    params, state = fused.init(rng, ShapeSpec((2, 8, 8, 10)))
+    params2, state2 = plain.init(rng, ShapeSpec((2, 8, 8, 10)))
+    assert (jax.tree_util.tree_structure(params)
+            == jax.tree_util.tree_structure(params2))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 8, 10), jnp.float32)
+    y_fused, _ = fused.apply(params, state, x, training=False)
+    y_plain, _ = plain.apply(params, state2, x, training=False)
+    assert y_fused.shape == (2, 8, 8, 8 + 12 + 8 + 6)
+    np.testing.assert_allclose(y_fused, y_plain, rtol=1e-5, atol=1e-5)
