@@ -17,11 +17,9 @@ unaffected.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 from paddle_tpu import nn
-from paddle_tpu.nn import initializers
 from paddle_tpu.nn.module import Layer, ShapeSpec
 from paddle_tpu.ops import activations as A
 from paddle_tpu.ops import conv as conv_ops
@@ -68,41 +66,18 @@ class Inception(Layer):
     def __init__(self, c1, c3r, c3, c5r, c5, proj, *, name):
         self.sizes = (c1, c3r, c3, c5r, c5, proj)
         self.name = name
-        # expose the logical branch structure for introspection —
-        # utils.diagram walks a `.branches` attribute; without it each
-        # block would render as one opaque node instead of its six convs
-        self.branches = _inception_branches(
-            name, c1, c3r, c3, c5r, c5, proj).branches
+        # the plain Branches expression is the single source of truth
+        # for the param tree (init delegates to it, so 'param-compatible'
+        # holds by construction) and for introspection — utils.diagram
+        # walks a `.branches` attribute
+        self._plain = _inception_branches(name, c1, c3r, c3, c5r, c5, proj)
+        self.branches = self._plain.branches
 
     def _key(self, suffix):
         return f"{self.name}_{suffix}"
 
     def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
-        c1, c3r, c3, c5r, c5, proj = self.sizes
-        n, h, w, c = spec.shape
-        out_spec = ShapeSpec((n, h, w, c1 + c3 + c5 + proj), spec.dtype)
-        if _abstract:
-            return {}, {}, out_spec
-        msra = initializers.get("msra")
-        ks = iter(jax.random.split(rng, 6))
-
-        def conv_p(kh, cin, cout):
-            return {"kernel": msra(next(ks), (kh, kh, cin, cout)),
-                    "bias": jnp.zeros((cout,))}
-
-        params = {
-            self._key("1x1"): conv_p(1, c, c1),
-            self._key("b3"): {
-                self._key("3x3r"): conv_p(1, c, c3r),
-                self._key("3x3"): conv_p(3, c3r, c3),
-            },
-            self._key("b5"): {
-                self._key("5x5r"): conv_p(1, c, c5r),
-                self._key("5x5"): conv_p(5, c5r, c5),
-            },
-            self._key("bp"): {self._key("proj"): conv_p(1, c, proj)},
-        }
-        return params, {}, out_spec
+        return self._plain._init(rng, spec, _abstract=_abstract)
 
     def _apply(self, params, state, x, *, training: bool, rng):
         c1, c3r, c3, c5r, c5, proj = self.sizes

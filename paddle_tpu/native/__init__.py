@@ -2,6 +2,7 @@
 master, TCP master service. Python binds via ctypes — no pybind."""
 
 from paddle_tpu.native.build import ensure_built, lib_path
+from paddle_tpu.native.loader import native_reader
 from paddle_tpu.native.recordio import (
     RecordReader,
     RecordWriter,

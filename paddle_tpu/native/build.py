@@ -17,7 +17,7 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src")
-_SOURCES = ["recordio.cc", "taskqueue.cc"]
+_SOURCES = ["recordio.cc", "taskqueue.cc", "loader.cc"]
 _LIB = os.path.join(_DIR, "libpaddle_tpu_native.so")
 _lock = threading.Lock()
 

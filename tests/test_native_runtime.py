@@ -449,3 +449,63 @@ def test_tcp_elastic_task_reassignment(tmp_path):
         # pass drains even though worker A never reported back
         assert q.next_pass() == 1
         b.close()
+
+
+class TestNativeLoader:
+    """C++ threaded prefetch loader (native/src/loader.cc — the async
+    DoubleBuffer DataProvider analog)."""
+
+    def _write_files(self, tmp_path, n_files=3, per_file=40):
+        from paddle_tpu import native
+
+        paths, want = [], []
+        for i in range(n_files):
+            p = tmp_path / f"part-{i}.rio"
+            recs = [f"f{i}r{j}".encode() for j in range(per_file)]
+            native.write_records(str(p), recs, records_per_chunk=7)
+            paths.append(str(p))
+            want.extend(recs)
+        return paths, want
+
+    def test_single_thread_preserves_order(self, tmp_path):
+        from paddle_tpu import native
+
+        paths, want = self._write_files(tmp_path)
+        got = list(native.native_reader(paths, n_threads=1)())
+        assert got == want
+
+    def test_multi_thread_full_coverage(self, tmp_path):
+        from paddle_tpu import native
+
+        paths, want = self._write_files(tmp_path)
+        got = list(native.native_reader(paths, n_threads=3, capacity=8)())
+        assert sorted(got) == sorted(want)
+        assert len(got) == len(want)
+
+    def test_reader_is_reusable(self, tmp_path):
+        from paddle_tpu import native
+
+        paths, want = self._write_files(tmp_path, n_files=1, per_file=5)
+        reader = native.native_reader(paths, n_threads=1)
+        assert list(reader()) == want
+        assert list(reader()) == want  # combinator contract: re-iterable
+
+    def test_early_close_does_not_hang(self, tmp_path):
+        from paddle_tpu import native
+
+        paths, _ = self._write_files(tmp_path, n_files=2, per_file=500)
+        it = native.native_reader(paths, n_threads=2, capacity=4)()
+        assert next(it) is not None
+        it.close()  # generator close -> ldr_close joins blocked producers
+
+    def test_missing_file_raises(self, tmp_path):
+        from paddle_tpu import native
+
+        reader = native.native_reader([str(tmp_path / "nope.rio")])
+        with pytest.raises(OSError):
+            list(reader())
+
+    def test_empty_path_list_yields_nothing(self):
+        from paddle_tpu import native
+
+        assert list(native.native_reader([])()) == []
