@@ -64,8 +64,11 @@ REF = {
     ("vgg19", 64): 64 / 28.5 * 1000, ("vgg19", 128): 128 / 29.8 * 1000,
     ("resnet50", 64): 64 / 81.7 * 1000, ("resnet50", 128): 128 / 82.4 * 1000,
     ("resnet50", 256): 256 / 84.1 * 1000,
-    # LSTM text classification, bs 64, hidden 256/512 (README.md:115-119)
+    # LSTM text classification, hidden 256/512/1280 at bs 64 and 128
+    # (README.md:115-126)
     ("lstm_h256", 64): 83.0, ("lstm_h512", 64): 184.0,
+    ("lstm_h1280", 64): 641.0,
+    ("lstm_h256", 128): 110.0, ("lstm_h512", 128): 261.0,
     # SmallNet CIFAR-quick, 32x32 (README.md:54-58)
     ("smallnet", 64): 10.463, ("smallnet", 128): 18.184,
     ("smallnet", 256): 33.113, ("smallnet", 512): 63.039,
@@ -423,7 +426,9 @@ def main():
     # SmallNet runs at its native 32x32 (the reference table's config)
     image_cfgs += [("smallnet", b)
                    for b in ((64,) if quick else (64, 128, 256, 512))]
-    lstm_cfgs = [("lstm_h256", 256, 64), ("lstm_h512", 512, 64)]
+    lstm_cfgs = [("lstm_h256", 256, 64), ("lstm_h512", 512, 64),
+                 ("lstm_h1280", 1280, 64),
+                 ("lstm_h256", 256, 128), ("lstm_h512", 512, 128)]
     only = set(args.only.split(",")) if args.only else None
 
     for name, batch in image_cfgs:

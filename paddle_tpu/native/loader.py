@@ -9,7 +9,6 @@ from __future__ import annotations
 import ctypes
 from typing import Sequence
 
-from paddle_tpu.native.build import ensure_built
 from paddle_tpu.native.recordio import get_lib as _rio_lib
 
 
@@ -19,8 +18,10 @@ _cached = None
 def get_lib():
     global _cached
     if _cached is None:
-        _rio_lib()  # ensure the shared .so is built
-        lib = ctypes.CDLL(ensure_built())
+        # one shared binding object for libpaddle_tpu_native.so: reuse
+        # recordio's (it already ran ensure_built) and declare the ldr_*
+        # prototypes on it
+        lib = _rio_lib()
         lib.ldr_open.restype = ctypes.c_void_p
         lib.ldr_open.argtypes = [ctypes.POINTER(ctypes.c_char_p),
                                  ctypes.c_int, ctypes.c_int, ctypes.c_int]

@@ -246,9 +246,10 @@ def gru(params, x, lengths=None, *, initial_state=None, reverse: bool = False,
 
 
 def simple_rnn(params, x, lengths=None, *, activation=jnp.tanh,
-               reverse: bool = False):
+               reverse: bool = False, impl: str = "auto"):
     """Vanilla RNN h' = act(x W_ih + h W_hh + b) (reference:
-    gserver/layers/RecurrentLayer.cpp)."""
+    gserver/layers/RecurrentLayer.cpp). The fused Pallas path
+    (ops.pallas_rnn) applies for the default tanh activation."""
     b, t, _ = x.shape
     hdim = params["w_hh"].shape[0]
     h0 = jnp.zeros((b, hdim), _carry_dtype())
@@ -258,6 +259,28 @@ def simple_rnn(params, x, lengths=None, *, activation=jnp.tanh,
         mask = jnp.arange(t)[None, :] < lengths[:, None]
     x_proj = linalg.matmul(x, params["w_ih"]) + params["b"]  # hoisted
     xs = jnp.swapaxes(x_proj, 0, 1)
+
+    from paddle_tpu.core.errors import enforce
+    from paddle_tpu.ops import pallas_lstm as PL
+    from paddle_tpu.ops import pallas_rnn as PR
+
+    if impl == "pallas":
+        enforce(activation is jnp.tanh,
+                "the fused simple_rnn kernel supports only tanh")
+    fused = (activation is jnp.tanh
+             and _use_fused_kernel(impl, "simple_rnn", PR, b, hdim))
+    if fused:
+        xs_f = jnp.flip(xs, axis=0) if reverse else xs
+        bounds = PL.make_bounds(b, t, lengths, reverse)
+        hs, h_last = PR.fused_simple_rnn(
+            xs_f, params["w_hh"], h0.astype(jnp.float32), bounds)
+        if reverse:
+            hs = jnp.flip(hs, axis=0)
+        outputs = jnp.swapaxes(hs, 0, 1).astype(h0.dtype)
+        if lengths is not None:
+            outputs = outputs * mask[..., None].astype(outputs.dtype)
+        return outputs, h_last.astype(h0.dtype)
+
     ms = jnp.swapaxes(mask, 0, 1)
 
     def step(h, xp_t):

@@ -251,3 +251,41 @@ class TestFusedPallasGru:
         o_pl, _ = R.bidirectional(
             functools.partial(R.gru, impl="pallas"), params, params2, x, lens)
         np.testing.assert_allclose(o_pl, o_xla, rtol=1e-5, atol=1e-6)
+
+
+class TestFusedPallasSimpleRnn:
+    @pytest.mark.parametrize("reverse", [False, True])
+    @pytest.mark.parametrize("with_lengths", [False, True])
+    def test_matches_scan(self, reverse, with_lengths):
+        rs = np.random.RandomState(7)
+        params = R.init_rnn_params(jax.random.key(0), 12, 16)
+        x = jnp.asarray(rs.randn(4, 9, 12), jnp.float32)
+        lens = jnp.asarray([9, 4, 1, 7]) if with_lengths else None
+        o_xla, h_xla = R.simple_rnn(params, x, lens, impl="xla",
+                                    reverse=reverse)
+        o_pl, h_pl = R.simple_rnn(params, x, lens, impl="pallas",
+                                  reverse=reverse)
+        np.testing.assert_allclose(o_pl, o_xla, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(h_pl, h_xla, rtol=1e-5, atol=1e-6)
+
+        def loss(params, impl):
+            o, h = R.simple_rnn(params, x, lens, impl=impl,
+                                reverse=reverse)
+            return jnp.sum(o * o) + jnp.sum(h ** 2)
+
+        g_xla = jax.grad(loss)(params, "xla")
+        g_pl = jax.grad(loss)(params, "pallas")
+        for a, b in zip(jax.tree_util.tree_leaves(g_xla),
+                        jax.tree_util.tree_leaves(g_pl)):
+            np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-5)
+
+    def test_custom_activation_rejected_when_forced(self):
+        from paddle_tpu.core.errors import PaddleTpuError
+
+        params = R.init_rnn_params(jax.random.key(0), 4, 8)
+        x = jnp.zeros((2, 3, 4), jnp.float32)
+        with pytest.raises(PaddleTpuError):
+            R.simple_rnn(params, x, activation=jnp.abs, impl="pallas")
+        # auto with a custom activation silently keeps the scan
+        o, _ = R.simple_rnn(params, x, activation=jnp.abs, impl="auto")
+        assert o.shape == (2, 3, 8)
