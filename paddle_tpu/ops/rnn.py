@@ -267,8 +267,10 @@ def simple_rnn(params, x, lengths=None, *, activation=jnp.tanh,
     if impl == "pallas":
         enforce(activation is jnp.tanh,
                 "the fused simple_rnn kernel supports only tanh")
-    fused = (activation is jnp.tanh
-             and _use_fused_kernel(impl, "simple_rnn", PR, b, hdim))
+    # validate impl FIRST (lstm/gru contract: typos always raise), then
+    # AND the tanh condition for auto
+    fused = (_use_fused_kernel(impl, "simple_rnn", PR, b, hdim)
+             and activation is jnp.tanh)
     if fused:
         xs_f = jnp.flip(xs, axis=0) if reverse else xs
         bounds = PL.make_bounds(b, t, lengths, reverse)
