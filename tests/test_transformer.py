@@ -135,3 +135,22 @@ def test_tp_sharded_loss_matches(params):
     for a, b in zip(jax.tree_util.tree_leaves(ref_grad),
                     jax.tree_util.tree_leaves(g)):
         np.testing.assert_allclose(a, np.asarray(b), rtol=1e-4, atol=1e-5)
+
+
+def test_transformer_serving_artifact(tmp_path, params):
+    """The generic StableHLO artifact path serves the transformer LM
+    (weights folded; greedy next-token head)."""
+    from paddle_tpu.serve import export_compiled_model, load_compiled_model
+
+    path = str(tmp_path / "lm.ptc")
+    toks = jnp.asarray(np.random.RandomState(9).randint(0, 61, (2, 12)))
+
+    def next_token_logits(toks):
+        return T.apply(params, CFG, toks)[:, -1]
+
+    export_compiled_model(next_token_logits, [toks], path, name="tiny-lm")
+    m = load_compiled_model(path)
+    got = m.predict(np.asarray(toks))
+    want = next_token_logits(toks)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
