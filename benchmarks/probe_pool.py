@@ -1,0 +1,84 @@
+"""A/B the two maxpool backward formulations on the real chip.
+
+Round-3 question: suite resnet50 bs64 measured 40.4 ms/batch on
+2026-07-31 vs 31.3 ms in round 1. The tie-split maxpool VJP (committed
+f098b23, after the last good measurement window) is the prime suspect;
+relay-condition drift is the alternative. This probe times the SAME
+ResNet-50 bs-64 train step (bench.bench_resnet — the one implementation
+of the headline timing protocol) under both gradients — each in its own
+subprocess because PADDLE_TPU_POOL_TIE_SPLIT is read at trace time, so
+one jit compile freezes the choice per process — and prints the two
+numbers side by side.
+
+Run: python benchmarks/probe_pool.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+CHILD = "--child"
+
+
+def child() -> None:
+    import jax
+
+    # the TPU plugin force-selects its platform at config level,
+    # outranking JAX_PLATFORMS — mirror a cpu request into the config so
+    # a cpu smoke run never claims (or hangs on) the chip
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import bench
+
+    tie = os.environ.get("PADDLE_TPU_POOL_TIE_SPLIT", "1") != "0"
+    on_tpu = bench.init_devices_or_die()[0].platform != "cpu"
+    batch, iters = (64, 30) if on_tpu else (8, 3)
+
+    def emit(batch_, ms, imgs_per_sec):
+        print(json.dumps({"probe": "pool_ab", "tie_split": tie,
+                          "batch": batch_,
+                          "ms_per_batch": round(ms, 2),
+                          "imgs_per_sec": round(imgs_per_sec, 1)}),
+              flush=True)
+
+    bench.bench_resnet(batch_override=batch, iters_override=iters,
+                       emit_fn=emit)
+
+
+def main() -> None:
+    # bench.run_child supplies the one shared child-reaping policy
+    # (SIGTERM + 60s grace before SIGKILL — a hard-killed relay claimant
+    # can wedge the chip); per-arm timeout keeps a wedging compile in
+    # one arm from starving the other.
+    from bench import run_child
+
+    here = os.path.abspath(__file__)
+    failures = 0
+    for tie in (False, True):
+        os.environ["PADDLE_TPU_POOL_TIE_SPLIT"] = "1" if tie else "0"
+        print(f"[probe_pool] tie_split={tie} ...", file=sys.stderr, flush=True)
+        rc, lines = run_child(f"probe_pool tie_split={tie}",
+                              [sys.executable, here, CHILD], 600)
+        got = False
+        for line in lines:
+            if line.strip().startswith("{"):
+                print(line.strip(), flush=True)
+                got = True
+        if rc != 0 or not got:
+            failures += 1
+            print(f"[probe_pool] FAILED arm tie_split={tie} "
+                  f"(rc={rc}, json={got}) — A/B incomplete",
+                  file=sys.stderr, flush=True)
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == CHILD:
+        child()
+    else:
+        main()

@@ -1,9 +1,22 @@
 #!/bin/bash
-# Round-3 chip measurement campaign — run the moment the TPU answers.
-# Each stage is subprocess-isolated with a timeout (a pathological
-# compile must not take the whole campaign down) and logs to
-# benchmarks/r3_logs/. Order: cheap probes first, the big suite last,
-# so partial chip time still yields the highest-value numbers.
+# Round-3 chip measurement campaign, wedge-aware revision (r3b).
+#
+# What happened to r3a (2026-07-31 01:01-01:21): bench.py produced the
+# seq2seq + CTR north stars, then the relay's remote-compile endpoint
+# dropped the ResNet bs-256 compile ("response body closed"); the suite
+# retry hung 13 min in the same compile and killing it wedged the chip
+# (tiny-matmul probe now times out). Lessons encoded here:
+#   * cheap compiles first — every stage that compiles at bs<=128 runs
+#     before anything that compiles at bs256;
+#   * the pool A/B probe runs early (it answers this round's open
+#     regression question at bs64);
+#   * bench.py is now internally subprocess-isolated with retry+fallback
+#     so it can never lose already-printed metrics to a late crash;
+#   * big-batch image rows run LAST, each in its own stage, so a
+#     wedging compile costs only the stages after it.
+#
+# Each stage is subprocess-isolated with a timeout and logs to
+# benchmarks/r3_logs/.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p benchmarks/r3_logs
@@ -19,24 +32,39 @@ run() {  # name timeout cmd...
 # 0. liveness
 run probe 180 python -c "import jax, jax.numpy as jnp; print((jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16))[0,0])"
 
-# 1. the north stars, driver-format (fixed CTR, fused-GRU seq2seq)
-run bench 2400 python bench.py
+# 1. the open regression question: tie-split vs select-and-scatter
+#    maxpool backward, resnet bs64 (cheap compile, done twice)
+run probe_pool 1500 python benchmarks/probe_pool.py
 
-# 2. resnet50 plain vs s2d stem (the profile-driven fix)
-run suite_resnet 1800 python benchmarks/suite.py --only resnet50,resnet50_s2d
-
-# 3. lstm benches (now on the fused kernel) + inversion probe
+# 2. lstm benches (fused kernel) + the h256/h512 inversion probe
 run suite_lstm 1200 python benchmarks/suite.py --only lstm_h256,lstm_h512
 run probe_lstm 1200 python benchmarks/probe_lstm.py
 
-# 4. CTR stage probe (steady-state attribution after the recompile fix)
+# 3. CTR stage probe (steady-state attribution after the recompile fix)
 run probe_ctr 1200 python benchmarks/probe_ctr.py
 
-# 5. the rest of the published-config suite
-run suite_images 3600 python benchmarks/suite.py --only alexnet,googlenet,vgg19,smallnet
-run suite_misc 2400 python benchmarks/suite.py --only seq2seq,ctr,transformer,trainer_loop
+# 4. cheap suite rows: smallnet, trainer-loop overhead, transformer
+#    (all compile small; seq2seq/ctr are NOT here — the bench stage
+#    below runs them via bench.py, no duplicate chip time)
+run suite_small 2400 python benchmarks/suite.py --only smallnet,trainer_loop
+run suite_misc 2400 python benchmarks/suite.py --only transformer
 
-# 6. refreshed profile trace for PROFILE_NOTES
+# 5. the north stars, driver-format (resnet bs256 inside, isolated+retry;
+#    worst case 2x(1200+60)s suite stages + 3x(900+60)s resnet attempts
+#    = 5400s, plus margin for interpreter startup — a stage timeout that
+#    SIGTERMs bench.py mid-reap would orphan a grandchild holding the
+#    relay claim, the exact wedge this script exists to avoid)
+run bench 5700 python bench.py
+
+# 6. image suite, batch-ascending; bs256 rows are the wedge risk so they
+#    go last, one stage each
+run suite_alexnet 1800 python benchmarks/suite.py --only alexnet
+run suite_googlenet 1800 python benchmarks/suite.py --only googlenet
+run suite_resnet 1800 python benchmarks/suite.py --only resnet50
+run suite_resnet_s2d 1800 python benchmarks/suite.py --only resnet50_s2d
+run suite_vgg 1800 python benchmarks/suite.py --only vgg19
+
+# 7. refreshed profile trace for PROFILE_NOTES
 run profile 1200 python benchmarks/profile_step.py --batch 256 --iters 10
 
 echo "=== done ($(date +%H:%M:%S)) — logs in benchmarks/r3_logs/ ==="

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Probe the chip every PERIOD seconds; the moment a tiny matmul
+# completes, fire the r3b measurement campaign once and exit.
+# Each probe runs in its own subprocess under `timeout` — a wedged
+# relay makes the probe hang, the timeout reaps it, we sleep and retry.
+set -u
+cd "$(dirname "$0")/.."
+PERIOD=${PERIOD:-300}
+LOG=benchmarks/r3_logs/watcher.log
+mkdir -p benchmarks/r3_logs
+
+while true; do
+  if timeout 150 python -c "import jax, jax.numpy as jnp; print((jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16))[0,0])" \
+       >> "$LOG" 2>&1; then
+    echo "[watcher $(date +%H:%M:%S)] chip ANSWERED — firing campaign" | tee -a "$LOG"
+    bash benchmarks/run_r3_measurements.sh 2>&1 | tee -a benchmarks/r3_logs/campaign_console.txt
+    exit 0
+  fi
+  echo "[watcher $(date +%H:%M:%S)] chip still wedged; retry in ${PERIOD}s" >> "$LOG"
+  sleep "$PERIOD"
+done

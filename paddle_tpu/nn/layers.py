@@ -150,7 +150,7 @@ class Conv2D(Layer):
 
 class MaxPool2D(Layer):
     def __init__(self, window=2, *, stride=None, padding="VALID", name=None,
-                 tie_split=True):
+                 tie_split=None):
         self.window = conv_ops._pair(window)
         self.stride = conv_ops._pair(stride if stride is not None else window)
         self.padding = padding
@@ -158,7 +158,11 @@ class MaxPool2D(Layer):
         # tie_split routes grads through the select-and-scatter-free
         # custom VJP (ops.conv._max_pool2d_ts). Set False if the layer
         # must be forward-mode differentiable (jvp/jacfwd): custom_vjp
-        # functions reject jvp.
+        # functions reject jvp. None defers to ops.conv.max_pool2d's
+        # env-read default (PADDLE_TPU_POOL_TIE_SPLIT), read at TRACE
+        # time — one jit compile freezes the choice, so flip the env
+        # only across processes (as benchmarks/probe_pool.py does), not
+        # between jitted calls in one process.
         self.tie_split = tie_split
 
     def _out_hw(self, h, w):

@@ -11,6 +11,7 @@ Layout is NHWC/HWIO (TPU-preferred), not the reference's NCHW.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
@@ -316,15 +317,20 @@ _max_pool2d_ts.defvjp(_max_pool2d_ts_fwd, _max_pool2d_ts_bwd)
 
 
 def max_pool2d(x, window: IntOr2 = 2, *, stride: Optional[IntOr2] = None,
-               padding="VALID", tie_split: bool = True):
+               padding="VALID", tie_split: Optional[bool] = None):
     """Max pooling (reference: gserver/layers/PoolLayer.cpp MaxPooling,
     paddle/operators/pool_op.cc).
 
     tie_split=True (floats only) routes the gradient through the
     select-and-scatter-free custom VJP above; tie_split=False keeps
     XLA's native pick-first semantics AND forward-mode (jvp/jacfwd)
-    differentiability, which custom_vjp functions reject.
+    differentiability, which custom_vjp functions reject. The default
+    (None) reads env PADDLE_TPU_POOL_TIE_SPLIT (default on) so the two
+    backward formulations can be A/B-benchmarked on the chip without a
+    code edit.
     """
+    if tie_split is None:
+        tie_split = os.environ.get("PADDLE_TPU_POOL_TIE_SPLIT", "1") != "0"
     win = _pair(window)
     strd = _pair(stride if stride is not None else window)
     pad2 = explicit_pad(x.shape[1], x.shape[2], win, strd, padding)
