@@ -56,9 +56,9 @@ run suite_misc 2400 python benchmarks/suite.py --only transformer
 #    relay claim, the exact wedge this script exists to avoid)
 run bench 5700 python bench.py
 
-# 6. image suite, batch-ascending; bs256 rows are the wedge risk so they
-#    go last, one stage each
-run suite_alexnet 1800 python benchmarks/suite.py --only alexnet
+# 6. image suite, batch-ascending; big-batch rows are the wedge risk so
+#    they go last, one stage each
+run suite_alexnet 1800 python benchmarks/suite.py --only alexnet --batches 64,128,256
 run suite_googlenet 1800 python benchmarks/suite.py --only googlenet
 run suite_resnet 1800 python benchmarks/suite.py --only resnet50
 run suite_resnet_s2d 1800 python benchmarks/suite.py --only resnet50_s2d
@@ -66,5 +66,9 @@ run suite_vgg 1800 python benchmarks/suite.py --only vgg19
 
 # 7. refreshed profile trace for PROFILE_NOTES
 run profile 1200 python benchmarks/profile_step.py --batch 256 --iters 10
+
+# 8. the single biggest compile (alexnet bs512, the reference table's
+#    last row) dead last: if it wedges the chip nothing is behind it
+run suite_alexnet512 1800 python benchmarks/suite.py --only alexnet --batches 512
 
 echo "=== done ($(date +%H:%M:%S)) — logs in benchmarks/r3_logs/ ==="
