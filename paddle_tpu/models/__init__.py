@@ -20,3 +20,6 @@ from paddle_tpu.models import quick_start
 from paddle_tpu.models import smallnet
 from paddle_tpu.models import traffic
 from paddle_tpu.models import transformer
+from paddle_tpu.models import word2vec
+from paddle_tpu.models import recommender
+from paddle_tpu.models import srl

@@ -1,0 +1,85 @@
+"""N-gram word-embedding model (the reference's word2vec book chapter).
+
+Parity target: 4 context words share ONE embedding table, concat, fc
+sigmoid hidden, softmax over the vocabulary (reference:
+python/paddle/v2/fluid/tests/book/test_word2vec.py:26-54 — 'shared_w'
+param tied across the four embedding layers, EMBED_SIZE 32, HIDDEN 256).
+The TPU-native version takes the whole [B, N-1] context as one gather
+and offers an NCE training path for large vocabularies (reference:
+gserver/layers/NCELayer.cpp serves the same role for v1 configs).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.nn import initializers
+from paddle_tpu.ops import linalg, losses, sampling
+
+
+def init_params(rng, vocab: int, *, embed_dim: int = 32, hidden: int = 256,
+                context: int = 4):
+    k_emb, k_h, k_out = jax.random.split(rng, 3)
+    return {
+        # one shared table — the reference ties 'shared_w' across its four
+        # embedding layers; here sharing is structural (a single gather)
+        "embed": initializers.normal(0.05)(k_emb, (vocab, embed_dim)),
+        "hidden": {
+            "kernel": initializers.smart_uniform()(
+                k_h, (context * embed_dim, hidden)),
+            "bias": jnp.zeros((hidden,)),
+        },
+        # output table kept [V, H] so the NCE path can row-gather it
+        "out": {
+            "kernel": initializers.smart_uniform()(k_out, (vocab, hidden)),
+            "bias": jnp.zeros((vocab,)),
+        },
+    }
+
+
+def features(params, context_ids):
+    """context_ids: [B, N-1] int32 -> hidden features [B, H]."""
+    b = context_ids.shape[0]
+    emb = jnp.take(params["embed"], context_ids, axis=0)  # [B, N-1, D]
+    h = linalg.dense(emb.reshape(b, -1), params["hidden"]["kernel"],
+                     params["hidden"]["bias"])
+    return jax.nn.sigmoid(h)
+
+
+def logits(params, context_ids):
+    """Full-softmax prediction logits [B, V]."""
+    h = features(params, context_ids)
+    return h @ params["out"]["kernel"].T + params["out"]["bias"]
+
+
+def loss(params, context_ids, next_ids):
+    """Mean softmax cross-entropy vs the next word (the book objective)."""
+    return jnp.mean(losses.softmax_cross_entropy(
+        logits(params, context_ids), next_ids))
+
+
+def loss_nce(params, context_ids, next_ids, rng, *, num_noise: int = 16):
+    """NCE objective: log-uniform negatives against the same output
+    table — O(S) instead of O(V) per example, the shape v1 users pick
+    for big vocabularies (reference: gserver/layers/NCELayer.cpp)."""
+    h = features(params, context_ids)
+    vocab = params["out"]["kernel"].shape[0]
+    noise = sampling.log_uniform_sample(
+        rng, num_noise, vocab, shape=(context_ids.shape[0],))
+    per_ex = sampling.nce_loss(
+        params["out"]["kernel"], params["out"]["bias"], h, next_ids, noise,
+        noise_probs=sampling.log_uniform_prob(jnp.arange(vocab), vocab))
+    return jnp.mean(per_ex)
+
+
+def nearest(params, word_ids, k: int = 5):
+    """k nearest words by embedding cosine — the demo's qualitative
+    check. Returns int32 [B, k] (self included at rank 0)."""
+    table = params["embed"]
+    q = jnp.take(table, word_ids, axis=0)
+    qn = q / jnp.linalg.norm(q, axis=-1, keepdims=True).clip(1e-8)
+    tn = table / jnp.linalg.norm(table, axis=-1, keepdims=True).clip(1e-8)
+    sims = qn @ tn.T
+    _, ids = jax.lax.top_k(sims, k)
+    return ids

@@ -14,6 +14,12 @@ against tests/golden/zoo_topology.json. Regenerate deliberately with:
 import json
 import math
 import os
+import sys
+
+# must precede the paddle_tpu imports so the documented regen command
+# (`python tests/test_zoo_golden.py --regen`) resolves the package when
+# run from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import pytest
@@ -59,6 +65,13 @@ def _cases():
         "transformer_small": lambda rng: tf.init_params(
             rng, tf.TransformerConfig(vocab=512, dim=64, n_layers=2,
                                       n_heads=4)),
+        "word2vec": lambda rng: models.word2vec.init_params(
+            rng, 1000, embed_dim=32, hidden=64),
+        "recommender": lambda rng: models.recommender.init_params(
+            rng, models.recommender.RecommenderConfig(
+                n_users=400, n_movies=600, title_vocab=256)),
+        "srl_db_lstm": lambda rng: models.srl.init_params(
+            rng, word_vocab=500, pred_vocab=50, num_labels=9, hidden=32),
     }
 
 
@@ -91,10 +104,6 @@ def test_zoo_topology_matches_golden(name):
 
 
 if __name__ == "__main__":
-    import sys
-
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
     if "--regen" in sys.argv:
         jax.config.update("jax_platforms", "cpu")
         os.makedirs(os.path.dirname(GOLDEN), exist_ok=True)
