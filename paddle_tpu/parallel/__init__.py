@@ -31,6 +31,13 @@ from paddle_tpu.parallel.sparse import (
     unique_rows_grad,
 )
 from paddle_tpu.parallel import distributed
+from paddle_tpu.parallel import moe
+from paddle_tpu.parallel.moe import (
+    init_moe_params,
+    make_expert_parallel_ffn,
+    moe_ffn,
+    shard_moe_params,
+)
 from paddle_tpu.parallel import pipeline
 from paddle_tpu.parallel.pipeline import (
     make_pipeline_forward,

@@ -1,0 +1,241 @@
+"""Mixture-of-experts with expert parallelism over the mesh.
+
+No reference counterpart (acmol/Paddle predates MoE); this extends the
+framework's "EP" story beyond sparse embeddings (parallel/sparse.py) to
+sparsely-activated FFNs, the modern TPU workload the mesh design exists
+for. Design follows the GShard/Switch dispatch shape — chosen because
+it is the MXU-native formulation:
+
+- top-k softmax router with an auxiliary load-balancing loss;
+- FIXED expert capacity C (static shapes — XLA requirement), tokens
+  over capacity are dropped (their combine weight is zero, the
+  residual stream carries them through unchanged);
+- dispatch/combine are one-hot einsums — big batched matmuls instead
+  of scatter/gather, which is exactly what the MXU wants;
+- expert parallelism: experts sharded over the mesh `model` axis, the
+  dispatched [E, C, D] block exchanged with ONE tiled all_to_all each
+  way over ICI (the same exchange shape as sparse.alltoall_lookup).
+
+Parity of intent: the reference scaled sparse models by sharding
+embedding rows across pservers; this shards expert FFNs across chips.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core.mesh import MODEL_AXIS
+from paddle_tpu.nn import initializers
+
+
+class MoEOutput(NamedTuple):
+    y: jnp.ndarray          # [T, D] combined expert outputs
+    aux_loss: jnp.ndarray   # scalar load-balancing loss
+    dropped: jnp.ndarray    # scalar fraction of tokens over capacity
+
+
+def init_moe_params(rng, n_experts: int, d_model: int, d_ff: int,
+                    dtype=jnp.float32):
+    """Stacked expert FFNs + router. Expert weights are [E, ...] so one
+    einsum runs every expert; shard axis 0 over the mesh for EP."""
+    k_r, k_1, k_2 = jax.random.split(rng, 3)
+    smart = initializers.smart_uniform()
+    w1 = jnp.stack([smart(k, (d_model, d_ff))
+                    for k in jax.random.split(k_1, n_experts)]).astype(dtype)
+    w2 = jnp.stack([smart(k, (d_ff, d_model))
+                    for k in jax.random.split(k_2, n_experts)]).astype(dtype)
+    return {
+        "router": {"kernel": initializers.normal(0.02)(
+            k_r, (d_model, n_experts)).astype(dtype)},
+        "w1": w1, "b1": jnp.zeros((n_experts, d_ff), dtype),
+        "w2": w2, "b2": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def shard_moe_params(params, mesh: Mesh, *, axis: str = MODEL_AXIS):
+    """Expert-shard the stacked weights over `axis` (router replicated)."""
+    e = params["w1"].shape[0]
+    if e % mesh.shape[axis] != 0:
+        raise ValueError(f"{e} experts not divisible by {axis} axis size "
+                         f"{mesh.shape[axis]}")
+    def put(x, s):
+        return jax.device_put(x, NamedSharding(mesh, s))
+
+    return {
+        "router": {"kernel": put(params["router"]["kernel"], P())},
+        "w1": put(params["w1"], P(axis)), "b1": put(params["b1"], P(axis)),
+        "w2": put(params["w2"], P(axis)), "b2": put(params["b2"], P(axis)),
+    }
+
+
+def capacity_for(n_tokens: int, n_experts: int,
+                 capacity_factor: float = 1.25, k: int = 1, *,
+                 multiple: int = 4) -> int:
+    """Static per-expert capacity: factor * k * tokens/experts, rounded
+    up to `multiple` (sublane-friendly). Top-k routing makes k*T
+    assignments, so capacity must scale with k or even perfectly
+    balanced routing drops (k-1)/k of the assignments (GShard sizes
+    capacity the same way)."""
+    raw = max(1, int(capacity_factor * k * n_tokens / n_experts))
+    return -(-raw // multiple) * multiple
+
+
+def top_k_gating(router_logits, k: int, capacity: int, *,
+                 rng: Optional[jax.Array] = None, jitter: float = 0.0):
+    """Dispatch/combine tensors from router logits.
+
+    router_logits: [T, E]. Returns (dispatch [T, E, C] one-hot,
+    combine [T, E, C] gate weights, aux_loss, dropped_frac).
+
+    aux_loss is the Switch/GShard load-balancing term: E * sum_e
+    (token_fraction_e * mean_router_prob_e) — 1.0 at perfect balance.
+    Position within each expert's capacity is assigned in token order
+    (cumsum over the one-hot), over-capacity assignments get weight 0.
+    """
+    t, e = router_logits.shape
+    if rng is not None and jitter > 0.0:
+        router_logits = router_logits * jax.random.uniform(
+            rng, router_logits.shape, router_logits.dtype,
+            1.0 - jitter, 1.0 + jitter)
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    # claimed[e] tokens already routed to expert e by earlier choices
+    claimed = jnp.zeros((e,), jnp.int32)
+    masked = probs
+    first_mask = None
+    kept_any = jnp.zeros((t,), bool)
+    for _ in range(k):
+        gate = jnp.max(masked, axis=-1)                      # [T]
+        choice = jnp.argmax(masked, axis=-1)                 # [T]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)
+        if first_mask is None:
+            first_mask = onehot
+        # position of each token in its chosen expert's buffer
+        pos = (jnp.cumsum(onehot, axis=0) - onehot) + claimed[None, :]
+        pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T]
+        keep = pos_tok < capacity
+        kept_any = kept_any | keep
+        pos_oh = jax.nn.one_hot(jnp.where(keep, pos_tok, capacity),
+                                capacity, dtype=jnp.float32)  # OOB -> zeros
+        sel = onehot[:, :, None] * pos_oh[:, None, :]         # [T, E, C]
+        dispatch = dispatch + sel
+        combine = combine + gate[:, None, None] * sel
+        claimed = claimed + jnp.sum(
+            onehot * keep[:, None].astype(jnp.float32), axis=0).astype(
+                jnp.int32)
+        masked = masked * (1.0 - onehot)                      # next choice
+
+    # renormalize over the KEPT gates so each surviving token's combine
+    # weights sum to 1 (dropped assignments are excluded from the mass)
+    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+    combine = jnp.where(denom > 0, combine / jnp.maximum(denom, 1e-9), 0.0)
+
+    frac_tokens = jnp.mean(first_mask, axis=0)                # [E]
+    mean_prob = jnp.mean(probs, axis=0)                       # [E]
+    aux = e * jnp.sum(frac_tokens * mean_prob)
+    dropped = 1.0 - jnp.mean(kept_any.astype(jnp.float32))
+    return dispatch, combine, aux, dropped
+
+
+def _expert_ffn(params, x, activation):
+    """x: [E_local, C', D] -> [E_local, C', D] via the stacked weights."""
+    h = jnp.einsum("ecd,edf->ecf", x, params["w1"]) + params["b1"][:, None, :]
+    h = activation(h)
+    return jnp.einsum("ecf,efd->ecd", h, params["w2"]) + params["b2"][:, None, :]
+
+
+def moe_ffn(params, x, *, k: int = 2, capacity_factor: float = 1.25,
+            rng=None, jitter: float = 0.0,
+            activation=jax.nn.gelu) -> MoEOutput:
+    """Single-device MoE FFN. x: [T, D] (flatten [B, S, D] first)."""
+    t, d = x.shape
+    e = params["w1"].shape[0]
+    cap = capacity_for(t, e, capacity_factor, k)
+    logits = x @ params["router"]["kernel"]
+    dispatch, combine, aux, dropped = top_k_gating(
+        logits, k, cap, rng=rng, jitter=jitter)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    expert_out = _expert_ffn(params, expert_in.astype(x.dtype), activation)
+    y = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
+    return MoEOutput(y.astype(x.dtype), aux, dropped)
+
+
+def make_expert_parallel_ffn(mesh: Mesh, *, axis: str = MODEL_AXIS,
+                             data_axis: Optional[str] = None,
+                             k: int = 2, capacity_factor: float = 1.25,
+                             jitter: float = 0.0,
+                             activation=jax.nn.gelu):
+    """Build an expert-parallel MoE FFN over `mesh`.
+
+    Tokens arrive sharded over `data_axis` (or replicated when None);
+    experts are sharded over `axis` (shard_moe_params). Each shard
+    routes its local tokens, dispatches into [E, C_loc, D], then ONE
+    tiled all_to_all regroups the block so every shard holds its OWN
+    experts' tokens from ALL shards; the FFN runs batched over local
+    experts; the mirrored all_to_all brings results home for the local
+    combine. Per-step ICI volume is 2 * E * C_loc * D — the K*D shape
+    of sparse.alltoall_lookup, with matmul dispatch instead of sorts.
+
+    Returns fn(params, x [T, D], rng=None) -> MoEOutput with y sharded
+    like x. T must divide by the data-axis size (static shapes).
+    """
+    n_exp_shards = mesh.shape[axis]
+    dspec = P(data_axis) if data_axis else P()
+
+    def body(params, x, rng):
+        t_loc, d = x.shape
+        e = params["w1"].shape[0] * n_exp_shards  # global expert count
+        cap = capacity_for(t_loc, e, capacity_factor, k)
+        logits = x @ params["router"]["kernel"]
+        if data_axis is not None:
+            # distinct jitter noise per data shard
+            rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
+        dispatch, combine, aux, dropped = top_k_gating(
+            logits, k, cap, rng=rng, jitter=jitter)
+        # local dispatch against ALL experts: [E, C, D]
+        expert_in = jnp.einsum("tec,td->ecd", dispatch,
+                               x.astype(jnp.float32)).astype(x.dtype)
+        # regroup: shard j receives its local experts' buffers from all
+        # shards -> [E_loc * n, C, D] == concat over source shards
+        recv = lax.all_to_all(expert_in, axis, split_axis=0, concat_axis=0,
+                              tiled=True)
+        # run local experts over the concatenated capacity blocks:
+        # [n * E_loc, C, D] -> group to [E_loc, n * C, D]
+        e_loc = params["w1"].shape[0]
+        grouped = recv.reshape(n_exp_shards, e_loc, cap, d).swapaxes(0, 1) \
+            .reshape(e_loc, n_exp_shards * cap, d)
+        out = _expert_ffn(params, grouped, activation)
+        # mirror the reshape + exchange to bring tokens home
+        back = out.reshape(e_loc, n_exp_shards, cap, d).swapaxes(0, 1) \
+            .reshape(n_exp_shards * e_loc, cap, d)
+        home = lax.all_to_all(back, axis, split_axis=0, concat_axis=0,
+                              tiled=True)                     # [E, C, D]
+        y = jnp.einsum("tec,ecd->td", combine,
+                       home.astype(jnp.float32)).astype(x.dtype)
+        if data_axis is not None:
+            aux = lax.pmean(aux, data_axis)
+            dropped = lax.pmean(dropped, data_axis)
+        return MoEOutput(y, aux, dropped)
+
+    pspec = {"router": {"kernel": P()},
+             "w1": P(axis), "b1": P(axis), "w2": P(axis), "b2": P(axis)}
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(pspec, dspec, P()),
+        out_specs=MoEOutput(dspec, P(), P()),
+        check_vma=False,
+    )
+
+    def apply(params, x, rng=None):
+        if rng is None:
+            rng = jax.random.key(0)
+        return fn(params, x, rng)
+
+    return apply

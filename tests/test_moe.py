@@ -1,0 +1,135 @@
+"""Mixture-of-experts tests: gating invariants, dense-dispatch vs naive
+per-token routing, and expert-parallel (all-to-all) vs single-device
+equivalence on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core import mesh as mesh_lib
+from paddle_tpu.parallel import moe
+
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs the 8-device CPU mesh")
+
+
+def _params(rng, e=4, d=8, f=16):
+    return moe.init_moe_params(rng, e, d, f)
+
+
+class TestGating:
+    def test_dispatch_combine_invariants(self):
+        t, e, cap = 16, 4, 16  # cap=T: nothing can ever drop
+        logits = jax.random.normal(jax.random.key(0), (t, e))
+        dispatch, combine, aux, dropped = moe.top_k_gating(logits, 2, cap)
+        # each token occupies at most k slots, each slot at most once
+        assert float(jnp.max(jnp.sum(dispatch, axis=(1, 2)))) <= 2 + 1e-6
+        slot_use = jnp.sum(dispatch, axis=0)  # [E, C]
+        assert float(jnp.max(slot_use)) <= 1 + 1e-6
+        # kept tokens' combine weights sum to 1
+        w = jnp.sum(combine, axis=(1, 2))
+        kept = jnp.sum(dispatch, axis=(1, 2)) > 0
+        np.testing.assert_allclose(np.asarray(w[kept]), 1.0, atol=1e-5)
+        assert float(aux) > 0
+        assert float(dropped) == 0.0
+
+    def test_capacity_drops(self):
+        t, e = 32, 2
+        # all tokens prefer expert 0 -> capacity 4 must drop most
+        logits = jnp.tile(jnp.asarray([[5.0, -5.0]]), (t, 1))
+        dispatch, combine, aux, dropped = moe.top_k_gating(logits, 1, 4)
+        assert float(jnp.sum(dispatch[:, 0])) == 4.0
+        assert float(dropped) == pytest.approx((t - 4) / t)
+        # aux loss far above the balanced value of 1.0
+        assert float(aux) > 1.5
+
+    def test_capacity_for(self):
+        assert moe.capacity_for(256, 8, 1.25) == 40
+        assert moe.capacity_for(256, 8, 1.25, k=2) == 80  # scales with k
+        assert moe.capacity_for(10, 64, 1.0) == 4  # floor 1, rounded to 4
+
+
+class TestMoEFFN:
+    def test_matches_naive_per_token(self):
+        t, d, e, f = 24, 8, 4, 16
+        params = _params(jax.random.key(1), e, d, f)
+        x = jax.random.normal(jax.random.key(2), (t, d))
+        out = moe.moe_ffn(params, x, k=2, capacity_factor=8.0)  # no drops
+        assert float(out.dropped) == 0.0
+
+        # naive: route each token through its top-2 experts in python
+        probs = np.asarray(jax.nn.softmax(
+            x @ params["router"]["kernel"], axis=-1))
+        y_ref = np.zeros((t, d), np.float32)
+        for i in range(t):
+            top = np.argsort(-probs[i])[:2]
+            gsum = probs[i][top].sum()
+            for ex in top:
+                h = np.asarray(jax.nn.gelu(
+                    x[i] @ params["w1"][ex] + params["b1"][ex]))
+                y = h @ params["w2"][ex] + params["b2"][ex]
+                y_ref[i] += (probs[i][ex] / gsum) * np.asarray(y)
+        np.testing.assert_allclose(np.asarray(out.y), y_ref, atol=1e-4)
+
+    def test_grads_flow_to_all_parts(self):
+        t, d = 16, 8
+        params = _params(jax.random.key(3))
+        x = jax.random.normal(jax.random.key(4), (t, d))
+
+        def loss(p):
+            out = moe.moe_ffn(p, x, k=2, capacity_factor=4.0)
+            return jnp.sum(out.y ** 2) + 0.01 * out.aux_loss
+
+        grads = jax.grad(loss)(params)
+        for name in ("w1", "w2"):
+            assert float(jnp.max(jnp.abs(grads[name]))) > 0
+        assert float(jnp.max(jnp.abs(grads["router"]["kernel"]))) > 0
+
+
+class TestExpertParallel:
+    def test_matches_single_device(self):
+        devices = jax.devices()
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshConfig(data=1, model=8), devices=devices[:8])
+        t, d, e, f = 32, 8, 8, 16
+        params = _params(jax.random.key(5), e, d, f)
+        x = jax.random.normal(jax.random.key(6), (t, d))
+
+        single = moe.moe_ffn(params, x, k=2, capacity_factor=8.0)
+
+        sharded = moe.shard_moe_params(params, mesh)
+        ep = moe.make_expert_parallel_ffn(
+            mesh, k=2, capacity_factor=8.0)
+        out = jax.jit(ep)(sharded, x)
+        np.testing.assert_allclose(np.asarray(out.y),
+                                   np.asarray(single.y), atol=1e-4)
+        np.testing.assert_allclose(float(out.aux_loss),
+                                   float(single.aux_loss), rtol=1e-5)
+
+    def test_data_sharded_tokens_train_step(self):
+        devices = jax.devices()
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshConfig(data=2, model=4), devices=devices[:8])
+        t, d, e, f = 32, 8, 8, 16
+        params = _params(jax.random.key(7), e, d, f)
+        sharded = moe.shard_moe_params(params, mesh)
+        x = jax.device_put(
+            np.random.RandomState(0).randn(t, d).astype(np.float32),
+            jax.NamedSharding(mesh, jax.sharding.PartitionSpec(
+                mesh_lib.DATA_AXIS)))
+        ep = moe.make_expert_parallel_ffn(
+            mesh, data_axis=mesh_lib.DATA_AXIS, k=2, capacity_factor=4.0)
+
+        @jax.jit
+        def step(p, x):
+            def loss(p):
+                out = ep(p, x)
+                return jnp.mean(out.y ** 2) + 0.01 * out.aux_loss, out
+            (l, out), grads = jax.value_and_grad(loss, has_aux=True)(p)
+            return l, out, grads
+
+        l, out, grads = step(sharded, x)
+        assert np.isfinite(float(l))
+        assert out.y.shape == (t, d)
+        assert float(jnp.max(jnp.abs(grads["w1"]))) > 0
