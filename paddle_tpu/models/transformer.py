@@ -41,6 +41,18 @@ from jax.sharding import PartitionSpec as P
 # plus the LM head sharded over the vocab dim
 TP_RULES = list(MEGATRON_RULES) + [(r"lm_head/kernel$", P(None, MODEL_AXIS))]
 
+# MoE variant: stacked expert weights sharded over their expert dim
+# (axis 0) on the model axis — pjit partitions the dispatch einsums;
+# the shard_map EP path (parallel.moe.make_expert_parallel_ffn) is the
+# hand-scheduled alternative for when the all-gather XLA inserts here
+# costs more than the explicit all-to-all. The router rule must come
+# FIRST: rules are first-match and MEGATRON's `out` alternation would
+# otherwise catch the substring in "r-out-er" and shard the router's
+# d_model dim (the router is replicated by design — the EP path's
+# shard_map pspec pins it P()).
+TP_MOE_RULES = ([(r"moe/router/kernel$", P())] + TP_RULES +
+                [(r"moe/(w1|b1|w2|b2)$", P(MODEL_AXIS))])
+
 
 @dataclasses.dataclass(frozen=True)
 class TransformerConfig:
@@ -55,10 +67,22 @@ class TransformerConfig:
     # elsewhere (interpret-mode flash would be slower than dense)
     attn_impl: str = "auto"
     remat: bool = False
+    # sparsely-activated FFN (GLaM-style): every `moe_every`-th block
+    # swaps its dense MLP for `moe_experts` experts with top-`moe_k`
+    # routing; 0 experts = all-dense
+    moe_experts: int = 0
+    moe_every: int = 2
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+    def is_moe_block(self, i: int) -> bool:
+        return self.moe_experts > 0 and i % self.moe_every == (
+            self.moe_every - 1)
 
 
 def init_params(rng, cfg: TransformerConfig):
@@ -66,22 +90,30 @@ def init_params(rng, cfg: TransformerConfig):
     d, h = cfg.dim, cfg.mlp_ratio * cfg.dim
     ks = iter(jax.random.split(rng, 4 + 4 * cfg.n_layers))
 
-    def block_params(k1, k2, k3, k4):
-        return {
+    def block_params(i, k1, k2, k3, k4):
+        p = {
             "ln1": {"scale": jnp.ones((d,)), "offset": jnp.zeros((d,))},
             "qkv": {"kernel": smart(k1, (d, 3 * d)),
                     "bias": jnp.zeros((3 * d,))},
             "proj": {"kernel": smart(k2, (d, d)), "bias": jnp.zeros((d,))},
             "ln2": {"scale": jnp.ones((d,)), "offset": jnp.zeros((d,))},
-            "fc1": {"kernel": smart(k3, (d, h)), "bias": jnp.zeros((h,))},
-            "fc2": {"kernel": smart(k4, (h, d)), "bias": jnp.zeros((d,))},
         }
+        if cfg.is_moe_block(i):
+            from paddle_tpu.parallel import moe
+
+            p["moe"] = moe.init_moe_params(k3, cfg.moe_experts, d, h)
+        else:
+            p["fc1"] = {"kernel": smart(k3, (d, h)),
+                        "bias": jnp.zeros((h,))}
+            p["fc2"] = {"kernel": smart(k4, (h, d)),
+                        "bias": jnp.zeros((d,))}
+        return p
 
     return {
         "embed": {"table": initializers.normal(0.02)(next(ks),
                                                      (cfg.vocab, d))},
-        "blocks": [block_params(next(ks), next(ks), next(ks), next(ks))
-                   for _ in range(cfg.n_layers)],
+        "blocks": [block_params(i, next(ks), next(ks), next(ks), next(ks))
+                   for i in range(cfg.n_layers)],
         "ln_f": {"scale": jnp.ones((d,)), "offset": jnp.zeros((d,))},
         "lm_head": {"kernel": smart(next(ks), (d, cfg.vocab))},
     }
@@ -126,12 +158,32 @@ def _attention(cfg: TransformerConfig, q, k, v, causal: bool):
     return _dense_attention(q, k, v, causal)
 
 
-def _block_parts(cfg: TransformerConfig, p, x, positions, attn_fn):
+def _ffn(cfg: TransformerConfig, p, y, token_mask=None):
+    """The block's position-wise FFN: dense MLP or MoE when the block
+    carries expert params. Returns (out, aux_loss). token_mask [B, T]
+    keeps padding from claiming expert capacity."""
+    if "moe" in p:
+        from paddle_tpu.parallel import moe
+
+        b, t, d = y.shape
+        flat_mask = None if token_mask is None else token_mask.reshape(b * t)
+        out = moe.moe_ffn(p["moe"], y.reshape(b * t, d), k=cfg.moe_k,
+                          capacity_factor=cfg.moe_capacity_factor,
+                          token_mask=flat_mask)
+        return out.y.reshape(b, t, d), out.aux_loss
+    y = jax.nn.gelu(linalg.dense(y, p["fc1"]["kernel"], p["fc1"]["bias"]))
+    return (linalg.dense(y, p["fc2"]["kernel"], p["fc2"]["bias"]),
+            jnp.zeros((), jnp.float32))
+
+
+def _block_parts(cfg: TransformerConfig, p, x, positions, attn_fn,
+                 token_mask=None):
     """One pre-LN block with a pluggable attention: attn_fn(q, k, v) ->
     [B,T,H,Dh]. The ONE definition of the block body — apply(), the
     decode prefill and the KV-cache step all run THIS code, so a model
     change cannot silently diverge between train and decode. Returns
-    (x_out, k, v) so cache builders can keep the rotated K/V."""
+    (x_out, k, v, aux) so cache builders can keep the rotated K/V and
+    training can collect the MoE load-balance aux loss."""
     b, t, d = x.shape
     h, dh = cfg.n_heads, cfg.head_dim
     y = norm_ops.layer_norm(x, p["ln1"]["scale"], p["ln1"]["offset"])
@@ -143,19 +195,23 @@ def _block_parts(cfg: TransformerConfig, p, x, positions, attn_fn):
     a = attn_fn(q, k, v).reshape(b, t, d)
     x = x + linalg.dense(a, p["proj"]["kernel"], p["proj"]["bias"])
     y = norm_ops.layer_norm(x, p["ln2"]["scale"], p["ln2"]["offset"])
-    y = jax.nn.gelu(linalg.dense(y, p["fc1"]["kernel"], p["fc1"]["bias"]))
-    return x + linalg.dense(y, p["fc2"]["kernel"], p["fc2"]["bias"]), k, v
+    out, aux = _ffn(cfg, p, y, token_mask)
+    return x + out, k, v, aux
 
 
-def _block(cfg: TransformerConfig, p, x, positions):
-    out, _, _ = _block_parts(
+def _block(cfg: TransformerConfig, p, x, positions, token_mask=None):
+    out, _, _, aux = _block_parts(
         cfg, p, x, positions,
-        lambda q, k, v: _attention(cfg, q, k, v, causal=True))
-    return out
+        lambda q, k, v: _attention(cfg, q, k, v, causal=True),
+        token_mask)
+    return out, aux
 
 
-def apply(params, cfg: TransformerConfig, tokens, positions=None):
-    """tokens [B,T] int32 -> logits [B,T,V]."""
+def _forward(params, cfg: TransformerConfig, tokens, positions=None,
+             token_mask=None):
+    """tokens [B,T] int32 -> (logits [B,T,V], summed MoE aux loss).
+    token_mask [B,T] bool marks real (non-padding) positions for MoE
+    capacity accounting."""
     policy = default_policy()
     x = jnp.take(params["embed"]["table"], tokens, axis=0)
     x = x.astype(policy.compute_dtype)
@@ -165,25 +221,41 @@ def apply(params, cfg: TransformerConfig, tokens, positions=None):
     blk = _block
     if cfg.remat:
         blk = jax.checkpoint(_block, static_argnums=(0,))
+    aux = jnp.zeros((), jnp.float32)
     for p in params["blocks"]:
-        x = blk(cfg, p, x, positions)
+        x, a = blk(cfg, p, x, positions, token_mask)
+        aux = aux + a
     x = norm_ops.layer_norm(x, params["ln_f"]["scale"],
                             params["ln_f"]["offset"])
-    return linalg.matmul(x, params["lm_head"]["kernel"])
+    return linalg.matmul(x, params["lm_head"]["kernel"]), aux
+
+
+def apply(params, cfg: TransformerConfig, tokens, positions=None):
+    """tokens [B,T] int32 -> logits [B,T,V]."""
+    return _forward(params, cfg, tokens, positions)[0]
 
 
 def loss(params, cfg: TransformerConfig, tokens, lengths=None):
-    """Next-token cross entropy; positions >= lengths are masked out."""
-    logits = apply(params, cfg, tokens[:, :-1])
+    """Next-token cross entropy (+ weighted MoE load-balance aux when
+    the config has experts); positions >= lengths are masked out of the
+    CE term AND of MoE expert capacity/aux accounting."""
+    tmask = None
+    if lengths is not None:
+        tmask = jnp.arange(tokens.shape[1] - 1)[None, :] < lengths[:, None]
+    logits, aux = _forward(params, cfg, tokens[:, :-1], token_mask=tmask)
     targets = tokens[:, 1:]
     lse = jax.nn.logsumexp(at_least_f32(logits), axis=-1)
     gold = jnp.take_along_axis(
         at_least_f32(logits), targets[..., None], axis=-1)[..., 0]
     nll = lse - gold
     if lengths is None:
-        return jnp.mean(nll)
-    mask = jnp.arange(1, tokens.shape[1])[None, :] < lengths[:, None]
-    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+        ce = jnp.mean(nll)
+    else:
+        mask = jnp.arange(1, tokens.shape[1])[None, :] < lengths[:, None]
+        ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    if cfg.moe_experts > 0:
+        ce = ce + cfg.moe_aux_weight * aux
+    return ce
 
 
 def generate(params, cfg: TransformerConfig, prompt, steps: int):
@@ -211,7 +283,7 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int):
     pos = jnp.broadcast_to(jnp.arange(t0), (b, t0))
     caches = []
     for p in params["blocks"]:
-        x, k, v = _block_parts(
+        x, k, v, _ = _block_parts(
             cfg, p, x, pos,
             lambda q, k, v: _attention(cfg, q, k, v, causal=True))
         k_buf = jnp.zeros((b, total, h, dh), k.dtype).at[:, :t0].set(k)
@@ -244,7 +316,7 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int):
                 w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
                 return jnp.einsum("bhqk,bkhd->bqhd", w, v_buf)
 
-            x, _, _ = _block_parts(cfg, p, x, pos, cached_attn)
+            x, _, _, _ = _block_parts(cfg, p, x, pos, cached_attn)
         nxt = jnp.argmax(final_logits(x), axis=-1).astype(tok.dtype)
         return (nxt, t + 1, new_caches), tok
 

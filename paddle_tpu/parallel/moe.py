@@ -86,11 +86,15 @@ def capacity_for(n_tokens: int, n_experts: int,
 
 
 def top_k_gating(router_logits, k: int, capacity: int, *,
-                 rng: Optional[jax.Array] = None, jitter: float = 0.0):
+                 rng: Optional[jax.Array] = None, jitter: float = 0.0,
+                 token_mask=None):
     """Dispatch/combine tensors from router logits.
 
-    router_logits: [T, E]. Returns (dispatch [T, E, C] one-hot,
-    combine [T, E, C] gate weights, aux_loss, dropped_frac).
+    router_logits: [T, E]. token_mask: optional [T] bool — False
+    positions (padding) claim NO capacity slots, contribute nothing to
+    the aux loss, and don't count as dropped. Returns (dispatch
+    [T, E, C] one-hot, combine [T, E, C] gate weights, aux_loss,
+    dropped_frac).
 
     aux_loss is the Switch/GShard load-balancing term: E * sum_e
     (token_fraction_e * mean_router_prob_e) — 1.0 at perfect balance.
@@ -103,6 +107,10 @@ def top_k_gating(router_logits, k: int, capacity: int, *,
             rng, router_logits.shape, router_logits.dtype,
             1.0 - jitter, 1.0 + jitter)
     probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    if token_mask is None:
+        valid = jnp.ones((t,), jnp.float32)
+    else:
+        valid = token_mask.astype(jnp.float32)
 
     dispatch = jnp.zeros((t, e, capacity), jnp.float32)
     combine = jnp.zeros((t, e, capacity), jnp.float32)
@@ -112,9 +120,10 @@ def top_k_gating(router_logits, k: int, capacity: int, *,
     first_mask = None
     kept_any = jnp.zeros((t,), bool)
     for _ in range(k):
-        gate = jnp.max(masked, axis=-1)                      # [T]
+        gate = jnp.max(masked, axis=-1) * valid              # [T]
         choice = jnp.argmax(masked, axis=-1)                 # [T]
-        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32) \
+            * valid[:, None]                                 # pads claim 0
         if first_mask is None:
             first_mask = onehot
         # position of each token in its chosen expert's buffer
@@ -137,10 +146,11 @@ def top_k_gating(router_logits, k: int, capacity: int, *,
     denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
     combine = jnp.where(denom > 0, combine / jnp.maximum(denom, 1e-9), 0.0)
 
-    frac_tokens = jnp.mean(first_mask, axis=0)                # [E]
-    mean_prob = jnp.mean(probs, axis=0)                       # [E]
+    n_valid = jnp.maximum(jnp.sum(valid), 1.0)
+    frac_tokens = jnp.sum(first_mask, axis=0) / n_valid       # [E]
+    mean_prob = jnp.sum(probs * valid[:, None], axis=0) / n_valid  # [E]
     aux = e * jnp.sum(frac_tokens * mean_prob)
-    dropped = 1.0 - jnp.mean(kept_any.astype(jnp.float32))
+    dropped = 1.0 - jnp.sum(kept_any.astype(jnp.float32) * valid) / n_valid
     return dispatch, combine, aux, dropped
 
 
@@ -152,15 +162,17 @@ def _expert_ffn(params, x, activation):
 
 
 def moe_ffn(params, x, *, k: int = 2, capacity_factor: float = 1.25,
-            rng=None, jitter: float = 0.0,
+            rng=None, jitter: float = 0.0, token_mask=None,
             activation=jax.nn.gelu) -> MoEOutput:
-    """Single-device MoE FFN. x: [T, D] (flatten [B, S, D] first)."""
+    """Single-device MoE FFN. x: [T, D] (flatten [B, S, D] first).
+    token_mask [T] bool: padding positions neither claim capacity nor
+    bias the aux loss."""
     t, d = x.shape
     e = params["w1"].shape[0]
     cap = capacity_for(t, e, capacity_factor, k)
     logits = x @ params["router"]["kernel"]
     dispatch, combine, aux, dropped = top_k_gating(
-        logits, k, cap, rng=rng, jitter=jitter)
+        logits, k, cap, rng=rng, jitter=jitter, token_mask=token_mask)
     expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
     expert_out = _expert_ffn(params, expert_in.astype(x.dtype), activation)
     y = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
@@ -191,7 +203,8 @@ def make_expert_parallel_ffn(mesh: Mesh, *, axis: str = MODEL_AXIS,
 
     def body(params, x, rng):
         t_loc, d = x.shape
-        e = params["w1"].shape[0] * n_exp_shards  # global expert count
+        e_loc = params["w1"].shape[0]
+        e = e_loc * n_exp_shards  # global expert count
         cap = capacity_for(t_loc, e, capacity_factor, k)
         logits = x @ params["router"]["kernel"]
         if data_axis is not None:
@@ -202,13 +215,28 @@ def make_expert_parallel_ffn(mesh: Mesh, *, axis: str = MODEL_AXIS,
         # local dispatch against ALL experts: [E, C, D]
         expert_in = jnp.einsum("tec,td->ecd", dispatch,
                                x.astype(jnp.float32)).astype(x.dtype)
+        if data_axis is None:
+            # tokens replicated: every shard already holds identical
+            # dispatch buffers, so exchanging them would move (and then
+            # compute on) n identical copies. Slice the LOCAL experts'
+            # block, run only those, and psum the partial combines —
+            # zero all-to-all, 1/n the expert FLOPs.
+            shard = lax.axis_index(axis)
+            local_in = lax.dynamic_slice_in_dim(
+                expert_in, shard * e_loc, e_loc, axis=0)
+            out = _expert_ffn(params, local_in, activation)
+            local_combine = lax.dynamic_slice_in_dim(
+                combine, shard * e_loc, e_loc, axis=1)   # [T, E_loc, C]
+            y = jnp.einsum("tec,ecd->td", local_combine,
+                           out.astype(jnp.float32))
+            y = lax.psum(y, axis).astype(x.dtype)
+            return MoEOutput(y, aux, dropped)
         # regroup: shard j receives its local experts' buffers from all
         # shards -> [E_loc * n, C, D] == concat over source shards
         recv = lax.all_to_all(expert_in, axis, split_axis=0, concat_axis=0,
                               tiled=True)
         # run local experts over the concatenated capacity blocks:
         # [n * E_loc, C, D] -> group to [E_loc, n * C, D]
-        e_loc = params["w1"].shape[0]
         grouped = recv.reshape(n_exp_shards, e_loc, cap, d).swapaxes(0, 1) \
             .reshape(e_loc, n_exp_shards * cap, d)
         out = _expert_ffn(params, grouped, activation)
@@ -219,9 +247,8 @@ def make_expert_parallel_ffn(mesh: Mesh, *, axis: str = MODEL_AXIS,
                               tiled=True)                     # [E, C, D]
         y = jnp.einsum("tec,ecd->td", combine,
                        home.astype(jnp.float32)).astype(x.dtype)
-        if data_axis is not None:
-            aux = lax.pmean(aux, data_axis)
-            dropped = lax.pmean(dropped, data_axis)
+        aux = lax.pmean(aux, data_axis)
+        dropped = lax.pmean(dropped, data_axis)
         return MoEOutput(y, aux, dropped)
 
     pspec = {"router": {"kernel": P()},
