@@ -405,6 +405,54 @@ def _init_devices_or_die(timeout_s: int = 600):
     return impl(timeout_s, progress)
 
 
+def bench_moe_lm(seq_len: int = 2048, *, batch: int = 8, dim: int = 512,
+                 n_layers: int = 8, n_heads: int = 8, vocab: int = 32000,
+                 experts: int = 8, iters: int = 10):
+    """Sparsely-activated (MoE) transformer-LM training throughput.
+    Every other block carries `experts` experts with top-2 routing —
+    ~4x the FFN parameters of the dense model at roughly iso-FLOPs;
+    the interesting number is tokens/sec vs the dense transformer row."""
+    from paddle_tpu import optim
+    from paddle_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                              n_heads=n_heads, attn_impl="auto", remat=True,
+                              moe_experts=experts)
+    params = T.init_params(jax.random.key(0), cfg)
+    n_params = sum(int(np.prod(x.shape))
+                   for x in jax.tree_util.tree_leaves(params))
+    opt = optim.adam(1e-3)
+    opt_state = opt.init(params)
+    toks = jnp.asarray(np.random.RandomState(0).randint(
+        0, vocab, (batch, seq_len)), jnp.int32)
+
+    @jax.jit
+    def step(params, opt_state, toks):
+        loss, grads = jax.value_and_grad(
+            lambda p: T.loss(p, cfg, toks))(params)
+        new_params, new_opt = opt.update(grads, opt_state, params,
+                                         jnp.zeros((), jnp.int32))
+        return new_params, new_opt, loss
+
+    progress(f"moe: warmup/compile (T={seq_len} dim={dim} E={experts})")
+    params, opt_state, loss = step(params, opt_state, toks)
+    float(loss)
+    progress(f"moe: timing {iters} steps")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, toks)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    progress(f"moe: done ({1000*dt:.1f} ms/batch)")
+    return {
+        "bench": "moe_transformer_lm", "batch": batch, "seq_len": seq_len,
+        "dim": dim, "n_layers": n_layers, "experts": experts,
+        "n_params": n_params,
+        "ms_per_batch": round(1000 * dt, 2),
+        "tokens_per_sec": round(batch * seq_len / dt, 1),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -483,6 +531,14 @@ def main():
             dim=64 if quick else 512, n_layers=2 if quick else 8,
             n_heads=2 if quick else 8, vocab=500 if quick else 32000,
             iters=iters)
+        print(json.dumps(rec))
+
+    if only and "moe" in only:  # opt-in (not in the default campaign)
+        rec = bench_moe_lm(
+            seq_len=128 if quick else 2048, batch=2 if quick else 8,
+            dim=64 if quick else 512, n_layers=2 if quick else 8,
+            n_heads=2 if quick else 8, vocab=500 if quick else 32000,
+            experts=4 if quick else 8, iters=iters)
         print(json.dumps(rec))
 
     if not only or "trainer_loop" in only:

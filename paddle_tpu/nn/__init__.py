@@ -23,6 +23,7 @@ from paddle_tpu.nn.wrappers import (
     CRF,
     CTC,
     NCE,
+    MoE,
     AdditiveAttention,
     BlockExpand,
     DataNorm,

@@ -879,3 +879,43 @@ class RowConv(Layer):
 
         lens = lengths[0] if lengths else None
         return misc_ops.row_conv(x, params["weight"], lens), {}
+
+
+class MoE(Layer):
+    """Mixture-of-experts FFN layer for the Layer DSL (no reference
+    counterpart — see parallel/moe.py for the design and the
+    expert-parallel execution path). Input [B, T, D] or [T, D]; each
+    apply writes THIS call's load-balance aux loss to
+    `state["aux_loss"]` (per-call value, not a running sum) so Trainer
+    flows can fold it into the cost."""
+
+    def __init__(self, experts: int, hidden: int, *, k: int = 2,
+                 capacity_factor: float = 1.25, activation="gelu",
+                 name: Optional[str] = None):
+        enforce(experts >= 2, "MoE needs at least 2 experts")
+        self.experts = experts
+        self.hidden = hidden
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.activation = A.get(activation)
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract: bool = False):
+        if _abstract:
+            return {}, {"aux_loss": None}, spec
+        from paddle_tpu.parallel import moe as moe_lib
+
+        d = spec.shape[-1]
+        params = moe_lib.init_moe_params(rng, self.experts, d, self.hidden)
+        return params, {"aux_loss": jnp.zeros((), jnp.float32)}, spec
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        from paddle_tpu.parallel import moe as moe_lib
+
+        shape = x.shape
+        flat = x.reshape(-1, shape[-1])
+        out = moe_lib.moe_ffn(
+            params, flat, k=self.k,
+            capacity_factor=self.capacity_factor,
+            activation=self.activation)
+        return out.y.reshape(shape), {"aux_loss": out.aux_loss}
