@@ -35,10 +35,14 @@ def make_train_step(
     remat: bool = False,
     accum_steps: int = 1,
     constrain_state_fn: Optional[Callable] = None,
+    aux_loss_weight: float = 0.0,
 ):
     """Build the jitted train step.
 
     loss_fn(outputs, *labels) -> scalar loss.
+    aux_loss_weight>0 adds that multiple of every `aux_loss` leaf found
+    in the model state to the cost (layers like nn.MoE surface their
+    load-balance regularizer this way).
     metrics_fn(outputs, *labels) -> dict of scalar metrics (optional).
     remat=True rematerialises the forward during the backward
     (jax.checkpoint) — trades FLOPs for HBM on long sequences / deep
@@ -64,6 +68,12 @@ def make_train_step(
         def compute_loss(p):
             out, new_mstate = apply_model(p, mstate, rng, *inputs)
             loss = loss_fn(out, *labels)
+            if aux_loss_weight:
+                for path, leaf in jax.tree_util.tree_leaves_with_path(
+                        new_mstate):
+                    key = getattr(path[-1], "key", None) if path else None
+                    if key == "aux_loss":
+                        loss = loss + aux_loss_weight * leaf
             return loss, (out, new_mstate)
 
         (loss, (out, new_mstate)), grads = jax.value_and_grad(
@@ -171,6 +181,7 @@ class Trainer:
         num_inputs: int = 1,
         seed: int = 0,
         remat: bool = False,
+        aux_loss_weight: float = 0.0,
     ):
         self.model = model
         self.loss_fn = loss_fn
@@ -179,7 +190,8 @@ class Trainer:
         self.num_inputs = num_inputs
         self._rng = jax.random.key(seed)
         self._train_step = make_train_step(
-            model, loss_fn, optimizer, metrics_fn=metrics_fn, remat=remat
+            model, loss_fn, optimizer, metrics_fn=metrics_fn, remat=remat,
+            aux_loss_weight=aux_loss_weight,
         )
         self._eval_step = make_eval_step(model, loss_fn, metrics_fn=metrics_fn)
 

@@ -274,3 +274,49 @@ class TestMoELayerWrapper:
         assert np.isfinite(float(new_state["aux_loss"]))
         # shape inference without allocation
         assert layer.out_spec(ShapeSpec((2, 6, 8))).shape == (2, 6, 8)
+
+
+class TestMoETrainerFlow:
+    def test_trainer_with_aux_loss_weight(self):
+        """The Layer-DSL user flow: Sequential with an MoE block under
+        the Trainer, load-balance aux folded into the cost via
+        aux_loss_weight."""
+        from paddle_tpu import nn, optim
+        from paddle_tpu.nn.module import ShapeSpec
+        from paddle_tpu.ops import losses
+        from paddle_tpu.train import events as E
+        from paddle_tpu.train.trainer import Trainer
+
+        model = nn.Sequential([
+            nn.Dense(16, name="in", activation="relu"),
+            nn.MoE(4, 32, capacity_factor=4.0, name="moe"),
+            nn.Dense(4, name="out"),
+        ])
+        trainer = Trainer(
+            model,
+            loss_fn=lambda logits, y: jnp.mean(
+                losses.softmax_cross_entropy(logits[:, 0], y)),
+            optimizer=optim.adam(3e-3),
+            aux_loss_weight=0.01,
+        )
+        state = trainer.init_state(ShapeSpec((16, 1, 8)))
+        r = np.random.RandomState(0)
+        xs = r.randn(4, 16, 1, 8).astype(np.float32)
+        ys = r.randint(0, 4, (4, 16))
+
+        def batches():
+            for i in range(4):
+                yield (jnp.asarray(xs[i]), jnp.asarray(ys[i]))
+
+        costs = []
+
+        def handler(ev):
+            if isinstance(ev, E.EndIteration):
+                costs.append(float(ev.cost))
+
+        state = trainer.train(state, batches, num_passes=30,
+                              event_handler=handler)
+        assert costs[-1] < costs[0], (costs[0], costs[-1])
+        # the state carries the per-call aux loss
+        aux = state.model_state["moe"]["aux_loss"]
+        assert np.isfinite(float(aux)) and float(aux) > 0
