@@ -1,0 +1,91 @@
+"""Train a small transformer LM and sample from it — the modern
+flagship's user flow (dense or MoE, long-context ready).
+
+Run: python examples/transformer_lm.py [--steps 200] [--moe]
+
+The task is character-level copy-structure text (synthetic, zero
+egress): sequences follow an order-1 Markov chain, so a small model
+learns it quickly and greedy samples show the learned structure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+    jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu import optim
+from paddle_tpu.models import transformer as T
+
+
+def make_batch(rng, vocab, batch, seq_len):
+    toks = np.empty((batch, seq_len), np.int32)
+    toks[:, 0] = rng.randint(0, vocab, batch)
+    for t in range(1, seq_len):
+        toks[:, t] = (3 * toks[:, t - 1] + rng.randint(0, 5, batch)) % vocab
+    return jnp.asarray(toks)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq-len", type=int, default=64)
+    ap.add_argument("--vocab", type=int, default=128)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--moe", action="store_true",
+                    help="sparse FFN blocks (4 experts, top-2)")
+    args = ap.parse_args()
+
+    cfg = T.TransformerConfig(
+        vocab=args.vocab, dim=args.dim, n_layers=args.layers, n_heads=4,
+        attn_impl="auto",
+        moe_experts=4 if args.moe else 0, moe_capacity_factor=2.0)
+    params = T.init_params(jax.random.key(0), cfg)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    print(f"{'MoE' if args.moe else 'dense'} transformer: "
+          f"{n_params:,} parameters")
+
+    opt = optim.adam(3e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, toks, i):
+        loss, grads = jax.value_and_grad(
+            lambda p: T.loss(p, cfg, toks))(params)
+        params, opt_state = opt.update(grads, opt_state, params, i)
+        return params, opt_state, loss
+
+    r = np.random.RandomState(0)
+    for i in range(args.steps):
+        toks = make_batch(r, args.vocab, args.batch, args.seq_len)
+        params, opt_state, loss = step(params, opt_state, toks,
+                                       jnp.asarray(i))
+        if i % 50 == 0 or i == args.steps - 1:
+            print(f"step {i:4d}  loss {float(loss):.4f}")
+
+    prompt = make_batch(np.random.RandomState(7), args.vocab, 2, 8)
+    out = T.generate(params, cfg, prompt, steps=12)
+    print("greedy samples (prompt | continuation):")
+    for row in np.asarray(out):
+        print(" ", [int(v) for v in row[:8]], "|",
+              [int(v) for v in row[8:]])
+    # the learned rule is next = (3*tok + U[0,5)) % vocab — check the
+    # first continuation step obeys it for both samples
+    ok = all((row[8] - 3 * row[7]) % args.vocab < 5 for row in np.asarray(out))
+    print("continuations obey the chain rule:", ok)
+
+
+if __name__ == "__main__":
+    main()
