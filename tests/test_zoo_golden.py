@@ -65,6 +65,9 @@ def _cases():
         "transformer_small": lambda rng: tf.init_params(
             rng, tf.TransformerConfig(vocab=512, dim=64, n_layers=2,
                                       n_heads=4)),
+        "transformer_moe": lambda rng: tf.init_params(
+            rng, tf.TransformerConfig(vocab=512, dim=64, n_layers=2,
+                                      n_heads=4, moe_experts=4)),
         "word2vec": lambda rng: models.word2vec.init_params(
             rng, 1000, embed_dim=32, hidden=64),
         "recommender": lambda rng: models.recommender.init_params(
