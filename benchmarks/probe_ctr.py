@@ -38,10 +38,12 @@ def main():
     args = ap.parse_args()
 
     from paddle_tpu import optim
+    from paddle_tpu.core import devices as dev_lib
     from paddle_tpu.core import mesh as mesh_lib
     from paddle_tpu.models.ctr import CTRModel
 
-    n_dev = len(jax.devices())
+    # fail fast (exit 3) on a wedged relay instead of hanging
+    n_dev = len(dev_lib.init_devices_or_die())
     mesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=1, model=n_dev))
     model = CTRModel(vocab=args.vocab, embed_dim=args.dim, mesh=mesh)
     r = np.random.RandomState(0)

@@ -39,9 +39,13 @@ def main():
     ap.add_argument("--seq", type=int, default=100)
     args = ap.parse_args()
 
+    from paddle_tpu.core import devices as dev_lib
     from paddle_tpu.core import dtypes
     from paddle_tpu.ops import rnn as rnn_ops
 
+    # fail fast (exit 3) on a wedged relay instead of hanging until the
+    # campaign stage timeout reaps us
+    dev_lib.init_devices_or_die()
     dtypes.set_default_policy(dtypes.bf16_compute_policy())
     b, t = args.batch, args.seq
 

@@ -61,6 +61,10 @@ def main():
     ap.add_argument("--logdir", default="/tmp/pt_trace")
     args = ap.parse_args()
 
+    from paddle_tpu.core import devices as dev_lib
+
+    # fail fast (exit 3) on a wedged relay instead of hanging
+    dev_lib.init_devices_or_die()
     step, state, rng, x, y = build_step(args.model, args.batch)
     state, loss, _ = step(state, rng, (x,), (y,))
     float(loss)
