@@ -64,6 +64,9 @@ run suite_resnet 1800 python benchmarks/suite.py --only resnet50
 run suite_resnet_s2d 1800 python benchmarks/suite.py --only resnet50_s2d
 run suite_vgg 1800 python benchmarks/suite.py --only vgg19
 
+# 6b. MoE transformer row (opt-in bench; T=2048 compiles small)
+run suite_moe 1800 python benchmarks/suite.py --only moe
+
 # 7. refreshed profile trace for PROFILE_NOTES
 run profile 1200 python benchmarks/profile_step.py --batch 256 --iters 10
 
