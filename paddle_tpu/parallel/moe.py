@@ -85,21 +85,32 @@ def capacity_for(n_tokens: int, n_experts: int,
     return -(-raw // multiple) * multiple
 
 
-def top_k_gating(router_logits, k: int, capacity: int, *,
-                 rng: Optional[jax.Array] = None, jitter: float = 0.0,
-                 token_mask=None):
-    """Dispatch/combine tensors from router logits.
+class Routing(NamedTuple):
+    """Index-form routing: per round r < k and token t, token t goes to
+    `expert[r, t]` slot `slot[r, t]` with weight `gate[r, t]` (0 when
+    dropped). Linear in T — the dense [T, E, C] tensors are derived
+    views for small shapes (top_k_gating)."""
+    expert: jnp.ndarray    # [k, T] int32
+    slot: jnp.ndarray      # [k, T] int32
+    keep: jnp.ndarray      # [k, T] bool
+    gate: jnp.ndarray      # [k, T] f32, kept-renormalized per token
+    aux_loss: jnp.ndarray  # scalar
+    dropped: jnp.ndarray   # scalar
+
+
+def top_k_routing(router_logits, k: int, capacity: int, *,
+                  rng: Optional[jax.Array] = None, jitter: float = 0.0,
+                  token_mask=None) -> Routing:
+    """Top-k expert assignment with fixed capacity, in index form.
 
     router_logits: [T, E]. token_mask: optional [T] bool — False
     positions (padding) claim NO capacity slots, contribute nothing to
-    the aux loss, and don't count as dropped. Returns (dispatch
-    [T, E, C] one-hot, combine [T, E, C] gate weights, aux_loss,
-    dropped_frac).
+    the aux loss, and don't count as dropped.
 
     aux_loss is the Switch/GShard load-balancing term: E * sum_e
     (token_fraction_e * mean_router_prob_e) — 1.0 at perfect balance.
     Position within each expert's capacity is assigned in token order
-    (cumsum over the one-hot), over-capacity assignments get weight 0.
+    (cumsum over the one-hot), over-capacity assignments get gate 0.
     """
     t, e = router_logits.shape
     if rng is not None and jitter > 0.0:
@@ -112,13 +123,12 @@ def top_k_gating(router_logits, k: int, capacity: int, *,
     else:
         valid = token_mask.astype(jnp.float32)
 
-    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
-    combine = jnp.zeros((t, e, capacity), jnp.float32)
     # claimed[e] tokens already routed to expert e by earlier choices
     claimed = jnp.zeros((e,), jnp.int32)
     masked = probs
     first_mask = None
     kept_any = jnp.zeros((t,), bool)
+    experts, slots, keeps, gates = [], [], [], []
     for _ in range(k):
         gate = jnp.max(masked, axis=-1) * valid              # [T]
         choice = jnp.argmax(masked, axis=-1)                 # [T]
@@ -129,29 +139,119 @@ def top_k_gating(router_logits, k: int, capacity: int, *,
         # position of each token in its chosen expert's buffer
         pos = (jnp.cumsum(onehot, axis=0) - onehot) + claimed[None, :]
         pos_tok = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)  # [T]
-        keep = pos_tok < capacity
+        keep = (pos_tok < capacity) & (valid > 0)
         kept_any = kept_any | keep
-        pos_oh = jax.nn.one_hot(jnp.where(keep, pos_tok, capacity),
-                                capacity, dtype=jnp.float32)  # OOB -> zeros
-        sel = onehot[:, :, None] * pos_oh[:, None, :]         # [T, E, C]
-        dispatch = dispatch + sel
-        combine = combine + gate[:, None, None] * sel
+        experts.append(choice.astype(jnp.int32))
+        slots.append(jnp.minimum(pos_tok, capacity - 1))
+        keeps.append(keep)
+        gates.append(gate * keep.astype(jnp.float32))
         claimed = claimed + jnp.sum(
             onehot * keep[:, None].astype(jnp.float32), axis=0).astype(
                 jnp.int32)
         masked = masked * (1.0 - onehot)                      # next choice
 
-    # renormalize over the KEPT gates so each surviving token's combine
-    # weights sum to 1 (dropped assignments are excluded from the mass)
-    denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
-    combine = jnp.where(denom > 0, combine / jnp.maximum(denom, 1e-9), 0.0)
+    gate_kt = jnp.stack(gates)                                # [k, T]
+    # renormalize over the KEPT gates so each surviving token's weights
+    # sum to 1 (dropped assignments are excluded from the mass)
+    denom = jnp.sum(gate_kt, axis=0, keepdims=True)
+    gate_kt = jnp.where(denom > 0, gate_kt / jnp.maximum(denom, 1e-9), 0.0)
 
     n_valid = jnp.maximum(jnp.sum(valid), 1.0)
     frac_tokens = jnp.sum(first_mask, axis=0) / n_valid       # [E]
     mean_prob = jnp.sum(probs * valid[:, None], axis=0) / n_valid  # [E]
     aux = e * jnp.sum(frac_tokens * mean_prob)
     dropped = 1.0 - jnp.sum(kept_any.astype(jnp.float32) * valid) / n_valid
-    return dispatch, combine, aux, dropped
+    return Routing(jnp.stack(experts), jnp.stack(slots), jnp.stack(keeps),
+                   gate_kt, aux, dropped)
+
+
+def top_k_gating(router_logits, k: int, capacity: int, *,
+                 rng: Optional[jax.Array] = None, jitter: float = 0.0,
+                 token_mask=None):
+    """Dense [T, E, C] dispatch/combine tensors derived from
+    top_k_routing — O(T*E*C) memory, intended for small shapes and
+    tests; the compute paths use the index form or the einsum dispatch
+    chosen by _use_scatter. Returns (dispatch, combine, aux_loss,
+    dropped_frac)."""
+    t, e = router_logits.shape
+    r = top_k_routing(router_logits, k, capacity, rng=rng, jitter=jitter,
+                      token_mask=token_mask)
+    dispatch, combine = _dense_from_routing(r, e, capacity)
+    return dispatch, combine, r.aux_loss, r.dropped
+
+
+def _dense_from_routing(r: Routing, e: int, capacity: int):
+    eo = jax.nn.one_hot(r.expert, e, dtype=jnp.float32) \
+        * r.keep[..., None]                                   # [k, T, E]
+    so = jax.nn.one_hot(r.slot, capacity, dtype=jnp.float32) \
+        * r.keep[..., None]                                   # [k, T, C]
+    sel = eo[:, :, :, None] * so[:, :, None, :]               # [k, T, E, C]
+    dispatch = jnp.sum(sel, axis=0)
+    combine = jnp.sum(r.gate[:, :, None, None] * sel, axis=0)
+    return dispatch, combine
+
+
+# element-count ceiling for materializing the dense [T, E, C] dispatch
+# tensor (einsum dispatch feeds the MXU best at small/medium shapes; at
+# LM shapes C grows with T so the tensor is quadratic in T and must be
+# avoided — 2^24 f32 elements = 64 MiB)
+_EINSUM_DISPATCH_MAX = 1 << 24
+
+
+def _use_scatter(impl: str, t: int, e: int, cap: int) -> bool:
+    if impl == "auto":
+        return t * e * cap > _EINSUM_DISPATCH_MAX
+    if impl in ("scatter", "einsum"):
+        return impl == "scatter"
+    raise ValueError(f"dispatch_impl must be auto|einsum|scatter, got {impl}")
+
+
+def _dispatch_expert_in(routing: Routing, x, e: int, cap: int, impl: str):
+    """[E, C, D] expert inputs via the impl chosen by _use_scatter.
+    Returns (expert_in, dense_combine_or_None) — the dense combine is
+    reused by _combine_out when the einsum path was taken."""
+    t = x.shape[0]
+    if _use_scatter(impl, t, e, cap):
+        return scatter_dispatch(routing, x, e, cap), None
+    dispatch, combine = _dense_from_routing(routing, e, cap)
+    ein = jnp.einsum("tec,td->ecd", dispatch,
+                     x.astype(jnp.float32)).astype(x.dtype)
+    return ein, combine
+
+
+def _combine_out(routing: Routing, dense_combine, out_ecd, cap: int):
+    """Per-token combine matching _dispatch_expert_in's chosen impl."""
+    if dense_combine is None:
+        return gather_combine(routing, out_ecd, cap)
+    return jnp.einsum("tec,ecd->td", dense_combine,
+                      out_ecd.astype(jnp.float32))
+
+
+def scatter_dispatch(routing: Routing, x, n_experts: int, capacity: int):
+    """Build [E, C, D] expert inputs by scatter-add — O(k*T + E*C*D)
+    memory (the einsum dispatch materializes [T, E, C], quadratic in T
+    since C grows with T; at LM shapes that tensor is GBs)."""
+    k, t = routing.expert.shape
+    d = x.shape[-1]
+    flat = routing.expert * capacity + routing.slot           # [k, T]
+    # dropped assignments -> index E*C, written into a dump row
+    flat = jnp.where(routing.keep, flat, n_experts * capacity)
+    buf = jnp.zeros((n_experts * capacity + 1, d), x.dtype)
+    xs = jnp.broadcast_to(x, (k, t, d)).reshape(k * t, d)
+    buf = buf.at[flat.reshape(-1)].add(xs)
+    return buf[:-1].reshape(n_experts, capacity, d)
+
+
+def gather_combine(routing: Routing, expert_out, capacity: int):
+    """Combine [E, C, D] expert outputs back per token: y[t] = sum_r
+    gate[r,t] * out[expert[r,t], slot[r,t]] — gates are 0 for dropped
+    assignments, so any gathered row there is discarded."""
+    e, c, d = expert_out.shape
+    flat_out = expert_out.reshape(e * c, d).astype(jnp.float32)
+    flat = routing.expert * capacity + routing.slot           # [k, T]
+    picked = jnp.take(flat_out, flat.reshape(-1), axis=0)     # [k*T, D]
+    picked = picked.reshape(*flat.shape, d)                   # [k, T, D]
+    return jnp.sum(routing.gate[..., None] * picked, axis=0)  # [T, D]
 
 
 def _expert_ffn(params, x, activation):
@@ -163,27 +263,32 @@ def _expert_ffn(params, x, activation):
 
 def moe_ffn(params, x, *, k: int = 2, capacity_factor: float = 1.25,
             rng=None, jitter: float = 0.0, token_mask=None,
-            activation=jax.nn.gelu) -> MoEOutput:
+            activation=jax.nn.gelu,
+            dispatch_impl: str = "auto") -> MoEOutput:
     """Single-device MoE FFN. x: [T, D] (flatten [B, S, D] first).
     token_mask [T] bool: padding positions neither claim capacity nor
-    bias the aux loss."""
+    bias the aux loss. dispatch_impl: "einsum" (one-hot matmuls,
+    materializes [T, E, C]) vs "scatter" (linear-memory scatter/gather);
+    "auto" picks by the dense tensor's size."""
     t, d = x.shape
     e = params["w1"].shape[0]
     cap = capacity_for(t, e, capacity_factor, k)
     logits = x @ params["router"]["kernel"]
-    dispatch, combine, aux, dropped = top_k_gating(
-        logits, k, cap, rng=rng, jitter=jitter, token_mask=token_mask)
-    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
-    expert_out = _expert_ffn(params, expert_in.astype(x.dtype), activation)
-    y = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
-    return MoEOutput(y.astype(x.dtype), aux, dropped)
+    routing = top_k_routing(logits, k, cap, rng=rng, jitter=jitter,
+                            token_mask=token_mask)
+    expert_in, dense_combine = _dispatch_expert_in(routing, x, e, cap,
+                                                   dispatch_impl)
+    expert_out = _expert_ffn(params, expert_in, activation)
+    y = _combine_out(routing, dense_combine, expert_out, cap)
+    return MoEOutput(y.astype(x.dtype), routing.aux_loss, routing.dropped)
 
 
 def make_expert_parallel_ffn(mesh: Mesh, *, axis: str = MODEL_AXIS,
                              data_axis: Optional[str] = None,
                              k: int = 2, capacity_factor: float = 1.25,
                              jitter: float = 0.0,
-                             activation=jax.nn.gelu):
+                             activation=jax.nn.gelu,
+                             dispatch_impl: str = "auto"):
     """Build an expert-parallel MoE FFN over `mesh`.
 
     Tokens arrive sharded over `data_axis` (or replicated when None);
@@ -210,27 +315,30 @@ def make_expert_parallel_ffn(mesh: Mesh, *, axis: str = MODEL_AXIS,
         if data_axis is not None:
             # distinct jitter noise per data shard
             rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
-        dispatch, combine, aux, dropped = top_k_gating(
-            logits, k, cap, rng=rng, jitter=jitter)
-        # local dispatch against ALL experts: [E, C, D]
-        expert_in = jnp.einsum("tec,td->ecd", dispatch,
-                               x.astype(jnp.float32)).astype(x.dtype)
+        routing = top_k_routing(logits, k, cap, rng=rng, jitter=jitter)
+        aux, dropped = routing.aux_loss, routing.dropped
         if data_axis is None:
-            # tokens replicated: every shard already holds identical
-            # dispatch buffers, so exchanging them would move (and then
-            # compute on) n identical copies. Slice the LOCAL experts'
-            # block, run only those, and psum the partial combines —
-            # zero all-to-all, 1/n the expert FLOPs.
+            # tokens replicated: every shard computes identical routing,
+            # so exchanging dispatch buffers would move (and compute on)
+            # n identical copies. Run only the LOCAL experts'
+            # assignments and psum the partial combines — zero
+            # all-to-all, 1/n the expert FLOPs.
             shard = lax.axis_index(axis)
-            local_in = lax.dynamic_slice_in_dim(
-                expert_in, shard * e_loc, e_loc, axis=0)
+            local_e = routing.expert - shard * e_loc
+            in_range = (local_e >= 0) & (local_e < e_loc) & routing.keep
+            r_loc = routing._replace(
+                expert=jnp.clip(local_e, 0, e_loc - 1),
+                keep=in_range,
+                gate=routing.gate * in_range.astype(jnp.float32))
+            local_in, dense_c = _dispatch_expert_in(r_loc, x, e_loc, cap,
+                                                    dispatch_impl)
             out = _expert_ffn(params, local_in, activation)
-            local_combine = lax.dynamic_slice_in_dim(
-                combine, shard * e_loc, e_loc, axis=1)   # [T, E_loc, C]
-            y = jnp.einsum("tec,ecd->td", local_combine,
-                           out.astype(jnp.float32))
+            y = _combine_out(r_loc, dense_c, out, cap)
             y = lax.psum(y, axis).astype(x.dtype)
             return MoEOutput(y, aux, dropped)
+        # local dispatch against ALL experts: [E, C, D]
+        expert_in, combine = _dispatch_expert_in(routing, x, e, cap,
+                                                 dispatch_impl)
         # regroup: shard j receives its local experts' buffers from all
         # shards -> [E_loc * n, C, D] == concat over source shards
         recv = lax.all_to_all(expert_in, axis, split_axis=0, concat_axis=0,
@@ -245,8 +353,7 @@ def make_expert_parallel_ffn(mesh: Mesh, *, axis: str = MODEL_AXIS,
             .reshape(n_exp_shards * e_loc, cap, d)
         home = lax.all_to_all(back, axis, split_axis=0, concat_axis=0,
                               tiled=True)                     # [E, C, D]
-        y = jnp.einsum("tec,ecd->td", combine,
-                       home.astype(jnp.float32)).astype(x.dtype)
+        y = _combine_out(routing, combine, home, cap).astype(x.dtype)
         aux = lax.pmean(aux, data_axis)
         dropped = lax.pmean(dropped, data_axis)
         return MoEOutput(y, aux, dropped)
