@@ -361,3 +361,78 @@ class TestDispatchImpls:
         np.testing.assert_allclose(
             np.asarray(ga["router"]["kernel"]),
             np.asarray(gb["router"]["kernel"]), atol=1e-5)
+
+
+class TestRoutingProperties:
+    def test_invariants_random_shapes(self):
+        """Property sweep: for random (T, E, cap, k, mask) the routing
+        must never collide slots, never let pads claim capacity, and
+        keep per-token gate mass in [0, 1]."""
+        r = np.random.RandomState(0)
+        for trial in range(12):
+            t = int(r.randint(3, 40))
+            e = int(r.choice([2, 3, 4, 8]))
+            cap = int(r.randint(1, 10))
+            k = int(r.randint(1, min(e, 3) + 1))
+            logits = jnp.asarray(r.randn(t, e), jnp.float32)
+            mask = jnp.asarray(r.rand(t) > 0.3) if trial % 2 else None
+            rt = moe.top_k_routing(logits, k, cap, token_mask=mask)
+            kept = np.asarray(rt.keep)
+            ex = np.asarray(rt.expert)[kept]
+            sl = np.asarray(rt.slot)[kept]
+            # (expert, slot) pairs unique among kept assignments
+            pairs = list(zip(ex.tolist(), sl.tolist()))
+            assert len(pairs) == len(set(pairs)), (trial, pairs)
+            assert (sl < cap).all()
+            # pads never kept
+            if mask is not None:
+                assert not kept[:, ~np.asarray(mask)].any()
+            # gate mass per token in [0, 1] (+eps)
+            mass = np.asarray(jnp.sum(rt.gate, axis=0))
+            assert (mass <= 1 + 1e-5).all() and (mass >= -1e-6).all()
+            # dropped fraction consistent with keeps on valid tokens
+            valid = np.ones(t, bool) if mask is None else np.asarray(mask)
+            got_any = kept.any(axis=0)
+            want = 1.0 - got_any[valid].mean() if valid.any() else 0.0
+            np.testing.assert_allclose(float(rt.dropped), want, atol=1e-6)
+
+    def test_layer_dsl_sharded_moe_step(self):
+        """Layer-DSL EP: Sequential with nn.MoE under
+        make_sharded_train_step with expert-dim param rules."""
+        from jax.sharding import PartitionSpec as P
+
+        from paddle_tpu import nn, optim, parallel
+        from paddle_tpu.nn.module import ShapeSpec
+        from paddle_tpu.ops import losses
+        from paddle_tpu.train.state import TrainState
+
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshConfig(data=2, model=4),
+            devices=jax.devices()[:8])
+        model = nn.Sequential([
+            nn.Dense(16, name="in", activation="relu"),
+            nn.MoE(4, 32, capacity_factor=4.0, name="moe"),
+            nn.Dense(4, name="out"),
+        ])
+        rules = [(r"moe/router/kernel$", P()),
+                 (r"moe/(w1|b1|w2|b2)$", P(mesh_lib.MODEL_AXIS))]
+        params, mstate = model.init(jax.random.key(0),
+                                    ShapeSpec((16, 1, 8)))
+        opt = optim.adam(1e-3)
+        state = parallel.shard_train_state(
+            TrainState.create(params, mstate, opt), mesh,
+            param_rules=rules)
+        step = parallel.make_sharded_train_step(
+            model, lambda logits, y: jnp.mean(
+                losses.softmax_cross_entropy(logits[:, 0], y)),
+            opt, mesh, param_rules=rules)
+        r = np.random.RandomState(0)
+        x = jax.device_put(r.randn(16, 1, 8).astype(np.float32),
+                           parallel.batch_sharding(mesh))
+        y = jax.device_put(r.randint(0, 4, 16),
+                           parallel.batch_sharding(mesh))
+        new_state, loss, _ = step(state, jax.random.key(1), (x,), (y,))
+        jax.block_until_ready(new_state.params)
+        assert np.isfinite(float(loss))
+        spec = new_state.params["moe"]["w1"].sharding.spec
+        assert spec[0] == mesh_lib.MODEL_AXIS
