@@ -75,6 +75,9 @@ class TransformerConfig:
     moe_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # "topk" (GShard token-choice, needs the aux loss) or
+    # "expert_choice" (experts pick tokens: perfect balance, no aux)
+    moe_router: str = "topk"
 
     @property
     def head_dim(self) -> int:
@@ -167,9 +170,19 @@ def _ffn(cfg: TransformerConfig, p, y, token_mask=None):
 
         b, t, d = y.shape
         flat_mask = None if token_mask is None else token_mask.reshape(b * t)
-        out = moe.moe_ffn(p["moe"], y.reshape(b * t, d), k=cfg.moe_k,
-                          capacity_factor=cfg.moe_capacity_factor,
-                          token_mask=flat_mask)
+        if cfg.moe_router == "expert_choice":
+            out = moe.expert_choice_ffn(
+                p["moe"], y.reshape(b * t, d),
+                capacity_factor=cfg.moe_capacity_factor,
+                token_mask=flat_mask)
+        elif cfg.moe_router == "topk":
+            out = moe.moe_ffn(p["moe"], y.reshape(b * t, d), k=cfg.moe_k,
+                              capacity_factor=cfg.moe_capacity_factor,
+                              token_mask=flat_mask)
+        else:
+            raise ValueError(
+                f"moe_router must be 'topk' or 'expert_choice', got "
+                f"{cfg.moe_router!r}")
         return out.y.reshape(b, t, d), out.aux_loss
     y = jax.nn.gelu(linalg.dense(y, p["fc1"]["kernel"], p["fc1"]["bias"]))
     return (linalg.dense(y, p["fc2"]["kernel"], p["fc2"]["bias"]),

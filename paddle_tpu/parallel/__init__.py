@@ -33,6 +33,7 @@ from paddle_tpu.parallel.sparse import (
 from paddle_tpu.parallel import distributed
 from paddle_tpu.parallel import moe
 from paddle_tpu.parallel.moe import (
+    expert_choice_ffn,
     init_moe_params,
     make_expert_parallel_ffn,
     moe_ffn,

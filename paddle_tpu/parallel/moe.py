@@ -165,6 +165,59 @@ def top_k_routing(router_logits, k: int, capacity: int, *,
                    gate_kt, aux, dropped)
 
 
+class ECRouting(NamedTuple):
+    """Expert-choice routing (Zhou et al. 2022): each EXPERT picks its
+    top-`capacity` tokens. token_idx[e, c] is the token filling expert
+    e's slot c; gate[e, c] its combine weight (0 for masked padding)."""
+    token_idx: jnp.ndarray  # [E, C] int32
+    gate: jnp.ndarray       # [E, C] f32
+    dropped: jnp.ndarray    # scalar: fraction of valid tokens no expert picked
+
+
+def expert_choice_routing(router_logits, capacity: int, *,
+                          token_mask=None) -> ECRouting:
+    """Every expert slot fills (perfect load balance, no aux loss
+    needed); a token can be picked by several experts or none (residual
+    carries unpicked tokens). Dispatch is a pure gather, combine a
+    scatter-add — no capacity bookkeeping at all."""
+    t, e = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    if token_mask is not None:
+        probs = probs * token_mask.astype(jnp.float32)[:, None]
+    gate, token_idx = jax.lax.top_k(probs.T, capacity)    # [E, C] each
+    picked = jnp.zeros((t,), bool).at[token_idx.reshape(-1)].set(
+        True, mode="drop")
+    valid = jnp.ones((t,), bool) if token_mask is None else token_mask
+    n_valid = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+    dropped = 1.0 - jnp.sum((picked & valid).astype(jnp.float32)) / n_valid
+    return ECRouting(token_idx.astype(jnp.int32), gate, dropped)
+
+
+def expert_choice_ffn(params, x, *, capacity_factor: float = 2.0,
+                      token_mask=None,
+                      activation=jax.nn.gelu) -> MoEOutput:
+    """MoE FFN under expert-choice routing. x: [T, D]. Capacity per
+    expert = capacity_factor * T / E (the paper's formulation; factor 2
+    means each token is used twice on average)."""
+    t, d = x.shape
+    e = params["w1"].shape[0]
+    # an expert can never take more tokens than exist — decode steps
+    # (t = batch) and short prefills would otherwise ask top_k for more
+    # entries than the token axis holds
+    cap = min(capacity_for(t, e, capacity_factor), t)
+    logits = x @ params["router"]["kernel"]
+    r = expert_choice_routing(logits, cap, token_mask=token_mask)
+    expert_in = jnp.take(x, r.token_idx.reshape(-1), axis=0) \
+        .reshape(e, cap, d)                               # pure gather
+    out = _expert_ffn(params, expert_in, activation)
+    weighted = (r.gate[..., None] * out.astype(jnp.float32)) \
+        .reshape(e * cap, d)
+    y = jnp.zeros((t, d), jnp.float32).at[r.token_idx.reshape(-1)] \
+        .add(weighted)                                    # scatter combine
+    return MoEOutput(y.astype(x.dtype), jnp.zeros((), jnp.float32),
+                     r.dropped)
+
+
 def top_k_gating(router_logits, k: int, capacity: int, *,
                  rng: Optional[jax.Array] = None, jitter: float = 0.0,
                  token_mask=None):
