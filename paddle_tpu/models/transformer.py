@@ -212,19 +212,21 @@ def _block_parts(cfg: TransformerConfig, p, x, positions, attn_fn,
     return x + out, k, v, aux
 
 
-def _block(cfg: TransformerConfig, p, x, positions, token_mask=None):
-    out, _, _, aux = _block_parts(
-        cfg, p, x, positions,
-        lambda q, k, v: _attention(cfg, q, k, v, causal=True),
-        token_mask)
+def _block(cfg: TransformerConfig, p, x, positions, token_mask=None,
+           attn_fn=None):
+    if attn_fn is None:
+        attn_fn = lambda q, k, v: _attention(cfg, q, k, v, causal=True)
+    out, _, _, aux = _block_parts(cfg, p, x, positions, attn_fn,
+                                  token_mask)
     return out, aux
 
 
 def _forward(params, cfg: TransformerConfig, tokens, positions=None,
-             token_mask=None):
+             token_mask=None, attn_fn=None):
     """tokens [B,T] int32 -> (logits [B,T,V], summed MoE aux loss).
     token_mask [B,T] bool marks real (non-padding) positions for MoE
-    capacity accounting."""
+    capacity accounting. attn_fn overrides the config's attention (the
+    context-parallel builder injects ring/Ulysses attention here)."""
     policy = default_policy()
     x = jnp.take(params["embed"]["table"], tokens, axis=0)
     x = x.astype(policy.compute_dtype)
@@ -233,10 +235,11 @@ def _forward(params, cfg: TransformerConfig, tokens, positions=None,
             jnp.arange(tokens.shape[1]), tokens.shape)
     blk = _block
     if cfg.remat:
-        blk = jax.checkpoint(_block, static_argnums=(0,))
+        # cfg and attn_fn are static (non-pytree) arguments
+        blk = jax.checkpoint(_block, static_argnums=(0, 5))
     aux = jnp.zeros((), jnp.float32)
     for p in params["blocks"]:
-        x, a = blk(cfg, p, x, positions, token_mask)
+        x, a = blk(cfg, p, x, positions, token_mask, attn_fn)
         aux = aux + a
     x = norm_ops.layer_norm(x, params["ln_f"]["scale"],
                             params["ln_f"]["offset"])
@@ -248,14 +251,16 @@ def apply(params, cfg: TransformerConfig, tokens, positions=None):
     return _forward(params, cfg, tokens, positions)[0]
 
 
-def loss(params, cfg: TransformerConfig, tokens, lengths=None):
+def loss(params, cfg: TransformerConfig, tokens, lengths=None,
+         attn_fn=None):
     """Next-token cross entropy (+ weighted MoE load-balance aux when
     the config has experts); positions >= lengths are masked out of the
     CE term AND of MoE expert capacity/aux accounting."""
     tmask = None
     if lengths is not None:
         tmask = jnp.arange(tokens.shape[1] - 1)[None, :] < lengths[:, None]
-    logits, aux = _forward(params, cfg, tokens[:, :-1], token_mask=tmask)
+    logits, aux = _forward(params, cfg, tokens[:, :-1], token_mask=tmask,
+                           attn_fn=attn_fn)
     targets = tokens[:, 1:]
     lse = jax.nn.logsumexp(at_least_f32(logits), axis=-1)
     gold = jnp.take_along_axis(
@@ -269,6 +274,30 @@ def loss(params, cfg: TransformerConfig, tokens, lengths=None):
     if cfg.moe_experts > 0:
         ce = ce + cfg.moe_aux_weight * aux
     return ce
+
+
+def make_context_parallel_loss(cfg: TransformerConfig, mesh, *,
+                               kind: str = "ring",
+                               batch_axis: Optional[str] = None):
+    """Context parallelism for the flagship LM: sequence-shard the
+    tokens over the mesh `seq` axis and run every attention layer as
+    ring (or Ulysses) attention — exact causal attention where no
+    device ever holds the full sequence's K/V (parallel/ring_attention
+    .py). Position-wise layers partition automatically under jit.
+
+    Returns loss_fn(params, tokens, lengths=None). Feed tokens of
+    length n*seq_shards + 1 (the loss slices one off for targets and
+    the sharded attention needs T % seq_shards == 0).
+    """
+    from paddle_tpu import parallel as par
+
+    attn = par.make_sequence_parallel_attention(
+        mesh, kind=kind, causal=True, batch_axis=batch_axis)
+
+    def loss_fn(params, tokens, lengths=None):
+        return loss(params, cfg, tokens, lengths, attn_fn=attn)
+
+    return loss_fn
 
 
 def generate(params, cfg: TransformerConfig, prompt, steps: int):

@@ -36,10 +36,15 @@ NEG_INF = -1e30
 def dense_attention(q, k, v, *, causal: bool = False, mask=None):
     """Reference dense attention. q,k,v: [B, T, H, D] -> [B, T, H, D].
 
-    `mask`: optional [B, Tq, Tk] boolean, True = attend.
+    `mask`: optional [B, Tq, Tk] boolean, True = attend. Scores and
+    softmax run in f32 whatever the compute dtype (the models' shared
+    attention invariant).
     """
     d = q.shape[-1]
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    acc_dtype = jnp.promote_types(jnp.float32, q.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=acc_dtype) / jnp.sqrt(
+        d).astype(acc_dtype)
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         cm = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
@@ -47,7 +52,8 @@ def dense_attention(q, k, v, *, causal: bool = False, mask=None):
     if mask is not None:
         scores = jnp.where(mask[:, None], scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                      preferred_element_type=acc_dtype).astype(q.dtype)
 
 
 def _block_attend(q, k, v, q_offset, k_offset, *, causal, scale):
@@ -56,7 +62,13 @@ def _block_attend(q, k, v, q_offset, k_offset, *, causal, scale):
     Returns (o, l, m): un-normalised output [B,Tq,H,D], row sum l and row
     max m [B,Tq,H] — the flash-attention streaming-softmax statistics.
     """
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # scores/exp/sums in >=f32 regardless of the compute dtype — the
+    # same invariant as the models' dense attention (bf16 running
+    # exp-sums degrade with sequence length and break CP==dense parity)
+    acc_dtype = jnp.promote_types(jnp.float32, q.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=acc_dtype) \
+        * scale.astype(acc_dtype)
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         qpos = q_offset + jnp.arange(tq)
@@ -66,7 +78,8 @@ def _block_attend(q, k, v, q_offset, k_offset, *, causal, scale):
     m = jnp.max(scores, axis=-1)  # [B,H,Tq]
     p = jnp.exp(scores - m[..., None])
     l = jnp.sum(p, axis=-1)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=acc_dtype)
     # -> [B,Tq,H] layout for the running stats
     return o, l.transpose(0, 2, 1), m.transpose(0, 2, 1)
 
@@ -113,15 +126,17 @@ def ring_attention(q, k, v, *, axis: str = SEQ_AXIS, causal: bool = False):
         return (kb, vb, src, acc), None
 
     b, _, h, d_ = q.shape
+    # accumulators match _block_attend's >=f32 partials
+    acc_dtype = jnp.promote_types(jnp.float32, q.dtype)
     zero = (
-        jnp.zeros((b, t_local, h, d_), q.dtype),
-        jnp.zeros((b, t_local, h), q.dtype),
-        jnp.full((b, t_local, h), NEG_INF, q.dtype),
+        jnp.zeros((b, t_local, h, d_), acc_dtype),
+        jnp.zeros((b, t_local, h), acc_dtype),
+        jnp.full((b, t_local, h), NEG_INF, acc_dtype),
     )
     (kb, vb, src, acc), _ = jax.lax.scan(
         step, (k, v, idx, zero), None, length=n)
     o, l, _ = acc
-    return o / l[..., None]
+    return (o / l[..., None]).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, *, axis: str = SEQ_AXIS,
