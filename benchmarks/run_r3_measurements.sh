@@ -67,6 +67,9 @@ run suite_vgg 1800 python benchmarks/suite.py --only vgg19
 # 6b. MoE transformer row (opt-in bench; T=2048 compiles small)
 run suite_moe 1800 python benchmarks/suite.py --only moe
 
+# 6c. KV-cache decode throughput (serving latency analog)
+run suite_decode 1800 python benchmarks/suite.py --only decode
+
 # 7. refreshed profile trace for PROFILE_NOTES
 run profile 1200 python benchmarks/profile_step.py --batch 256 --iters 10
 

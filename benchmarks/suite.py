@@ -453,6 +453,43 @@ def bench_moe_lm(seq_len: int = 2048, *, batch: int = 8, dim: int = 512,
     }
 
 
+def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
+                 dim: int = 512, n_layers: int = 8, n_heads: int = 8,
+                 vocab: int = 32000, iters: int = 5):
+    """Greedy KV-cache decode throughput (new tokens/sec) — the serving
+    latency analog of the reference's C-API forward path (reference:
+    capi/gradient_machine.h forward; its era had no autoregressive
+    decode, so there is no reference number — the row tracks our own
+    regression)."""
+    from paddle_tpu.models import transformer as T
+
+    cfg = T.TransformerConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                              n_heads=n_heads, attn_impl="dense")
+    params = T.init_params(jax.random.key(0), cfg)
+    prompt = jnp.asarray(np.random.RandomState(0).randint(
+        0, vocab, (batch, prompt_len)), jnp.int32)
+
+    gen = jax.jit(lambda p, toks: T.generate(p, cfg, toks, steps=steps))
+    progress(f"decode: warmup/compile (B={batch} T0={prompt_len} "
+             f"steps={steps})")
+    out = gen(params, prompt)
+    jax.block_until_ready(out)
+    progress(f"decode: timing {iters} runs")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = gen(params, prompt)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    progress(f"decode: done ({1000*dt:.1f} ms/run)")
+    return {
+        "bench": "decode", "batch": batch, "prompt_len": prompt_len,
+        "steps": steps, "dim": dim, "n_layers": n_layers,
+        "ms_per_decode": round(1000 * dt, 2),
+        "new_tokens_per_sec": round(batch * steps / dt, 1),
+        "ms_per_token_step": round(1000 * dt / steps, 3),
+    }
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
@@ -531,6 +568,14 @@ def main():
             dim=64 if quick else 512, n_layers=2 if quick else 8,
             n_heads=2 if quick else 8, vocab=500 if quick else 32000,
             iters=iters)
+        print(json.dumps(rec))
+
+    if only and "decode" in only:  # opt-in
+        rec = bench_decode(
+            batch=2 if quick else 8, prompt_len=16 if quick else 128,
+            steps=8 if quick else 128, dim=64 if quick else 512,
+            n_layers=2 if quick else 8, n_heads=2 if quick else 8,
+            vocab=500 if quick else 32000, iters=2 if quick else 5)
         print(json.dumps(rec))
 
     if only and "moe" in only:  # opt-in (not in the default campaign)
