@@ -232,3 +232,23 @@ class TestContextParallel:
         finally:
             dtypes.set_default_policy(old)
         assert abs(dense - cp) < 3e-2 * max(1.0, abs(dense)), (dense, cp)
+
+    def test_cp_composes_with_moe(self):
+        """Context parallelism and MoE blocks in one model: the seq-
+        sharded loss must still equal the single-device loss (routing is
+        over the same global token set either way)."""
+        from paddle_tpu.core import mesh as mesh_lib
+
+        cfg = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
+                                  mlp_ratio=2, attn_impl="dense",
+                                  moe_experts=4, moe_capacity_factor=8.0)
+        params = T.init_params(jax.random.key(3), cfg)
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshConfig(data=1, model=1, seq=8),
+            devices=jax.devices()[:8])
+        toks = jnp.asarray(
+            np.random.RandomState(3).randint(0, 32, (2, 33)), jnp.int32)
+        cp_loss = T.make_context_parallel_loss(cfg, mesh)
+        dense = float(T.loss(params, cfg, toks))
+        cp = float(jax.jit(cp_loss)(params, toks))
+        assert abs(dense - cp) < 1e-4, (dense, cp)
