@@ -300,14 +300,23 @@ def make_context_parallel_loss(cfg: TransformerConfig, mesh, *,
     return loss_fn
 
 
-def generate(params, cfg: TransformerConfig, prompt, steps: int):
+def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
+             select_fn=None, rng=None):
     """Greedy decode with a KV cache carried through lax.scan.
 
     prompt [B,T0] int32 -> [B, T0+steps]. The cache holds K/V per layer
     at full T0+steps length (static shapes for XLA); each scan step
     attends over the valid prefix via an explicit position mask.
+
+    select_fn(logits [B, V], rng_step) -> [B] int chooses each next
+    token (default: argmax/greedy); `sample` builds temperature/top-k/
+    top-p selectors and threads fresh rng per step through the scan.
     """
     b, t0 = prompt.shape
+    if select_fn is None:
+        select_fn = lambda logits, r: jnp.argmax(logits, axis=-1)
+    if rng is None:
+        rng = jax.random.key(0)
     total = t0 + steps
     h, dh = cfg.n_heads, cfg.head_dim
     policy = default_policy()
@@ -332,10 +341,13 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int):
         v_buf = jnp.zeros((b, total, h, dh), v.dtype).at[:, :t0].set(v)
         caches.append((k_buf, v_buf))
     # only the last position's logits matter — don't LN/project all T0
-    first = jnp.argmax(final_logits(x[:, -1:]), axis=-1).astype(prompt.dtype)
+    rng, first_rng = jax.random.split(rng)
+    first = select_fn(final_logits(x[:, -1:]), first_rng) \
+        .astype(prompt.dtype)
 
     def step(carry, _):
-        tok, t, caches = carry  # tok [B], t scalar, caches per layer
+        tok, t, caches, rng = carry  # tok [B], t scalar, caches per layer
+        rng, step_rng = jax.random.split(rng)
         x = jnp.take(params["embed"]["table"], tok[:, None], axis=0)
         x = x.astype(policy.compute_dtype)
         pos = jnp.broadcast_to(t[None, None], (b, 1))
@@ -359,11 +371,64 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int):
                 return jnp.einsum("bhqk,bkhd->bqhd", w, v_buf)
 
             x, _, _, _ = _block_parts(cfg, p, x, pos, cached_attn)
-        nxt = jnp.argmax(final_logits(x), axis=-1).astype(tok.dtype)
-        return (nxt, t + 1, new_caches), tok
+        nxt = select_fn(final_logits(x), step_rng).astype(tok.dtype)
+        return (nxt, t + 1, new_caches, rng), tok
 
     _, toks = jax.lax.scan(
-        step, (first, jnp.asarray(t0, jnp.int32), caches), None,
+        step, (first, jnp.asarray(t0, jnp.int32), caches, rng), None,
         length=steps)
     # emitted = [first, t1, ..., t_{steps-1}]: exactly the new tokens
     return jnp.concatenate([prompt, toks.transpose(1, 0)], axis=1)
+
+
+def make_sampler(*, temperature: float = 1.0, top_k: Optional[int] = None,
+                 top_p: Optional[float] = None):
+    """Build a select_fn for `generate`: temperature scaling, then
+    optional top-k truncation, then optional nucleus (top-p) filtering,
+    then a categorical draw. temperature=0 degenerates to greedy."""
+    if temperature < 0:
+        raise ValueError("temperature must be >= 0")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+    def select(logits, rng):
+        logits = at_least_f32(logits)
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1)
+        logits = logits / temperature
+        if top_k is not None or top_p is not None:
+            # one descending sort serves both filters; top-k in sorted
+            # space is just position < k, and the nucleus is computed
+            # over the top-k-FILTERED distribution (sequential filter
+            # semantics)
+            desc = jnp.sort(logits, axis=-1)[:, ::-1]
+            if top_k is not None:
+                kth = desc[:, top_k - 1][:, None]
+                logits = jnp.where(logits >= kth, logits, -jnp.inf)
+                desc = jnp.where(jnp.arange(desc.shape[-1])[None, :] <
+                                 top_k, desc, -jnp.inf)
+            if top_p is not None:
+                probs = jax.nn.softmax(desc, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1) - probs
+                # keep every token whose preceding nucleus mass < top_p
+                # (the argmax always survives: its preceding mass is 0)
+                cutoff_logit = jnp.min(jnp.where(
+                    cum < top_p, desc, jnp.inf), axis=-1, keepdims=True)
+                logits = jnp.where(logits >= cutoff_logit, logits,
+                                   -jnp.inf)
+        return jax.random.categorical(rng, logits, axis=-1)
+
+    return select
+
+
+def sample(params, cfg: TransformerConfig, prompt, steps: int, rng, *,
+           temperature: float = 1.0, top_k: Optional[int] = None,
+           top_p: Optional[float] = None):
+    """Sampled decode: generate() with a temperature/top-k/top-p
+    selector and per-step rng."""
+    return generate(params, cfg, prompt, steps,
+                    select_fn=make_sampler(temperature=temperature,
+                                           top_k=top_k, top_p=top_p),
+                    rng=rng)

@@ -252,3 +252,67 @@ class TestContextParallel:
         dense = float(T.loss(params, cfg, toks))
         cp = float(jax.jit(cp_loss)(params, toks))
         assert abs(dense - cp) < 1e-4, (dense, cp)
+
+
+class TestSampling:
+    CFG = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
+                              mlp_ratio=2, attn_impl="dense")
+
+    def test_temperature_zero_is_greedy(self):
+        params = T.init_params(jax.random.key(0), self.CFG)
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(0, 32, (3, 5)), jnp.int32)
+        greedy = T.generate(params, self.CFG, prompt, steps=6)
+        sampled = T.sample(params, self.CFG, prompt, steps=6,
+                           rng=jax.random.key(1), temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(greedy),
+                                      np.asarray(sampled))
+
+    def test_sampling_deterministic_per_key_and_varies(self):
+        params = T.init_params(jax.random.key(0), self.CFG)
+        prompt = jnp.zeros((2, 4), jnp.int32)
+        a = T.sample(params, self.CFG, prompt, steps=8,
+                     rng=jax.random.key(7), temperature=1.5)
+        b = T.sample(params, self.CFG, prompt, steps=8,
+                     rng=jax.random.key(7), temperature=1.5)
+        c = T.sample(params, self.CFG, prompt, steps=8,
+                     rng=jax.random.key(8), temperature=1.5)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_top_k_and_top_p_filters(self):
+        # direct selector check on a known distribution
+        logits = jnp.log(jnp.asarray(
+            [[0.5, 0.3, 0.15, 0.05]], jnp.float32))
+        draws = []
+        sel = T.make_sampler(top_k=2)
+        for i in range(64):
+            draws.append(int(sel(logits, jax.random.key(i))[0]))
+        assert set(draws) <= {0, 1}
+        draws = []
+        sel = T.make_sampler(top_p=0.6)
+        for i in range(64):
+            draws.append(int(sel(logits, jax.random.key(i))[0]))
+        # nucleus 0.6: token 0 (mass 0.5, preceding 0) and token 1
+        # (preceding 0.5 < 0.6) survive; token 2 (preceding 0.8) doesn't
+        assert set(draws) <= {0, 1}
+        # extreme: tiny top_p keeps only the argmax
+        sel = T.make_sampler(top_p=1e-6)
+        assert int(sel(logits, jax.random.key(0))[0]) == 0
+
+    def test_sampler_validation_and_combined_filters(self):
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="top_k"):
+            T.make_sampler(top_k=0)
+        with _pytest.raises(ValueError, match="top_p"):
+            T.make_sampler(top_p=0.0)
+        with _pytest.raises(ValueError, match="top_p"):
+            T.make_sampler(top_p=1.5)
+        # combined: nucleus over the top-k-filtered distribution.
+        # probs .4/.3/.2/.1 -> top_k=3 renormalizes to .444/.333/.222;
+        # top_p=.5 then keeps tokens 0 (preceding 0) and 1 (preceding
+        # .444 < .5) but NOT 2 (preceding .777)
+        logits = jnp.log(jnp.asarray([[0.4, 0.3, 0.2, 0.1]], jnp.float32))
+        sel = T.make_sampler(top_k=3, top_p=0.5)
+        draws = {int(sel(logits, jax.random.key(i))[0]) for i in range(64)}
+        assert draws == {0, 1}, draws
