@@ -301,7 +301,8 @@ def make_context_parallel_loss(cfg: TransformerConfig, mesh, *,
 
 
 def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
-             select_fn=None, rng=None):
+             select_fn=None, rng=None, eos_id: Optional[int] = None,
+             pad_id: Optional[int] = None):
     """Greedy decode with a KV cache carried through lax.scan.
 
     prompt [B,T0] int32 -> [B, T0+steps]. The cache holds K/V per layer
@@ -311,12 +312,17 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
     select_fn(logits [B, V], rng_step) -> [B] int chooses each next
     token (default: argmax/greedy); `sample` builds temperature/top-k/
     top-p selectors and threads fresh rng per step through the scan.
+
+    eos_id: once a row emits it, every later position is pad_id
+    (default: eos_id) — the scan length stays static, finished rows
+    just stop changing.
     """
     b, t0 = prompt.shape
     if select_fn is None:
         select_fn = lambda logits, r: jnp.argmax(logits, axis=-1)
     if rng is None:
         rng = jax.random.key(0)
+    fill = eos_id if pad_id is None else pad_id
     total = t0 + steps
     h, dh = cfg.n_heads, cfg.head_dim
     policy = default_policy()
@@ -344,9 +350,10 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
     rng, first_rng = jax.random.split(rng)
     first = select_fn(final_logits(x[:, -1:]), first_rng) \
         .astype(prompt.dtype)
+    done0 = jnp.zeros((b,), bool)
 
     def step(carry, _):
-        tok, t, caches, rng = carry  # tok [B], t scalar, caches per layer
+        tok, t, caches, rng, done = carry  # tok [B], t scalar
         rng, step_rng = jax.random.split(rng)
         x = jnp.take(params["embed"]["table"], tok[:, None], axis=0)
         x = x.astype(policy.compute_dtype)
@@ -372,11 +379,16 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
 
             x, _, _, _ = _block_parts(cfg, p, x, pos, cached_attn)
         nxt = select_fn(final_logits(x), step_rng).astype(tok.dtype)
-        return (nxt, t + 1, new_caches, rng), tok
+        if eos_id is not None:
+            new_done = done | (tok == eos_id)
+            nxt = jnp.where(new_done, jnp.asarray(fill, tok.dtype), nxt)
+        else:
+            new_done = done
+        return (nxt, t + 1, new_caches, rng, new_done), tok
 
     _, toks = jax.lax.scan(
-        step, (first, jnp.asarray(t0, jnp.int32), caches, rng), None,
-        length=steps)
+        step, (first, jnp.asarray(t0, jnp.int32), caches, rng, done0),
+        None, length=steps)
     # emitted = [first, t1, ..., t_{steps-1}]: exactly the new tokens
     return jnp.concatenate([prompt, toks.transpose(1, 0)], axis=1)
 

@@ -316,3 +316,21 @@ class TestSampling:
         sel = T.make_sampler(top_k=3, top_p=0.5)
         draws = {int(sel(logits, jax.random.key(i))[0]) for i in range(64)}
         assert draws == {0, 1}, draws
+
+    def test_eos_stops_generation(self):
+        """After a row emits eos, every later position is pad."""
+        params = T.init_params(jax.random.key(0), self.CFG)
+        prompt = jnp.asarray(
+            np.random.RandomState(4).randint(0, 32, (4, 5)), jnp.int32)
+        # pick the greedy run's own 2nd generated token as "eos" for row0
+        free = np.asarray(T.generate(params, self.CFG, prompt, steps=8))
+        eos = int(free[0, 5 + 1])
+        out = np.asarray(T.generate(params, self.CFG, prompt, steps=8,
+                                    eos_id=eos, pad_id=0))
+        for b in range(out.shape[0]):
+            row = out[b, 5:]
+            hits = np.where(row == eos)[0]
+            if hits.size:
+                assert (row[hits[0] + 1:] == 0).all(), (b, row)
+        # row 0 definitely hit it at step 1
+        assert (out[0, 5 + 2:] == 0).all(), out[0]
