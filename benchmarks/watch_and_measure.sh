@@ -6,10 +6,17 @@
 set -u
 cd "$(dirname "$0")/.."
 PERIOD=${PERIOD:-300}
+# don't START the campaign close to round end: the driver's own bench
+# run needs the single chip claim; a campaign mid-flight would starve it
+DEADLINE=${DEADLINE:-1410}   # HHMM local
 LOG=benchmarks/r3_logs/watcher.log
 mkdir -p benchmarks/r3_logs
 
 while true; do
+  if [ "$(date +%H%M)" -ge "$DEADLINE" ]; then
+    echo "[watcher $(date +%H:%M:%S)] past deadline $DEADLINE — standing down so the driver's bench owns the chip" | tee -a "$LOG"
+    exit 0
+  fi
   if timeout 150 python -c "import jax, jax.numpy as jnp; print((jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16))[0,0])" \
        >> "$LOG" 2>&1; then
     echo "[watcher $(date +%H:%M:%S)] chip ANSWERED — firing campaign" | tee -a "$LOG"
