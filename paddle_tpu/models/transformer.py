@@ -134,8 +134,9 @@ def _rope(x, positions, base: float):
     return out.reshape(x.shape)
 
 
-def _dense_attention(q, k, v, causal: bool):
-    """Exact reference attention; [B,T,H,Dh] in/out, f32 scores."""
+def _dense_attention(q, k, v, causal: bool, key_mask=None):
+    """Exact reference attention; [B,T,H,Dh] in/out, f32 scores.
+    key_mask: optional [B, Tk] bool, False keys are never attended."""
     dh = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
         jnp.asarray(dh, q.dtype))
@@ -144,11 +145,14 @@ def _dense_attention(q, k, v, causal: bool):
         tq, tk = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
         scores = jnp.where(mask, scores, -1e30)
+    if key_mask is not None:
+        scores = jnp.where(key_mask[:, None, None, :], scores, -1e30)
     w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", w, v)
 
 
-def _attention(cfg: TransformerConfig, q, k, v, causal: bool):
+def _attention(cfg: TransformerConfig, q, k, v, causal: bool,
+               key_mask=None):
     impl = cfg.attn_impl
     if impl == "auto":
         # flash ONLY where the Pallas kernel compiles natively — the
@@ -156,9 +160,12 @@ def _attention(cfg: TransformerConfig, q, k, v, causal: bool):
         # anywhere else interpret-mode emulation would be far slower
         # than the dense fallback
         impl = "flash" if jax.default_backend() == "tpu" else "dense"
-    if impl == "flash":
+    if impl == "flash" and key_mask is None:
         return flash_attention(q, k, v, causal=causal)
-    return _dense_attention(q, k, v, causal)
+    # key-masked attention always takes the dense path (the flash
+    # kernel has no key-mask plumbing) — ONE dense implementation
+    # decides both masked and unmasked prefills
+    return _dense_attention(q, k, v, causal, key_mask)
 
 
 def _ffn(cfg: TransformerConfig, p, y, token_mask=None):
@@ -302,7 +309,7 @@ def make_context_parallel_loss(cfg: TransformerConfig, mesh, *,
 
 def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
              select_fn=None, rng=None, eos_id: Optional[int] = None,
-             pad_id: Optional[int] = None):
+             pad_id: Optional[int] = None, prompt_lens=None):
     """Greedy decode with a KV cache carried through lax.scan.
 
     prompt [B,T0] int32 -> [B, T0+steps]. The cache holds K/V per layer
@@ -316,6 +323,15 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
     eos_id: once a row emits it, every later position is pad_id
     (default: eos_id) — the scan length stays static, finished rows
     just stop changing.
+
+    prompt_lens [B]: RIGHT-padded variable-length prompts. Row i's real
+    prompt is prompt[i, :lens[i]]; pad keys are masked out of every
+    attention, rope positions continue from each row's own length, and
+    the first generated token reads row i's logits at lens[i]-1.
+    Output stays [B, T0+steps]: continuations start at column T0 for
+    every row (pads remain in the middle for short rows). The prefill
+    runs masked dense attention in this mode (the flash kernel has no
+    key-mask path).
     """
     b, t0 = prompt.shape
     if select_fn is None:
@@ -327,10 +343,10 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
     h, dh = cfg.n_heads, cfg.head_dim
     policy = default_policy()
 
-    def final_logits(x):
-        x = norm_ops.layer_norm(x, params["ln_f"]["scale"],
-                                params["ln_f"]["offset"])
-        return linalg.matmul(x[:, -1], params["lm_head"]["kernel"])
+    def head(x_last):
+        x_last = norm_ops.layer_norm(x_last, params["ln_f"]["scale"],
+                                     params["ln_f"]["offset"])
+        return linalg.matmul(x_last, params["lm_head"]["kernel"])
 
     # prefill: the same _block_parts body as apply() (cfg.attn_impl
     # decides flash vs dense — a 32k prompt needs the flash path), with
@@ -338,26 +354,42 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
     x = jnp.take(params["embed"]["table"], prompt, axis=0)
     x = x.astype(policy.compute_dtype)
     pos = jnp.broadcast_to(jnp.arange(t0), (b, t0))
+    if prompt_lens is None:
+        key_ok = None
+        prefill_attn = lambda q, k, v: _attention(cfg, q, k, v, causal=True)
+    else:
+        key_ok = jnp.arange(t0)[None, :] < prompt_lens[:, None]  # [B, Tk]
+        prefill_attn = lambda q, k, v: _attention(
+            cfg, q, k, v, causal=True, key_mask=key_ok)
     caches = []
     for p in params["blocks"]:
-        x, k, v, _ = _block_parts(
-            cfg, p, x, pos,
-            lambda q, k, v: _attention(cfg, q, k, v, causal=True))
+        # key_ok doubles as the MoE token mask: pad positions must not
+        # claim expert capacity either
+        x, k, v, _ = _block_parts(cfg, p, x, pos, prefill_attn, key_ok)
         k_buf = jnp.zeros((b, total, h, dh), k.dtype).at[:, :t0].set(k)
         v_buf = jnp.zeros((b, total, h, dh), v.dtype).at[:, :t0].set(v)
         caches.append((k_buf, v_buf))
-    # only the last position's logits matter — don't LN/project all T0
+    # only the last REAL position's logits matter
     rng, first_rng = jax.random.split(rng)
-    first = select_fn(final_logits(x[:, -1:]), first_rng) \
-        .astype(prompt.dtype)
+    if prompt_lens is None:
+        x_last = x[:, -1]
+    else:
+        x_last = jnp.take_along_axis(
+            x, (prompt_lens - 1)[:, None, None].astype(jnp.int32), axis=1
+        )[:, 0]
+    first = select_fn(head(x_last), first_rng).astype(prompt.dtype)
     done0 = jnp.zeros((b,), bool)
 
-    def step(carry, _):
-        tok, t, caches, rng, done = carry  # tok [B], t scalar
+    def step(carry, s):
+        tok, t, caches, rng, done = carry  # tok [B], t scalar slot
         rng, step_rng = jax.random.split(rng)
         x = jnp.take(params["embed"]["table"], tok[:, None], axis=0)
         x = x.astype(policy.compute_dtype)
-        pos = jnp.broadcast_to(t[None, None], (b, 1))
+        # rope position continues from each row's OWN length
+        if prompt_lens is None:
+            pos = jnp.broadcast_to(t[None, None], (b, 1))
+        else:
+            pos = (prompt_lens.astype(jnp.int32) + s)[:, None]
         new_caches = []
         for p, (k_buf, v_buf) in zip(params["blocks"], caches):
 
@@ -372,13 +404,20 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
                 scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_buf) / jnp.sqrt(
                     jnp.asarray(dh, q.dtype))
                 scores = at_least_f32(scores)
-                valid = (jnp.arange(total) <= t)[None, None, None, :]
+                ar = jnp.arange(total)
+                if prompt_lens is None:
+                    valid = (ar <= t)[None, None, None, :]
+                else:
+                    # real prompt keys + generated slots written so far
+                    valid = ((ar[None, :] < prompt_lens[:, None]) |
+                             ((ar[None, :] >= t0) & (ar[None, :] <= t)))
+                    valid = valid[:, None, None, :]
                 scores = jnp.where(valid, scores, -1e30)
                 w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
                 return jnp.einsum("bhqk,bkhd->bqhd", w, v_buf)
 
             x, _, _, _ = _block_parts(cfg, p, x, pos, cached_attn)
-        nxt = select_fn(final_logits(x), step_rng).astype(tok.dtype)
+        nxt = select_fn(head(x[:, -1]), step_rng).astype(tok.dtype)
         if eos_id is not None:
             new_done = done | (tok == eos_id)
             nxt = jnp.where(new_done, jnp.asarray(fill, tok.dtype), nxt)
@@ -388,7 +427,7 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
 
     _, toks = jax.lax.scan(
         step, (first, jnp.asarray(t0, jnp.int32), caches, rng, done0),
-        None, length=steps)
+        jnp.arange(steps), length=steps)
     # emitted = [first, t1, ..., t_{steps-1}]: exactly the new tokens
     return jnp.concatenate([prompt, toks.transpose(1, 0)], axis=1)
 
@@ -437,10 +476,13 @@ def make_sampler(*, temperature: float = 1.0, top_k: Optional[int] = None,
 
 def sample(params, cfg: TransformerConfig, prompt, steps: int, rng, *,
            temperature: float = 1.0, top_k: Optional[int] = None,
-           top_p: Optional[float] = None):
+           top_p: Optional[float] = None, eos_id: Optional[int] = None,
+           pad_id: Optional[int] = None, prompt_lens=None):
     """Sampled decode: generate() with a temperature/top-k/top-p
-    selector and per-step rng."""
+    selector and per-step rng; forwards eos/pad and variable-length
+    prompt support."""
     return generate(params, cfg, prompt, steps,
                     select_fn=make_sampler(temperature=temperature,
                                            top_k=top_k, top_p=top_p),
-                    rng=rng)
+                    rng=rng, eos_id=eos_id, pad_id=pad_id,
+                    prompt_lens=prompt_lens)

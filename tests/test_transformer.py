@@ -334,3 +334,69 @@ class TestSampling:
                 assert (row[hits[0] + 1:] == 0).all(), (b, row)
         # row 0 definitely hit it at step 1
         assert (out[0, 5 + 2:] == 0).all(), out[0]
+
+
+class TestVariableLengthPrompts:
+    CFG = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
+                              mlp_ratio=2, attn_impl="dense")
+
+    def test_padded_row_matches_solo_run(self):
+        """A short prompt decoded inside a padded batch must produce
+        exactly the continuation it gets when decoded alone."""
+        params = T.init_params(jax.random.key(0), self.CFG)
+        r = np.random.RandomState(0)
+        long_p = r.randint(1, 32, (1, 8)).astype(np.int32)
+        short_p = r.randint(1, 32, (1, 5)).astype(np.int32)
+
+        solo = np.asarray(T.generate(params, self.CFG,
+                                     jnp.asarray(short_p), steps=6))
+        batch = np.zeros((2, 8), np.int32)
+        batch[0] = long_p[0]
+        batch[1, :5] = short_p[0]
+        lens = jnp.asarray([8, 5], jnp.int32)
+        out = np.asarray(T.generate(params, self.CFG, jnp.asarray(batch),
+                                    steps=6, prompt_lens=lens))
+        # row 1's continuation (cols 8..13) == solo continuation (5..10)
+        np.testing.assert_array_equal(out[1, 8:], solo[0, 5:11])
+        # row 0 (full length) must match an unpadded batch-of-one run
+        full = np.asarray(T.generate(params, self.CFG,
+                                     jnp.asarray(long_p), steps=6))
+        np.testing.assert_array_equal(out[0, 8:], full[0, 8:])
+
+    def test_variable_length_sampling_matches_solo(self):
+        """sample() forwards prompt_lens: with temperature 0 (greedy)
+        the padded short row must equal its solo sampled run."""
+        params = T.init_params(jax.random.key(1), self.CFG)
+        r = np.random.RandomState(1)
+        short_p = r.randint(1, 32, (1, 4)).astype(np.int32)
+        batch = np.zeros((2, 7), np.int32)
+        batch[0] = r.randint(1, 32, 7)
+        batch[1, :4] = short_p[0]
+        out = np.asarray(T.sample(
+            params, self.CFG, jnp.asarray(batch), steps=5,
+            rng=jax.random.key(2), temperature=0.0,
+            prompt_lens=jnp.asarray([7, 4], jnp.int32)))
+        solo = np.asarray(T.sample(params, self.CFG, jnp.asarray(short_p),
+                                   steps=5, rng=jax.random.key(2),
+                                   temperature=0.0))
+        np.testing.assert_array_equal(out[1, 7:], solo[0, 4:9])
+
+    def test_padded_row_matches_solo_with_moe(self):
+        """Pad positions must not claim MoE expert capacity: at a
+        no-drop capacity the padded short row still equals its solo
+        continuation through sparse blocks."""
+        cfg = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
+                                  mlp_ratio=2, attn_impl="dense",
+                                  moe_experts=4, moe_capacity_factor=8.0)
+        params = T.init_params(jax.random.key(2), cfg)
+        r = np.random.RandomState(2)
+        short_p = r.randint(1, 32, (1, 5)).astype(np.int32)
+        batch = np.zeros((2, 8), np.int32)
+        batch[0] = r.randint(1, 32, 8)
+        batch[1, :5] = short_p[0]
+        out = np.asarray(T.generate(
+            params, cfg, jnp.asarray(batch), steps=4,
+            prompt_lens=jnp.asarray([8, 5], jnp.int32)))
+        solo = np.asarray(T.generate(params, cfg, jnp.asarray(short_p),
+                                     steps=4))
+        np.testing.assert_array_equal(out[1, 8:], solo[0, 5:9])
