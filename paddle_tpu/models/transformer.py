@@ -283,6 +283,28 @@ def loss(params, cfg: TransformerConfig, tokens, lengths=None,
     return ce
 
 
+def score(params, cfg: TransformerConfig, tokens, lengths=None):
+    """Per-token next-token log-probabilities [B, T-1] (0 past each
+    row's length) and per-sequence mean NLL [B] — the perplexity /
+    rescoring surface (reference analog: the v1 SequenceGenerator's
+    sequence scores)."""
+    tmask = None
+    if lengths is not None:
+        # pads must not claim MoE expert capacity (same as loss())
+        tmask = jnp.arange(tokens.shape[1] - 1)[None, :] < lengths[:, None]
+    logits, _ = _forward(params, cfg, tokens[:, :-1], token_mask=tmask)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(at_least_f32(logits), axis=-1)
+    gold = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if lengths is None:
+        mask = jnp.ones_like(gold, bool)
+    else:
+        mask = jnp.arange(1, tokens.shape[1])[None, :] < lengths[:, None]
+    gold = jnp.where(mask, gold, 0.0)
+    n = jnp.maximum(jnp.sum(mask, axis=1), 1)
+    return gold, -jnp.sum(gold, axis=1) / n
+
+
 def make_context_parallel_loss(cfg: TransformerConfig, mesh, *,
                                kind: str = "ring",
                                batch_axis: Optional[str] = None):
@@ -305,6 +327,30 @@ def make_context_parallel_loss(cfg: TransformerConfig, mesh, *,
         return loss(params, cfg, tokens, lengths, attn_fn=attn)
 
     return loss_fn
+
+
+def _head(params, x_last):
+    """Final LN + LM head over last-position activations [B, D]."""
+    x_last = norm_ops.layer_norm(x_last, params["ln_f"]["scale"],
+                                 params["ln_f"]["offset"])
+    return linalg.matmul(x_last, params["lm_head"]["kernel"])
+
+
+def _cached_attention(q, k, v, k_buf, v_buf, t, valid):
+    """THE single-position decode attention: write this step's K/V at
+    cache slot t, attend the 1-position q over `valid` cache keys
+    ([..., total] bool, broadcastable over [B, H, 1, total]). Returns
+    (out, k_buf, v_buf). Every decode path (greedy/sampled/beam) runs
+    THIS math so a scoring change cannot diverge between them."""
+    dh = q.shape[-1]
+    k_buf = jax.lax.dynamic_update_slice_in_dim(k_buf, k, t, axis=1)
+    v_buf = jax.lax.dynamic_update_slice_in_dim(v_buf, v, t, axis=1)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_buf) / jnp.sqrt(
+        jnp.asarray(dh, q.dtype))
+    scores = at_least_f32(scores)
+    scores = jnp.where(valid, scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v_buf), k_buf, v_buf
 
 
 def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
@@ -342,11 +388,7 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
     total = t0 + steps
     h, dh = cfg.n_heads, cfg.head_dim
     policy = default_policy()
-
-    def head(x_last):
-        x_last = norm_ops.layer_norm(x_last, params["ln_f"]["scale"],
-                                     params["ln_f"]["offset"])
-        return linalg.matmul(x_last, params["lm_head"]["kernel"])
+    head = lambda x_last: _head(params, x_last)
 
     # prefill: the same _block_parts body as apply() (cfg.attn_impl
     # decides flash vs dense — a 32k prompt needs the flash path), with
@@ -390,31 +432,23 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
             pos = jnp.broadcast_to(t[None, None], (b, 1))
         else:
             pos = (prompt_lens.astype(jnp.int32) + s)[:, None]
+        ar = jnp.arange(total)
+        if prompt_lens is None:
+            valid = (ar <= t)[None, None, None, :]
+        else:
+            # real prompt keys + generated slots written so far
+            valid = ((ar[None, :] < prompt_lens[:, None]) |
+                     ((ar[None, :] >= t0) & (ar[None, :] <= t)))
+            valid = valid[:, None, None, :]
         new_caches = []
         for p, (k_buf, v_buf) in zip(params["blocks"], caches):
 
             def cached_attn(q, k, v, k_buf=k_buf, v_buf=v_buf):
-                # single-position attention over the updated cache; the
-                # update is captured via new_caches (traced normally)
-                k_buf = jax.lax.dynamic_update_slice_in_dim(
-                    k_buf, k, t, axis=1)
-                v_buf = jax.lax.dynamic_update_slice_in_dim(
-                    v_buf, v, t, axis=1)
+                # the update is captured via new_caches (traced normally)
+                out, k_buf, v_buf = _cached_attention(
+                    q, k, v, k_buf, v_buf, t, valid)
                 new_caches.append((k_buf, v_buf))
-                scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_buf) / jnp.sqrt(
-                    jnp.asarray(dh, q.dtype))
-                scores = at_least_f32(scores)
-                ar = jnp.arange(total)
-                if prompt_lens is None:
-                    valid = (ar <= t)[None, None, None, :]
-                else:
-                    # real prompt keys + generated slots written so far
-                    valid = ((ar[None, :] < prompt_lens[:, None]) |
-                             ((ar[None, :] >= t0) & (ar[None, :] <= t)))
-                    valid = valid[:, None, None, :]
-                scores = jnp.where(valid, scores, -1e30)
-                w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-                return jnp.einsum("bhqk,bkhd->bqhd", w, v_buf)
+                return out
 
             x, _, _, _ = _block_parts(cfg, p, x, pos, cached_attn)
         nxt = select_fn(head(x[:, -1]), step_rng).astype(tok.dtype)
@@ -430,6 +464,75 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
         jnp.arange(steps), length=steps)
     # emitted = [first, t1, ..., t_{steps-1}]: exactly the new tokens
     return jnp.concatenate([prompt, toks.transpose(1, 0)], axis=1)
+
+
+def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
+                beam_size: int = 4, *, eos_id: Optional[int] = None,
+                length_penalty: float = 0.0):
+    """Beam-search decode over the KV cache (reference analog: the v1
+    SequenceGenerator / RecurrentGradientMachine beam, here closed over
+    the transformer's cached step via ops.beam_search's fixed-shape
+    engine).
+
+    prompt [B, T0] (uniform length) -> (sequences [B, K, T0+steps],
+    scores [B, K]) sorted best-first; without an eos_id every beam runs
+    the full `steps`.
+    """
+    from paddle_tpu.ops import beam_search as bs
+
+    b, t0 = prompt.shape
+    total = t0 + steps
+    h, dh = cfg.n_heads, cfg.head_dim
+    policy = default_policy()
+    head = lambda x_last: _head(params, x_last)
+
+    # prefill all but the last prompt token; the engine feeds that last
+    # token as each row's first input (bos_tokens)
+    x = jnp.take(params["embed"]["table"], prompt[:, :-1], axis=0)
+    x = x.astype(policy.compute_dtype)
+    pos = jnp.broadcast_to(jnp.arange(t0 - 1), (b, t0 - 1))
+    caches = {}
+    for i, p in enumerate(params["blocks"]):
+        x, k, v, _ = _block_parts(
+            cfg, p, x, pos,
+            lambda q, k, v: _attention(cfg, q, k, v, causal=True))
+        caches[f"k{i}"] = jnp.zeros((b, total, h, dh), k.dtype) \
+            .at[:, :t0 - 1].set(k)
+        caches[f"v{i}"] = jnp.zeros((b, total, h, dh), v.dtype) \
+            .at[:, :t0 - 1].set(v)
+    caches["t"] = jnp.full((b,), t0 - 1, jnp.int32)
+
+    def step_fn(toks, dec):
+        t = dec["t"][0]  # slot for THIS input token (uniform)
+        x = jnp.take(params["embed"]["table"], toks[:, None], axis=0)
+        x = x.astype(policy.compute_dtype)
+        pos = jnp.broadcast_to(t[None, None], (toks.shape[0], 1))
+        new_dec = {"t": dec["t"] + 1}
+        valid = (jnp.arange(total) <= t)[None, None, None, :]
+        for i in range(len(params["blocks"])):
+            k_buf, v_buf = dec[f"k{i}"], dec[f"v{i}"]
+
+            def cached_attn(q, k, v, k_buf=k_buf, v_buf=v_buf, li=i):
+                out, k_buf, v_buf = _cached_attention(
+                    q, k, v, k_buf, v_buf, t, valid)
+                new_dec[f"k{li}"] = k_buf
+                new_dec[f"v{li}"] = v_buf
+                return out
+
+            x, _, _, _ = _block_parts(cfg, params["blocks"][i], x, pos,
+                                      cached_attn)
+        return head(x[:, -1]), new_dec
+
+    toks, scores, _ = bs.beam_search(
+        caches, step_fn, batch_size=b, beam_size=beam_size,
+        max_len=steps, bos_id=0,
+        eos_id=-1 if eos_id is None else eos_id,
+        vocab_size=cfg.vocab, length_penalty=length_penalty,
+        bos_tokens=prompt[:, -1])
+    seqs = jnp.concatenate(
+        [jnp.broadcast_to(prompt[:, None, :], (b, beam_size, t0)), toks],
+        axis=-1)
+    return seqs, scores
 
 
 def make_sampler(*, temperature: float = 1.0, top_k: Optional[int] = None,

@@ -50,12 +50,15 @@ def beam_search(
     vocab_size: int,
     length_penalty: float = 0.0,
     modify_logits_fn: Optional[Callable] = None,
+    bos_tokens=None,
 ):
     """Run beam search.
 
     step_fn(tokens_t [B*K], decoder_state) -> (logits [B*K, V], new_state)
     where decoder_state leaves are [B*K, ...].
     init_decoder_state leaves must be [B, ...]; they are tiled to beams.
+    bos_tokens: optional [B] per-row first input tokens (an LM continuing
+    a prompt feeds the prompt's last token); default: bos_id everywhere.
 
     Returns (tokens [B, K, max_len], scores [B, K], lengths [B, K]) sorted
     best-first per batch row.
@@ -76,7 +79,11 @@ def beam_search(
         decoder_state=jax.tree.map(tile_to_beams, init_decoder_state),
         step=jnp.zeros((), jnp.int32),
     )
-    prev_tokens0 = jnp.full((b * k,), bos_id, jnp.int32)
+    if bos_tokens is None:
+        prev_tokens0 = jnp.full((b * k,), bos_id, jnp.int32)
+    else:
+        prev_tokens0 = jnp.repeat(
+            jnp.asarray(bos_tokens, jnp.int32), k, axis=0)
 
     def body(carry, _):
         state, prev_tokens = carry

@@ -400,3 +400,78 @@ class TestVariableLengthPrompts:
         solo = np.asarray(T.generate(params, cfg, jnp.asarray(short_p),
                                      steps=4))
         np.testing.assert_array_equal(out[1, 8:], solo[0, 5:9])
+
+
+class TestBeamDecode:
+    CFG = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
+                              mlp_ratio=2, attn_impl="dense")
+
+    def test_beam1_equals_greedy(self):
+        params = T.init_params(jax.random.key(0), self.CFG)
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(1, 32, (3, 6)), jnp.int32)
+        greedy = np.asarray(T.generate(params, self.CFG, prompt, steps=5))
+        seqs, scores = T.beam_decode(params, self.CFG, prompt, steps=5,
+                                     beam_size=1)
+        np.testing.assert_array_equal(np.asarray(seqs[:, 0]), greedy)
+
+    def test_wider_beam_never_scores_worse(self):
+        """The best beam's total log-prob must be >= the greedy
+        sequence's (verified with score())."""
+        params = T.init_params(jax.random.key(1), self.CFG)
+        prompt = jnp.asarray(
+            np.random.RandomState(1).randint(1, 32, (2, 6)), jnp.int32)
+        steps = 6
+        greedy = T.generate(params, self.CFG, prompt, steps=steps)
+        seqs, scores = T.beam_decode(params, self.CFG, prompt,
+                                     steps=steps, beam_size=4)
+
+        def continuation_logprob(full):
+            lp, _ = T.score(params, self.CFG, full)
+            return np.asarray(lp)[:, -steps:].sum(axis=1)
+
+        greedy_lp = continuation_logprob(greedy)
+        best_lp = continuation_logprob(seqs[:, 0])
+        assert (best_lp >= greedy_lp - 1e-4).all(), (greedy_lp, best_lp)
+        # the engine's own scores agree with independently recomputed
+        # log-probs of the returned sequences
+        np.testing.assert_allclose(np.asarray(scores[:, 0]), best_lp,
+                                   atol=1e-3)
+
+    def test_eos_finishes_beams(self):
+        params = T.init_params(jax.random.key(2), self.CFG)
+        prompt = jnp.asarray(
+            np.random.RandomState(2).randint(1, 32, (2, 5)), jnp.int32)
+        free = np.asarray(T.beam_decode(params, self.CFG, prompt, steps=6,
+                                        beam_size=2)[0])
+        eos = int(free[0, 0, 5])  # first continuation token of best beam
+        seqs, _ = T.beam_decode(params, self.CFG, prompt, steps=6,
+                                beam_size=2, eos_id=eos)
+        rows = np.asarray(seqs)[0, :, 5:]
+        # step-0 candidates are identical to the free run, so SOME beam
+        # must emit the free run's first token (= eos) and finish
+        assert (rows == eos).any(), rows
+        for row in rows:
+            hits = np.where(row == eos)[0]
+            if hits.size:  # once finished, only eos follows
+                assert (row[hits[0]:] == eos).all(), row
+
+
+class TestScore:
+    def test_logprobs_and_masking(self):
+        cfg = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
+                                  mlp_ratio=2, attn_impl="dense")
+        params = T.init_params(jax.random.key(0), cfg)
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 32, (3, 10)), jnp.int32)
+        lens = jnp.asarray([10, 7, 4])
+        lp, nll = T.score(params, cfg, toks, lens)
+        assert lp.shape == (3, 9) and nll.shape == (3,)
+        mask = np.arange(1, 10)[None, :] < np.asarray(lens)[:, None]
+        assert (np.asarray(lp)[~mask] == 0).all()
+        assert (np.asarray(lp)[mask] < 0).all()
+        # an untrained model scores near uniform: NLL ~ log(32)
+        assert abs(float(nll[0]) - np.log(32)) < 1.0
+        # consistency with loss() (unmasked row)
+        full_nll = float(T.loss(params, cfg, toks[:1]))
+        np.testing.assert_allclose(float(nll[0]), full_nll, rtol=1e-5)
