@@ -1,0 +1,96 @@
+#!/bin/bash
+# Round-4 chip measurement campaign.
+#
+# Inherits the r3b wedge lessons (see run_r3_measurements.sh header):
+# cheap compiles first, pool A/B early, subprocess-isolated stages,
+# big-batch image rows last.  New in r4:
+#   * STOP_EPOCH (unix seconds): stages are SKIPPED once past it, so a
+#     campaign that starts late never overruns into the driver's own
+#     end-of-round bench run (the r3 watcher only gated *starting* the
+#     campaign; a late start could still have collided).
+#   * remat/fusion A-B rows for the HBM-roofline work (resnet50_remat).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p benchmarks/r4_logs
+STOP_EPOCH=${STOP_EPOCH:-1785555000}   # 2026-08-01 03:30 UTC
+
+# a stage killed at its timeout may have wedged the relay (the r3
+# hazard: a killed claimant wedges the chip ~2h) — launching the next
+# stage into a wedged chip just burns its full timeout and re-wedges.
+# After any rc=124, hold here re-probing until the chip answers again
+# (or STOP_EPOCH passes, which aborts the campaign).
+wait_alive() {
+  while true; do
+    if [ "$(date +%s)" -ge "$STOP_EPOCH" ]; then
+      echo "=== chip still wedged at STOP_EPOCH — aborting campaign ==="
+      exit 0
+    fi
+    if timeout 150 python -c "import jax, jax.numpy as jnp; print((jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16))[0,0])" \
+         >> benchmarks/r4_logs/realive.log 2>&1; then
+      echo "    (chip alive again $(date +%H:%M:%S))"
+      return
+    fi
+    echo "    (chip not answering, re-probe in 300s)"
+    sleep 300
+  done
+}
+
+run() {  # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  if [ "$(date +%s)" -ge "$STOP_EPOCH" ]; then
+    echo "=== $name SKIPPED (past STOP_EPOCH) ==="
+    return
+  fi
+  echo "=== $name ($(date +%H:%M:%S)) ==="
+  timeout "$tmo" "$@" > "benchmarks/r4_logs/$name.out" 2> "benchmarks/r4_logs/$name.err"
+  local rc=$?
+  echo "    rc=$rc  (tail of out:)"; tail -3 "benchmarks/r4_logs/$name.out" | sed 's/^/    /'
+  if [ "$rc" = 124 ]; then
+    wait_alive
+  fi
+}
+
+# 0. liveness
+run probe 180 python -c "import jax, jax.numpy as jnp; print((jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16))[0,0])"
+
+# 1. the open regression question: tie-split vs select-and-scatter
+#    maxpool backward, resnet bs64 (cheap compile, done twice)
+run probe_pool 1500 python benchmarks/probe_pool.py
+
+# 2. lstm benches (fused kernel) + the h256/h512 inversion probe
+run suite_lstm 1200 python benchmarks/suite.py --only lstm_h256,lstm_h512
+run probe_lstm 1200 python benchmarks/probe_lstm.py
+
+# 3. CTR stage probe (steady-state attribution after the recompile fix)
+run probe_ctr 1200 python benchmarks/probe_ctr.py
+
+# 4. cheap suite rows: smallnet, trainer-loop overhead, transformer
+run suite_small 2400 python benchmarks/suite.py --only smallnet,trainer_loop
+run suite_misc 2400 python benchmarks/suite.py --only transformer
+
+# 5. the north stars, driver-format (resnet bs256 inside, isolated+retry)
+run bench 5700 python bench.py
+
+# 6. image suite, batch-ascending; big-batch rows are the wedge risk so
+#    they go last, one stage each
+run suite_alexnet 1800 python benchmarks/suite.py --only alexnet --batches 64,128,256
+run suite_googlenet 1800 python benchmarks/suite.py --only googlenet
+run suite_resnet 1800 python benchmarks/suite.py --only resnet50
+run suite_resnet_s2d 1800 python benchmarks/suite.py --only resnet50_s2d
+run suite_resnet_remat 1800 python benchmarks/suite.py --only resnet50_remat
+run suite_vgg 1800 python benchmarks/suite.py --only vgg19
+
+# 6b. MoE transformer row (opt-in bench; T=2048 compiles small)
+run suite_moe 1800 python benchmarks/suite.py --only moe
+
+# 6c. KV-cache decode throughput (serving latency analog)
+run suite_decode 1800 python benchmarks/suite.py --only decode
+
+# 7. refreshed profile trace for PROFILE_NOTES
+run profile 1200 python benchmarks/profile_step.py --batch 256 --iters 10
+
+# 8. the single biggest compile (alexnet bs512) dead last: if it wedges
+#    the chip nothing is behind it
+run suite_alexnet512 1800 python benchmarks/suite.py --only alexnet --batches 512
+
+echo "=== done ($(date +%H:%M:%S)) — logs in benchmarks/r4_logs/ ==="

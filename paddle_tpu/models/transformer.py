@@ -152,7 +152,16 @@ def _dense_attention(q, k, v, causal: bool, key_mask=None):
 
 
 def _attention(cfg: TransformerConfig, q, k, v, causal: bool,
-               key_mask=None):
+               key_mask=None, key_lens=None):
+    """key_lens [B] describes RIGHT-padded rows (keys [0, lens[b]) are
+    real) and rides the flash kernel's per-row bound; key_mask [B, Tk]
+    is an arbitrary mask and forces the dense path. They are two
+    encodings of a mask, not composable — pass exactly one."""
+    if key_mask is not None and key_lens is not None:
+        raise ValueError("pass key_mask or key_lens, not both — the "
+                         "flash path would honor only key_lens and "
+                         "silently diverge from dense for any mask "
+                         "that isn't right-padding")
     impl = cfg.attn_impl
     if impl == "auto":
         # flash ONLY where the Pallas kernel compiles natively — the
@@ -160,11 +169,21 @@ def _attention(cfg: TransformerConfig, q, k, v, causal: bool,
         # anywhere else interpret-mode emulation would be far slower
         # than the dense fallback
         impl = "flash" if jax.default_backend() == "tpu" else "dense"
-    if impl == "flash" and key_mask is None:
-        return flash_attention(q, k, v, causal=causal)
-    # key-masked attention always takes the dense path (the flash
-    # kernel has no key-mask plumbing) — ONE dense implementation
-    # decides both masked and unmasked prefills
+    if impl == "flash":
+        if key_lens is not None:
+            # right-padded variable-length rows ride the kernel's
+            # per-row key-length bound — a long variable-length prefill
+            # keeps O(T·block) memory instead of falling back to the
+            # [B,H,Tq,Tk] dense score tensor
+            return flash_attention(q, k, v, causal=causal,
+                                   key_lens=key_lens)
+        if key_mask is None:
+            return flash_attention(q, k, v, causal=causal)
+    # arbitrary key masks take the dense path — ONE dense
+    # implementation decides both masked and unmasked prefills;
+    # lens-only callers get the equivalent right-padding mask here
+    if key_mask is None and key_lens is not None:
+        key_mask = jnp.arange(k.shape[1])[None, :] < key_lens[:, None]
     return _dense_attention(q, k, v, causal, key_mask)
 
 
@@ -376,8 +395,9 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
     the first generated token reads row i's logits at lens[i]-1.
     Output stays [B, T0+steps]: continuations start at column T0 for
     every row (pads remain in the middle for short rows). The prefill
-    runs masked dense attention in this mode (the flash kernel has no
-    key-mask path).
+    stays on the flash path (per-row key-length bound in the kernel);
+    only the dense impl materializes [B,H,Tq,Tk] scores, so prefer
+    attn_impl "auto"/"flash" for long variable-length prompts.
     """
     b, t0 = prompt.shape
     if select_fn is None:
@@ -401,8 +421,11 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
         prefill_attn = lambda q, k, v: _attention(cfg, q, k, v, causal=True)
     else:
         key_ok = jnp.arange(t0)[None, :] < prompt_lens[:, None]  # [B, Tk]
+        # key_ok itself only feeds the MoE token mask below; attention
+        # takes the lens encoding (flash per-row bound, dense builds
+        # the equivalent right-padding mask internally)
         prefill_attn = lambda q, k, v: _attention(
-            cfg, q, k, v, causal=True, key_mask=key_ok)
+            cfg, q, k, v, causal=True, key_lens=prompt_lens)
     caches = []
     for p in params["blocks"]:
         # key_ok doubles as the MoE token mask: pad positions must not
@@ -487,19 +510,38 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
     head = lambda x_last: _head(params, x_last)
 
     # prefill all but the last prompt token; the engine feeds that last
-    # token as each row's first input (bos_tokens)
-    x = jnp.take(params["embed"]["table"], prompt[:, :-1], axis=0)
-    x = x.astype(policy.compute_dtype)
-    pos = jnp.broadcast_to(jnp.arange(t0 - 1), (b, t0 - 1))
+    # token as each row's first input (bos_tokens). A 1-token prompt
+    # has nothing to prefill — the caches start empty rather than
+    # tracing a T=0 sequence through the attention kernels.
     caches = {}
-    for i, p in enumerate(params["blocks"]):
-        x, k, v, _ = _block_parts(
-            cfg, p, x, pos,
-            lambda q, k, v: _attention(cfg, q, k, v, causal=True))
-        caches[f"k{i}"] = jnp.zeros((b, total, h, dh), k.dtype) \
-            .at[:, :t0 - 1].set(k)
-        caches[f"v{i}"] = jnp.zeros((b, total, h, dh), v.dtype) \
-            .at[:, :t0 - 1].set(v)
+    if t0 > 1:
+        x = jnp.take(params["embed"]["table"], prompt[:, :-1], axis=0)
+        x = x.astype(policy.compute_dtype)
+        pos = jnp.broadcast_to(jnp.arange(t0 - 1), (b, t0 - 1))
+        for i, p in enumerate(params["blocks"]):
+            x, k, v, _ = _block_parts(
+                cfg, p, x, pos,
+                lambda q, k, v: _attention(cfg, q, k, v, causal=True))
+            caches[f"k{i}"] = jnp.zeros((b, total, h, dh), k.dtype) \
+                .at[:, :t0 - 1].set(k)
+            caches[f"v{i}"] = jnp.zeros((b, total, h, dh), v.dtype) \
+                .at[:, :t0 - 1].set(v)
+    else:
+        # each buffer's dtype must equal what the decode step will
+        # write into it (dtype promotion depends on that BLOCK's param
+        # dtypes, e.g. under x64 or mixed-precision blocks) —
+        # eval_shape each block body, threading x's dtype through the
+        # stack exactly like the decode step will
+        x_shape = jax.ShapeDtypeStruct((b, 1, cfg.dim),
+                                       policy.compute_dtype)
+        pos_shape = jax.ShapeDtypeStruct((b, 1), jnp.int32)
+        for i, p in enumerate(params["blocks"]):
+            x_shape, k_shape = jax.eval_shape(
+                lambda p, x, pos: _block_parts(cfg, p, x, pos,
+                                               lambda q, k, v: q)[:2],
+                p, x_shape, pos_shape)
+            caches[f"k{i}"] = jnp.zeros((b, total, h, dh), k_shape.dtype)
+            caches[f"v{i}"] = jnp.zeros((b, total, h, dh), k_shape.dtype)
     caches["t"] = jnp.full((b,), t0 - 1, jnp.int32)
 
     def step_fn(toks, dec):
@@ -539,7 +581,11 @@ def make_sampler(*, temperature: float = 1.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None):
     """Build a select_fn for `generate`: temperature scaling, then
     optional top-k truncation, then optional nucleus (top-p) filtering,
-    then a categorical draw. temperature=0 degenerates to greedy."""
+    then a categorical draw. temperature=0 degenerates to greedy.
+
+    top_k is clamped to the vocab size (k >= vocab means no filtering),
+    and ties at the kth logit all survive (the filter keeps every logit
+    >= the kth largest, so more than k tokens can pass)."""
     if temperature < 0:
         raise ValueError("temperature must be >= 0")
     if top_k is not None and top_k < 1:
@@ -559,10 +605,11 @@ def make_sampler(*, temperature: float = 1.0, top_k: Optional[int] = None,
             # semantics)
             desc = jnp.sort(logits, axis=-1)[:, ::-1]
             if top_k is not None:
-                kth = desc[:, top_k - 1][:, None]
+                k_eff = min(top_k, logits.shape[-1])
+                kth = desc[:, k_eff - 1][:, None]
                 logits = jnp.where(logits >= kth, logits, -jnp.inf)
                 desc = jnp.where(jnp.arange(desc.shape[-1])[None, :] <
-                                 top_k, desc, -jnp.inf)
+                                 k_eff, desc, -jnp.inf)
             if top_p is not None:
                 probs = jax.nn.softmax(desc, axis=-1)
                 cum = jnp.cumsum(probs, axis=-1) - probs

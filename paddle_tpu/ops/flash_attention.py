@@ -42,14 +42,16 @@ DEFAULT_BLOCK_K = 512
 _LANE = 128  # TPU minimum tile width (lane count)
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                 *, scale: float, causal: bool, t_kv: int):
+def _attn_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                 m_ref, l_ref, *, scale: float, causal: bool):
     """One (batch*head, q-block, k-block) grid step. The innermost grid
     dim walks k/v blocks sequentially (TPU grids are sequential), so
     VMEM scratch (acc/m/l) carries streaming-softmax state across k
     steps; only one [BK, D] k/v tile is resident at a time.
 
-    Refs: q [1,BQ,D]; k/v [1,BK,D]; o [1,BQ,D]; lse [1,BQ,LANE];
+    Refs: len [1] i32 (this row's valid key count — t_kv when no key
+    mask; tail padding and right-padded variable-length prompts are the
+    SAME mask); q [1,BQ,D]; k/v [1,BK,D]; o [1,BQ,D]; lse [1,BQ,LANE];
     scratch acc [BQ,D] f32, m/l [BQ,LANE] f32.
     """
     qi = pl.program_id(1)
@@ -64,8 +66,13 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         m_ref[:] = jnp.full_like(m_ref, NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # causal: k blocks entirely above the diagonal contribute nothing
-    needed = True if not causal else (j * block_k <= (qi + 1) * bq - 1)
+    # skip k blocks entirely above the causal diagonal or entirely past
+    # this row's key length (a fully-invalid block is a no-op anyway:
+    # p=0, alpha=1 — skipping just saves the dead MXU work; a short row
+    # in a long padded batch touches ~len/BK blocks, not ~T/BK)
+    needed = j * block_k < len_ref[0]
+    if causal:
+        needed = needed & (j * block_k <= (qi + 1) * bq - 1)
 
     @pl.when(needed)
     def _compute():
@@ -75,7 +82,7 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             preferred_element_type=jnp.float32) * scale  # [BQ, BK]
         kpos = j * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (bq, block_k), 1)
-        valid = kpos < t_kv                            # tail padding
+        valid = kpos < len_ref[0]              # tail padding / key mask
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
@@ -85,7 +92,11 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         l_prev = l_ref[:, :1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new)
+        # mask p too: a row with NO valid key would otherwise see
+        # exp(NEG_INF - NEG_INF) = 1 everywhere (NEG_INF is finite) and
+        # return the unweighted mean of v; with p zeroed it returns 0,
+        # matching the backward's zero grads
+        p = jnp.where(valid, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)                # [BQ, 1]
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -112,9 +123,10 @@ def _pad_to(x, size, axis):
     return jnp.pad(x, widths)
 
 
-def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
-    """q,k,v: [BH, T, D] -> (o [BH, T, D], lse [BH, T])."""
+def _flash_forward(q, k, v, lens, *, causal: bool, block_q: int,
+                   block_k: int, interpret: bool):
+    """q,k,v: [BH, T, D]; lens: [BH] i32 valid key counts ->
+    (o [BH, T, D], lse [BH, T])."""
     if pltpu is None:
         raise NotImplementedError(
             "Pallas TPU support is unavailable in this jax build; use "
@@ -133,16 +145,17 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
     grid = (bh, tq_pad // block_q, tk_pad // block_k)
     kwargs = dict(memory_space=_VMEM) if (_VMEM is not None
                                           and not interpret) else {}
+    smem = dict(memory_space=pltpu.SMEM) if not interpret else {}
     scratch = [
         pltpu.VMEM((block_q, d), jnp.float32),
         pltpu.VMEM((block_q, _LANE), jnp.float32),
         pltpu.VMEM((block_q, _LANE), jnp.float32),
     ]
     o, lse = pl.pallas_call(
-        functools.partial(_attn_kernel, scale=scale, causal=causal,
-                          t_kv=t_kv),
+        functools.partial(_attn_kernel, scale=scale, causal=causal),
         grid=grid,
         in_specs=[
+            pl.BlockSpec((1,), lambda b, i, j: (b,), **smem),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          **kwargs),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
@@ -162,11 +175,12 @@ def _flash_forward(q, k, v, *, causal: bool, block_q: int, block_k: int,
         ],
         scratch_shapes=scratch,
         interpret=interpret,
-    )(qp, kp, vp)
+    )(lens.astype(jnp.int32), qp, kp, vp)
     return o[:, :t], lse[:, :t, 0]
 
 
-def _blockwise_backward(q, k, v, o, lse, g, *, causal: bool, block_k: int):
+def _blockwise_backward(q, k, v, lens, o, lse, g, *, causal: bool,
+                        block_k: int):
     """Recompute-based flash backward in plain JAX, O(T·block) memory."""
     bh, t, d = q.shape
     t_kv = k.shape[1]
@@ -187,7 +201,7 @@ def _blockwise_backward(q, k, v, o, lse, g, *, causal: bool, block_k: int):
         j, kj, vj = blk                                    # kj/vj [BH,BK,D]
         s = jnp.einsum("bqd,bkd->bqk", qf, kj)
         kpos = j * block_k + kpos_base
-        valid = (kpos < t_kv)[None, None, :]
+        valid = kpos[None, None, :] < lens[:, None, None]
         if causal:
             valid = valid & (qpos[None, :, None] >= kpos[None, None, :])
         p = jnp.where(valid, jnp.exp(s - lse[..., None]), 0.0)  # [BH,Tq,BK]
@@ -208,25 +222,28 @@ def _blockwise_backward(q, k, v, o, lse, g, *, causal: bool, block_k: int):
             dv.astype(v.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, lens_f, causal, block_q, block_k):
     interpret = jax.default_backend() != "tpu"
-    o, _ = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+    o, _ = _flash_forward(q, k, v, lens_f, causal=causal, block_q=block_q,
                           block_k=block_k, interpret=interpret)
     return o
 
 
-def _flash_fwd(q, k, v, causal, block_q, block_k):
+def _flash_fwd(q, k, v, lens_f, causal, block_q, block_k):
     interpret = jax.default_backend() != "tpu"
-    o, lse = _flash_forward(q, k, v, causal=causal, block_q=block_q,
+    o, lse = _flash_forward(q, k, v, lens_f, causal=causal, block_q=block_q,
                             block_k=block_k, interpret=interpret)
-    return o, (q, k, v, o, lse)
+    return o, (q, k, v, lens_f, o, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, res, g):
-    q, k, v, o, lse = res
-    return _blockwise_backward(q, k, v, o, lse, g, causal=causal,
-                               block_k=block_k)
+    q, k, v, lens_f, o, lse = res
+    dq, dk, dv = _blockwise_backward(q, k, v, lens_f, o, lse, g,
+                                     causal=causal, block_k=block_k)
+    # lens is carried as f32 so the custom_vjp can hand back an ordinary
+    # zero cotangent (int operands would need float0 plumbing)
+    return dq, dk, dv, jnp.zeros_like(lens_f)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -234,20 +251,36 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K):
+                    block_k: int = DEFAULT_BLOCK_K,
+                    key_lens=None):
     """Fused scaled-dot-product attention.
 
     q: [B, Tq, H, D]; k, v: [B, Tkv, H, D]. Returns [B, Tq, H, D].
     O(T·block) memory; exact (fp32 accumulation internally).
+
+    key_lens: optional [B] int — row b attends only keys [0, lens[b])
+    (right-padded variable-length sequences, e.g. a batched prefill).
+    Implemented as the kernel's existing tail-padding bound made
+    per-row, so the masked path costs nothing extra.
     """
     if q.ndim != 4:
         raise ValueError(f"expected [B, T, H, D], got {q.shape}")
     b, t, h, d = q.shape
     t_kv = k.shape[1]
+    if key_lens is None:
+        lens = jnp.full((b * h,), t_kv, jnp.float32)
+    else:
+        if key_lens.shape != (b,):
+            raise ValueError(
+                f"key_lens must be [B]=({b},), got {key_lens.shape}")
+        # clamp so out-of-range lengths degrade to the no-mask behavior
+        # instead of attending the kernel's zero-padded key tail
+        lens = jnp.repeat(
+            jnp.minimum(key_lens, t_kv).astype(jnp.float32), h)
 
     def flat(x, tt):
         return x.transpose(0, 2, 1, 3).reshape(b * h, tt, d)
 
-    o = _flash(flat(q, t), flat(k, t_kv), flat(v, t_kv), causal, block_q,
-               block_k)
+    o = _flash(flat(q, t), flat(k, t_kv), flat(v, t_kv), lens, causal,
+               block_q, block_k)
     return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)

@@ -344,20 +344,29 @@ def make_expert_parallel_ffn(mesh: Mesh, *, axis: str = MODEL_AXIS,
                              dispatch_impl: str = "auto"):
     """Build an expert-parallel MoE FFN over `mesh`.
 
-    Tokens arrive sharded over `data_axis` (or replicated when None);
-    experts are sharded over `axis` (shard_moe_params). Each shard
-    routes its local tokens, dispatches into [E, C_loc, D], then ONE
-    tiled all_to_all regroups the block so every shard holds its OWN
-    experts' tokens from ALL shards; the FFN runs batched over local
-    experts; the mirrored all_to_all brings results home for the local
-    combine. Per-step ICI volume is 2 * E * C_loc * D — the K*D shape
-    of sparse.alltoall_lookup, with matmul dispatch instead of sorts.
+    Tokens arrive sharded over BOTH mesh axes (or replicated when
+    `data_axis` is None); experts are sharded over `axis`
+    (shard_moe_params). Each shard routes its local tokens, dispatches
+    into [E, C_loc, D], then ONE tiled all_to_all regroups the block so
+    every shard holds its OWN experts' tokens from ALL shards; the FFN
+    runs batched over local experts; the mirrored all_to_all brings
+    results home for the local combine. Per-step ICI volume is
+    2 * E * C_loc * D — the K*D shape of sparse.alltoall_lookup, with
+    matmul dispatch instead of sorts.
+
+    The token axis is split over (data_axis, axis) jointly: if it were
+    split over data_axis alone, every `axis` peer would hold the same
+    tokens, compute the same routing, and the exchange would carry
+    n_model identical copies — n_model-fold redundant expert FLOPs and
+    ICI traffic. With the joint split each peer's C_loc block is
+    distinct tokens and the exchange volume claim above is real.
 
     Returns fn(params, x [T, D], rng=None) -> MoEOutput with y sharded
-    like x. T must divide by the data-axis size (static shapes).
+    like x. T must divide by data_axis_size * axis_size (static
+    shapes).
     """
     n_exp_shards = mesh.shape[axis]
-    dspec = P(data_axis) if data_axis else P()
+    dspec = P((data_axis, axis)) if data_axis else P()
 
     def body(params, x, rng):
         t_loc, d = x.shape
@@ -366,8 +375,10 @@ def make_expert_parallel_ffn(mesh: Mesh, *, axis: str = MODEL_AXIS,
         cap = capacity_for(t_loc, e, capacity_factor, k)
         logits = x @ params["router"]["kernel"]
         if data_axis is not None:
-            # distinct jitter noise per data shard
-            rng = jax.random.fold_in(rng, lax.axis_index(data_axis))
+            # distinct jitter noise per token shard (both mesh axes)
+            rng = jax.random.fold_in(
+                rng, lax.axis_index(data_axis) * n_exp_shards
+                + lax.axis_index(axis))
         routing = top_k_routing(logits, k, cap, rng=rng, jitter=jitter)
         aux, dropped = routing.aux_loss, routing.dropped
         if data_axis is None:
@@ -407,8 +418,8 @@ def make_expert_parallel_ffn(mesh: Mesh, *, axis: str = MODEL_AXIS,
         home = lax.all_to_all(back, axis, split_axis=0, concat_axis=0,
                               tiled=True)                     # [E, C, D]
         y = _combine_out(routing, combine, home, cap).astype(x.dtype)
-        aux = lax.pmean(aux, data_axis)
-        dropped = lax.pmean(dropped, data_axis)
+        aux = lax.pmean(aux, (data_axis, axis))
+        dropped = lax.pmean(dropped, (data_axis, axis))
         return MoEOutput(y, aux, dropped)
 
     pspec = {"router": {"kernel": P()},

@@ -75,3 +75,62 @@ def test_bad_rank_raises(np_rng):
     with pytest.raises(ValueError, match="B, T, H, D"):
         flash_attention(jnp.zeros((4, 8, 3)), jnp.zeros((4, 8, 3)),
                         jnp.zeros((4, 8, 3)))
+
+
+def _masked_dense(q, k, v, lens, causal):
+    """key_lens as a dense mask, via the ONE canonical dense impl."""
+    b, tq, tk = q.shape[0], q.shape[1], k.shape[1]
+    key_ok = jnp.arange(tk)[None, :] < lens[:, None]
+    return dense_attention(
+        q, k, v, causal=causal,
+        mask=jnp.broadcast_to(key_ok[:, None, :], (b, tq, tk)))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_key_lens_matches_masked_dense(np_rng, causal):
+    """Per-row key-length bound (variable-length right-padded prefill)
+    vs a key-masked dense reference — rows attend only [0, lens[b])."""
+    q, k, v = _qkv(np_rng, b=3, t=24, h=2, d=8)
+    lens = jnp.asarray([24, 13, 5], jnp.int32)  # incl. non-block-aligned
+    out = flash_attention(q, k, v, causal=causal, block_q=8, block_k=8,
+                          key_lens=lens)
+    ref = _masked_dense(q, k, v, lens, causal)
+    # rows past their length see garbage queries attending real keys —
+    # only positions with at least one valid key are meaningful; here
+    # every QUERY row is compared (the mask bounds keys, not queries)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_key_lens_grads_match_masked_dense(np_rng):
+    q, k, v = _qkv(np_rng, b=2, t=16, h=1, d=8)
+    lens = jnp.asarray([16, 7], jnp.int32)
+
+    gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=True, block_q=8, block_k=8, key_lens=lens) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(lambda q, k, v: jnp.sum(
+        _masked_dense(q, k, v, lens, True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_key_lens_zero_and_overlong_rows(np_rng):
+    """lens=0 rows must output exactly 0 (not the mean of v — NEG_INF
+    is finite so an unmasked p would be exp(0)=1 everywhere), matching
+    the backward's zero grads; lens>Tkv clamps to the no-mask result."""
+    q, k, v = _qkv(np_rng, b=3, t=8, h=1, d=8)
+    lens = jnp.asarray([0, 8, 100], jnp.int32)
+    out = flash_attention(q, k, v, block_q=8, block_k=8, key_lens=lens)
+    np.testing.assert_array_equal(np.asarray(out[0]), 0.0)
+    ref = dense_attention(q[1:], k[1:], v[1:])
+    np.testing.assert_allclose(np.asarray(out[1:]), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_key_lens_shape_validated(np_rng):
+    q, k, v = _qkv(np_rng, b=2, t=8, h=1, d=8)
+    with pytest.raises(ValueError, match="key_lens"):
+        flash_attention(q, k, v, key_lens=jnp.asarray([8, 8, 8]))

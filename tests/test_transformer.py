@@ -317,6 +317,14 @@ class TestSampling:
         draws = {int(sel(logits, jax.random.key(i))[0]) for i in range(64)}
         assert draws == {0, 1}, draws
 
+    def test_top_k_beyond_vocab_is_noop(self):
+        # k >= vocab must degrade to no filtering, not index OOB
+        logits = jnp.log(jnp.asarray(
+            [[0.4, 0.3, 0.2, 0.1]], jnp.float32))
+        sel = T.make_sampler(top_k=9)
+        draws = {int(sel(logits, jax.random.key(i))[0]) for i in range(96)}
+        assert draws == {0, 1, 2, 3}, draws
+
     def test_eos_stops_generation(self):
         """After a row emits eos, every later position is pad."""
         params = T.init_params(jax.random.key(0), self.CFG)
@@ -381,6 +389,24 @@ class TestVariableLengthPrompts:
                                    temperature=0.0))
         np.testing.assert_array_equal(out[1, 7:], solo[0, 4:9])
 
+    def test_flash_prefill_matches_dense_prefill(self):
+        """attn_impl='flash' + prompt_lens: the prefill rides the Pallas
+        kernel's per-row key-length bound and must reproduce the dense
+        masked prefill's continuations exactly."""
+        import dataclasses as dc
+        params = T.init_params(jax.random.key(3), self.CFG)
+        r = np.random.RandomState(3)
+        batch = np.zeros((2, 8), np.int32)
+        batch[0] = r.randint(1, 32, 8)
+        batch[1, :5] = r.randint(1, 32, 5)
+        lens = jnp.asarray([8, 5], jnp.int32)
+        dense = np.asarray(T.generate(params, self.CFG, jnp.asarray(batch),
+                                      steps=3, prompt_lens=lens))
+        flash_cfg = dc.replace(self.CFG, attn_impl="flash")
+        flash = np.asarray(T.generate(params, flash_cfg, jnp.asarray(batch),
+                                      steps=3, prompt_lens=lens))
+        np.testing.assert_array_equal(flash, dense)
+
     def test_padded_row_matches_solo_with_moe(self):
         """Pad positions must not claim MoE expert capacity: at a
         no-drop capacity the padded short row still equals its solo
@@ -437,6 +463,22 @@ class TestBeamDecode:
         # log-probs of the returned sequences
         np.testing.assert_allclose(np.asarray(scores[:, 0]), best_lp,
                                    atol=1e-3)
+
+    def test_single_token_prompt(self):
+        """t0 == 1 has nothing to prefill: the caches must start empty
+        instead of tracing a T=0 sequence through the blocks, and beam-1
+        must still equal greedy from the same one-token prompt."""
+        params = T.init_params(jax.random.key(3), self.CFG)
+        prompt = jnp.asarray([[5], [17]], jnp.int32)
+        greedy = np.asarray(T.generate(params, self.CFG, prompt, steps=4))
+        seqs, _ = T.beam_decode(params, self.CFG, prompt, steps=4,
+                                beam_size=1)
+        np.testing.assert_array_equal(np.asarray(seqs[:, 0]), greedy)
+        # wider beam still runs (the r3 advisor flagged the T=0 prefill)
+        seqs2, scores2 = T.beam_decode(params, self.CFG, prompt, steps=4,
+                                       beam_size=3)
+        assert seqs2.shape == (2, 3, 5)
+        assert np.isfinite(np.asarray(scores2)).all()
 
     def test_eos_finishes_beams(self):
         params = T.init_params(jax.random.key(2), self.CFG)
