@@ -1,0 +1,17 @@
+# Shared definitions for the r4 watcher + campaign (sourced, not run).
+#
+# STOP_EPOCH: unix time after which no chip work may start (and running
+# stages are capped) so the driver's end-of-round bench owns the claim.
+export STOP_EPOCH=${STOP_EPOCH:-1785555000}   # 2026-08-01 03:30 UTC
+
+# One liveness criterion everywhere: the tiny matmul must complete AND
+# the backend must be the chip (platform "axon" through the relay; a
+# silent CPU fallback would otherwise declare a wedged chip alive and
+# launch the next heavy stage into it).
+chip_probe() {
+  timeout 150 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() != 'cpu', jax.default_backend()
+print((jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16))[0,0])
+"
+}

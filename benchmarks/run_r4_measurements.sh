@@ -11,8 +11,8 @@
 #   * remat/fusion A-B rows for the HBM-roofline work (resnet50_remat).
 set -u
 cd "$(dirname "$0")/.."
+. benchmarks/r4_common.sh   # STOP_EPOCH + chip_probe (shared w/ watcher)
 mkdir -p benchmarks/r4_logs
-STOP_EPOCH=${STOP_EPOCH:-1785555000}   # 2026-08-01 03:30 UTC
 
 # a stage killed at its timeout may have wedged the relay (the r3
 # hazard: a killed claimant wedges the chip ~2h) — launching the next
@@ -25,8 +25,7 @@ wait_alive() {
       echo "=== chip still wedged at STOP_EPOCH — aborting campaign ==="
       exit 0
     fi
-    if timeout 150 python -c "import jax, jax.numpy as jnp; print((jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16))[0,0])" \
-         >> benchmarks/r4_logs/realive.log 2>&1; then
+    if chip_probe >> benchmarks/r4_logs/realive.log 2>&1; then
       echo "    (chip alive again $(date +%H:%M:%S))"
       return
     fi
@@ -37,11 +36,17 @@ wait_alive() {
 
 run() {  # name timeout cmd...
   local name=$1 tmo=$2; shift 2
-  if [ "$(date +%s)" -ge "$STOP_EPOCH" ]; then
+  local now=$(date +%s)
+  if [ "$now" -ge "$STOP_EPOCH" ]; then
     echo "=== $name SKIPPED (past STOP_EPOCH) ==="
     return
   fi
-  echo "=== $name ($(date +%H:%M:%S)) ==="
+  # cap the stage budget at the deadline: a stage launched shortly
+  # before STOP_EPOCH must not run its full timeout past it and
+  # collide with the driver's own bench on the single chip claim
+  local budget=$(( STOP_EPOCH - now ))
+  if [ "$tmo" -gt "$budget" ]; then tmo=$budget; fi
+  echo "=== $name ($(date +%H:%M:%S), budget ${tmo}s) ==="
   timeout "$tmo" "$@" > "benchmarks/r4_logs/$name.out" 2> "benchmarks/r4_logs/$name.err"
   local rc=$?
   echo "    rc=$rc  (tail of out:)"; tail -3 "benchmarks/r4_logs/$name.out" | sed 's/^/    /'
@@ -50,8 +55,10 @@ run() {  # name timeout cmd...
   fi
 }
 
-# 0. liveness
-run probe 180 python -c "import jax, jax.numpy as jnp; print((jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16))[0,0])"
+# 0. liveness (same criterion as the watcher/wait_alive)
+echo "=== probe ($(date +%H:%M:%S)) ==="
+chip_probe > benchmarks/r4_logs/probe.out 2> benchmarks/r4_logs/probe.err \
+  || wait_alive
 
 # 1. the open regression question: tie-split vs select-and-scatter
 #    maxpool backward, resnet bs64 (cheap compile, done twice)
@@ -77,7 +84,8 @@ run suite_alexnet 1800 python benchmarks/suite.py --only alexnet --batches 64,12
 run suite_googlenet 1800 python benchmarks/suite.py --only googlenet
 run suite_resnet 1800 python benchmarks/suite.py --only resnet50
 run suite_resnet_s2d 1800 python benchmarks/suite.py --only resnet50_s2d
-run suite_resnet_remat 1800 python benchmarks/suite.py --only resnet50_remat
+run suite_resnet_remat 1800 python benchmarks/suite.py --only resnet50_remat --batches 64,256
+run suite_resnet_remat_full 1800 python benchmarks/suite.py --only resnet50_remat_full --batches 64,256
 run suite_vgg 1800 python benchmarks/suite.py --only vgg19
 
 # 6b. MoE transformer row (opt-in bench; T=2048 compiles small)

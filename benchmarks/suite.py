@@ -75,8 +75,11 @@ REF = {
     ("smallnet", 256): 33.113, ("smallnet", 512): 63.039,
 }
 
-# analytic fwd GFLOPs per image at 224x224 (2*MACs), for MFU reporting
-FWD_GFLOPS = {"resnet50": 8.2, "resnet50_s2d": 8.2, "vgg19": 39.0,
+# analytic fwd GFLOPs per image at 224x224 (2*MACs), for MFU reporting.
+# remat variants report MODEL-flops MFU (3x fwd) like everything else —
+# the recompute FLOPs are implementation cost, not model work
+FWD_GFLOPS = {"resnet50": 8.2, "resnet50_s2d": 8.2, "resnet50_remat": 8.2,
+              "resnet50_remat_full": 8.2, "vgg19": 39.0,
               "alexnet": 1.4, "googlenet": 3.0}
 V5E_PEAK_TFLOPS = 197.0
 
@@ -95,6 +98,15 @@ def _image_model(name):
     if name == "resnet50_s2d":
         # math-identical stem on a 2x2 space-to-depth blocking
         return models.resnet.resnet(50, num_classes=1000, s2d_stem=True)
+    if name == "resnet50_remat":
+        # save only conv outputs; recompute BN/ReLU in the backward
+        # (HBM-bytes reduction — PROFILE_NOTES roofline attack)
+        return models.resnet.resnet(50, num_classes=1000, remat="conv_out")
+    if name == "resnet50_remat_full":
+        # save nothing inside each block: max bytes reduction, +1 fwd
+        # of recompute FLOPs (the MXU idles at ~39% so recompute is
+        # cheaper than the bytes it saves if the roofline argument holds)
+        return models.resnet.resnet(50, num_classes=1000, remat="full")
     if name == "smallnet":
         return models.smallnet.smallnet(num_classes=10)
     raise ValueError(name)
@@ -511,7 +523,8 @@ def main():
     iters = 2 if quick else 20
 
     image_cfgs = [(n, b) for n in ("alexnet", "googlenet", "vgg19",
-                                   "resnet50", "resnet50_s2d")
+                                   "resnet50", "resnet50_s2d",
+                                   "resnet50_remat", "resnet50_remat_full")
                   for b in ((64,) if quick else (64, 128, 256))]
     # the reference's AlexNet table has a bs-512 row (benchmark/README.md)
     if not quick:

@@ -1,17 +1,17 @@
 #!/bin/bash
-# Round-4 watcher: probe the chip every PERIOD seconds; the moment a
-# tiny matmul completes, fire the r4 measurement campaign once and exit.
+# Round-4 watcher: probe the chip every PERIOD seconds; the moment the
+# probe completes, fire the r4 measurement campaign once and exit.
 # Each probe runs in its own subprocess under `timeout` — a wedged relay
 # makes the probe hang, the timeout reaps it, we sleep and retry.
 #
-# r4 change vs watch_and_measure.sh: the deadline is unix-epoch based
-# (HHMM comparison broke across midnight, and round 4 spans it).  The
-# same STOP_EPOCH is exported to the campaign so a late start cannot
-# overrun into the driver's end-of-round bench.
+# r4 changes vs watch_and_measure.sh: the deadline is unix-epoch based
+# (HHMM comparison broke across midnight, and round 4 spans it), and the
+# probe + STOP_EPOCH live in r4_common.sh shared with the campaign so
+# the two can't desynchronize.
 set -u
 cd "$(dirname "$0")/.."
+. benchmarks/r4_common.sh
 PERIOD=${PERIOD:-300}
-export STOP_EPOCH=${STOP_EPOCH:-1785555000}   # 2026-08-01 03:30 UTC
 LOG=benchmarks/r4_logs/watcher.log
 mkdir -p benchmarks/r4_logs
 
@@ -20,8 +20,7 @@ while true; do
     echo "[watcher $(date +%H:%M:%S)] past STOP_EPOCH — standing down so the driver's bench owns the chip" | tee -a "$LOG"
     exit 0
   fi
-  if timeout 150 python -c "import jax, jax.numpy as jnp; print((jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16))[0,0])" \
-       >> "$LOG" 2>&1; then
+  if chip_probe >> "$LOG" 2>&1; then
     echo "[watcher $(date +%H:%M:%S)] chip ANSWERED — firing campaign" | tee -a "$LOG"
     bash benchmarks/run_r4_measurements.sh 2>&1 | tee -a benchmarks/r4_logs/campaign_console.txt
     exit 0

@@ -69,17 +69,34 @@ _SPECS = {
 
 
 def resnet(depth: int = 50, num_classes: int = 1000, *, width: int = 64,
-           s2d_stem: bool = False) -> nn.Sequential:
+           s2d_stem: bool = False,
+           remat: Optional[str] = None) -> nn.Sequential:
     """ImageNet-style ResNet (reference: benchmark/paddle/image/resnet.py).
 
     s2d_stem=True computes the 7x7/s2 stem on a 2x2 space-to-depth
     blocking of the input — same math, same parameters, but the conv
     streams C_in=12 instead of 3, which the TPU tiles far better
     (benchmarks/PROFILE_NOTES.md item 3).
+
+    remat wraps every residual block in nn.Remat (same params, same
+    math): "conv_out" saves only conv outputs and recomputes BN/ReLU in
+    the backward; "full" saves nothing inside a block. Both REDUCE the
+    HBM bytes each train step streams — the binding resource for this
+    net on TPU (PROFILE_NOTES roofline: 57.6 GiB/step ≈ 7.8 passes over
+    the activation set; the MXU idles at ~39% waiting on those bytes).
     """
+    if remat not in (None, "conv_out", "full"):
+        raise ValueError(
+            f"remat must be None, 'conv_out' or 'full', got {remat!r}")
     kind, reps = _SPECS[depth]
     block = basic_block if kind == "basic" else bottleneck_block
     expansion = 1 if kind == "basic" else 4
+
+    def wrap(layer):
+        if remat is None:
+            return layer
+        return nn.Remat(layer,
+                        policy="conv_out" if remat == "conv_out" else None)
 
     layers = conv_bn(width, 7, 2, name="stem", space_to_depth=s2d_stem) + [
         nn.MaxPool2D(3, stride=2, padding="SAME", name="stem_pool")]
@@ -88,7 +105,8 @@ def resnet(depth: int = 50, num_classes: int = 1000, *, width: int = 64,
         out_ch = width * (2 ** stage) * expansion
         for i in range(n):
             stride = 2 if (stage > 0 and i == 0) else 1
-            layers.append(block(in_ch, out_ch, stride, name=f"s{stage}_b{i}"))
+            layers.append(
+                wrap(block(in_ch, out_ch, stride, name=f"s{stage}_b{i}")))
             in_ch = out_ch
     layers += [
         nn.GlobalAvgPool2D(name="gap"),

@@ -18,7 +18,7 @@ from paddle_tpu.nn.layers import (
     Activation,
     Lambda,
 )
-from paddle_tpu.nn.composite import Residual, Branches, MultiTask
+from paddle_tpu.nn.composite import Residual, Branches, MultiTask, Remat
 from paddle_tpu.nn.wrappers import (
     CRF,
     CTC,

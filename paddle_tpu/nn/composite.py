@@ -11,6 +11,7 @@ one fused graph either way.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence
 
 import jax
@@ -79,6 +80,56 @@ class Residual(Layer):
         if s_s:
             new_state["shortcut"] = s_s
         return self.activation(y + sc), new_state
+
+
+class Remat(Layer):
+    """Rematerialize a sub-layer's forward during the backward
+    (jax.checkpoint around the wrapped apply).
+
+    The reference had no activation checkpointing (SURVEY §5 — its
+    long-sequence memory grew linearly); on TPU remat is also a
+    BANDWIDTH tool: ResNet-50 training is HBM-bound at ~7.8 passes over
+    the activation set (benchmarks/PROFILE_NOTES.md), so re-computing
+    cheap VPU ops (BN normalize, ReLU) in the backward instead of
+    streaming their saved outputs trades idle MXU FLOPs for the scarce
+    resource, bytes.
+
+    policy:
+      None        — save nothing inside the block; the backward re-runs
+                    the whole forward from the block input.
+      "conv_out"  — save only tensors tagged ``checkpoint_name
+                    'conv_out'`` (every nn.Conv2D output); BN stats,
+                    normalize and activations recompute from those.
+
+    The wrapper is transparent: it adopts the inner layer's name and
+    passes params/state through unchanged, so wrapping does not change
+    the checkpoint/pytree layout of a model.
+    """
+
+    def __init__(self, inner: Layer, *, policy: Optional[str] = "conv_out",
+                 name: Optional[str] = None):
+        if policy not in (None, "conv_out"):
+            raise ValueError(
+                f"Remat policy must be None or 'conv_out', got {policy!r}")
+        self.inner = inner
+        self.policy = policy
+        self.name = name if name is not None else inner.name
+
+    def _init(self, rng, *specs, _abstract: bool = False):
+        return self.inner._init(rng, *specs, _abstract=_abstract)
+
+    def _apply(self, params, state, *inputs, training: bool, rng):
+        kwargs = {}
+        if self.policy == "conv_out":
+            kwargs["policy"] = \
+                jax.checkpoint_policies.save_only_these_names("conv_out")
+
+        @functools.partial(jax.checkpoint, **kwargs)
+        def fn(params, state, rng, *inputs):
+            return self.inner._apply(params, state, *inputs,
+                                     training=training, rng=rng)
+
+        return fn(params, state, rng, *inputs)
 
 
 class MultiTask(Layer):

@@ -11,6 +11,7 @@ from typing import Callable, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from paddle_tpu.core.dtypes import Policy, default_policy
 from paddle_tpu.core.errors import enforce
@@ -145,6 +146,11 @@ class Conv2D(Layer):
                 bias=params.get("bias"),
                 policy=self.policy or default_policy(),
             )
+        # inert tag unless an nn.Remat(policy="conv_out") ancestor is
+        # active, in which case ONLY these outputs are saved for the
+        # backward (BN/activations recompute — bytes, not FLOPs, bound
+        # conv nets on TPU; benchmarks/PROFILE_NOTES.md)
+        y = checkpoint_name(y, "conv_out")
         return self.activation(y), {}
 
 

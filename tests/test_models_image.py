@@ -70,6 +70,41 @@ def test_resnet_s2d_stem_equivalent(rng):
         np.testing.assert_allclose(a, b, rtol=1e-2, atol=2e-2)
 
 
+@pytest.mark.parametrize("policy", ["conv_out", "full"])
+def test_resnet_remat_equivalent(rng, policy):
+    """resnet(remat=...) is the SAME function with the SAME params —
+    identical loss AND grads; only what the backward saves vs recomputes
+    differs (nn.Remat wrapping each residual block)."""
+    plain = models.resnet.resnet(18, num_classes=5, width=8)
+    remat = models.resnet.resnet(18, num_classes=5, width=8, remat=policy)
+    params, state = plain.init(rng, ShapeSpec((2, 32, 32, 3)))
+    params2, _ = remat.init(rng, ShapeSpec((2, 32, 32, 3)))
+    assert (jax.tree_util.tree_structure(params)
+            == jax.tree_util.tree_structure(params2))
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 32, 32, 3),
+                    jnp.float32)
+    y = jnp.array([0, 3])
+
+    def loss_fn(model):
+        def f(p):
+            logits, _ = model.apply(p, state, x, training=True)
+            return jnp.mean(losses.softmax_cross_entropy(logits, y))
+        return f
+
+    l0, g0 = jax.value_and_grad(loss_fn(plain))(params)
+    l1, g1 = jax.value_and_grad(loss_fn(remat))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0),
+                    jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_resnet_remat_validates():
+    with pytest.raises(ValueError, match="remat"):
+        models.resnet.resnet(18, remat="bogus")
+
+
 def test_smallnet(rng):
     """The CIFAR-quick benchmark net (reference:
     benchmark/paddle/image/smallnet_mnist_cifar.py)."""
