@@ -151,8 +151,9 @@ def lstm(params, x, lengths=None, *, initial_state: Optional[LSTMState] = None,
     impl: "auto" uses the fused Pallas time-loop kernel
     (ops.pallas_lstm — W_hh and the carries stay VMEM-resident across
     steps instead of round-tripping HBM per step) on TPU when the shape
-    fits and there is no length masking; "pallas" forces it (interpret
-    mode off-TPU, for tests); "xla" forces the lax.scan.
+    fits; variable lengths ride the kernel's ragged [start, end) bounds
+    (PL.make_bounds). "pallas" forces it (interpret mode off-TPU, for
+    tests); "xla" forces the lax.scan.
     """
     b, t, _ = x.shape
     hdim = params["w_hh"].shape[0]

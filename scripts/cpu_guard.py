@@ -1,0 +1,31 @@
+"""Import this FIRST in any ad-hoc script: pins jax to the CPU backend.
+
+The one real TPU sits behind a single-claim relay, and the container's
+TPU plugin force-selects its platform at jax CONFIG level — outranking a
+plain ``JAX_PLATFORMS=cpu`` env var. Any python process that imports jax
+without both the env var and the config mirror claims the chip; if that
+process is then killed, the claim wedges and ``jax.devices()`` hangs in
+every later process for up to ~2 hours (this killed an entire round-3
+measurement session — benchmarks/results_v5e1.md).
+
+Usage, before anything that imports jax::
+
+    import scripts.cpu_guard  # noqa: F401  (repo root on sys.path)
+
+or for one-liners::
+
+    python -c "import scripts.cpu_guard, jax; ..."
+
+Scripts that are DELIBERATELY chip benchmarks must instead carry a
+``# chip-bench`` marker comment near the top; tests/test_chip_guard.py
+rejects any repo script that imports jax with neither the guard nor the
+marker.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,75 @@
+"""Wedge discipline, enforced: every runnable repo script that can pull
+in jax must be chip-safe.
+
+The TPU sits behind a single-claim relay; a killed claimant wedges the
+chip for hours (it cost the entire round-3 measurement session —
+benchmarks/results_v5e1.md). The container's TPU plugin outranks the
+``JAX_PLATFORMS=cpu`` env var at jax-config level, so a script is only
+safe if it does one of:
+
+  * import ``scripts.cpu_guard`` (pins cpu unconditionally), or
+  * mirror the env request into the config
+    (``jax.config.update("jax_platforms", "cpu")``), or
+  * declare itself a DELIBERATE chip claimant with a ``# chip-bench``
+    marker comment.
+
+Package modules (paddle_tpu/) and tests are exempt: they don't run as
+entry points, and tests/conftest.py already double-guards the suite.
+"""
+
+import pathlib
+import re
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+# importing paddle_tpu transitively imports jax, so scripts reaching
+# for either are in scope
+_PULLS_IN_JAX = re.compile(
+    r"^\s*(import jax\b|from jax\b|import paddle_tpu\b|from paddle_tpu\b)",
+    re.M)
+_SAFE = (
+    "scripts.cpu_guard",                      # unconditional cpu pin
+    'jax.config.update("jax_platforms", "cpu")',  # env-mirror pattern
+    "jax.config.update('jax_platforms', 'cpu')",
+    "# chip-bench",                           # deliberate chip claimant
+)
+# non-entry-point trees: package modules and the pytest suite (the
+# conftest double-guards the latter); everything else in the repo is
+# treated as runnable
+_EXEMPT_PARTS = {"paddle_tpu", "tests", ".git", ".claude", "__pycache__"}
+
+
+def test_every_jax_script_is_guarded_or_marked():
+    offenders = []
+    for path in sorted(REPO.rglob("*.py")):
+        rel = path.relative_to(REPO)
+        if _EXEMPT_PARTS & set(rel.parts[:-1]):
+            continue
+        text = path.read_text()
+        if not _PULLS_IN_JAX.search(text):
+            continue
+        if not any(s in text for s in _SAFE):
+            offenders.append(str(rel))
+    assert not offenders, (
+        "scripts can pull in jax with no cpu guard, no jax_platforms "
+        "cpu config mirror, and no '# chip-bench' marker (a killed "
+        f"chip claimant wedges the relay for hours): {offenders}")
+
+
+def test_cpu_guard_pins_cpu_in_clean_process():
+    """The prelude must force cpu in a process whose env does NOT ask
+    for it (conftest pins this process, so an in-process assert would
+    be vacuous). Reading jax.config doesn't initialize a backend, so
+    the child never touches the chip even if the guard were broken."""
+    import os
+
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import scripts.cpu_guard, os, jax; "
+         "print(os.environ['JAX_PLATFORMS'], jax.config.jax_platforms)"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.split() == ["cpu", "cpu"], out.stdout
