@@ -200,11 +200,15 @@ def main():
     # would lock the suite.py subprocesses out of it
     on_cpu = os.environ.get("JAX_PLATFORMS", "").startswith("cpu")
     timeout = 300 if on_cpu else 1200
+    # decode compiles small (T0=128 prefill + scan) — a tighter child
+    # budget keeps the whole-bench worst case inside the campaign stage
+    decode_timeout = 300 if on_cpu else 600
     # the resnet attempt chain (try + retry + bs-128 fallback) gets a
-    # tighter per-attempt budget so the WHOLE bench fits a ~95 min
+    # tighter per-attempt budget so the WHOLE bench fits the campaign
     # stage timeout even when every attempt hangs to its limit AND
     # needs the full 60s SIGTERM grace:
-    # 2*(1200+60) (suite) + 3*(900+60) = 5400s (stage budget: 5700)
+    # 2*(1200+60) (seq2seq+ctr) + (600+60) (decode) + 3*(900+60)
+    # = 6060s (campaign stage budget: 6300)
     resnet_timeout = 300 if on_cpu else 900
 
     for rec in run_suite_only("seq2seq", timeout):
@@ -218,6 +222,13 @@ def main():
         if rec.get("bench") == "ctr_sparse":
             emit("ctr_sparse_rows_per_sec", rec["rows_per_sec"],
                  "rows/sec", None)
+
+    # KV-cache autoregressive decode (the serving-latency analog of the
+    # reference's SequenceGenerator; no published reference number)
+    for rec in run_suite_only("decode", decode_timeout):
+        if rec.get("bench") == "decode":
+            emit("decode_new_tokens_per_sec", rec["new_tokens_per_sec"],
+                 "tokens/sec", None)
 
     # headline last; retry once (relay compile-cache may save the rerun),
     # then fall back to batch 128 — an honest lower number beats none

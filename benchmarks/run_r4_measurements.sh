@@ -75,8 +75,9 @@ run probe_ctr 1200 python benchmarks/probe_ctr.py
 run suite_small 2400 python benchmarks/suite.py --only smallnet,trainer_loop
 run suite_misc 2400 python benchmarks/suite.py --only transformer
 
-# 5. the north stars, driver-format (resnet bs256 inside, isolated+retry)
-run bench 5700 python bench.py
+# 5. the north stars + decode, driver-format (resnet bs256 inside,
+#    isolated+retry; worst case 6060s — see bench.py main's budget)
+run bench 6300 python bench.py
 
 # 6. image suite, batch-ascending; big-batch rows are the wedge risk so
 #    they go last, one stage each

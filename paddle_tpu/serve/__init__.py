@@ -4,5 +4,6 @@ the C inference ABI (reference: paddle/capi + merge_model)."""
 from paddle_tpu.serve.artifact import (
     CompiledModel,
     export_compiled_model,
+    export_decoder,
     load_compiled_model,
 )

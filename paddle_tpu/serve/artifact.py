@@ -103,6 +103,76 @@ def export_compiled_model(
         tar.addfile(info, io.BytesIO(mlir_text))
 
 
+def export_decoder(
+    params,
+    cfg,
+    path: str,
+    *,
+    batch: int,
+    prompt_len: int,
+    steps: int,
+    eos_id: Optional[int] = None,
+    pad_id: Optional[int] = None,
+    variable_lengths: bool = False,
+    temperature: Optional[float] = None,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
+    name: str = "decoder",
+) -> None:
+    """Export the transformer's FULL autoregressive decode loop — KV-cache
+    prefill + the lax.scan over steps — as a serving artifact.
+
+    The reference's generation-serving surface was a live
+    SequenceGenerator object (reference: api/PaddleAPI.h:1025,
+    capi/gradient_machine.h forward); the TPU-native artifact compiles
+    the whole loop into one StableHLO program with the weights folded
+    in, so serving autoregressive decode needs no model code.
+
+    Fixed at export (XLA static shapes): batch, prompt_len, steps.
+    Greedy by default; pass temperature (and optional top_k/top_p) to
+    bake a sampler in — the program then takes a uint32 [2] rng key
+    seed as its last input. variable_lengths=True adds a [batch] int32
+    prompt-lengths input (right-padded prompts).
+
+    Program signature:
+        prompt [batch, prompt_len] i32
+        [, prompt_lens [batch] i32]      (variable_lengths)
+        [, rng_seed [2] u32]             (temperature is not None)
+        -> tokens [batch, prompt_len + steps] i32
+    """
+    from paddle_tpu.models import transformer as T
+
+    select_fn = None
+    if temperature is not None:
+        select_fn = T.make_sampler(temperature=temperature, top_k=top_k,
+                                   top_p=top_p)
+
+    def decode(prompt, *rest):
+        rest = list(rest)
+        lens = rest.pop(0) if variable_lengths else None
+        rng = jax.random.wrap_key_data(rest.pop(0)) if select_fn else None
+        return T.generate(params, cfg, prompt, steps,
+                          select_fn=select_fn, rng=rng, eos_id=eos_id,
+                          pad_id=pad_id, prompt_lens=lens)
+
+    example = [np.zeros((batch, prompt_len), np.int32)]
+    if variable_lengths:
+        example.append(np.full((batch,), prompt_len, np.int32))
+    if select_fn:
+        example.append(np.zeros((2,), np.uint32))
+    export_compiled_model(
+        decode, example, path, name=name,
+        extra_meta={"kind": "decoder", "steps": steps,
+                    "prompt_len": prompt_len,
+                    "variable_lengths": variable_lengths,
+                    "sampled": temperature is not None,
+                    "temperature": temperature, "top_k": top_k,
+                    "top_p": top_p, "eos_id": eos_id,
+                    # what finished rows are filled with — a consumer
+                    # stripping padding needs this, not a guess
+                    "pad_id": eos_id if pad_id is None else pad_id})
+
+
 def extract_mlir(path: str, out_path: str) -> dict:
     """Pull the raw StableHLO module text out of an artifact for the
     PJRT-C server; returns the artifact meta."""

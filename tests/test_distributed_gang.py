@@ -1,0 +1,152 @@
+"""Real multi-process jax.distributed gang: two local processes join a
+coordinator, form one global mesh, and run an all-reduce and a sharded
+train step whose results must match a single-process run.
+
+Every other multi-device test in this suite runs single-process on the
+virtual 8-CPU mesh; this is the one that exercises the actual multi-host
+join path that parallel/launch.py promises (reference analog: the
+in-process multi-node simulation of
+paddle/trainer/tests/test_TrainerOnePass.cpp:245-258 with real server
+objects, and go/pserver/etcd_client.go's init barrier).
+"""
+
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+CHILD = r"""
+import json, os, sys
+import scripts.cpu_guard  # pins cpu; config-only, backend stays cold
+
+from paddle_tpu.parallel import distributed as D
+
+addr, pid = sys.argv[1], int(sys.argv[2])
+D.initialize(coordinator_address=addr, num_processes=2, process_id=pid)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+assert D.process_count() == 2
+assert D.is_primary() == (pid == 0)
+
+devs = jax.devices()
+assert len(devs) == 2, devs  # one cpu device per process, global view
+mesh = Mesh(np.array(devs), ("data",))
+
+# global [8, 4] array, each process owning its 4-row half
+rows = np.arange(32, dtype=np.float32).reshape(8, 4)
+local = rows[pid * 4:(pid + 1) * 4]
+sharding = NamedSharding(mesh, P("data"))
+garr = jax.make_array_from_process_local_data(sharding, local, (8, 4))
+
+# all-reduce: global sum must see BOTH halves
+total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(garr)
+D.sync_hosts("after-allreduce")
+
+# one sharded train step on the global mesh (batch over `data`)
+from paddle_tpu import nn, optim, parallel
+from paddle_tpu.core import mesh as mesh_lib
+from paddle_tpu.nn.module import ShapeSpec
+from paddle_tpu.ops import losses
+from paddle_tpu.train.state import TrainState
+
+gmesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=2), devices=devs)
+model = nn.Sequential([nn.Dense(8, name="fc", activation="relu"),
+                       nn.Dense(3, name="out")])
+params, mstate = model.init(jax.random.key(0), ShapeSpec((8, 4)))
+opt = optim.sgd(0.1)
+state = parallel.shard_train_state(
+    TrainState.create(params, mstate, opt), gmesh)
+step = parallel.make_sharded_train_step(
+    model, lambda lg, y: jnp.mean(losses.softmax_cross_entropy(lg, y)),
+    opt, gmesh)
+y_all = (np.arange(8) % 3).astype(np.int32)
+x_g = jax.make_array_from_process_local_data(
+    parallel.batch_sharding(gmesh), local, (8, 4))
+y_g = jax.make_array_from_process_local_data(
+    parallel.batch_sharding(gmesh), y_all[pid * 4:(pid + 1) * 4], (8,))
+new_state, loss, _ = step(state, jax.random.key(1), (x_g,), (y_g,))
+kernel_sum = float(jnp.sum(jnp.abs(new_state.params["fc"]["kernel"])))
+
+if D.is_primary():
+    print(json.dumps({"total": float(total), "loss": float(loss),
+                      "kernel_sum": kernel_sum}), flush=True)
+D.sync_hosts("done")
+"""
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_gang_matches_single_process(tmp_path):
+    # bounded by the 240s communicate() timeout below, not a marker
+    # (pytest-timeout isn't installed here)
+    addr = f"127.0.0.1:{_free_port()}"
+    script = tmp_path / "gang_child.py"
+    script.write_text(CHILD)
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    # the child script lives in tmp_path, so sys.path[0] isn't the repo
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), addr, str(pid)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True) for pid in (0, 1)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, err[-3000:]
+    rec = json.loads(outs[0][1].strip().splitlines()[-1])
+
+    # the all-reduce saw both halves
+    assert rec["total"] == float(np.arange(32).sum())
+
+    # single-process reference for the same global step
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import nn, optim, parallel
+    from paddle_tpu.core import mesh as mesh_lib
+    from paddle_tpu.nn.module import ShapeSpec
+    from paddle_tpu.ops import losses
+    from paddle_tpu.train.state import TrainState
+
+    mesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=1),
+                               devices=jax.devices()[:1])
+    model = nn.Sequential([nn.Dense(8, name="fc", activation="relu"),
+                           nn.Dense(3, name="out")])
+    params, mstate = model.init(jax.random.key(0), ShapeSpec((8, 4)))
+    opt = optim.sgd(0.1)
+    state = parallel.shard_train_state(
+        TrainState.create(params, mstate, opt), mesh)
+    step = parallel.make_sharded_train_step(
+        model, lambda lg, y: jnp.mean(losses.softmax_cross_entropy(lg, y)),
+        opt, mesh)
+    x = jnp.asarray(np.arange(32, dtype=np.float32).reshape(8, 4))
+    y = jnp.asarray((np.arange(8) % 3).astype(np.int32))
+    new_state, loss, _ = step(state, jax.random.key(1), (x,), (y,))
+    np.testing.assert_allclose(rec["loss"], float(loss), rtol=1e-5)
+    np.testing.assert_allclose(
+        rec["kernel_sum"],
+        float(jnp.sum(jnp.abs(new_state.params["fc"]["kernel"]))),
+        rtol=1e-5)
