@@ -224,8 +224,10 @@ def main():
                  "rows/sec", None)
 
     # KV-cache autoregressive decode (the serving-latency analog of the
-    # reference's SequenceGenerator; no published reference number)
-    for rec in run_suite_only("decode", decode_timeout):
+    # reference's SequenceGenerator; no published reference number).
+    # Greedy only here — sample/beam cost chip time the campaign's
+    # suite_decode stage measures instead
+    for rec in run_suite_only("decode_greedy", decode_timeout):
         if rec.get("bench") == "decode":
             emit("decode_new_tokens_per_sec", rec["new_tokens_per_sec"],
                  "tokens/sec", None)

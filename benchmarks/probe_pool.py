@@ -35,7 +35,7 @@ def child() -> None:
 
     import bench
 
-    tie = os.environ.get("PADDLE_TPU_POOL_TIE_SPLIT", "1") != "0"
+    tie = os.environ.get("PADDLE_TPU_POOL_TIE_SPLIT", "0") != "0"
     on_tpu = bench.init_devices_or_die()[0].platform != "cpu"
     batch, iters = (64, 30) if on_tpu else (8, 3)
 

@@ -467,12 +467,17 @@ def bench_moe_lm(seq_len: int = 2048, *, batch: int = 8, dim: int = 512,
 
 def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
                  dim: int = 512, n_layers: int = 8, n_heads: int = 8,
-                 vocab: int = 32000, iters: int = 5):
-    """Greedy KV-cache decode throughput (new tokens/sec) — the serving
-    latency analog of the reference's C-API forward path (reference:
-    capi/gradient_machine.h forward; its era had no autoregressive
-    decode, so there is no reference number — the row tracks our own
-    regression)."""
+                 vocab: int = 32000, iters: int = 5,
+                 modes=("greedy", "sample", "beam")):
+    """KV-cache decode throughput (new tokens/sec) per decode mode —
+    the serving latency analog of the reference's C-API forward path
+    (reference: capi/gradient_machine.h; the SequenceGenerator is the
+    beam mode's ancestor — api/PaddleAPI.h:1025). No reference number
+    exists; the rows track our own regression.
+
+    PRINTS one JSON record per mode the moment that mode is measured —
+    a later mode's hang (beam compiles a B*K-wide path) must not lose
+    an already-produced metric (bench.py run_child's invariant)."""
     from paddle_tpu.models import transformer as T
 
     cfg = T.TransformerConfig(vocab=vocab, dim=dim, n_layers=n_layers,
@@ -480,26 +485,55 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
     params = T.init_params(jax.random.key(0), cfg)
     prompt = jnp.asarray(np.random.RandomState(0).randint(
         0, vocab, (batch, prompt_len)), jnp.int32)
+    base = {"batch": batch, "prompt_len": prompt_len, "steps": steps,
+            "dim": dim, "n_layers": n_layers}
 
-    gen = jax.jit(lambda p, toks: T.generate(p, cfg, toks, steps=steps))
-    progress(f"decode: warmup/compile (B={batch} T0={prompt_len} "
-             f"steps={steps})")
-    out = gen(params, prompt)
-    jax.block_until_ready(out)
-    progress(f"decode: timing {iters} runs")
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = gen(params, prompt)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
-    progress(f"decode: done ({1000*dt:.1f} ms/run)")
-    return {
-        "bench": "decode", "batch": batch, "prompt_len": prompt_len,
-        "steps": steps, "dim": dim, "n_layers": n_layers,
-        "ms_per_decode": round(1000 * dt, 2),
-        "new_tokens_per_sec": round(batch * steps / dt, 1),
-        "ms_per_token_step": round(1000 * dt / steps, 3),
-    }
+    def timed(label, fn, *args):
+        progress(f"decode/{label}: warmup/compile (B={batch} "
+                 f"T0={prompt_len} steps={steps})")
+        out = fn(*args)
+        jax.block_until_ready(out)
+        progress(f"decode/{label}: timing {iters} runs")
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        dt = (time.perf_counter() - t0) / iters
+        progress(f"decode/{label}: done ({1000*dt:.1f} ms/run)")
+        return dt
+
+    if "greedy" in modes:
+        gen = jax.jit(lambda p, toks: T.generate(p, cfg, toks,
+                                                 steps=steps))
+        dt = timed("greedy", gen, params, prompt)
+        print(json.dumps({
+            "bench": "decode", **base,
+            "ms_per_decode": round(1000 * dt, 2),
+            "new_tokens_per_sec": round(batch * steps / dt, 1),
+            "ms_per_token_step": round(1000 * dt / steps, 3)}),
+            flush=True)
+
+    if "sample" in modes:
+        samp = jax.jit(lambda p, toks, r: T.sample(
+            p, cfg, toks, steps=steps, rng=r, temperature=0.8,
+            top_p=0.95))
+        dt = timed("sample", samp, params, prompt, jax.random.key(1))
+        print(json.dumps({
+            "bench": "decode_sample", **base,
+            "temperature": 0.8, "top_p": 0.95,
+            "new_tokens_per_sec": round(batch * steps / dt, 1)}),
+            flush=True)
+
+    if "beam" in modes:
+        beam_n = 4
+        beam = jax.jit(lambda p, toks: T.beam_decode(
+            p, cfg, toks, steps=steps, beam_size=beam_n)[0])
+        dt = timed(f"beam{beam_n}", beam, params, prompt)
+        print(json.dumps({
+            "bench": "decode_beam", **base, "beam_size": beam_n,
+            # beam explores B*K hypotheses; counts kept tokens only
+            "new_tokens_per_sec": round(batch * steps / dt, 1)}),
+            flush=True)
 
 
 def main():
@@ -583,13 +617,17 @@ def main():
             iters=iters)
         print(json.dumps(rec))
 
-    if only and "decode" in only:  # opt-in
-        rec = bench_decode(
+    if only and ("decode" in only or "decode_greedy" in only):  # opt-in
+        # decode_greedy: the cheap mode alone (bench.py's driver line);
+        # decode: all three modes (campaign's suite_decode stage)
+        modes = (("greedy",) if "decode" not in only
+                 else ("greedy", "sample", "beam"))
+        bench_decode(  # prints one record per mode itself
             batch=2 if quick else 8, prompt_len=16 if quick else 128,
             steps=8 if quick else 128, dim=64 if quick else 512,
             n_layers=2 if quick else 8, n_heads=2 if quick else 8,
-            vocab=500 if quick else 32000, iters=2 if quick else 5)
-        print(json.dumps(rec))
+            vocab=500 if quick else 32000, iters=2 if quick else 5,
+            modes=modes)
 
     if only and "moe" in only:  # opt-in (not in the default campaign)
         rec = bench_moe_lm(

@@ -325,12 +325,16 @@ def max_pool2d(x, window: IntOr2 = 2, *, stride: Optional[IntOr2] = None,
     select-and-scatter-free custom VJP above; tie_split=False keeps
     XLA's native pick-first semantics AND forward-mode (jvp/jacfwd)
     differentiability, which custom_vjp functions reject. The default
-    (None) reads env PADDLE_TPU_POOL_TIE_SPLIT (default on) so the two
-    backward formulations can be A/B-benchmarked on the chip without a
-    code edit.
+    (None) reads env PADDLE_TPU_POOL_TIE_SPLIT so the two backward
+    formulations can be A/B-benchmarked on the chip without a code
+    edit. Default OFF: the only suite rows ever measured with the
+    custom VJP active were ~25% SLOWER than round 1 (resnet bs64
+    40.4 vs 31.3 ms — results_v5e1.md), and an unmeasured suspect must
+    not sit in the headline path; benchmarks/probe_pool.py's on-chip
+    A/B is the evidence that flips this back.
     """
     if tie_split is None:
-        tie_split = os.environ.get("PADDLE_TPU_POOL_TIE_SPLIT", "1") != "0"
+        tie_split = os.environ.get("PADDLE_TPU_POOL_TIE_SPLIT", "0") != "0"
     win = _pair(window)
     strd = _pair(stride if stride is not None else window)
     pad2 = explicit_pad(x.shape[1], x.shape[2], win, strd, padding)

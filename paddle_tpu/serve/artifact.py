@@ -142,6 +142,10 @@ def export_decoder(
     """
     from paddle_tpu.models import transformer as T
 
+    if temperature is None and (top_k is not None or top_p is not None):
+        raise ValueError(
+            "top_k/top_p require temperature — without it the export "
+            "would be a greedy decoder silently ignoring the filters")
     select_fn = None
     if temperature is not None:
         select_fn = T.make_sampler(temperature=temperature, top_k=top_k,

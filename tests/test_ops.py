@@ -104,9 +104,12 @@ class TestConv:
         np.testing.assert_allclose(g_ts, g_raw, rtol=1e-5, atol=1e-6)
 
     def test_max_pool_tie_split_shares_gradient(self):
-        # a 4-way tie gets dy/4 each (XLA native would give one element 1)
+        # a 4-way tie gets dy/4 each (XLA native would give one element
+        # 1); explicit opt-in — the DEFAULT is the native formulation
+        # until the on-chip A/B clears the custom VJP (probe_pool.py)
         x = jnp.ones((1, 2, 2, 1), jnp.float32)
-        g = jax.grad(lambda x: jnp.sum(C.max_pool2d(x, 2)))(x)
+        g = jax.grad(lambda x: jnp.sum(
+            C.max_pool2d(x, 2, tie_split=True)))(x)
         np.testing.assert_allclose(g, np.full((1, 2, 2, 1), 0.25))
         # gradient mass is conserved either way
         assert float(jnp.sum(g)) == pytest.approx(1.0)
