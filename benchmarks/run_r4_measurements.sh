@@ -95,8 +95,11 @@ run suite_moe 1800 python benchmarks/suite.py --only moe
 # 6c. KV-cache decode throughput (serving latency analog)
 run suite_decode 1800 python benchmarks/suite.py --only decode
 
-# 7. refreshed profile trace for PROFILE_NOTES
+# 7. refreshed profile traces for PROFILE_NOTES: the headline resnet
+#    step (now with the remat A/B interesting) and the googlenet MFU
+#    floor (VERDICT r3 #8: 10-19% MFU, 3x below VGG — trace or number)
 run profile 1200 python benchmarks/profile_step.py --batch 256 --iters 10
+run profile_googlenet 1200 python benchmarks/profile_step.py --model googlenet --batch 256 --iters 10
 
 # 8. the single biggest compile (alexnet bs512) dead last: if it wedges
 #    the chip nothing is behind it

@@ -468,7 +468,7 @@ def bench_moe_lm(seq_len: int = 2048, *, batch: int = 8, dim: int = 512,
 def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
                  dim: int = 512, n_layers: int = 8, n_heads: int = 8,
                  vocab: int = 32000, iters: int = 5,
-                 modes=("greedy", "sample", "beam")):
+                 modes=("greedy", "sample", "beam", "gqa")):
     """KV-cache decode throughput (new tokens/sec) per decode mode —
     the serving latency analog of the reference's C-API forward path
     (reference: capi/gradient_machine.h; the SequenceGenerator is the
@@ -532,6 +532,23 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
         print(json.dumps({
             "bench": "decode_beam", **base, "beam_size": beam_n,
             # beam explores B*K hypotheses; counts kept tokens only
+            "new_tokens_per_sec": round(batch * steps / dt, 1)}),
+            flush=True)
+
+    if "gqa" in modes:
+        # same model size, KV heads / 4: the cache (and its per-step
+        # HBM read, the decode bottleneck) shrinks 4x — this row
+        # measures how much of that shows up as throughput
+        kv = max(1, n_heads // 4)
+        gcfg = T.TransformerConfig(vocab=vocab, dim=dim,
+                                   n_layers=n_layers, n_heads=n_heads,
+                                   n_kv_heads=kv, attn_impl="dense")
+        gparams = T.init_params(jax.random.key(0), gcfg)
+        gen_g = jax.jit(lambda p, toks: T.generate(p, gcfg, toks,
+                                                   steps=steps))
+        dt = timed(f"gqa_kv{kv}", gen_g, gparams, prompt)
+        print(json.dumps({
+            "bench": "decode_gqa", **base, "n_kv_heads": kv,
             "new_tokens_per_sec": round(batch * steps / dt, 1)}),
             flush=True)
 
@@ -619,15 +636,14 @@ def main():
 
     if only and ("decode" in only or "decode_greedy" in only):  # opt-in
         # decode_greedy: the cheap mode alone (bench.py's driver line);
-        # decode: all three modes (campaign's suite_decode stage)
-        modes = (("greedy",) if "decode" not in only
-                 else ("greedy", "sample", "beam"))
+        # decode: bench_decode's full default mode list (campaign's
+        # suite_decode stage) — ONE authoritative list, in the function
         bench_decode(  # prints one record per mode itself
             batch=2 if quick else 8, prompt_len=16 if quick else 128,
             steps=8 if quick else 128, dim=64 if quick else 512,
             n_layers=2 if quick else 8, n_heads=2 if quick else 8,
             vocab=500 if quick else 32000, iters=2 if quick else 5,
-            modes=modes)
+            **({"modes": ("greedy",)} if "decode" not in only else {}))
 
     if only and "moe" in only:  # opt-in (not in the default campaign)
         rec = bench_moe_lm(

@@ -66,6 +66,12 @@ class TransformerConfig:
     # "auto" = flash where the kernel compiles natively (TPU), dense
     # elsewhere (interpret-mode flash would be slower than dense)
     attn_impl: str = "auto"
+    # grouped-query attention: n_kv_heads < n_heads shares each K/V
+    # head across n_heads/n_kv_heads query heads. None = MHA. The win
+    # is decode bandwidth: the KV cache (and its per-step HBM reads —
+    # the decode bottleneck) shrink by that factor; the cached-attention
+    # einsums read the compact cache directly, never expanding it.
+    n_kv_heads: Optional[int] = None
     remat: bool = False
     # sparsely-activated FFN (GLaM-style): every `moe_every`-th block
     # swaps its dense MLP for `moe_experts` experts with top-`moe_k`
@@ -83,6 +89,14 @@ class TransformerConfig:
     def head_dim(self) -> int:
         return self.dim // self.n_heads
 
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+        if self.n_heads % kv != 0:
+            raise ValueError(
+                f"n_kv_heads {kv} must divide n_heads {self.n_heads}")
+        return kv
+
     def is_moe_block(self, i: int) -> bool:
         return self.moe_experts > 0 and i % self.moe_every == (
             self.moe_every - 1)
@@ -93,11 +107,15 @@ def init_params(rng, cfg: TransformerConfig):
     d, h = cfg.dim, cfg.mlp_ratio * cfg.dim
     ks = iter(jax.random.split(rng, 4 + 4 * cfg.n_layers))
 
+    # fused projection width: H query heads + 2 * KV heads (GQA keys/
+    # values are narrower when n_kv_heads < n_heads; 3*d exactly for MHA)
+    qkv_w = (cfg.n_heads + 2 * cfg.kv_heads) * cfg.head_dim
+
     def block_params(i, k1, k2, k3, k4):
         p = {
             "ln1": {"scale": jnp.ones((d,)), "offset": jnp.zeros((d,))},
-            "qkv": {"kernel": smart(k1, (d, 3 * d)),
-                    "bias": jnp.zeros((3 * d,))},
+            "qkv": {"kernel": smart(k1, (d, qkv_w)),
+                    "bias": jnp.zeros((qkv_w,))},
             "proj": {"kernel": smart(k2, (d, d)), "bias": jnp.zeros((d,))},
             "ln2": {"scale": jnp.ones((d,)), "offset": jnp.zeros((d,))},
         }
@@ -151,12 +169,25 @@ def _dense_attention(q, k, v, causal: bool, key_mask=None):
     return jnp.einsum("bhqk,bkhd->bqhd", w, v)
 
 
+def _expand_kv(q, k, v):
+    """Broadcast compact GQA K/V ([B,T,Hkv,Dh]) to q's full head count
+    for attention impls that require matching heads (dense, flash,
+    ring/Ulysses). One-shot paths only — the decode cache path never
+    expands (that's GQA's whole win)."""
+    h, hkv = q.shape[2], k.shape[2]
+    if hkv == h:
+        return k, v
+    g = h // hkv
+    return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
+
+
 def _attention(cfg: TransformerConfig, q, k, v, causal: bool,
                key_mask=None, key_lens=None):
     """key_lens [B] describes RIGHT-padded rows (keys [0, lens[b]) are
     real) and rides the flash kernel's per-row bound; key_mask [B, Tk]
     is an arbitrary mask and forces the dense path. They are two
     encodings of a mask, not composable — pass exactly one."""
+    k, v = _expand_kv(q, k, v)
     if key_mask is not None and key_lens is not None:
         raise ValueError("pass key_mask or key_lens, not both — the "
                          "flash path would honor only key_lens and "
@@ -222,13 +253,18 @@ def _block_parts(cfg: TransformerConfig, p, x, positions, attn_fn,
     decode prefill and the KV-cache step all run THIS code, so a model
     change cannot silently diverge between train and decode. Returns
     (x_out, k, v, aux) so cache builders can keep the rotated K/V and
-    training can collect the MoE load-balance aux loss."""
+    training can collect the MoE load-balance aux loss. Under GQA both
+    attn_fn and the return see COMPACT K/V ([B,T,Hkv,Dh]): caches store
+    that form and the cached attention reads it directly; full-H paths
+    (_attention's dense/flash, external ring/Ulysses fns) expand at
+    their own entry (`_expand_kv`)."""
     b, t, d = x.shape
-    h, dh = cfg.n_heads, cfg.head_dim
+    h, hkv, dh = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     y = norm_ops.layer_norm(x, p["ln1"]["scale"], p["ln1"]["offset"])
     qkv = linalg.dense(y, p["qkv"]["kernel"], p["qkv"]["bias"])
-    q, k, v = [a.reshape(b, t, h, dh)
-               for a in jnp.split(qkv, 3, axis=-1)]
+    q = qkv[..., :h * dh].reshape(b, t, h, dh)
+    k = qkv[..., h * dh:(h + hkv) * dh].reshape(b, t, hkv, dh)
+    v = qkv[..., (h + hkv) * dh:].reshape(b, t, hkv, dh)
     q = _rope(q, positions, cfg.rope_base)
     k = _rope(k, positions, cfg.rope_base)
     a = attn_fn(q, k, v).reshape(b, t, d)
@@ -242,6 +278,11 @@ def _block(cfg: TransformerConfig, p, x, positions, token_mask=None,
            attn_fn=None):
     if attn_fn is None:
         attn_fn = lambda q, k, v: _attention(cfg, q, k, v, causal=True)
+    else:
+        # external impls (ring/Ulysses context parallelism) expect
+        # matching head counts — expand compact GQA K/V at their door
+        inner = attn_fn
+        attn_fn = lambda q, k, v: inner(q, *_expand_kv(q, k, v))
     out, _, _, aux = _block_parts(cfg, p, x, positions, attn_fn,
                                   token_mask)
     return out, aux
@@ -360,16 +401,33 @@ def _cached_attention(q, k, v, k_buf, v_buf, t, valid):
     cache slot t, attend the 1-position q over `valid` cache keys
     ([..., total] bool, broadcastable over [B, H, 1, total]). Returns
     (out, k_buf, v_buf). Every decode path (greedy/sampled/beam) runs
-    THIS math so a scoring change cannot diverge between them."""
-    dh = q.shape[-1]
+    THIS math so a scoring change cannot diverge between them.
+
+    Under GQA the buffers hold COMPACT [B, total, Hkv, Dh] K/V; the
+    grouped einsums read them directly (q reshaped to [.., Hkv, G, ..])
+    so the per-step HBM read — the decode bottleneck — stays 1/G of the
+    MHA cache, which is the entire point of GQA."""
+    b, tq, h, dh = q.shape
+    hkv = k_buf.shape[2]
     k_buf = jax.lax.dynamic_update_slice_in_dim(k_buf, k, t, axis=1)
     v_buf = jax.lax.dynamic_update_slice_in_dim(v_buf, v, t, axis=1)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_buf) / jnp.sqrt(
-        jnp.asarray(dh, q.dtype))
-    scores = at_least_f32(scores)
+    scale = jnp.sqrt(jnp.asarray(dh, q.dtype))
+    if hkv == h:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_buf) / scale
+        scores = at_least_f32(scores)
+        scores = jnp.where(valid, scores, -1e30)
+        w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v_buf), k_buf, v_buf
+    g = h // hkv
+    qg = q.reshape(b, tq, hkv, g, dh)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_buf) / scale
+    # [B, Hkv, G, Tq, Tk] -> flatten head groups for the shared mask
+    scores = at_least_f32(scores).reshape(b, h, tq, -1)
     scores = jnp.where(valid, scores, -1e30)
     w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", w, v_buf), k_buf, v_buf
+    wg = w.reshape(b, hkv, g, tq, -1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", wg, v_buf)
+    return out.reshape(b, tq, h, dh), k_buf, v_buf
 
 
 def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
@@ -406,7 +464,6 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
         rng = jax.random.key(0)
     fill = eos_id if pad_id is None else pad_id
     total = t0 + steps
-    h, dh = cfg.n_heads, cfg.head_dim
     policy = default_policy()
     head = lambda x_last: _head(params, x_last)
 
@@ -431,8 +488,11 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
         # key_ok doubles as the MoE token mask: pad positions must not
         # claim expert capacity either
         x, k, v, _ = _block_parts(cfg, p, x, pos, prefill_attn, key_ok)
-        k_buf = jnp.zeros((b, total, h, dh), k.dtype).at[:, :t0].set(k)
-        v_buf = jnp.zeros((b, total, h, dh), v.dtype).at[:, :t0].set(v)
+        # buffers take k/v's own head count: compact Hkv under GQA
+        k_buf = jnp.zeros((b, total) + k.shape[2:], k.dtype) \
+            .at[:, :t0].set(k)
+        v_buf = jnp.zeros((b, total) + v.shape[2:], v.dtype) \
+            .at[:, :t0].set(v)
         caches.append((k_buf, v_buf))
     # only the last REAL position's logits matter
     rng, first_rng = jax.random.split(rng)
@@ -505,7 +565,6 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
 
     b, t0 = prompt.shape
     total = t0 + steps
-    h, dh = cfg.n_heads, cfg.head_dim
     policy = default_policy()
     head = lambda x_last: _head(params, x_last)
 
@@ -522,10 +581,10 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
             x, k, v, _ = _block_parts(
                 cfg, p, x, pos,
                 lambda q, k, v: _attention(cfg, q, k, v, causal=True))
-            caches[f"k{i}"] = jnp.zeros((b, total, h, dh), k.dtype) \
-                .at[:, :t0 - 1].set(k)
-            caches[f"v{i}"] = jnp.zeros((b, total, h, dh), v.dtype) \
-                .at[:, :t0 - 1].set(v)
+            caches[f"k{i}"] = jnp.zeros((b, total) + k.shape[2:],
+                                         k.dtype).at[:, :t0 - 1].set(k)
+            caches[f"v{i}"] = jnp.zeros((b, total) + v.shape[2:],
+                                        v.dtype).at[:, :t0 - 1].set(v)
     else:
         # each buffer's dtype must equal what the decode step will
         # write into it (dtype promotion depends on that BLOCK's param
@@ -540,8 +599,10 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
                 lambda p, x, pos: _block_parts(cfg, p, x, pos,
                                                lambda q, k, v: q)[:2],
                 p, x_shape, pos_shape)
-            caches[f"k{i}"] = jnp.zeros((b, total, h, dh), k_shape.dtype)
-            caches[f"v{i}"] = jnp.zeros((b, total, h, dh), k_shape.dtype)
+            caches[f"k{i}"] = jnp.zeros(
+                (b, total) + k_shape.shape[2:], k_shape.dtype)
+            caches[f"v{i}"] = jnp.zeros(
+                (b, total) + k_shape.shape[2:], k_shape.dtype)
     caches["t"] = jnp.full((b,), t0 - 1, jnp.int32)
 
     def step_fn(toks, dec):

@@ -499,6 +499,82 @@ class TestBeamDecode:
                 assert (row[hits[0]:] == eos).all(), row
 
 
+class TestGQA:
+    """Grouped-query attention: compact KV caches (the decode-bandwidth
+    lever), decode ≡ teacher-forced forward, and training."""
+
+    def _cfg(self, kv):
+        return T.TransformerConfig(vocab=32, dim=32, n_layers=2,
+                                   n_heads=4, n_kv_heads=kv, mlp_ratio=2,
+                                   attn_impl="dense")
+
+    def test_invalid_kv_heads_raises(self):
+        import pytest as _pytest
+        with _pytest.raises(ValueError, match="n_kv_heads"):
+            T.init_params(jax.random.key(0), self._cfg(3))
+
+    def test_param_shapes_compact(self):
+        cfg = self._cfg(1)
+        params = T.init_params(jax.random.key(0), cfg)
+        # H*Dh for q + 2 * Hkv*Dh for k/v = 32 + 2*8
+        assert params["blocks"][0]["qkv"]["kernel"].shape == (32, 48)
+
+    def test_full_kv_equals_mha_layout(self):
+        # n_kv_heads == n_heads must be exactly the MHA parameterization
+        cfg = self._cfg(4)
+        params = T.init_params(jax.random.key(0), cfg)
+        assert params["blocks"][0]["qkv"]["kernel"].shape == (32, 96)
+
+    @pytest.mark.parametrize("kv", [1, 2])
+    def test_decode_matches_forward(self, kv):
+        """Greedy decode's token-by-token cached path must reproduce the
+        teacher-forced argmax of the full forward — the grouped cached
+        einsums against the whole-sequence attention."""
+        cfg = self._cfg(kv)
+        params = T.init_params(jax.random.key(1), cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(1, 32, (2, 6)), jnp.int32)
+        out = np.asarray(T.generate(params, cfg, prompt, steps=4))
+        # teacher-force the generated sequence; every generated token
+        # must equal the argmax at its position
+        logits = np.asarray(T.apply(params, cfg, jnp.asarray(out)))
+        for s in range(4):
+            col = 6 + s
+            np.testing.assert_array_equal(
+                out[:, col], logits[:, col - 1].argmax(-1))
+
+    def test_beam1_matches_greedy(self):
+        cfg = self._cfg(2)
+        params = T.init_params(jax.random.key(2), cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(1).randint(1, 32, (2, 5)), jnp.int32)
+        greedy = np.asarray(T.generate(params, cfg, prompt, steps=4))
+        seqs, _ = T.beam_decode(params, cfg, prompt, steps=4, beam_size=1)
+        np.testing.assert_array_equal(np.asarray(seqs[:, 0]), greedy)
+
+    def test_trains(self):
+        from paddle_tpu import optim
+        cfg = self._cfg(2)
+        params = T.init_params(jax.random.key(3), cfg)
+        opt = optim.adam(3e-3)
+        ostate = opt.init(params)
+        base = np.random.RandomState(0).randint(0, 16, (8, 1))
+        toks = jnp.asarray((base + np.arange(12)) % 16, jnp.int32)
+
+        @jax.jit
+        def step(p, o, t, i):
+            l, g = jax.value_and_grad(lambda p: T.loss(p, cfg, t))(p)
+            p, o = opt.update(g, o, p, i)
+            return p, o, l
+
+        first = last = None
+        for i in range(40):
+            params, ostate, l = step(params, ostate, toks, jnp.asarray(i))
+            first = first if first is not None else float(l)
+            last = float(l)
+        assert last < first * 0.6, (first, last)
+
+
 class TestScore:
     def test_logprobs_and_masking(self):
         cfg = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
