@@ -409,16 +409,10 @@ def _cached_attention(q, k, v, k_buf, v_buf, t, valid):
     MHA cache, which is the entire point of GQA."""
     b, tq, h, dh = q.shape
     hkv = k_buf.shape[2]
+    g = h // hkv  # 1 for MHA — the grouped path IS the only path
     k_buf = jax.lax.dynamic_update_slice_in_dim(k_buf, k, t, axis=1)
     v_buf = jax.lax.dynamic_update_slice_in_dim(v_buf, v, t, axis=1)
     scale = jnp.sqrt(jnp.asarray(dh, q.dtype))
-    if hkv == h:
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_buf) / scale
-        scores = at_least_f32(scores)
-        scores = jnp.where(valid, scores, -1e30)
-        w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", w, v_buf), k_buf, v_buf
-    g = h // hkv
     qg = q.reshape(b, tq, hkv, g, dh)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_buf) / scale
     # [B, Hkv, G, Tq, Tk] -> flatten head groups for the shared mask

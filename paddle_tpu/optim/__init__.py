@@ -11,6 +11,7 @@ from paddle_tpu.optim.optimizers import (
     adam,
     adamax,
     ftrl,
+    lbfgs,
     proximal_gd,
     chain,
     clip_by_global_norm,

@@ -22,6 +22,7 @@ from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.pytree import global_norm, named_leaves
 from paddle_tpu.optim import schedules
@@ -278,6 +279,108 @@ def ftrl(learning_rate=0.01, l1: float = 0.0, l2: float = 0.0,
     return Optimizer(init, update)
 
 
+def lbfgs(learning_rate=1.0, history: int = 10,
+          min_curvature: float = 1e-10) -> Optimizer:
+    """Limited-memory BFGS with the standard two-loop recursion.
+
+    Reference parity: the pserver's `doOperation` vector-op set
+    (`pserver/ParameterServer2.h op_SGD … op_fix_omega_signs`,
+    `op_make_steepest_desc_dir`) existed precisely to host
+    (OWL-)L-BFGS-style algorithms server-side; the TPU-native answer is
+    a pure-functional optimizer whose history pytree shards like any
+    other optimizer state (ZeRO via shard_train_state).
+
+    Fixed-size history (XLA static shapes): the m most recent (s, y)
+    pairs live in [m, ...] buffers with a rolling write index under
+    `lax.fori_loop`-free masked arithmetic; pairs with curvature
+    s·y <= min_curvature are skipped (keeps H positive-definite). No
+    line search — the step is `learning_rate * H⁻¹g` (deterministic
+    full-batch or large-batch regimes; for stochastic minibatches
+    prefer adam). First step falls back to plain gradient descent.
+    """
+    lr_fn = schedules.resolve(learning_rate)
+    m = history
+
+    def init(params):
+        flat, _ = jax.tree.flatten(params)
+        dim_total = sum(int(np.prod(p.shape)) for p in flat)
+        return {
+            "s": jnp.zeros((m, dim_total), jnp.float32),
+            "y": jnp.zeros((m, dim_total), jnp.float32),
+            "rho": jnp.zeros((m,), jnp.float32),  # 1/(s·y), 0 = empty
+            "prev_x": jnp.zeros((dim_total,), jnp.float32),
+            "prev_g": jnp.zeros((dim_total,), jnp.float32),
+            "count": jnp.zeros((), jnp.int32),
+        }
+
+    def _flatten(tree):
+        flat, _ = jax.tree.flatten(tree)
+        return jnp.concatenate([jnp.ravel(a).astype(jnp.float32)
+                                for a in flat])
+
+    def _unflatten_like(vec, params):
+        flat, treedef = jax.tree.flatten(params)
+        out, off = [], 0
+        for p in flat:
+            n = int(np.prod(p.shape))
+            out.append(vec[off:off + n].reshape(p.shape).astype(p.dtype))
+            off += n
+        return treedef.unflatten(out)
+
+    def update(grads, opt_state, params, step):
+        lr = lr_fn(step)
+        x = _flatten(params)
+        g = _flatten(grads)
+        st = opt_state
+        count = st["count"]
+
+        # record the newest (s, y) pair from the PREVIOUS step
+        s_new = x - st["prev_x"]
+        y_new = g - st["prev_g"]
+        sy = jnp.dot(s_new, y_new)
+        ok = (count > 0) & (sy > min_curvature)
+        slot = jnp.where(count > 0, (count - 1) % m, 0)
+        s_buf = st["s"].at[slot].set(jnp.where(ok, s_new, st["s"][slot]))
+        y_buf = st["y"].at[slot].set(jnp.where(ok, y_new, st["y"][slot]))
+        # a rejected pair INVALIDATES the slot (rho 0) rather than
+        # leaving an m-steps-old pair masquerading as the newest
+        rho = st["rho"].at[slot].set(
+            jnp.where(ok, 1.0 / jnp.maximum(sy, min_curvature), 0.0))
+
+        # two-loop recursion, newest -> oldest then back; empty slots
+        # carry rho == 0 so their terms vanish
+        def newest_first(i):
+            return (slot - i) % m
+
+        q = g
+        alphas = []
+        for i in range(m):
+            j = newest_first(i)
+            a = rho[j] * jnp.dot(s_buf[j], q)
+            q = q - a * y_buf[j]
+            alphas.append((j, a))
+        # initial Hessian scale gamma = s·y / y·y of the newest pair
+        ynorm = jnp.dot(y_buf[slot], y_buf[slot])
+        gamma = jnp.where(rho[slot] > 0,
+                          1.0 / jnp.maximum(rho[slot] * ynorm, 1e-12),
+                          1.0)
+        r = gamma * q
+        for j, a in reversed(alphas):
+            b = rho[j] * jnp.dot(y_buf[j], r)
+            r = r + (a - b) * s_buf[j]
+
+        # first step (no history): plain gradient direction
+        direction = jnp.where(count > 0, r, g)
+        new_x = x - lr * direction
+        new_state = {
+            "s": s_buf, "y": y_buf, "rho": rho,
+            "prev_x": x, "prev_g": g, "count": count + 1,
+        }
+        return _unflatten_like(new_x, params), new_state
+
+    return Optimizer(init, update)
+
+
 def proximal_gd(learning_rate=0.01, l1: float = 0.0, l2: float = 0.0) -> Optimizer:
     """Proximal gradient descent (reference: operators/proximal_gd_op.cc)."""
     lr_fn = schedules.resolve(learning_rate)
@@ -364,6 +467,7 @@ def get(name: str, **kwargs) -> Optimizer:
         "adam": adam,
         "adamax": adamax,
         "ftrl": ftrl,
+        "lbfgs": lbfgs,
         "proximal_gd": proximal_gd,
     }
     try:

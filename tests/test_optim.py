@@ -25,6 +25,7 @@ ALL_OPTS = [
     ("adam", dict(learning_rate=0.2)),
     ("adamax", dict(learning_rate=0.2)),
     ("ftrl", dict(learning_rate=0.5)),
+    ("lbfgs", dict(learning_rate=0.5, history=5)),
     ("proximal_gd", dict(learning_rate=0.1)),
 ]
 
@@ -81,6 +82,54 @@ def test_adam_bias_correction_first_step():
     new_params, _ = opt.update(g, opt.init(params), params, jnp.zeros((), jnp.int32))
     # first adam step with bias correction moves by ~lr in grad direction
     np.testing.assert_allclose(new_params["w"], [1.0 - 0.001], rtol=1e-4)
+
+
+def test_lbfgs_beats_sgd_on_rosenbrock():
+    """The point of (L-)BFGS: curvature exploitation on an ill-
+    conditioned deterministic objective. Same step budget, same lr
+    family — L-BFGS must land far closer to the optimum than SGD."""
+    def rosen(params):
+        x = params["x"]
+        return jnp.sum(100.0 * (x[1:] - x[:-1] ** 2) ** 2
+                       + (1.0 - x[:-1]) ** 2)
+
+    def run(opt, steps=200):
+        params = {"x": jnp.zeros((4,))}
+        st = opt.init(params)
+
+        @jax.jit
+        def body(carry, i):
+            params, st = carry
+            g = jax.grad(rosen)(params)
+            params, st = opt.update(g, st, params, i)
+            return (params, st), None
+
+        (params, st), _ = jax.lax.scan(body, (params, st),
+                                       jnp.arange(steps))
+        return float(rosen(params))
+
+    l_lbfgs = run(optim.lbfgs(learning_rate=0.1, history=10))
+    l_sgd = run(optim.sgd(learning_rate=1e-3))  # larger lr diverges
+    assert np.isfinite(l_lbfgs)
+    assert l_lbfgs < l_sgd * 0.2, (l_lbfgs, l_sgd)
+
+
+def test_lbfgs_quadratic_near_newton():
+    """On a diagonal quadratic with lr=1, L-BFGS approaches the Newton
+    step once history accumulates: a handful of iterations should reach
+    machine-level loss where plain GD at a stable lr cannot."""
+    scales = jnp.asarray([1.0, 10.0, 100.0])
+
+    def loss(params):
+        return 0.5 * jnp.sum(scales * jnp.square(params["w"] - 1.0))
+
+    opt = optim.lbfgs(learning_rate=1.0, history=10)
+    params = {"w": jnp.zeros((3,))}
+    st = opt.init(params)
+    for i in range(30):
+        g = jax.grad(loss)(params)
+        params, st = opt.update(g, st, params, jnp.asarray(i))
+    assert float(loss(params)) < 1e-6, float(loss(params))
 
 
 def test_clip_global_norm():

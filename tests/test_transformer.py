@@ -509,8 +509,7 @@ class TestGQA:
                                    attn_impl="dense")
 
     def test_invalid_kv_heads_raises(self):
-        import pytest as _pytest
-        with _pytest.raises(ValueError, match="n_kv_heads"):
+        with pytest.raises(ValueError, match="n_kv_heads"):
             T.init_params(jax.random.key(0), self._cfg(3))
 
     def test_param_shapes_compact(self):
