@@ -12,6 +12,7 @@ from paddle_tpu.optim.optimizers import (
     adamax,
     ftrl,
     lbfgs,
+    owlqn,
     proximal_gd,
     chain,
     clip_by_global_norm,

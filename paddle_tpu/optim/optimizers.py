@@ -279,25 +279,9 @@ def ftrl(learning_rate=0.01, l1: float = 0.0, l2: float = 0.0,
     return Optimizer(init, update)
 
 
-def lbfgs(learning_rate=1.0, history: int = 10,
-          min_curvature: float = 1e-10) -> Optimizer:
-    """Limited-memory BFGS with the standard two-loop recursion.
-
-    Reference parity: the pserver's `doOperation` vector-op set
-    (`pserver/ParameterServer2.h op_SGD … op_fix_omega_signs`,
-    `op_make_steepest_desc_dir`) existed precisely to host
-    (OWL-)L-BFGS-style algorithms server-side; the TPU-native answer is
-    a pure-functional optimizer whose history pytree shards like any
-    other optimizer state (ZeRO via shard_train_state).
-
-    Fixed-size history (XLA static shapes): the m most recent (s, y)
-    pairs live in [m, ...] buffers with a rolling write index under
-    `lax.fori_loop`-free masked arithmetic; pairs with curvature
-    s·y <= min_curvature are skipped (keeps H positive-definite). No
-    line search — the step is `learning_rate * H⁻¹g` (deterministic
-    full-batch or large-batch regimes; for stochastic minibatches
-    prefer adam). First step falls back to plain gradient descent.
-    """
+def _lbfgs_family(learning_rate, history: int, min_curvature: float,
+                  l1: float) -> Optimizer:
+    """Shared L-BFGS / OWL-QN core (see lbfgs() and owlqn())."""
     lr_fn = schedules.resolve(learning_rate)
     m = history
 
@@ -310,6 +294,7 @@ def lbfgs(learning_rate=1.0, history: int = 10,
             "rho": jnp.zeros((m,), jnp.float32),  # 1/(s·y), 0 = empty
             "prev_x": jnp.zeros((dim_total,), jnp.float32),
             "prev_g": jnp.zeros((dim_total,), jnp.float32),
+            "gamma": jnp.ones((), jnp.float32),
             "count": jnp.zeros((), jnp.int32),
         }
 
@@ -331,6 +316,17 @@ def lbfgs(learning_rate=1.0, history: int = 10,
         lr = lr_fn(step)
         x = _flatten(params)
         g = _flatten(grads)
+        if l1 > 0.0:
+            # op_make_steepest_desc_dir: L1 pseudo-gradient — the l1
+            # subgradient chosen to point into the descent orthant;
+            # coordinates pinned at 0 inside the [-l1, l1] band get 0
+            pg = jnp.where(
+                x < 0, g - l1,
+                jnp.where(x > 0, g + l1,
+                          jnp.where(g < -l1, g + l1,
+                                    jnp.where(g > l1, g - l1, 0.0))))
+        else:
+            pg = g
         st = opt_state
         count = st["count"]
 
@@ -352,33 +348,81 @@ def lbfgs(learning_rate=1.0, history: int = 10,
         def newest_first(i):
             return (slot - i) % m
 
-        q = g
+        q = pg
         alphas = []
         for i in range(m):
             j = newest_first(i)
             a = rho[j] * jnp.dot(s_buf[j], q)
             q = q - a * y_buf[j]
             alphas.append((j, a))
-        # initial Hessian scale gamma = s·y / y·y of the newest pair
-        ynorm = jnp.dot(y_buf[slot], y_buf[slot])
-        gamma = jnp.where(rho[slot] > 0,
-                          1.0 / jnp.maximum(rho[slot] * ynorm, 1e-12),
-                          1.0)
+        # initial Hessian scale gamma = s·y / y·y of the newest ACCEPTED
+        # pair (Nocedal & Wright 7.20) — a rejected step keeps the last
+        # good scale rather than collapsing to I, which on an ill-
+        # conditioned objective would blow the un-line-searched step up
+        # by 1/gamma
+        ynorm = jnp.dot(y_new, y_new)
+        gamma = jnp.where(ok, sy / jnp.maximum(ynorm, 1e-12),
+                          st["gamma"])
         r = gamma * q
         for j, a in reversed(alphas):
             b = rho[j] * jnp.dot(y_buf[j], r)
             r = r + (a - b) * s_buf[j]
 
-        # first step (no history): plain gradient direction
-        direction = jnp.where(count > 0, r, g)
+        # first step (no history): plain (pseudo-)gradient direction
+        direction = jnp.where(count > 0, r, pg)
+        if l1 > 0.0:
+            # op_fix_dir_signs: the quasi-Newton direction may not
+            # leave the steepest-descent orthant — zero disagreeing
+            # coordinates (move dir -direction vs steepest -pg)
+            direction = jnp.where(direction * pg > 0, direction, 0.0)
         new_x = x - lr * direction
+        if l1 > 0.0:
+            # op_fix_omega_signs: a coordinate crossing zero clamps AT
+            # zero (the orthant-projection that makes OWL-QN sparse)
+            new_x = jnp.where(x * new_x < 0, 0.0, new_x)
         new_state = {
             "s": s_buf, "y": y_buf, "rho": rho,
-            "prev_x": x, "prev_g": g, "count": count + 1,
+            "prev_x": x, "prev_g": g, "gamma": gamma,
+            "count": count + 1,
         }
         return _unflatten_like(new_x, params), new_state
 
     return Optimizer(init, update)
+
+
+def lbfgs(learning_rate=1.0, history: int = 10,
+          min_curvature: float = 1e-10) -> Optimizer:
+    """Limited-memory BFGS with the standard two-loop recursion.
+
+    Reference parity: the pserver's `doOperation` vector-op set
+    (`pserver/ParameterServer2.h op_SGD … op_fix_omega_signs`,
+    `op_make_steepest_desc_dir`) existed precisely to host
+    (OWL-)L-BFGS-style algorithms server-side; the TPU-native answer is
+    a pure-functional optimizer whose history pytree shards like any
+    other optimizer state (ZeRO via shard_train_state).
+
+    Fixed-size history (XLA static shapes): the m most recent (s, y)
+    pairs live in [m, ...] buffers with a rolling write index; pairs
+    with curvature s·y <= min_curvature invalidate their slot (keeps H
+    positive-definite). No line search — the step is
+    `learning_rate * H⁻¹g` (deterministic full-batch or large-batch
+    regimes; for stochastic minibatches prefer adam). First step falls
+    back to plain gradient descent.
+    """
+    return _lbfgs_family(learning_rate, history, min_curvature, 0.0)
+
+
+def owlqn(learning_rate=1.0, l1: float = 1e-4, history: int = 10,
+          min_curvature: float = 1e-10) -> Optimizer:
+    """Orthant-wise L-BFGS for L1-regularized objectives (OWL-QN) —
+    the exact algorithm the reference's pserver op set implements
+    (`op_make_steepest_desc_dir` = the L1 pseudo-gradient,
+    `op_fix_dir_signs`, `op_fix_omega_signs` = the orthant projection;
+    pserver/ParameterServer2.cpp:1153-1202). Minimizes f(x) + l1*|x|_1
+    with exact zeros in the solution (the sparsity L1 is for)."""
+    if l1 <= 0:
+        raise ValueError(f"owlqn requires l1 > 0, got {l1}")
+    return _lbfgs_family(learning_rate, history, min_curvature, l1)
 
 
 def proximal_gd(learning_rate=0.01, l1: float = 0.0, l2: float = 0.0) -> Optimizer:
@@ -468,6 +512,7 @@ def get(name: str, **kwargs) -> Optimizer:
         "adamax": adamax,
         "ftrl": ftrl,
         "lbfgs": lbfgs,
+        "owlqn": owlqn,
         "proximal_gd": proximal_gd,
     }
     try:
