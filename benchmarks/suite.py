@@ -468,7 +468,7 @@ def bench_moe_lm(seq_len: int = 2048, *, batch: int = 8, dim: int = 512,
 def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
                  dim: int = 512, n_layers: int = 8, n_heads: int = 8,
                  vocab: int = 32000, iters: int = 5,
-                 modes=("greedy", "sample", "beam", "gqa")):
+                 modes=("greedy", "sample", "beam", "gqa", "int8")):
     """KV-cache decode throughput (new tokens/sec) per decode mode —
     the serving latency analog of the reference's C-API forward path
     (reference: capi/gradient_machine.h; the SequenceGenerator is the
@@ -532,6 +532,19 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
         print(json.dumps({
             "bench": "decode_beam", **base, "beam_size": beam_n,
             # beam explores B*K hypotheses; counts kept tokens only
+            "new_tokens_per_sec": round(batch * steps / dt, 1)}),
+            flush=True)
+
+    if "int8" in modes:
+        # weight-only int8 (serve.quant): per-token weight streaming
+        # halves vs bf16 — the dequant fuses into the dot's operand read
+        from paddle_tpu.serve import quant
+        qp = quant.quantize_params(params)  # DEFAULT_MATCH kernels
+        gen_q = jax.jit(lambda qp, toks: T.generate(
+            quant.dequantize_params(qp), cfg, toks, steps=steps))
+        dt = timed("int8", gen_q, qp, prompt)
+        print(json.dumps({
+            "bench": "decode_int8", **base,
             "new_tokens_per_sec": round(batch * steps / dt, 1)}),
             flush=True)
 

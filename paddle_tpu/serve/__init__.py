@@ -7,3 +7,9 @@ from paddle_tpu.serve.artifact import (
     export_decoder,
     load_compiled_model,
 )
+from paddle_tpu.serve import quant
+from paddle_tpu.serve.quant import (
+    QuantizedTensor,
+    dequantize_params,
+    quantize_params,
+)

@@ -117,6 +117,7 @@ def export_decoder(
     temperature: Optional[float] = None,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    int8_weights: bool = False,
     name: str = "decoder",
 ) -> None:
     """Export the transformer's FULL autoregressive decode loop — KV-cache
@@ -134,6 +135,12 @@ def export_decoder(
     seed as its last input. variable_lengths=True adds a [batch] int32
     prompt-lengths input (right-padded prompts).
 
+    int8_weights=True quantizes every matmul kernel to int8 with
+    per-channel scales (serve.quant) and bakes the INT8 constants into
+    the program with the dequant ops traced — the artifact shrinks ~4x
+    vs f32; see serve/quant.py's module docstring for the runtime-
+    bandwidth caveat (the decode_int8 suite row measures it).
+
     Program signature:
         prompt [batch, prompt_len] i32
         [, prompt_lens [batch] i32]      (variable_lengths)
@@ -141,6 +148,7 @@ def export_decoder(
         -> tokens [batch, prompt_len + steps] i32
     """
     from paddle_tpu.models import transformer as T
+    from paddle_tpu.serve import quant
 
     if temperature is None and (top_k is not None or top_p is not None):
         raise ValueError(
@@ -150,12 +158,17 @@ def export_decoder(
     if temperature is not None:
         select_fn = T.make_sampler(temperature=temperature, top_k=top_k,
                                    top_p=top_p)
+    if int8_weights:
+        # quant.DEFAULT_MATCH: matmul kernels only, embedding excluded
+        qparams = quant.quantize_params(params)
 
     def decode(prompt, *rest):
         rest = list(rest)
         lens = rest.pop(0) if variable_lengths else None
         rng = jax.random.wrap_key_data(rest.pop(0)) if select_fn else None
-        return T.generate(params, cfg, prompt, steps,
+        p = (quant.dequantize_params(qparams) if int8_weights
+             else params)
+        return T.generate(p, cfg, prompt, steps,
                           select_fn=select_fn, rng=rng, eos_id=eos_id,
                           pad_id=pad_id, prompt_lens=lens)
 
@@ -172,6 +185,7 @@ def export_decoder(
                     "sampled": temperature is not None,
                     "temperature": temperature, "top_k": top_k,
                     "top_p": top_p, "eos_id": eos_id,
+                    "int8_weights": int8_weights,
                     # what finished rows are filled with — a consumer
                     # stripping padding needs this, not a guess
                     "pad_id": eos_id if pad_id is None else pad_id})

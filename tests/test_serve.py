@@ -119,6 +119,91 @@ print("ok")
         assert "ok" in r.stdout
 
 
+class TestInt8Quant:
+    """Weight-only int8 serving: per-channel quantization accuracy,
+    in-jit dequant decode parity, and the shrunk decoder artifact."""
+
+    def test_roundtrip_error_small(self):
+        from paddle_tpu.serve import quant
+        w = np.random.RandomState(0).randn(64, 32).astype(np.float32)
+        # per-channel scales must survive wildly different column norms
+        w[:, 0] *= 100.0
+        qt = quant.quantize_tensor(jnp.asarray(w))
+        assert qt.q.dtype == jnp.int8 and qt.scale.shape == (32,)
+        d = np.asarray(quant.dequantize_tensor(qt))
+        rel = np.linalg.norm(d - w) / np.linalg.norm(w)
+        assert rel < 0.01, rel
+        # int8 range actually used (not crushed to a few levels)
+        assert int(jnp.max(jnp.abs(qt.q))) == 127
+
+    def test_vectors_ints_and_unmatched_pass_through(self):
+        from paddle_tpu.serve import quant
+        tree = {"proj": {"kernel": jnp.ones((4, 4)),
+                         "bias": jnp.ones((4,))},
+                "embed": {"table": jnp.ones((8, 4))},
+                "ids": jnp.arange(6)}
+        qt = quant.quantize_params(tree)  # DEFAULT_MATCH
+        assert isinstance(qt["proj"]["kernel"], quant.QuantizedTensor)
+        assert qt["proj"]["bias"].shape == (4,)       # vector: untouched
+        assert not isinstance(qt["embed"]["table"],
+                              quant.QuantizedTensor)  # excluded by match
+        assert jnp.issubdtype(qt["ids"].dtype, jnp.integer)
+        back = quant.dequantize_params(qt)
+        np.testing.assert_allclose(np.asarray(back["proj"]["kernel"]),
+                                   np.ones((4, 4)), atol=0.02)
+
+    def test_per_expert_scales_on_stacked_kernels(self):
+        from paddle_tpu.serve import quant
+        # one expert 100x larger must not crush the others' resolution
+        w = np.random.RandomState(0).randn(4, 16, 8).astype(np.float32)
+        w[3] *= 100.0
+        qt = quant.quantize_tensor(jnp.asarray(w))
+        assert qt.scale.shape == (4, 8)  # per expert, per out channel
+        d = np.asarray(quant.dequantize_tensor(qt))
+        for e in range(4):
+            rel = np.linalg.norm(d[e] - w[e]) / np.linalg.norm(w[e])
+            assert rel < 0.01, (e, rel)
+
+    def test_quantized_decode_close_to_full_precision(self):
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.serve import quant
+        cfg = T.TransformerConfig(vocab=32, dim=32, n_layers=2,
+                                  n_heads=4, mlp_ratio=2,
+                                  attn_impl="dense")
+        params = T.init_params(jax.random.key(0), cfg)
+        qp = quant.quantize_params(params)  # DEFAULT_MATCH
+        assert quant.quantization_error(params, qp) < 0.02
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(1, 32, (2, 8)), jnp.int32)
+        full = np.asarray(T.apply(params, cfg, toks))
+        q = np.asarray(T.apply(quant.dequantize_params(qp), cfg, toks))
+        # logits track closely; argmax agrees on a large majority
+        agree = (full.argmax(-1) == q.argmax(-1)).mean()
+        assert agree >= 0.8, agree
+
+    def test_int8_decoder_artifact_shrinks_and_runs(self, tmp_path):
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.serve import export_decoder
+        cfg = T.TransformerConfig(vocab=64, dim=64, n_layers=2,
+                                  n_heads=4, mlp_ratio=4,
+                                  attn_impl="dense")
+        params = T.init_params(jax.random.key(1), cfg)
+        p32 = str(tmp_path / "dec32.ptc")
+        p8 = str(tmp_path / "dec8.ptc")
+        export_decoder(params, cfg, p32, batch=1, prompt_len=4, steps=3)
+        export_decoder(params, cfg, p8, batch=1, prompt_len=4, steps=3,
+                       int8_weights=True)
+        # matmul weights dominate this model: int8 must cut the
+        # artifact to well under half the f32 size
+        assert os.path.getsize(p8) < 0.5 * os.path.getsize(p32), (
+            os.path.getsize(p8), os.path.getsize(p32))
+        m = load_compiled_model(p8)
+        assert m.meta["int8_weights"] is True
+        out = np.asarray(m.predict(np.ones((1, 4), np.int32)))
+        assert out.shape == (1, 7)
+        assert (out >= 0).all() and (out < 64).all()
+
+
 def test_artifact_input_validation(tmp_path):
     path = str(tmp_path / "mlp.ptc")
     _export_mlp(path)
