@@ -8,8 +8,15 @@ export STOP_EPOCH=${STOP_EPOCH:-1785555000}   # 2026-08-01 03:30 UTC
 # the backend must be the chip (platform "axon" through the relay; a
 # silent CPU fallback would otherwise declare a wedged chip alive and
 # launch the next heavy stage into it).
+#
+# 600s probe budget, NOT 150: the r3+r4 wedge persisted for 16+ hours
+# under a 150s/5-min prober — consistent with each killed probe
+# grabbing the claim the moment the previous wedge expires and being
+# SIGTERMed mid-init, re-wedging the relay for another window. A probe
+# long enough to ride out a slow grant (+ the ~30s compile) breaks
+# that cycle instead of perpetuating it.
 chip_probe() {
-  timeout 150 python -c "
+  timeout 600 python -c "
 import jax, jax.numpy as jnp
 assert jax.default_backend() != 'cpu', jax.default_backend()
 print((jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16))[0,0])

@@ -64,6 +64,11 @@ chip_probe > benchmarks/r4_logs/probe.out 2> benchmarks/r4_logs/probe.err \
 #    maxpool backward, resnet bs64 (cheap compile, done twice)
 run probe_pool 1500 python benchmarks/probe_pool.py
 
+# 1b. the round's key perf question at its cheapest shape: remat A/B
+#     at bs64 (full bs-256 rows run later in stage 6; this early row
+#     survives even if a later compile wedges the chip)
+run probe_remat 2400 python benchmarks/suite.py --only resnet50,resnet50_remat,resnet50_remat_full --batches 64
+
 # 2. lstm benches (fused kernel) + the h256/h512 inversion probe
 run suite_lstm 1200 python benchmarks/suite.py --only lstm_h256,lstm_h512
 run probe_lstm 1200 python benchmarks/probe_lstm.py

@@ -11,7 +11,7 @@
 set -u
 cd "$(dirname "$0")/.."
 . benchmarks/r4_common.sh
-PERIOD=${PERIOD:-300}
+PERIOD=${PERIOD:-600}
 LOG=benchmarks/r4_logs/watcher.log
 mkdir -p benchmarks/r4_logs
 
