@@ -147,8 +147,17 @@ def export_decoder(
         [, rng_seed [2] u32]             (temperature is not None)
         -> tokens [batch, prompt_len + steps] i32
     """
+    import dataclasses
+
     from paddle_tpu.models import transformer as T
     from paddle_tpu.serve import quant
+
+    # exported programs must be PORTABLE StableHLO: the flash Pallas
+    # kernel lowers to tpu_custom_call, which jax.export refuses (no
+    # compatibility guarantees). The prefill therefore exports with the
+    # exact dense attention; serve very long prompts in-process where
+    # the flash path applies.
+    cfg = dataclasses.replace(cfg, attn_impl="dense")
 
     if temperature is None and (top_k is not None or top_p is not None):
         raise ValueError(
