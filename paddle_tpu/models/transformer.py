@@ -72,6 +72,12 @@ class TransformerConfig:
     # the decode bottleneck) shrink by that factor; the cached-attention
     # einsums read the compact cache directly, never expanding it.
     n_kv_heads: Optional[int] = None
+    # rotary context extension for serving beyond the training length:
+    # "none" | "linear" (positions / rope_factor — Chen et al. 2023) |
+    # "ntk" (base * factor^(dh/(dh-2)) — frequency interpolation that
+    # keeps high-frequency dims intact). factor 1.0 = off either way.
+    rope_scaling: str = "none"
+    rope_factor: float = 1.0
     remat: bool = False
     # sparsely-activated FFN (GLaM-style): every `moe_every`-th block
     # swaps its dense MLP for `moe_experts` experts with top-`moe_k`
@@ -140,9 +146,25 @@ def init_params(rng, cfg: TransformerConfig):
     }
 
 
-def _rope(x, positions, base: float):
-    """Rotary embedding. x: [B,T,H,Dh] (Dh even), positions: [B,T]."""
+def _rope(x, positions, base: float, scaling: str = "none",
+          factor: float = 1.0):
+    """Rotary embedding. x: [B,T,H,Dh] (Dh even), positions: [B,T].
+
+    scaling extends usable context past the training length without new
+    parameters: "linear" compresses positions by `factor` (every
+    frequency slows uniformly); "ntk" rescales the BASE so low
+    frequencies stretch while the highest stay near-intact (usually
+    degrades short-context quality less)."""
     dh = x.shape[-1]
+    if scaling not in ("none", "linear", "ntk"):
+        raise ValueError(
+            f"rope_scaling must be none|linear|ntk, got {scaling!r}")
+    if factor <= 0:
+        raise ValueError(f"rope_factor must be > 0, got {factor}")
+    if scaling == "linear" and factor != 1.0:
+        positions = positions / factor
+    elif scaling == "ntk" and factor != 1.0:
+        base = base * factor ** (dh / max(dh - 2, 1))
     freqs = base ** (-jnp.arange(0, dh, 2, dtype=jnp.float32) / dh)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,Dh/2]
     cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
@@ -265,8 +287,10 @@ def _block_parts(cfg: TransformerConfig, p, x, positions, attn_fn,
     q = qkv[..., :h * dh].reshape(b, t, h, dh)
     k = qkv[..., h * dh:(h + hkv) * dh].reshape(b, t, hkv, dh)
     v = qkv[..., (h + hkv) * dh:].reshape(b, t, hkv, dh)
-    q = _rope(q, positions, cfg.rope_base)
-    k = _rope(k, positions, cfg.rope_base)
+    q = _rope(q, positions, cfg.rope_base, cfg.rope_scaling,
+              cfg.rope_factor)
+    k = _rope(k, positions, cfg.rope_base, cfg.rope_scaling,
+              cfg.rope_factor)
     a = attn_fn(q, k, v).reshape(b, t, d)
     x = x + linalg.dense(a, p["proj"]["kernel"], p["proj"]["bias"])
     y = norm_ops.layer_norm(x, p["ln2"]["scale"], p["ln2"]["offset"])

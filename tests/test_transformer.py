@@ -574,6 +574,59 @@ class TestGQA:
         assert last < first * 0.6, (first, last)
 
 
+class TestRopeScaling:
+    """Context extension without new parameters: linear position
+    compression and NTK base rescaling."""
+
+    def _cfg(self, **kw):
+        return T.TransformerConfig(vocab=32, dim=16, n_layers=1,
+                                   n_heads=2, mlp_ratio=2,
+                                   attn_impl="dense", **kw)
+
+    def test_factor_one_is_identity(self):
+        params = T.init_params(jax.random.key(0), self._cfg())
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, 32, (2, 8)), jnp.int32)
+        base = np.asarray(T.apply(params, self._cfg(), toks))
+        for mode in ("linear", "ntk"):
+            same = np.asarray(T.apply(
+                params, self._cfg(rope_scaling=mode, rope_factor=1.0),
+                toks))
+            np.testing.assert_allclose(same, base, rtol=1e-6)
+
+    def test_linear_scaling_matches_compressed_positions(self):
+        """factor-f linear scaling at positions p must equal the
+        unscaled model at positions p/f (the definition)."""
+        cfg = self._cfg()
+        params = T.init_params(jax.random.key(1), cfg)
+        toks = jnp.asarray(
+            np.random.RandomState(1).randint(0, 32, (2, 8)), jnp.int32)
+        pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.float32) * 4.0,
+                               (2, 8))
+        want = np.asarray(T.apply(params, cfg, toks, positions=pos / 4.0))
+        got = np.asarray(T.apply(
+            params, self._cfg(rope_scaling="linear", rope_factor=4.0),
+            toks, positions=pos))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_ntk_decodes_and_differs(self):
+        cfg = self._cfg(rope_scaling="ntk", rope_factor=8.0)
+        params = T.init_params(jax.random.key(2), cfg)
+        toks = jnp.asarray(
+            np.random.RandomState(2).randint(1, 32, (1, 6)), jnp.int32)
+        out = T.generate(params, cfg, toks, steps=4)
+        assert out.shape == (1, 10)
+        plain = np.asarray(T.apply(params, self._cfg(), toks))
+        scaled = np.asarray(T.apply(params, cfg, toks))
+        assert not np.allclose(plain, scaled)
+
+    def test_bad_mode_raises(self):
+        cfg = self._cfg(rope_scaling="bogus", rope_factor=2.0)
+        params = T.init_params(jax.random.key(3), cfg)
+        with pytest.raises(ValueError, match="rope_scaling"):
+            T.apply(params, cfg, jnp.zeros((1, 4), jnp.int32))
+
+
 class TestScore:
     def test_logprobs_and_masking(self):
         cfg = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
