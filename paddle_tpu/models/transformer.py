@@ -414,10 +414,35 @@ def make_context_parallel_loss(cfg: TransformerConfig, mesh, *,
 
 
 def _head(params, x_last):
-    """Final LN + LM head over last-position activations [B, D]."""
+    """Final LN + LM head over the last dim: [..., D] -> [..., V]
+    (used on [B, D] last-position activations and [B, W, D] windows —
+    ONE definition so a head change reaches every decode path)."""
     x_last = norm_ops.layer_norm(x_last, params["ln_f"]["scale"],
                                  params["ln_f"]["offset"])
     return linalg.matmul(x_last, params["lm_head"]["kernel"])
+
+
+def _prefill_kv(params, cfg: TransformerConfig, toks, total: int):
+    """Run `toks` [B, W] through the stack with plain causal attention
+    and return per-block `total`-slot K/V buffers filled at [:, :W] —
+    the shared prefill of the speculative and beam decoders (generate's
+    prefill stays separate: it also threads prompt_lens/MoE masks)."""
+    policy = default_policy()
+    b, w = toks.shape
+    x = jnp.take(params["embed"]["table"], toks, axis=0)
+    x = x.astype(policy.compute_dtype)
+    pos = jnp.broadcast_to(jnp.arange(w), (b, w))
+    caches = []
+    for blk in params["blocks"]:
+        x, k, v, _ = _block_parts(
+            cfg, blk, x, pos,
+            lambda q, k_, v_: _attention(cfg, q, k_, v_, causal=True))
+        caches.append((
+            jnp.zeros((b, total) + k.shape[2:], k.dtype)
+            .at[:, :w].set(k),
+            jnp.zeros((b, total) + v.shape[2:], v.dtype)
+            .at[:, :w].set(v)))
+    return caches
 
 
 def _cached_attention(q, k, v, k_buf, v_buf, t, valid):
@@ -567,6 +592,133 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
     return jnp.concatenate([prompt, toks.transpose(1, 0)], axis=1)
 
 
+def speculative_generate(params, cfg: TransformerConfig,
+                         draft_params, draft_cfg: TransformerConfig,
+                         prompt, steps: int, *, draft_k: int = 4,
+                         return_stats: bool = False):
+    """Greedy speculative decoding: a small DRAFT model proposes
+    `draft_k` tokens autoregressively, the TARGET model scores all of
+    them in ONE K+1-position cached forward, and the longest agreeing
+    prefix is accepted plus the target's own token at the first
+    disagreement — ≥1 target-quality token per round for ~1 target
+    forward per round instead of per token.
+
+    The output is EXACTLY the target model's greedy decode (the
+    accept rule keeps every token the target would have picked), so a
+    bad draft costs speed, never quality — tested as a hard equality.
+
+    Batch 1 only (rows would accept different prefix lengths and
+    desynchronize the shared scan); no eos early-stop. Cache slots are
+    indexed by token position, so rejected speculative writes are
+    simply overwritten when the real token reaches that position —
+    no rollback copies.
+
+    return_stats=True additionally returns the number of rounds — the
+    acceptance-rate observable: a perfect draft finishes `steps` tokens
+    in ceil(steps / (draft_k+1)) rounds, a hopeless one in `steps`.
+    """
+    b, t0 = prompt.shape
+    if b != 1:
+        raise ValueError(
+            f"speculative_generate is batch-1 only, got batch {b}")
+    if t0 < 2:
+        raise ValueError("need a >=2-token prompt (prefill t0-1, then "
+                         "the last token seeds the first round)")
+    policy = default_policy()
+    # pad the buffers so the final round may overshoot by a window
+    total = t0 + steps + draft_k + 1
+
+    def window_forward(p, c, caches, toks, start):
+        """Process `toks` [1, W] at positions start..start+W-1 through
+        the cached stack; returns (logits [1, W, V], new caches)."""
+        w = toks.shape[1]
+        x = jnp.take(p["embed"]["table"], toks, axis=0)
+        x = x.astype(policy.compute_dtype)
+        pos = start + jnp.arange(w)[None, :]
+        ar = jnp.arange(total)[None, :]
+        # window position j sees cache slots <= start + j
+        valid = (ar[None, :, :] <= (start + jnp.arange(w))[None, :, None]
+                 )[:, None]                      # [1, 1, W, total]
+        new_caches = []
+        for blk, (k_buf, v_buf) in zip(p["blocks"], caches):
+
+            def cached_attn(q, k, v, k_buf=k_buf, v_buf=v_buf):
+                out, k_buf, v_buf = _cached_attention(
+                    q, k, v, k_buf, v_buf, start, valid)
+                new_caches.append((k_buf, v_buf))
+                return out
+
+            x, _, _, _ = _block_parts(c, blk, x, pos, cached_attn)
+        return _head(p, x), new_caches
+
+    # prefill slots 0..t0-2 (token t0-1 stays unprocessed: its logits
+    # come from the first verify/draft window)
+    tgt_caches = _prefill_kv(params, cfg, prompt[:, :-1], total)
+    dft_caches = _prefill_kv(draft_params, draft_cfg, prompt[:, :-1],
+                             total)
+    out_buf = jnp.zeros((1, total), prompt.dtype).at[:, :t0].set(prompt)
+    t_end = t0 + steps
+
+    def cond(carry):
+        return carry[0] < t_end
+
+    def body(carry):
+        t, rounds, out_buf, tgt_caches, dft_caches = carry
+
+        # --- draft proposes draft_k tokens autoregressively ---------
+        # round start re-processes positions t-2 AND t-1: after a
+        # fully-accepted round the draft never processed its own last
+        # accepted token (slot t-2), and that gap would otherwise leave
+        # zero K/V attended forever, silently collapsing the acceptance
+        # rate. The 2-token window always covers the (at most 1 slot)
+        # gap; overwriting an already-filled slot is a no-op.
+        last2 = jax.lax.dynamic_slice(
+            out_buf, (jnp.zeros((), t.dtype), t - 2), (1, 2))
+        logits2, dft_caches = window_forward(
+            draft_params, draft_cfg, dft_caches, last2, t - 2)
+        d0 = jnp.argmax(logits2[:, -1], axis=-1).astype(prompt.dtype)
+
+        def draft_step(c, i):
+            dft, tok = c
+            logits, dft = window_forward(
+                draft_params, draft_cfg, dft, tok[:, None], t + i)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+            return (dft, nxt), nxt
+
+        (dft_caches, _), more = jax.lax.scan(
+            draft_step, (dft_caches, d0), jnp.arange(draft_k - 1))
+        drafts = jnp.concatenate(
+            [d0[None, :], more], axis=0).transpose(1, 0)   # [1, K]
+
+        # --- target verifies the window in one forward --------------
+        last = jax.lax.dynamic_slice_in_dim(out_buf, t - 1, 1, axis=1)
+        window = jnp.concatenate([last, drafts], axis=1)   # [1, K+1]
+        logits, tgt_caches = window_forward(
+            params, cfg, tgt_caches, window, t - 1)
+        greedy = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+
+        # longest agreeing prefix: drafts[j] == greedy[j] for j < n_acc
+        agree = drafts[0] == greedy[0, :draft_k]
+        n_acc = jnp.argmin(jnp.concatenate(
+            [agree, jnp.zeros((1,), bool)]).astype(jnp.int32))
+        # accepted drafts then the target's own token at the break
+        app = jnp.where(jnp.arange(draft_k + 1) < n_acc,
+                        jnp.concatenate([drafts[0], greedy[0, -1:]]),
+                        greedy[0])[None, :]
+        out_buf = jax.lax.dynamic_update_slice(
+            out_buf, app, (jnp.zeros((), t.dtype), t))
+        return ((t + n_acc + 1).astype(t.dtype), rounds + 1, out_buf,
+                tgt_caches, dft_caches)
+
+    _, rounds, out_buf, _, _ = jax.lax.while_loop(
+        cond, body, (jnp.asarray(t0, jnp.int32),
+                     jnp.zeros((), jnp.int32), out_buf, tgt_caches,
+                     dft_caches))
+    if return_stats:
+        return out_buf[:, :t_end], rounds
+    return out_buf[:, :t_end]
+
+
 def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
                 beam_size: int = 4, *, eos_id: Optional[int] = None,
                 length_penalty: float = 0.0):
@@ -592,17 +744,10 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
     # tracing a T=0 sequence through the attention kernels.
     caches = {}
     if t0 > 1:
-        x = jnp.take(params["embed"]["table"], prompt[:, :-1], axis=0)
-        x = x.astype(policy.compute_dtype)
-        pos = jnp.broadcast_to(jnp.arange(t0 - 1), (b, t0 - 1))
-        for i, p in enumerate(params["blocks"]):
-            x, k, v, _ = _block_parts(
-                cfg, p, x, pos,
-                lambda q, k, v: _attention(cfg, q, k, v, causal=True))
-            caches[f"k{i}"] = jnp.zeros((b, total) + k.shape[2:],
-                                         k.dtype).at[:, :t0 - 1].set(k)
-            caches[f"v{i}"] = jnp.zeros((b, total) + v.shape[2:],
-                                        v.dtype).at[:, :t0 - 1].set(v)
+        for i, (k_buf, v_buf) in enumerate(
+                _prefill_kv(params, cfg, prompt[:, :-1], total)):
+            caches[f"k{i}"] = k_buf
+            caches[f"v{i}"] = v_buf
     else:
         # each buffer's dtype must equal what the decode step will
         # write into it (dtype promotion depends on that BLOCK's param

@@ -574,6 +574,71 @@ class TestGQA:
         assert last < first * 0.6, (first, last)
 
 
+class TestSpeculativeDecode:
+    """Greedy speculative decoding must produce EXACTLY the target
+    model's greedy output — the draft only changes speed. That equality
+    holds for any draft, so it's asserted token-for-token."""
+
+    CFG = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
+                              mlp_ratio=2, attn_impl="dense")
+
+    def _models(self, seed_t=0, seed_d=9):
+        target = T.init_params(jax.random.key(seed_t), self.CFG)
+        draft_cfg = T.TransformerConfig(vocab=32, dim=8, n_layers=1,
+                                        n_heads=2, mlp_ratio=2,
+                                        attn_impl="dense")
+        draft = T.init_params(jax.random.key(seed_d), draft_cfg)
+        return target, draft, draft_cfg
+
+    @pytest.mark.parametrize("k", [1, 3, 5])
+    def test_matches_greedy_with_unrelated_draft(self, k):
+        target, draft, draft_cfg = self._models()
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(1, 32, (1, 6)), jnp.int32)
+        want = np.asarray(T.generate(target, self.CFG, prompt, steps=7))
+        got = np.asarray(T.speculative_generate(
+            target, self.CFG, draft, draft_cfg, prompt, steps=7,
+            draft_k=k))
+        np.testing.assert_array_equal(got, want)
+
+    def test_matches_greedy_with_perfect_draft(self):
+        """draft == target: every window fully accepts, so `steps`
+        tokens take exactly ceil(steps/(k+1)) rounds — the observable
+        that catches a draft-cache gap silently collapsing acceptance —
+        and the output still equals plain greedy."""
+        target, _, _ = self._models()
+        prompt = jnp.asarray(
+            np.random.RandomState(1).randint(1, 32, (1, 5)), jnp.int32)
+        want = np.asarray(T.generate(target, self.CFG, prompt, steps=10))
+        got, rounds = T.speculative_generate(
+            target, self.CFG, target, self.CFG, prompt, steps=10,
+            draft_k=4, return_stats=True)
+        np.testing.assert_array_equal(np.asarray(got), want)
+        assert int(rounds) == 2, int(rounds)  # ceil(10/5)
+
+    def test_gqa_target(self):
+        cfg = T.TransformerConfig(vocab=32, dim=16, n_layers=2,
+                                  n_heads=4, n_kv_heads=1, mlp_ratio=2,
+                                  attn_impl="dense")
+        target = T.init_params(jax.random.key(2), cfg)
+        _, draft, draft_cfg = self._models()
+        prompt = jnp.asarray(
+            np.random.RandomState(2).randint(1, 32, (1, 4)), jnp.int32)
+        want = np.asarray(T.generate(target, cfg, prompt, steps=5))
+        got = np.asarray(T.speculative_generate(
+            target, cfg, draft, draft_cfg, prompt, steps=5, draft_k=3))
+        np.testing.assert_array_equal(got, want)
+
+    def test_validates_batch_and_prompt(self):
+        target, draft, draft_cfg = self._models()
+        with pytest.raises(ValueError, match="batch-1"):
+            T.speculative_generate(target, self.CFG, draft, draft_cfg,
+                                   jnp.zeros((2, 4), jnp.int32), steps=3)
+        with pytest.raises(ValueError, match="prompt"):
+            T.speculative_generate(target, self.CFG, draft, draft_cfg,
+                                   jnp.zeros((1, 1), jnp.int32), steps=3)
+
+
 class TestRopeScaling:
     """Context extension without new parameters: linear position
     compression and NTK base rescaling."""
