@@ -727,9 +727,11 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
     the transformer's cached step via ops.beam_search's fixed-shape
     engine).
 
-    prompt [B, T0] (uniform length) -> (sequences [B, K, T0+steps],
-    scores [B, K]) sorted best-first; without an eos_id every beam runs
-    the full `steps`.
+    prompt [B, T0] (uniform length — the fixed-shape engine advances
+    every row's cache slot in lockstep; decode variable-length batches
+    with `generate(prompt_lens=...)` instead) -> (sequences
+    [B, K, T0+steps], scores [B, K]) sorted best-first; without an
+    eos_id every beam runs the full `steps`.
     """
     from paddle_tpu.ops import beam_search as bs
 

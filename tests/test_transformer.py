@@ -533,14 +533,7 @@ class TestGQA:
         params = T.init_params(jax.random.key(1), cfg)
         prompt = jnp.asarray(
             np.random.RandomState(0).randint(1, 32, (2, 6)), jnp.int32)
-        out = np.asarray(T.generate(params, cfg, prompt, steps=4))
-        # teacher-force the generated sequence; every generated token
-        # must equal the argmax at its position
-        logits = np.asarray(T.apply(params, cfg, jnp.asarray(out)))
-        for s in range(4):
-            col = 6 + s
-            np.testing.assert_array_equal(
-                out[:, col], logits[:, col - 1].argmax(-1))
+        assert_decode_matches_teacher_forcing(params, cfg, prompt, 4)
 
     def test_beam1_matches_greedy(self):
         cfg = self._cfg(2)
@@ -637,6 +630,43 @@ class TestSpeculativeDecode:
         with pytest.raises(ValueError, match="prompt"):
             T.speculative_generate(target, self.CFG, draft, draft_cfg,
                                    jnp.zeros((1, 1), jnp.int32), steps=3)
+
+
+def assert_decode_matches_teacher_forcing(params, cfg, prompt, steps):
+    """Cached token-by-token greedy decode must equal the teacher-forced
+    argmax of one full forward — THE decode-correctness invariant, used
+    by the GQA tests and the cross-feature matrix."""
+    t0 = prompt.shape[1]
+    out = np.asarray(T.generate(params, cfg, prompt, steps=steps))
+    logits = np.asarray(T.apply(params, cfg, jnp.asarray(out)))
+    for s in range(steps):
+        col = t0 + s
+        np.testing.assert_array_equal(
+            out[:, col], logits[:, col - 1].argmax(-1),
+            err_msg=f"step {s} of {cfg}")
+
+
+class TestDecodeFeatureMatrix:
+    """Cross-feature decode consistency sweep: every combination of
+    GQA x MoE x rope-scaling must keep the cached token-by-token decode
+    identical to the teacher-forced argmax of the full forward — the
+    invariant that catches interactions between features that each pass
+    alone."""
+
+    @pytest.mark.parametrize("kv,moe,scaling", [
+        (1, 0, "none"), (2, 4, "none"), (1, 4, "ntk"),
+        (2, 0, "linear"), (1, 4, "linear"), (4, 4, "ntk"),
+    ])
+    def test_decode_matches_teacher_forcing(self, kv, moe, scaling):
+        cfg = T.TransformerConfig(
+            vocab=32, dim=16, n_layers=2, n_heads=4, n_kv_heads=kv,
+            mlp_ratio=2, attn_impl="dense", moe_experts=moe,
+            moe_capacity_factor=8.0,  # no drops: decode == forward
+            rope_scaling=scaling, rope_factor=2.0)
+        params = T.init_params(jax.random.key(kv + moe), cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(moe).randint(1, 32, (2, 5)), jnp.int32)
+        assert_decode_matches_teacher_forcing(params, cfg, prompt, 4)
 
 
 class TestRopeScaling:
