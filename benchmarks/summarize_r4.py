@@ -1,0 +1,51 @@
+"""Collect every JSON record the r4 campaign produced into one markdown
+table — run after (or during) `run_r4_measurements.sh` to refresh
+`results_v5e1.md` quickly. No jax import: safe anywhere.
+
+Usage: python benchmarks/summarize_r4.py [--dir benchmarks/r4_logs]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+
+
+def collect(log_dir: pathlib.Path):
+    recs = []
+    for path in sorted(log_dir.glob("*.out")):
+        for line in path.read_text().splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            rec["_stage"] = path.stem
+            recs.append(rec)
+    return recs
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="benchmarks/r4_logs")
+    args = ap.parse_args()
+    recs = collect(pathlib.Path(args.dir))
+    if not recs:
+        print("(no JSON records found yet)")
+        return
+    print("| stage | bench/metric | key numbers |")
+    print("|---|---|---|")
+    for r in recs:
+        stage = r.pop("_stage")
+        name = r.pop("bench", None) or r.pop("metric", None) \
+            or r.pop("probe", "?")
+        nums = ", ".join(f"{k}={v}" for k, v in r.items()
+                         if isinstance(v, (int, float)))
+        print(f"| {stage} | {name} | {nums} |")
+
+
+if __name__ == "__main__":
+    main()
