@@ -78,6 +78,13 @@ class TransformerConfig:
     # keeps high-frequency dims intact). factor 1.0 = off either way.
     rope_scaling: str = "none"
     rope_factor: float = 1.0
+    # sliding-window (local) attention: each position attends the last
+    # `attn_window` positions only (None = full causal). The flash
+    # FORWARD kernel skips out-of-band blocks (O(T*window) prefill/
+    # inference; the backward scans all blocks); decode masks cache
+    # slots outside the band (the cache buffer itself stays full-length
+    # — a rolling buffer is a future optimization).
+    attn_window: Optional[int] = None
     remat: bool = False
     # sparsely-activated FFN (GLaM-style): every `moe_every`-th block
     # swaps its dense MLP for `moe_experts` experts with top-`moe_k`
@@ -174,9 +181,11 @@ def _rope(x, positions, base: float, scaling: str = "none",
     return out.reshape(x.shape)
 
 
-def _dense_attention(q, k, v, causal: bool, key_mask=None):
+def _dense_attention(q, k, v, causal: bool, key_mask=None,
+                     window=None):
     """Exact reference attention; [B,T,H,Dh] in/out, f32 scores.
-    key_mask: optional [B, Tk] bool, False keys are never attended."""
+    key_mask: optional [B, Tk] bool, False keys are never attended.
+    window: sliding-window band (causal only)."""
     dh = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
         jnp.asarray(dh, q.dtype))
@@ -184,6 +193,9 @@ def _dense_attention(q, k, v, causal: bool, key_mask=None):
     if causal:
         tq, tk = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
+        if window is not None:
+            qpos = jnp.arange(tq)[:, None] + (tk - tq)
+            mask = mask & (qpos - jnp.arange(tk)[None, :] < window)
         scores = jnp.where(mask, scores, -1e30)
     if key_mask is not None:
         scores = jnp.where(key_mask[:, None, None, :], scores, -1e30)
@@ -222,6 +234,7 @@ def _attention(cfg: TransformerConfig, q, k, v, causal: bool,
         # anywhere else interpret-mode emulation would be far slower
         # than the dense fallback
         impl = "flash" if jax.default_backend() == "tpu" else "dense"
+    window = cfg.attn_window
     if impl == "flash":
         if key_lens is not None:
             # right-padded variable-length rows ride the kernel's
@@ -229,15 +242,16 @@ def _attention(cfg: TransformerConfig, q, k, v, causal: bool,
             # keeps O(T·block) memory instead of falling back to the
             # [B,H,Tq,Tk] dense score tensor
             return flash_attention(q, k, v, causal=causal,
-                                   key_lens=key_lens)
+                                   key_lens=key_lens, window=window)
         if key_mask is None:
-            return flash_attention(q, k, v, causal=causal)
+            return flash_attention(q, k, v, causal=causal,
+                                   window=window)
     # arbitrary key masks take the dense path — ONE dense
     # implementation decides both masked and unmasked prefills;
     # lens-only callers get the equivalent right-padding mask here
     if key_mask is None and key_lens is not None:
         key_mask = jnp.arange(k.shape[1])[None, :] < key_lens[:, None]
-    return _dense_attention(q, k, v, causal, key_mask)
+    return _dense_attention(q, k, v, causal, key_mask, window)
 
 
 def _ffn(cfg: TransformerConfig, p, y, token_mask=None):
@@ -404,6 +418,12 @@ def make_context_parallel_loss(cfg: TransformerConfig, mesh, *,
     """
     from paddle_tpu import parallel as par
 
+    if cfg.attn_window is not None:
+        raise ValueError(
+            "attn_window is not supported under context parallelism: "
+            "the ring/Ulysses attention has no sliding-band plumbing, "
+            "and silently training full-attention would diverge from "
+            "every other (windowed) path")
     attn = par.make_sequence_parallel_attention(
         mesh, kind=kind, causal=True, batch_axis=batch_axis)
 
@@ -443,6 +463,13 @@ def _prefill_kv(params, cfg: TransformerConfig, toks, total: int):
             jnp.zeros((b, total) + v.shape[2:], v.dtype)
             .at[:, :w].set(v)))
     return caches
+
+
+def _band_valid(slots, t, window):
+    """The sliding-window band over cache SLOT indices: slot in
+    (t - window, t]. ONE definition for every decode path (uniform
+    prompts only — slot == position there)."""
+    return (slots <= t) & (slots > t - window)
 
 
 def _cached_attention(q, k, v, k_buf, v_buf, t, valid):
@@ -501,6 +528,11 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
     attn_impl "auto"/"flash" for long variable-length prompts.
     """
     b, t0 = prompt.shape
+    if cfg.attn_window is not None and prompt_lens is not None:
+        raise ValueError(
+            "attn_window with variable-length prompts is unsupported: "
+            "cache slots and rope positions disagree for padded rows, "
+            "so a slot-index window band would be wrong")
     if select_fn is None:
         select_fn = lambda logits, r: jnp.argmax(logits, axis=-1)
     if rng is None:
@@ -560,7 +592,11 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
             pos = (prompt_lens.astype(jnp.int32) + s)[:, None]
         ar = jnp.arange(total)
         if prompt_lens is None:
-            valid = (ar <= t)[None, None, None, :]
+            if cfg.attn_window is not None:
+                valid = _band_valid(ar, t, cfg.attn_window)[
+                    None, None, None, :]
+            else:
+                valid = (ar <= t)[None, None, None, :]
         else:
             # real prompt keys + generated slots written so far
             valid = ((ar[None, :] < prompt_lens[:, None]) |
@@ -636,9 +672,14 @@ def speculative_generate(params, cfg: TransformerConfig,
         x = x.astype(policy.compute_dtype)
         pos = start + jnp.arange(w)[None, :]
         ar = jnp.arange(total)[None, :]
-        # window position j sees cache slots <= start + j
-        valid = (ar[None, :, :] <= (start + jnp.arange(w))[None, :, None]
-                 )[:, None]                      # [1, 1, W, total]
+        # window position j sees cache slots <= start + j (and within
+        # the sliding-attention band when configured)
+        qpos = (start + jnp.arange(w))[None, :, None]
+        if c.attn_window is not None:
+            valid = _band_valid(ar[None, :, :], qpos, c.attn_window)
+        else:
+            valid = ar[None, :, :] <= qpos
+        valid = valid[:, None]                   # [1, 1, W, total]
         new_caches = []
         for blk, (k_buf, v_buf) in zip(p["blocks"], caches):
 
@@ -776,7 +817,11 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
         x = x.astype(policy.compute_dtype)
         pos = jnp.broadcast_to(t[None, None], (toks.shape[0], 1))
         new_dec = {"t": dec["t"] + 1}
-        valid = (jnp.arange(total) <= t)[None, None, None, :]
+        if cfg.attn_window is not None:
+            valid = _band_valid(jnp.arange(total), t,
+                                cfg.attn_window)[None, None, None, :]
+        else:
+            valid = (jnp.arange(total) <= t)[None, None, None, :]
         for i in range(len(params["blocks"])):
             k_buf, v_buf = dec[f"k{i}"], dec[f"v{i}"]
 

@@ -43,7 +43,8 @@ _LANE = 128  # TPU minimum tile width (lane count)
 
 
 def _attn_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
-                 m_ref, l_ref, *, scale: float, causal: bool):
+                 m_ref, l_ref, *, scale: float, causal: bool,
+                 window):
     """One (batch*head, q-block, k-block) grid step. The innermost grid
     dim walks k/v blocks sequentially (TPU grids are sequential), so
     VMEM scratch (acc/m/l) carries streaming-softmax state across k
@@ -73,6 +74,12 @@ def _attn_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
     needed = j * block_k < len_ref[0]
     if causal:
         needed = needed & (j * block_k <= (qi + 1) * bq - 1)
+    if window is not None:
+        # sliding window: the block's newest key must reach the oldest
+        # key the block's oldest query may see (qpos - window + 1) —
+        # blocks entirely below the band skip, so long-T cost is
+        # O(T * window), not O(T^2)
+        needed = needed & ((j + 1) * block_k - 1 >= qi * bq - window + 1)
 
     @pl.when(needed)
     def _compute():
@@ -87,6 +94,8 @@ def _attn_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
             qpos = qi * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             valid = valid & (qpos >= kpos)
+            if window is not None:
+                valid = valid & (qpos - kpos < window)
         s = jnp.where(valid, s, NEG_INF)
         m_prev = m_ref[:, :1]                          # [BQ, 1]
         l_prev = l_ref[:, :1]
@@ -124,7 +133,7 @@ def _pad_to(x, size, axis):
 
 
 def _flash_forward(q, k, v, lens, *, causal: bool, block_q: int,
-                   block_k: int, interpret: bool):
+                   block_k: int, window, interpret: bool):
     """q,k,v: [BH, T, D]; lens: [BH] i32 valid key counts ->
     (o [BH, T, D], lse [BH, T])."""
     if pltpu is None:
@@ -152,7 +161,8 @@ def _flash_forward(q, k, v, lens, *, causal: bool, block_q: int,
         pltpu.VMEM((block_q, _LANE), jnp.float32),
     ]
     o, lse = pl.pallas_call(
-        functools.partial(_attn_kernel, scale=scale, causal=causal),
+        functools.partial(_attn_kernel, scale=scale, causal=causal,
+                          window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1,), lambda b, i, j: (b,), **smem),
@@ -180,7 +190,7 @@ def _flash_forward(q, k, v, lens, *, causal: bool, block_q: int,
 
 
 def _blockwise_backward(q, k, v, lens, o, lse, g, *, causal: bool,
-                        block_k: int):
+                        block_k: int, window):
     """Recompute-based flash backward in plain JAX, O(T·block) memory."""
     bh, t, d = q.shape
     t_kv = k.shape[1]
@@ -204,6 +214,9 @@ def _blockwise_backward(q, k, v, lens, o, lse, g, *, causal: bool,
         valid = kpos[None, None, :] < lens[:, None, None]
         if causal:
             valid = valid & (qpos[None, :, None] >= kpos[None, None, :])
+            if window is not None:
+                valid = valid & (qpos[None, :, None] - kpos[None, None, :]
+                                 < window)
         p = jnp.where(valid, jnp.exp(s - lse[..., None]), 0.0)  # [BH,Tq,BK]
         dv = jnp.einsum("bqk,bqd->bkd", p, gf)
         dp = jnp.einsum("bqd,bkd->bqk", gf, vj)
@@ -222,25 +235,28 @@ def _blockwise_backward(q, k, v, lens, o, lse, g, *, causal: bool,
             dv.astype(v.dtype))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash(q, k, v, lens_f, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, lens_f, causal, block_q, block_k, window):
     interpret = jax.default_backend() != "tpu"
     o, _ = _flash_forward(q, k, v, lens_f, causal=causal, block_q=block_q,
-                          block_k=block_k, interpret=interpret)
+                          block_k=block_k, window=window,
+                          interpret=interpret)
     return o
 
 
-def _flash_fwd(q, k, v, lens_f, causal, block_q, block_k):
+def _flash_fwd(q, k, v, lens_f, causal, block_q, block_k, window):
     interpret = jax.default_backend() != "tpu"
     o, lse = _flash_forward(q, k, v, lens_f, causal=causal, block_q=block_q,
-                            block_k=block_k, interpret=interpret)
+                            block_k=block_k, window=window,
+                            interpret=interpret)
     return o, (q, k, v, lens_f, o, lse)
 
 
-def _flash_bwd(causal, block_q, block_k, res, g):
+def _flash_bwd(causal, block_q, block_k, window, res, g):
     q, k, v, lens_f, o, lse = res
     dq, dk, dv = _blockwise_backward(q, k, v, lens_f, o, lse, g,
-                                     causal=causal, block_k=block_k)
+                                     causal=causal, block_k=block_k,
+                                     window=window)
     # lens is carried as f32 so the custom_vjp can hand back an ordinary
     # zero cotangent (int operands would need float0 plumbing)
     return dq, dk, dv, jnp.zeros_like(lens_f)
@@ -252,7 +268,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 def flash_attention(q, k, v, *, causal: bool = False,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
-                    key_lens=None):
+                    key_lens=None, window=None):
     """Fused scaled-dot-product attention.
 
     q: [B, Tq, H, D]; k, v: [B, Tkv, H, D]. Returns [B, Tq, H, D].
@@ -262,9 +278,21 @@ def flash_attention(q, k, v, *, causal: bool = False,
     (right-padded variable-length sequences, e.g. a batched prefill).
     Implemented as the kernel's existing tail-padding bound made
     per-row, so the masked path costs nothing extra.
+
+    window: optional int — sliding-window (local) attention: query t
+    attends keys (t-window, t]. Requires causal=True. The FORWARD
+    kernel skips k-blocks entirely below the band (O(T*window) instead
+    of O(T^2)); the recompute backward still scans every block (its
+    out-of-band terms are zero but not skipped), so training cost
+    remains quadratic — the win is inference/prefill.
     """
     if q.ndim != 4:
         raise ValueError(f"expected [B, T, H, D], got {q.shape}")
+    if window is not None:
+        if not causal:
+            raise ValueError("window requires causal=True")
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
     b, t, h, d = q.shape
     t_kv = k.shape[1]
     if key_lens is None:
@@ -282,5 +310,5 @@ def flash_attention(q, k, v, *, causal: bool = False,
         return x.transpose(0, 2, 1, 3).reshape(b * h, tt, d)
 
     o = _flash(flat(q, t), flat(k, t_kv), flat(v, t_kv), lens, causal,
-               block_q, block_k)
+               block_q, block_k, window)
     return o.reshape(b, h, t, d).transpose(0, 2, 1, 3)

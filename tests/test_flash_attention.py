@@ -130,6 +130,79 @@ def test_key_lens_zero_and_overlong_rows(np_rng):
                                rtol=2e-5, atol=2e-5)
 
 
+class TestSlidingWindow:
+    def _windowed_dense(self, q, k, v, window):
+        tq, tk = q.shape[1], k.shape[1]
+        qpos = jnp.arange(tq)[:, None] + (tk - tq)
+        mask = (qpos >= jnp.arange(tk)[None, :]) & \
+            (qpos - jnp.arange(tk)[None, :] < window)
+        return dense_attention(
+            q, k, v,
+            mask=jnp.broadcast_to(mask, (q.shape[0], tq, tk)))
+
+    @pytest.mark.parametrize("window", [1, 5, 16])
+    def test_matches_windowed_dense(self, np_rng, window):
+        q, k, v = _qkv(np_rng, b=2, t=40, h=2, d=8)
+        out = flash_attention(q, k, v, causal=True, block_q=8,
+                              block_k=8, window=window)
+        ref = self._windowed_dense(q, k, v, window)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_huge_window_equals_full_causal(self, np_rng):
+        q, k, v = _qkv(np_rng, b=1, t=24, h=2, d=8)
+        out = flash_attention(q, k, v, causal=True, block_q=8,
+                              block_k=8, window=10_000)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_grads_match_windowed_dense(self, np_rng):
+        q, k, v = _qkv(np_rng, b=1, t=24, h=1, d=8)
+        gf = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+            q, k, v, causal=True, block_q=8, block_k=8,
+            window=6) ** 2), argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(lambda q, k, v: jnp.sum(
+            self._windowed_dense(q, k, v, 6) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_validation(self, np_rng):
+        q, k, v = _qkv(np_rng, b=1, t=8, h=1, d=8)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, window=4)
+        with pytest.raises(ValueError, match="window"):
+            flash_attention(q, k, v, causal=True, window=0)
+
+    def test_window_composes_with_key_lens(self, np_rng):
+        """All three kernel masks at once — per-row length bound,
+        causal, band — including a short row whose band lies entirely
+        past its length for late queries."""
+        q, k, v = _qkv(np_rng, b=2, t=24, h=1, d=8)
+        lens = jnp.asarray([24, 7], jnp.int32)
+        window = 5
+        out = flash_attention(q, k, v, causal=True, block_q=8,
+                              block_k=8, key_lens=lens, window=window)
+        qpos = jnp.arange(24)[:, None]
+        kpos = jnp.arange(24)[None, :]
+        mask = (qpos >= kpos) & (qpos - kpos < window)
+        ref = dense_attention(
+            q, k, v,
+            mask=jnp.broadcast_to(mask, (2, 24, 24))
+            & (kpos < lens[:, None, None]))
+        # rows/queries with at least one in-band valid key must match;
+        # row 1 queries past pos 7+window-1 have NO valid key -> the
+        # kernel returns 0 there by contract
+        np.testing.assert_allclose(np.asarray(out[0]), np.asarray(ref[0]),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(out[1, :11]),
+                                   np.asarray(ref[1, :11]),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_array_equal(np.asarray(out[1, 12:]), 0.0)
+
+
 def test_key_lens_shape_validated(np_rng):
     q, k, v = _qkv(np_rng, b=2, t=8, h=1, d=8)
     with pytest.raises(ValueError, match="key_lens"):

@@ -669,6 +669,82 @@ class TestDecodeFeatureMatrix:
         assert_decode_matches_teacher_forcing(params, cfg, prompt, 4)
 
 
+class TestSlidingWindowAttention:
+    def _cfg(self, window=None):
+        return T.TransformerConfig(vocab=32, dim=16, n_layers=2,
+                                   n_heads=2, mlp_ratio=2,
+                                   attn_impl="dense",
+                                   attn_window=window)
+
+    def test_locality(self):
+        """A token farther back than the total receptive field
+        (window-1 per layer) must not influence the logits; a token
+        inside one window must."""
+        cfg = self._cfg(window=3)  # 2 layers -> receptive field 5
+        params = T.init_params(jax.random.key(0), cfg)
+        r = np.random.RandomState(0)
+        a = r.randint(1, 32, (1, 12)).astype(np.int32)
+        b = a.copy()
+        b[0, 2] = (b[0, 2] + 7) % 32  # >receptive-field from pos 11
+        la = np.asarray(T.apply(params, cfg, jnp.asarray(a)))
+        lb = np.asarray(T.apply(params, cfg, jnp.asarray(b)))
+        np.testing.assert_allclose(la[0, -1], lb[0, -1], rtol=1e-5,
+                                   atol=1e-5)
+        c = a.copy()
+        c[0, 10] = (c[0, 10] + 7) % 32  # inside the last window
+        lc = np.asarray(T.apply(params, cfg, jnp.asarray(c)))
+        assert np.abs(la[0, -1] - lc[0, -1]).max() > 1e-4
+
+    def test_huge_window_equals_full(self):
+        params = T.init_params(jax.random.key(1), self._cfg())
+        toks = jnp.asarray(
+            np.random.RandomState(1).randint(1, 32, (2, 9)), jnp.int32)
+        full = np.asarray(T.apply(params, self._cfg(), toks))
+        win = np.asarray(T.apply(params, self._cfg(window=1000), toks))
+        np.testing.assert_allclose(win, full, rtol=1e-6)
+
+    def test_decode_matches_teacher_forcing(self):
+        cfg = self._cfg(window=4)
+        params = T.init_params(jax.random.key(2), cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(2).randint(1, 32, (2, 6)), jnp.int32)
+        assert_decode_matches_teacher_forcing(params, cfg, prompt, 5)
+
+    def test_beam_and_spec_respect_window(self):
+        cfg = self._cfg(window=4)
+        params = T.init_params(jax.random.key(3), cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(3).randint(1, 32, (1, 6)), jnp.int32)
+        greedy = np.asarray(T.generate(params, cfg, prompt, steps=5))
+        seqs, _ = T.beam_decode(params, cfg, prompt, steps=5,
+                                beam_size=1)
+        np.testing.assert_array_equal(np.asarray(seqs[:, 0]), greedy)
+        dcfg = self._cfg(window=4)
+        draft = T.init_params(jax.random.key(4), dcfg)
+        spec = np.asarray(T.speculative_generate(
+            params, cfg, draft, dcfg, prompt, steps=5, draft_k=3))
+        np.testing.assert_array_equal(spec, greedy)
+
+    def test_varlen_prompts_rejected(self):
+        cfg = self._cfg(window=4)
+        params = T.init_params(jax.random.key(5), cfg)
+        with pytest.raises(ValueError, match="attn_window"):
+            T.generate(params, cfg, jnp.zeros((2, 6), jnp.int32),
+                       steps=3, prompt_lens=jnp.asarray([6, 4]))
+
+    def test_context_parallel_rejected(self):
+        """CP's ring attention has no band plumbing — silently training
+        full attention would diverge from every windowed path."""
+        from paddle_tpu.core import mesh as mesh_lib
+        if len(jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshConfig(data=2, model=1, seq=4),
+            devices=jax.devices()[:8])
+        with pytest.raises(ValueError, match="attn_window"):
+            T.make_context_parallel_loss(self._cfg(window=4), mesh)
+
+
 class TestRopeScaling:
     """Context extension without new parameters: linear position
     compression and NTK base rescaling."""
