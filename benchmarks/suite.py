@@ -325,7 +325,8 @@ def bench_ctr_sparse(batch: int = 4096, *, slots: int = 32,
 
 def bench_transformer_lm(seq_len: int = 8192, *, batch: int = 4,
                          dim: int = 512, n_layers: int = 8, n_heads: int = 8,
-                         vocab: int = 32000, iters: int = 10):
+                         vocab: int = 32000, iters: int = 10,
+                         window=None):
     """Long-context transformer-LM training throughput (tokens/sec) —
     the framework's modern long-sequence story: Pallas flash attention +
     per-block remat. No reference counterpart (the reference predates
@@ -335,7 +336,8 @@ def bench_transformer_lm(seq_len: int = 8192, *, batch: int = 4,
     from paddle_tpu.models import transformer as T
 
     cfg = T.TransformerConfig(vocab=vocab, dim=dim, n_layers=n_layers,
-                              n_heads=n_heads, attn_impl="auto", remat=True)
+                              n_heads=n_heads, attn_impl="auto",
+                              attn_window=window, remat=True)
     params = T.init_params(jax.random.key(0), cfg)
     opt = optim.adam(1e-3)
     opt_state = opt.init(params)
@@ -362,7 +364,9 @@ def bench_transformer_lm(seq_len: int = 8192, *, batch: int = 4,
     dt = (time.perf_counter() - t0) / iters
     progress(f"transformer: done ({1000*dt:.1f} ms/batch)")
     return {
-        "bench": "transformer_lm", "batch": batch, "seq_len": seq_len,
+        "bench": "transformer_lm" if window is None else
+                 "transformer_lm_swa",
+        "window": window, "batch": batch, "seq_len": seq_len,
         "dim": dim, "n_layers": n_layers,
         "ms_per_batch": round(1000 * dt, 2),
         "tokens_per_sec": round(batch * seq_len / dt, 1),
@@ -645,6 +649,14 @@ def main():
             dim=64 if quick else 512, n_layers=2 if quick else 8,
             n_heads=2 if quick else 8, vocab=500 if quick else 32000,
             iters=iters)
+        print(json.dumps(rec))
+        # sliding-window variant at the same shape: measures the flash
+        # kernel's out-of-band block skipping (fwd O(T*window))
+        rec = bench_transformer_lm(
+            seq_len=128 if quick else 8192, batch=2 if quick else 4,
+            dim=64 if quick else 512, n_layers=2 if quick else 8,
+            n_heads=2 if quick else 8, vocab=500 if quick else 32000,
+            iters=iters, window=32 if quick else 1024)
         print(json.dumps(rec))
 
     if only and ("decode" in only or "decode_greedy" in only):  # opt-in
