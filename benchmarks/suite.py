@@ -75,13 +75,10 @@ REF = {
     ("smallnet", 256): 33.113, ("smallnet", 512): 63.039,
 }
 
-# analytic fwd GFLOPs per image at 224x224 (2*MACs), for MFU reporting.
-# remat variants report MODEL-flops MFU (3x fwd) like everything else —
-# the recompute FLOPs are implementation cost, not model work
-FWD_GFLOPS = {"resnet50": 8.2, "resnet50_s2d": 8.2, "resnet50_remat": 8.2,
-              "resnet50_remat_full": 8.2, "vgg19": 39.0,
-              "alexnet": 1.4, "googlenet": 3.0}
-V5E_PEAK_TFLOPS = 197.0
+# hardware constants + analytic per-image FLOPs live in ONE place
+# shared with bench.py's headline MFU math (paddle_tpu/core/hw.py)
+from paddle_tpu.core.hw import (  # noqa: E402
+    FWD_GFLOPS, V5E_HBM_GBPS, V5E_PEAK_TFLOPS)
 
 
 def _image_model(name):
@@ -311,7 +308,7 @@ def bench_ctr_sparse(batch: int = 4096, *, slots: int = 32,
     # rows moved per step: deep + wide lookups AND their grad pushes
     rows = batch * slots * 2 * 2
     row_bytes = batch * slots * 2 * (dim + 1) * 4  # f32 vectors each way
-    hbm_peak = 819e9  # v5e HBM GB/s
+    hbm_peak = V5E_HBM_GBPS * 1e9
     return {
         "bench": "ctr_sparse", "batch": batch, "slots": slots,
         "vocab": vocab, "dim": dim, "n_devices": n_dev,
@@ -352,18 +349,32 @@ def bench_transformer_lm(seq_len: int = 8192, *, batch: int = 4,
                                          jnp.zeros((), jnp.int32))
         return new_params, new_opt, loss
 
-    progress(f"transformer: warmup/compile (T={seq_len} dim={dim} "
+    # AOT so XLA's own flop count of the compiled step feeds the mfu
+    # field (r4 verdict weak #8: the north-star metric must come from
+    # the driver-visible instrument, not hand math in the results doc)
+    progress(f"transformer: lowering (T={seq_len} dim={dim} "
              f"L={n_layers})")
-    params, opt_state, loss = step(params, opt_state, toks)
+    lowered = step.lower(params, opt_state, toks)
+    progress("transformer: compiling")
+    compiled = lowered.compile()
+    flops = None
+    try:
+        cost = compiled.cost_analysis()
+        if cost and "flops" in cost:
+            flops = float(cost["flops"])
+    except Exception:
+        pass
+    progress("transformer: warmup step")
+    params, opt_state, loss = compiled(params, opt_state, toks)
     float(loss)
     progress(f"transformer: timing {iters} steps")
     t0 = time.perf_counter()
     for _ in range(iters):
-        params, opt_state, loss = step(params, opt_state, toks)
+        params, opt_state, loss = compiled(params, opt_state, toks)
     float(loss)
     dt = (time.perf_counter() - t0) / iters
     progress(f"transformer: done ({1000*dt:.1f} ms/batch)")
-    return {
+    rec = {
         "bench": "transformer_lm" if window is None else
                  "transformer_lm_swa",
         "window": window, "batch": batch, "seq_len": seq_len,
@@ -371,6 +382,10 @@ def bench_transformer_lm(seq_len: int = 8192, *, batch: int = 4,
         "ms_per_batch": round(1000 * dt, 2),
         "tokens_per_sec": round(batch * seq_len / dt, 1),
     }
+    if flops:
+        rec["mfu_pct"] = round(
+            100 * (flops / dt) / (V5E_PEAK_TFLOPS * 1e12), 1)
+    return rec
 
 
 def bench_trainer_loop(name: str, batch: int, *, hw: int = 224,

@@ -1,0 +1,23 @@
+"""TPU hardware constants for the benchmark instruments.
+
+ONE definition each — bench.py (the driver-visible headline) and
+benchmarks/suite.py (the full suite) must compute MFU from the same
+peak, or the two driver-visible MFU fields could silently disagree
+after a constant is corrected in only one place.
+"""
+
+# TPU v5e (v5 lite) per-chip peak, bf16 on the MXU.
+V5E_PEAK_TFLOPS = 197.0
+
+# TPU v5e per-chip HBM bandwidth.
+V5E_HBM_GBPS = 819.0
+
+# Analytic forward GFLOPs per image at 224x224 (2*MACs), for MFU
+# reporting. Train MFU = 3x forward (fwd + ~2x bwd) — remat variants
+# report MODEL-flops MFU like everything else (the recompute FLOPs are
+# implementation cost, not model work).
+FWD_GFLOPS = {
+    "resnet50": 8.2, "resnet50_s2d": 8.2, "resnet50_remat": 8.2,
+    "resnet50_remat_full": 8.2, "vgg19": 39.0,
+    "alexnet": 1.4, "googlenet": 3.0,
+}

@@ -79,11 +79,11 @@ class TransformerConfig:
     rope_scaling: str = "none"
     rope_factor: float = 1.0
     # sliding-window (local) attention: each position attends the last
-    # `attn_window` positions only (None = full causal). The flash
-    # FORWARD kernel skips out-of-band blocks (O(T*window) prefill/
-    # inference; the backward scans all blocks); decode masks cache
-    # slots outside the band (the cache buffer itself stays full-length
-    # — a rolling buffer is a future optimization).
+    # `attn_window` positions only (None = full causal). The flash path
+    # skips out-of-band blocks in BOTH directions (O(T*window) training
+    # and prefill); decode masks cache slots outside the band (the
+    # cache buffer itself stays full-length — a rolling buffer is a
+    # future optimization).
     attn_window: Optional[int] = None
     remat: bool = False
     # sparsely-activated FFN (GLaM-style): every `moe_every`-th block
@@ -186,6 +186,11 @@ def _dense_attention(q, k, v, causal: bool, key_mask=None,
     """Exact reference attention; [B,T,H,Dh] in/out, f32 scores.
     key_mask: optional [B, Tk] bool, False keys are never attended.
     window: sliding-window band (causal only)."""
+    if window is not None and not causal:
+        # identical failure to ops.flash_attention's — the two backends
+        # must not disagree for the same config (r4 advisor finding:
+        # this path used to silently run FULL attention instead)
+        raise ValueError("window requires causal=True")
     dh = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
         jnp.asarray(dh, q.dtype))
