@@ -555,12 +555,13 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
             flush=True)
 
     if "int8" in modes:
-        # weight-only int8 (serve.quant): per-token weight streaming
-        # halves vs bf16 — the dequant fuses into the dot's operand read
+        # weight-only int8 (serve.quant): generate() traces the dequant
+        # inside the scan body, so the loop streams s8 weights with the
+        # convert+scale fused into each dot's operand read
         from paddle_tpu.serve import quant
         qp = quant.quantize_params(params)  # DEFAULT_MATCH kernels
         gen_q = jax.jit(lambda qp, toks: T.generate(
-            quant.dequantize_params(qp), cfg, toks, steps=steps))
+            qp, cfg, toks, steps=steps))
         dt = timed("int8", gen_q, qp, prompt)
         print(json.dumps({
             "bench": "decode_int8", **base,

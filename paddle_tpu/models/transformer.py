@@ -545,6 +545,30 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
     fill = eos_id if pad_id is None else pad_id
     total = t0 + steps
     policy = default_policy()
+    # weight-only int8 streaming (serve.quant): params with
+    # QuantizedTensor leaves dequantize ONCE for the prefill (one-shot,
+    # compute-bound) but PER STEP inside the scan body below — the
+    # decode loop then streams the s8 weights from HBM each step (1/4
+    # the bytes of hoisted f32 copies — the decode bottleneck), with
+    # the convert+scale fusing into each matmul's operand read. The
+    # optimization_barrier pins the dequant in the body: WITHOUT it,
+    # XLA's loop-invariant code motion hoists the convert and the loop
+    # carries f32 (observed on the CPU pipeline — the exact failure
+    # docs/PARITY.md:20 asked about). tests/test_compiled_cost.py
+    # asserts the compiled loop body keeps its s8 reads.
+    from paddle_tpu.serve import quant as _quant
+    if _quant.has_quantized(params):
+        qparams = params
+        params = _quant.dequantize_params(qparams)
+
+        def step_params(tok):
+            # the barrier is keyed on the loop-VARYING token: its
+            # outputs are then not loop-invariant, so LICM cannot hoist
+            # the dequant no matter how aggressive the pipeline
+            return _quant.dequantize_params(
+                jax.lax.optimization_barrier((qparams, tok))[0])
+    else:
+        step_params = lambda tok: params
     head = lambda x_last: _head(params, x_last)
 
     # prefill: the same _block_parts body as apply() (cfg.attn_impl
@@ -587,8 +611,11 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
 
     def step(carry, s):
         tok, t, caches, rng, done = carry  # tok [B], t scalar slot
+        # int8: dequant traced INSIDE the loop body (see note above);
+        # otherwise this is the same params object, zero cost
+        p_full = step_params(tok)
         rng, step_rng = jax.random.split(rng)
-        x = jnp.take(params["embed"]["table"], tok[:, None], axis=0)
+        x = jnp.take(p_full["embed"]["table"], tok[:, None], axis=0)
         x = x.astype(policy.compute_dtype)
         # rope position continues from each row's OWN length
         if prompt_lens is None:
@@ -608,7 +635,7 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
                      ((ar[None, :] >= t0) & (ar[None, :] <= t)))
             valid = valid[:, None, None, :]
         new_caches = []
-        for p, (k_buf, v_buf) in zip(params["blocks"], caches):
+        for p, (k_buf, v_buf) in zip(p_full["blocks"], caches):
 
             def cached_attn(q, k, v, k_buf=k_buf, v_buf=v_buf):
                 # the update is captured via new_caches (traced normally)
@@ -618,7 +645,7 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
                 return out
 
             x, _, _, _ = _block_parts(cfg, p, x, pos, cached_attn)
-        nxt = select_fn(head(x[:, -1]), step_rng).astype(tok.dtype)
+        nxt = select_fn(_head(p_full, x[:, -1]), step_rng).astype(tok.dtype)
         if eos_id is not None:
             new_done = done | (tok == eos_id)
             nxt = jnp.where(new_done, jnp.asarray(fill, tok.dtype), nxt)

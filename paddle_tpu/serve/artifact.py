@@ -137,9 +137,10 @@ def export_decoder(
 
     int8_weights=True quantizes every matmul kernel to int8 with
     per-channel scales (serve.quant) and bakes the INT8 constants into
-    the program with the dequant ops traced — the artifact shrinks ~4x
-    vs f32; see serve/quant.py's module docstring for the runtime-
-    bandwidth caveat (the decode_int8 suite row measures it).
+    the program — the artifact shrinks ~4x vs f32, AND the decode loop
+    streams the s8 weights per step (generate() traces the dequant
+    inside the scan body; tests/test_compiled_cost.py asserts the
+    compiled loop state stays s8).
 
     Program signature:
         prompt [batch, prompt_len] i32
@@ -175,8 +176,9 @@ def export_decoder(
         rest = list(rest)
         lens = rest.pop(0) if variable_lengths else None
         rng = jax.random.wrap_key_data(rest.pop(0)) if select_fn else None
-        p = (quant.dequantize_params(qparams) if int8_weights
-             else params)
+        # qparams pass through whole: generate() places the dequant
+        # inside the scan body so the exported loop streams s8 weights
+        p = qparams if int8_weights else params
         return T.generate(p, cfg, prompt, steps,
                           select_fn=select_fn, rng=rng, eos_id=eos_id,
                           pad_id=pad_id, prompt_lens=lens)

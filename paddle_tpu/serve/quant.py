@@ -4,20 +4,29 @@ No reference counterpart (its era predates quantized inference); this is
 the TPU-native serving lever alongside GQA: autoregressive decode
 re-reads every weight matrix once per generated token, so storing
 matmul weights as int8 (+ one f32 scale per output channel) shrinks the
-stored weights ~4x vs f32 (2x vs bf16). Whether that also shows up as
-decode BANDWIDTH depends on the compiler: the dequant runs before the
-generation scan, and XLA may hoist the converted weights out of the
-loop (loop-invariant code motion), in which case per-step streaming is
-back at full precision. The suite's `decode_int8` row measures exactly
-this on the chip — treat the runtime win as a hypothesis until that
-row reports; the artifact-size win is unconditional.
+stored weights ~4x vs f32 (2x vs bf16).
 
-Usage (any model whose params are a pytree of matmul kernels):
+Whether that also shows up as decode BANDWIDTH depends on WHERE the
+dequant is traced. Dequantizing before the generation scan leaves f32
+weights as loop invariants — full-precision streaming every step.
+`transformer.generate` therefore detects QuantizedTensor leaves and
+re-traces the dequant INSIDE the scan body: the while loop then
+carries the s8 weights and XLA's loop-invariant code motion declines
+to hoist the size-inflating convert back out, so each step streams s8
+and fuses convert+scale into the matmul's operand read.
+tests/test_compiled_cost.py asserts the compiled loop state stays s8;
+the suite's `decode_int8` row measures the resulting throughput.
+
+Usage (one-shot inference — dequant in-jit, hoisting is fine there):
 
     qparams = quantize_params(params)                  # offline
     fn = jax.jit(lambda qp, x: model_apply(
         dequantize_params(qp), x))                     # dequant IN-jit
     fn(qparams, x)
+
+For decode, pass qparams straight to `transformer.generate` (or
+`serve.export_decoder(..., int8_weights=True)`) — it places the
+dequant per-step itself.
 
 For the transformer decode loop the whole pattern is packaged by
 `serve.export_decoder(..., int8_weights=True)`: the exported artifact
@@ -96,6 +105,14 @@ def quantize_params(params, *, match: Optional[str] = DEFAULT_MATCH):
         return leaf
 
     return tree_map_with_name(fn, params)
+
+
+def has_quantized(params) -> bool:
+    """True if any leaf is a QuantizedTensor (the signal
+    transformer.generate uses to place the dequant inside the decode
+    loop body)."""
+    return any(isinstance(l, QuantizedTensor) for l in jax.tree.leaves(
+        params, is_leaf=lambda x: isinstance(x, QuantizedTensor)))
 
 
 def dequantize_params(qparams, dtype=jnp.float32):

@@ -1,0 +1,189 @@
+"""Chip-independent compiled-cost evidence (r4 verdict item #2).
+
+Two rounds of a wedged TPU relay proved the repo needs perf evidence
+that does not require the chip: these tests assert *compiled-program*
+properties — residual-set bytes, while-loop state dtypes, scan-body
+FLOP scaling — on the CPU backend, so every optimization in the
+unmeasured-IOU table has reviewable evidence even when the relay is
+dark. The on-chip campaign (benchmarks/run_r4_measurements.sh) turns
+these same claims into wall-clock numbers when the chip answers;
+benchmarks/results_v5e1.md's "compiled-cost evidence" section records
+the quantities measured here at the real bench shapes.
+
+Three claims:
+
+  (a) ResNet remat shrinks the fwd->bwd residual set (the HBM-resident
+      activations PROFILE_NOTES' 57.6 GiB/step roofline is made of) —
+      measured abstractly via eval_shape of the vjp closure, which is
+      exact at any batch size without materializing anything.
+  (b) The int8 decode loop STREAMS s8 weights: the compiled while
+      state carries s8 tensors (dequant traced inside the body, pinned
+      by a loop-varying optimization_barrier). The negative control —
+      dequant outside generate() — shows XLA hoisting the convert,
+      which is exactly the failure docs/PARITY.md asked about.
+  (c) Sliding-window attention cost scales with the window, not T^2:
+      the backward's scan-body FLOPs are CONSTANT as T doubles (trip
+      count is linear in T => linear total), where the full-attention
+      backward's body FLOPs are linear in T (=> quadratic total).
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import losses
+
+
+def _residual_bytes(model, mstate, params, rng, x_shape):
+    """Bytes of the fwd->bwd residual pytree — jax.vjp's returned
+    closure IS a pytree of the saved tensors, and eval_shape walks it
+    abstractly, so this is exact at any batch size at zero cost."""
+    x = jax.ShapeDtypeStruct(x_shape, jnp.float32)
+    y = jax.ShapeDtypeStruct((x_shape[0],), jnp.int32)
+
+    def loss_fn(p, x, y):
+        logits, _ = model.apply(p, mstate, x, training=True, rng=rng)
+        return jnp.mean(losses.softmax_cross_entropy(logits, y))
+
+    vjp_shape = jax.eval_shape(
+        lambda p, x, y: jax.vjp(loss_fn, p, x, y)[1], params, x, y)
+    return sum(l.size * jnp.dtype(l.dtype).itemsize
+               for l in jax.tree.leaves(vjp_shape))
+
+
+class TestRematResiduals:
+    def test_remat_shrinks_residual_set(self):
+        """Measured AT the headline bench config (bs 256, 224px —
+        eval_shape makes the big shape free): 42.16 GiB of residuals
+        baseline -> 18.69 (conv_out, -56%) -> 8.86 (full, -79%). Small
+        batches would dilute the ratio with the batch-independent
+        parameter residuals, so the assertion runs at the real shape."""
+        from paddle_tpu import models
+        from paddle_tpu.nn.module import ShapeSpec
+
+        rng = jax.random.key(0)
+        sizes = {}
+        for remat in (None, "conv_out", "full"):
+            model = models.resnet.resnet(50, num_classes=1000,
+                                         remat=remat)
+            params, mstate = model.init(rng, ShapeSpec((2, 224, 224, 3)))
+            sizes[remat] = _residual_bytes(model, mstate, params, rng,
+                                           (256, 224, 224, 3))
+        assert sizes[None] > 40 * 2**30, sizes   # the roofline's scale
+        assert sizes["conv_out"] < 0.5 * sizes[None], sizes
+        assert sizes["full"] < 0.25 * sizes[None], sizes
+
+    def test_remat_survives_lowering(self):
+        """The recompute must reach XLA: jax.checkpoint lowers its saved
+        residuals through optimization_barrier ops, so their presence in
+        the StableHLO is the signature that the remat was not traced
+        away before the compiler ever saw it."""
+        from paddle_tpu import models
+        from paddle_tpu.nn.module import ShapeSpec
+
+        rng = jax.random.key(0)
+
+        def lowered_text(remat):
+            model = models.resnet.resnet(18, num_classes=10, remat=remat)
+            params, mstate = model.init(rng, ShapeSpec((2, 64, 64, 3)))
+
+            def loss_fn(p, x, y):
+                logits, _ = model.apply(p, mstate, x, training=True,
+                                        rng=rng)
+                return jnp.mean(losses.softmax_cross_entropy(logits, y))
+
+            x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+            y = jnp.zeros((2,), jnp.int32)
+            return jax.jit(jax.grad(loss_fn)).lower(params, x, y).as_text()
+
+        assert "optimization_barrier" not in lowered_text(None)
+        assert lowered_text("full").count("optimization_barrier") >= 8
+
+
+def _while_lines(compiled_text):
+    return [l for l in compiled_text.splitlines() if " while(" in l]
+
+
+class TestInt8DecodeLoop:
+    @pytest.fixture(scope="class")
+    def setup(self):
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.serve import quant
+
+        cfg = T.TransformerConfig(vocab=128, dim=64, n_layers=2,
+                                  n_heads=2, attn_impl="dense")
+        params = T.init_params(jax.random.key(0), cfg)
+        qp = quant.quantize_params(params)
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(0, 128, (1, 8)), jnp.int32)
+        return T, quant, cfg, params, qp, prompt
+
+    def test_decode_loop_carries_s8(self, setup):
+        """The PARITY.md hoisting question, answered in the affirmative
+        direction: pass qparams to generate() and the compiled decode
+        while-loop's carried state includes the s8 weights — each step
+        streams 1/4 the weight bytes of a hoisted-f32 loop."""
+        T, quant, cfg, params, qp, prompt = setup
+        txt = jax.jit(
+            lambda qp, p: T.generate(qp, cfg, p, steps=4)
+        ).lower(qp, prompt).compile().as_text()
+        wl = _while_lines(txt)
+        assert wl, "decode did not compile to a while loop"
+        assert any("s8[" in l for l in wl), (
+            "int8 decode loop state carries no s8 tensors — the dequant "
+            "was hoisted and the loop streams full-precision weights")
+
+    def test_hoisted_control_has_no_s8_loop(self, setup):
+        """Negative control: dequantizing OUTSIDE generate() leaves the
+        f32 weights as loop invariants (this was the only int8 path
+        before r5) — documents why the in-loop placement matters."""
+        T, quant, cfg, params, qp, prompt = setup
+        txt = jax.jit(
+            lambda qp, p: T.generate(quant.dequantize_params(qp), cfg, p,
+                                     steps=4)
+        ).lower(qp, prompt).compile().as_text()
+        wl = _while_lines(txt)
+        assert wl and not any("s8[" in l for l in wl)
+
+    def test_streaming_matches_hoisted_tokens(self, setup):
+        """Placement must not change math: in-loop dequant decodes the
+        exact same tokens as the hoisted path."""
+        T, quant, cfg, params, qp, prompt = setup
+        a = T.generate(qp, cfg, prompt, steps=6)
+        b = T.generate(quant.dequantize_params(qp), cfg, prompt, steps=6)
+        assert jnp.array_equal(a, b)
+
+
+class TestSWAFlopScaling:
+    @staticmethod
+    def _bwd_body_flops(T, window):
+        """XLA cost analysis counts a scan's body ONCE (trip count is
+        not multiplied in), so body-FLOPs-vs-T is the scaling law of
+        the per-block work: constant body => linear total, linear body
+        => quadratic total."""
+        from paddle_tpu.ops.flash_attention import flash_attention
+
+        rng = np.random.RandomState(0)
+        q, k, v = (jnp.asarray(rng.randn(1, T, 2, 32), jnp.float32)
+                   for _ in range(3))
+        f = jax.jit(jax.grad(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=True, window=window,
+                block_q=128, block_k=128).sum(),
+            argnums=(0, 1, 2)))
+        return float(
+            f.lower(q, k, v).compile().cost_analysis()["flops"])
+
+    def test_swa_backward_linear_in_t(self):
+        """Measured: full backward body 3.45e8 -> 6.87e8 FLOPs as T
+        doubles 4096 -> 8192 (ratio 1.99: quadratic total); windowed
+        (w=256) body 3.53e7 -> 3.61e7 (ratio 1.02: linear total, and
+        ~19x less per-block work at T=8192)."""
+        full = [self._bwd_body_flops(t, None) for t in (4096, 8192)]
+        sw = [self._bwd_body_flops(t, 256) for t in (4096, 8192)]
+        assert full[1] / full[0] > 1.7, full     # body linear in T
+        assert sw[1] / sw[0] < 1.2, sw           # body constant in T
+        assert sw[1] < full[1] / 4, (sw, full)   # and much cheaper
