@@ -487,7 +487,8 @@ def bench_moe_lm(seq_len: int = 2048, *, batch: int = 8, dim: int = 512,
 def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
                  dim: int = 512, n_layers: int = 8, n_heads: int = 8,
                  vocab: int = 32000, iters: int = 5,
-                 modes=("greedy", "sample", "beam", "gqa", "int8")):
+                 modes=("greedy", "sample", "beam", "gqa", "int8",
+                        "spec")):
     """KV-cache decode throughput (new tokens/sec) per decode mode —
     the serving latency analog of the reference's C-API forward path
     (reference: capi/gradient_machine.h; the SequenceGenerator is the
@@ -565,6 +566,32 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
         dt = timed("int8", gen_q, qp, prompt)
         print(json.dumps({
             "bench": "decode_int8", **base,
+            "new_tokens_per_sec": round(batch * steps / dt, 1)}),
+            flush=True)
+
+    if "spec" in modes:
+        # batched speculative decoding (r5), two bracketing rows:
+        # perfect draft (== target) is the amortization CEILING — every
+        # round verifies K+1 tokens in one target forward; a small
+        # random draft is the overhead FLOOR (near-zero acceptance)
+        k = 4
+        spec_p = jax.jit(lambda p, toks: T.speculative_generate(
+            p, cfg, p, cfg, toks, steps=steps, draft_k=k))
+        dt = timed("spec_perfect", spec_p, params, prompt)
+        print(json.dumps({
+            "bench": "decode_spec_perfect", **base, "draft_k": k,
+            "new_tokens_per_sec": round(batch * steps / dt, 1)}),
+            flush=True)
+        dcfg = T.TransformerConfig(vocab=vocab, dim=max(dim // 4, 16),
+                                   n_layers=2, n_heads=n_heads,
+                                   attn_impl="dense")
+        dparams = T.init_params(jax.random.key(7), dcfg)
+        spec_s = jax.jit(lambda p, dp, toks: T.speculative_generate(
+            p, cfg, dp, dcfg, toks, steps=steps, draft_k=k))
+        dt = timed("spec_small_draft", spec_s, params, dparams, prompt)
+        print(json.dumps({
+            "bench": "decode_spec", **base, "draft_k": k,
+            "draft_dim": max(dim // 4, 16), "draft_layers": 2,
             "new_tokens_per_sec": round(batch * steps / dt, 1)}),
             flush=True)
 

@@ -663,6 +663,8 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
 def speculative_generate(params, cfg: TransformerConfig,
                          draft_params, draft_cfg: TransformerConfig,
                          prompt, steps: int, *, draft_k: int = 4,
+                         eos_id: Optional[int] = None,
+                         pad_id: Optional[int] = None,
                          return_stats: bool = False):
     """Greedy speculative decoding: a small DRAFT model proposes
     `draft_k` tokens autoregressively, the TARGET model scores all of
@@ -675,24 +677,34 @@ def speculative_generate(params, cfg: TransformerConfig,
     accept rule keeps every token the target would have picked), so a
     bad draft costs speed, never quality — tested as a hard equality.
 
-    Batch 1 only (rows would accept different prefix lengths and
-    desynchronize the shared scan); no eos early-stop. Cache slots are
-    indexed by token position, so rejected speculative writes are
-    simply overwritten when the real token reaches that position —
-    no rollback copies.
+    BATCHED (r5; the r4 version was batch-1): rows accept different
+    prefix lengths, so each row carries its OWN position pointer and
+    the whole round body runs under vmap inside one while_loop — rows
+    advance independently, per-row dynamic_slice reads/writes handle
+    the desync, and a finished row simply replays idempotent rounds
+    (same inputs -> same cache writes) with its pointer, output and
+    done flag frozen until every row finishes. Uniform prompt length
+    only (the batched analog of generate's prompt_lens is future work).
 
-    return_stats=True additionally returns the number of rounds — the
-    acceptance-rate observable: a perfect draft finishes `steps` tokens
-    in ceil(steps / (draft_k+1)) rounds, a hopeless one in `steps`.
+    eos_id: a row that emits it stops advancing; its positions after
+    the eos are pad_id (default eos_id), exactly matching generate()'s
+    eos semantics so the hard-equality contract extends to early stop.
+
+    Cache slots are indexed by token position, so rejected speculative
+    writes are simply overwritten when the real token reaches that
+    position — no rollback copies.
+
+    return_stats=True additionally returns the per-row number of
+    rounds [B] — the acceptance-rate observable: a perfect draft
+    finishes `steps` tokens in ceil(steps / (draft_k+1)) rounds, a
+    hopeless one in `steps`.
     """
     b, t0 = prompt.shape
-    if b != 1:
-        raise ValueError(
-            f"speculative_generate is batch-1 only, got batch {b}")
     if t0 < 2:
         raise ValueError("need a >=2-token prompt (prefill t0-1, then "
                          "the last token seeds the first round)")
     policy = default_policy()
+    fill = eos_id if pad_id is None else pad_id
     # pad the buffers so the final round may overshoot by a window
     total = t0 + steps + draft_k + 1
 
@@ -729,14 +741,19 @@ def speculative_generate(params, cfg: TransformerConfig,
     tgt_caches = _prefill_kv(params, cfg, prompt[:, :-1], total)
     dft_caches = _prefill_kv(draft_params, draft_cfg, prompt[:, :-1],
                              total)
-    out_buf = jnp.zeros((1, total), prompt.dtype).at[:, :t0].set(prompt)
+    out_buf = jnp.zeros((b, total), prompt.dtype).at[:, :t0].set(prompt)
     t_end = t0 + steps
+    karange = jnp.arange(draft_k + 1)
 
-    def cond(carry):
-        return carry[0] < t_end
-
-    def body(carry):
-        t, rounds, out_buf, tgt_caches, dft_caches = carry
+    def row_round(t, done, rounds, out_row, tgt_c, dft_c):
+        """One speculative round for ONE row. Runs under vmap: every
+        input arrives without its batch dim (caches [total, Hkv, Dh],
+        out_row [total], t/done/rounds scalars) and is re-wrapped to
+        the batch-1 shapes window_forward expects."""
+        active = (~done) & (t < t_end)
+        out1 = out_row[None]
+        tgt1 = jax.tree.map(lambda a: a[None], tgt_c)
+        dft1 = jax.tree.map(lambda a: a[None], dft_c)
 
         # --- draft proposes draft_k tokens autoregressively ---------
         # round start re-processes positions t-2 AND t-1: after a
@@ -746,47 +763,76 @@ def speculative_generate(params, cfg: TransformerConfig,
         # rate. The 2-token window always covers the (at most 1 slot)
         # gap; overwriting an already-filled slot is a no-op.
         last2 = jax.lax.dynamic_slice(
-            out_buf, (jnp.zeros((), t.dtype), t - 2), (1, 2))
-        logits2, dft_caches = window_forward(
-            draft_params, draft_cfg, dft_caches, last2, t - 2)
-        d0 = jnp.argmax(logits2[:, -1], axis=-1).astype(prompt.dtype)
+            out1, (jnp.zeros((), t.dtype), t - 2), (1, 2))
+        logits2, dft1 = window_forward(
+            draft_params, draft_cfg, dft1, last2, t - 2)
+        d0 = jnp.argmax(logits2[:, -1], axis=-1).astype(out_row.dtype)
 
         def draft_step(c, i):
             dft, tok = c
             logits, dft = window_forward(
                 draft_params, draft_cfg, dft, tok[:, None], t + i)
-            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(out_row.dtype)
             return (dft, nxt), nxt
 
-        (dft_caches, _), more = jax.lax.scan(
-            draft_step, (dft_caches, d0), jnp.arange(draft_k - 1))
+        (dft1, _), more = jax.lax.scan(
+            draft_step, (dft1, d0), jnp.arange(draft_k - 1))
         drafts = jnp.concatenate(
             [d0[None, :], more], axis=0).transpose(1, 0)   # [1, K]
 
         # --- target verifies the window in one forward --------------
-        last = jax.lax.dynamic_slice_in_dim(out_buf, t - 1, 1, axis=1)
+        last = jax.lax.dynamic_slice_in_dim(out1, t - 1, 1, axis=1)
         window = jnp.concatenate([last, drafts], axis=1)   # [1, K+1]
-        logits, tgt_caches = window_forward(
-            params, cfg, tgt_caches, window, t - 1)
-        greedy = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        logits, tgt1 = window_forward(params, cfg, tgt1, window, t - 1)
+        greedy = jnp.argmax(logits, axis=-1).astype(out_row.dtype)
 
         # longest agreeing prefix: drafts[j] == greedy[j] for j < n_acc
         agree = drafts[0] == greedy[0, :draft_k]
         n_acc = jnp.argmin(jnp.concatenate(
             [agree, jnp.zeros((1,), bool)]).astype(jnp.int32))
         # accepted drafts then the target's own token at the break
-        app = jnp.where(jnp.arange(draft_k + 1) < n_acc,
+        app = jnp.where(karange < n_acc,
                         jnp.concatenate([drafts[0], greedy[0, -1:]]),
-                        greedy[0])[None, :]
-        out_buf = jax.lax.dynamic_update_slice(
-            out_buf, app, (jnp.zeros((), t.dtype), t))
-        return ((t + n_acc + 1).astype(t.dtype), rounds + 1, out_buf,
-                tgt_caches, dft_caches)
+                        greedy[0])                         # [K+1]
+        if eos_id is not None:
+            # stop AFTER the first eos among the n_acc+1 appended
+            # tokens; the post-loop fill mask pads everything beyond it
+            hit = (app == eos_id) & (karange <= n_acc)
+            found = jnp.any(hit)
+            adv = jnp.where(found, jnp.argmax(hit) + 1, n_acc + 1)
+        else:
+            found = jnp.zeros((), bool)
+            adv = n_acc + 1
+        new_out = jax.lax.dynamic_update_slice(
+            out1, app[None], (jnp.zeros((), t.dtype), t))[0]
+        # a frozen row replays an IDENTICAL round (same t, same tokens
+        # -> same cache writes: idempotent); only its pointer, output,
+        # done flag and round count must not move
+        t = jnp.where(active, (t + adv).astype(t.dtype), t)
+        done = done | (active & found)
+        rounds = rounds + active.astype(rounds.dtype)
+        out_row = jnp.where(active, new_out, out_row)
+        return (t, done, rounds, out_row,
+                jax.tree.map(lambda a: a[0], tgt1),
+                jax.tree.map(lambda a: a[0], dft1))
 
-    _, rounds, out_buf, _, _ = jax.lax.while_loop(
-        cond, body, (jnp.asarray(t0, jnp.int32),
-                     jnp.zeros((), jnp.int32), out_buf, tgt_caches,
-                     dft_caches))
+    vround = jax.vmap(row_round)
+
+    def cond(carry):
+        t, done = carry[0], carry[1]
+        return jnp.any((~done) & (t < t_end))
+
+    t, done, rounds, out_buf, _, _ = jax.lax.while_loop(
+        cond, lambda c: vround(*c),
+        (jnp.full((b,), t0, jnp.int32), jnp.zeros((b,), bool),
+         jnp.zeros((b,), jnp.int32), out_buf, tgt_caches, dft_caches))
+    if eos_id is not None:
+        # finished rows: everything from their stop point on is fill —
+        # generate()'s post-eos semantics, so the hard-equality test
+        # covers the padding too
+        col = jnp.arange(total)[None, :]
+        out_buf = jnp.where(done[:, None] & (col >= t[:, None]),
+                            jnp.asarray(fill, out_buf.dtype), out_buf)
     if return_stats:
         return out_buf[:, :t_end], rounds
     return out_buf[:, :t_end]
