@@ -2,7 +2,7 @@
 
 from paddle_tpu.nn.module import Layer, Sequential, ShapeSpec, spec_of, merge_state
 from paddle_tpu.nn import initializers
-from paddle_tpu.nn.recurrent import LSTM, GRU, BiLSTM
+from paddle_tpu.nn.recurrent import LSTM, GRU, BiLSTM, MDLSTM
 from paddle_tpu.nn.layers import (
     Dense,
     Conv2D,

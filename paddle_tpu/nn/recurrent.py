@@ -57,6 +57,33 @@ class GRU(Layer):
         return out, {}
 
 
+class MDLSTM(Layer):
+    """2-D multi-dimensional LSTM over a [B, H, W, F] grid — the
+    reference's `mdlstmemory` layer (gserver/layers/MDLstmLayer.cpp),
+    rebuilt as a diagonal-wavefront scan (ops.rnn.md_lstm). reverse_*
+    map the reference's per-dimension `directions` flags (scan from any
+    of the four corners)."""
+
+    def __init__(self, hidden: int, *, reverse_rows: bool = False,
+                 reverse_cols: bool = False, name: Optional[str] = None):
+        self.hidden = hidden
+        self.reverse_rows = reverse_rows
+        self.reverse_cols = reverse_cols
+        self.name = name
+
+    def _init(self, rng, spec: ShapeSpec, _abstract=False):
+        b, h, w, f = spec.shape
+        out = ShapeSpec((b, h, w, self.hidden), spec.dtype)
+        if _abstract:
+            return {}, {}, out
+        return rnn_ops.init_md_lstm_params(rng, f, self.hidden), {}, out
+
+    def _apply(self, params, state, x, *, training: bool, rng):
+        out = rnn_ops.md_lstm(params, x, reverse_rows=self.reverse_rows,
+                              reverse_cols=self.reverse_cols)
+        return out, {}
+
+
 class BiLSTM(Layer):
     """Bidirectional LSTM, concat output [B, T, 2H] (reference:
     networks.py:1230 bidirectional_lstm)."""

@@ -4,11 +4,17 @@ slope_intercept, sum_to_one_norm, switch_order, trans, resize, maxid,
 scale_shift, scale_sub_region, data_norm, row_conv).
 
 Each is a pure function; nn.Mixed / nn.Lambda wrap them where a Layer
-form is wanted. Deliberately out of scope (documented, not stubbed):
-mdlstmemory — a 2-D recurrence scans poorly on TPU and the transformer
-family (models/transformer.py) is the modern replacement for its use
-case; get_output — tapping intermediate activations falls out of the
-functional API for free.
+form is wanted.
+
+mdlstmemory landed in r5 as nn.MDLSTM / ops.rnn.md_lstm (a diagonal-
+wavefront scan — the 2-D recurrence restructured so a whole
+anti-diagonal updates per step). get_output remains a non-feature BY
+DESIGN, with this mapping for migrating configs: the reference needed
+a layer to tap a multi-output layer's non-default output because its
+graph was name-wired; here every ops-level function already RETURNS
+all its outputs (ops.rnn.lstm returns (outputs, final state);
+beam_search returns (tokens, scores, state)) — call the function and
+index the tuple.
 """
 
 from __future__ import annotations

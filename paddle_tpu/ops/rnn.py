@@ -327,6 +327,122 @@ def init_gru_params(rng, in_features: int, hidden: int, dtype=jnp.float32):
     }
 
 
+def init_md_lstm_params(rng, in_features: int, hidden: int,
+                        dtype=jnp.float32):
+    """2-D MDLSTM parameters: 5 gate chunks (g, i, f_row, f_col, o) —
+    the reference's inode/ig/fg×D/og packing at D=2 dimensions
+    (reference: gserver/layers/MDLstmLayer.cpp:178 'IG Layer: (Input,
+    InputGate, ForgetGates, OutputGate)', init :221-236). One recurrent
+    matrix per grid dimension; both forget-gate biases start at 1.0
+    (same trainability trick as init_lstm_params)."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale = 1.0 / jnp.sqrt(in_features)
+    hscale = 1.0 / jnp.sqrt(hidden)
+    b = jnp.zeros((5 * hidden,), dtype)
+    b = b.at[2 * hidden:4 * hidden].set(1.0)
+    return {
+        "w_ih": jax.random.uniform(k1, (in_features, 5 * hidden), dtype,
+                                   -scale, scale),
+        "w_row": jax.random.uniform(k2, (hidden, 5 * hidden), dtype,
+                                    -hscale, hscale),
+        "w_col": jax.random.uniform(k3, (hidden, 5 * hidden), dtype,
+                                    -hscale, hscale),
+        "b": b,
+    }
+
+
+def md_lstm_cell(z, c_up, c_left):
+    """One MDLSTM cell from summed pre-activations z [..., 5H]:
+
+        c = σ(i)·tanh(g) + σ(f_row)·c_up + σ(f_col)·c_left
+        h = σ(o)·tanh(c)
+
+    — the reference cell with one forget gate PER DIMENSION
+    (reference: gserver/layers/MDLstmLayer.cpp:160-177; its optional
+    peephole 'check' connections are omitted — the capability is the
+    2-D recurrence, and peepholes have long been dropped from practice).
+    """
+    hdim = c_up.shape[-1]
+    g, i, f_r, f_c, o = (z[..., k * hdim:(k + 1) * hdim]
+                         for k in range(5))
+    c = (jax.nn.sigmoid(i) * jnp.tanh(g)
+         + jax.nn.sigmoid(f_r) * c_up
+         + jax.nn.sigmoid(f_c) * c_left)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return h, c
+
+
+def md_lstm(params, x, *, reverse_rows: bool = False,
+            reverse_cols: bool = False):
+    """2-D multi-dimensional LSTM over a grid: cell (i, j) recurs on its
+    row-neighbor (i-1, j) and column-neighbor (i, j-1), with zero
+    states beyond the boundary (reference:
+    gserver/layers/MDLstmLayer.cpp 'mdlstmemory' at numDims=2 — there a
+    per-sample CoordIterator walks cells ONE AT A TIME; reverse_* maps
+    its per-dimension `directions`).
+
+    TPU-first restructuring: cells on an anti-diagonal are independent,
+    so the scan runs over the H+W-1 diagonals — every cell of a
+    diagonal updates in ONE [B·H, H]x[H, 5H] matmul pair (wavefront
+    parallelism) instead of H·W serial cell updates, and the input
+    projection is hoisted out of the scan entirely (one
+    [B·H·W, F]x[F, 5H] MXU call, the same trick the 1-D runners use).
+    Grid-skewing turns the diagonals into a static-shape scan: buffer
+    slot i of diagonal d holds cell (i, d-i), so the row neighbor is
+    slot i-1 and the column neighbor slot i of the PREVIOUS diagonal.
+
+    x: [B, H, W, F] -> h: [B, H, W, hidden].
+    """
+    if reverse_rows:
+        x = x[:, ::-1]
+    if reverse_cols:
+        x = x[:, :, ::-1]
+    b, h, w, f = x.shape
+    hdim = params["w_row"].shape[0]
+    dt = _carry_dtype()
+    xp = (linalg.matmul(x, params["w_ih"]) + params["b"]).astype(dt)
+    nd = h + w - 1
+
+    rows = jnp.arange(h)[:, None]
+    cols = jnp.arange(nd)[None, :] - rows              # [H, ND] j = d - i
+    on_grid = (cols >= 0) & (cols < w)
+    # skewed[:, i, d, :] = xp[:, i, d - i, :] (zero off-grid)
+    skewed = jnp.take_along_axis(
+        xp, jnp.clip(cols, 0, w - 1)[None, :, :, None], axis=2)
+    skewed = jnp.where(on_grid[None, :, :, None], skewed, 0.0)
+
+    def diag_step(carry, inp):
+        h_prev, c_prev = carry                        # diagonal d-1
+        x_d, vd = inp                                 # [B, H, 5H], [H]
+        # row neighbor (i-1, j): slot i-1; col neighbor (i, j-1): slot i
+        h_up = jnp.pad(h_prev, ((0, 0), (1, 0), (0, 0)))[:, :h]
+        c_up = jnp.pad(c_prev, ((0, 0), (1, 0), (0, 0)))[:, :h]
+        z = (x_d + linalg.matmul(h_up, params["w_row"])
+             + linalg.matmul(h_prev, params["w_col"]))
+        h_new, c_new = md_lstm_cell(z, c_up, c_prev)
+        # off-grid slots must carry ZERO (they are the boundary states
+        # of the next diagonal's edge cells)
+        m = vd[None, :, None]
+        h_new = jnp.where(m, h_new, 0.0)
+        c_new = jnp.where(m, c_new, 0.0)
+        return (h_new, c_new), h_new
+
+    zeros = jnp.zeros((b, h, hdim), dt)
+    _, ys = jax.lax.scan(
+        diag_step, (zeros, zeros),
+        (skewed.transpose(2, 0, 1, 3), on_grid.T))    # [ND, B, H, 5H]
+
+    # unskew: out[:, i, j] = ys[i + j, :, i]
+    diag_of = rows + jnp.arange(w)[None, :]            # [H, W]
+    out = jnp.take_along_axis(
+        ys.transpose(1, 2, 0, 3), diag_of[None, :, :, None], axis=2)
+    if reverse_cols:
+        out = out[:, :, ::-1]
+    if reverse_rows:
+        out = out[:, ::-1]
+    return out
+
+
 def init_rnn_params(rng, in_features: int, hidden: int, dtype=jnp.float32):
     k1, k2 = jax.random.split(rng)
     scale = 1.0 / jnp.sqrt(in_features)
