@@ -488,7 +488,7 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
                  dim: int = 512, n_layers: int = 8, n_heads: int = 8,
                  vocab: int = 32000, iters: int = 5,
                  modes=("greedy", "sample", "beam", "gqa", "int8",
-                        "spec")):
+                        "spec", "swa")):
     """KV-cache decode throughput (new tokens/sec) per decode mode —
     the serving latency analog of the reference's C-API forward path
     (reference: capi/gradient_machine.h; the SequenceGenerator is the
@@ -567,6 +567,32 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
         print(json.dumps({
             "bench": "decode_int8", **base,
             "new_tokens_per_sec": round(batch * steps / dt, 1)}),
+            flush=True)
+
+    if "swa" in modes:
+        # rolling-cache sliding-window decode (r5) at a LONG horizon,
+        # paired with full attention at the SAME horizon: the ring
+        # buffer makes per-step cache reads O(window) instead of
+        # O(t0+steps), so the gap between these two rows is the
+        # measurable win (and the memory gap is window/total)
+        import dataclasses as _dc
+
+        long_steps = steps * 8
+        gen_full = jax.jit(lambda p, toks: T.generate(
+            p, cfg, toks, steps=long_steps))
+        dt = timed("long_full", gen_full, params, prompt)
+        print(json.dumps({
+            "bench": "decode_long", **base, "steps": long_steps,
+            "new_tokens_per_sec": round(batch * long_steps / dt, 1)}),
+            flush=True)
+        wcfg = _dc.replace(cfg, attn_window=max(steps, 16))
+        gen_w = jax.jit(lambda p, toks: T.generate(
+            p, wcfg, toks, steps=long_steps))
+        dt = timed("long_swa", gen_w, params, prompt)
+        print(json.dumps({
+            "bench": "decode_swa_long", **base, "steps": long_steps,
+            "window": max(steps, 16),
+            "new_tokens_per_sec": round(batch * long_steps / dt, 1)}),
             flush=True)
 
     if "spec" in modes:

@@ -81,9 +81,9 @@ class TransformerConfig:
     # sliding-window (local) attention: each position attends the last
     # `attn_window` positions only (None = full causal). The flash path
     # skips out-of-band blocks in BOTH directions (O(T*window) training
-    # and prefill); decode masks cache slots outside the band (the
-    # cache buffer itself stays full-length — a rolling buffer is a
-    # future optimization).
+    # and prefill); generate() decodes over a ROLLING `window`-slot
+    # cache (O(window) memory and per-step HBM reads, r5); beam and
+    # speculative decode keep full-length band-masked buffers.
     attn_window: Optional[int] = None
     remat: bool = False
     # sparsely-activated FFN (GLaM-style): every `moe_every`-th block
@@ -544,6 +544,18 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
         rng = jax.random.key(0)
     fill = eos_id if pad_id is None else pad_id
     total = t0 + steps
+    # sliding-window decode uses a ROLLING cache (r5): `window` slots,
+    # written at t mod window — the full-length band-masked buffer
+    # would still STREAM O(total) cache bytes per step (the einsum
+    # reads the whole buffer; masking happens after), so the ring
+    # buffer is what converts SWA's O(window) math into O(window) HBM
+    # reads and memory. Slot s at step t holds absolute position
+    # p = t - ((t - s) mod window); attention order over cache slots is
+    # irrelevant (softmax is permutation-invariant over keys) and rope
+    # is applied to K before caching, so rotation survives the ring.
+    window = cfg.attn_window
+    rolling = window is not None and window < total
+    cache_len = window if rolling else total
     policy = default_policy()
     # weight-only int8 streaming (serve.quant): params with
     # QuantizedTensor leaves dequantize ONCE for the prefill (one-shot,
@@ -593,10 +605,20 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
         # claim expert capacity either
         x, k, v, _ = _block_parts(cfg, p, x, pos, prefill_attn, key_ok)
         # buffers take k/v's own head count: compact Hkv under GQA
-        k_buf = jnp.zeros((b, total) + k.shape[2:], k.dtype) \
-            .at[:, :t0].set(k)
-        v_buf = jnp.zeros((b, total) + v.shape[2:], v.dtype) \
-            .at[:, :t0].set(v)
+        if rolling:
+            # keep only the last `window` prompt positions, each in its
+            # ring slot p mod window (a permutation for consecutive p)
+            lo = max(0, t0 - cache_len)
+            slots_init = jnp.arange(lo, t0) % cache_len
+            k_buf = jnp.zeros((b, cache_len) + k.shape[2:], k.dtype) \
+                .at[:, slots_init].set(k[:, lo:t0])
+            v_buf = jnp.zeros((b, cache_len) + v.shape[2:], v.dtype) \
+                .at[:, slots_init].set(v[:, lo:t0])
+        else:
+            k_buf = jnp.zeros((b, total) + k.shape[2:], k.dtype) \
+                .at[:, :t0].set(k)
+            v_buf = jnp.zeros((b, total) + v.shape[2:], v.dtype) \
+                .at[:, :t0].set(v)
         caches.append((k_buf, v_buf))
     # only the last REAL position's logits matter
     rng, first_rng = jax.random.split(rng)
@@ -623,8 +645,17 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
         else:
             pos = (prompt_lens.astype(jnp.int32) + s)[:, None]
         ar = jnp.arange(total)
+        slot = t
         if prompt_lens is None:
-            if cfg.attn_window is not None:
+            if rolling:
+                # ring slot s holds absolute position t-((t-s) mod W);
+                # the band (p > t-window) holds by construction, so
+                # validity is just "the position exists"
+                arw = jnp.arange(cache_len)
+                pos_held = t - jnp.mod(t - arw, cache_len)
+                valid = (pos_held >= 0)[None, None, None, :]
+                slot = jnp.mod(t, cache_len)
+            elif cfg.attn_window is not None:
                 valid = _band_valid(ar, t, cfg.attn_window)[
                     None, None, None, :]
             else:
@@ -640,7 +671,7 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
             def cached_attn(q, k, v, k_buf=k_buf, v_buf=v_buf):
                 # the update is captured via new_caches (traced normally)
                 out, k_buf, v_buf = _cached_attention(
-                    q, k, v, k_buf, v_buf, t, valid)
+                    q, k, v, k_buf, v_buf, slot, valid)
                 new_caches.append((k_buf, v_buf))
                 return out
 

@@ -157,6 +157,50 @@ class TestInt8DecodeLoop:
         assert jnp.array_equal(a, b)
 
 
+class TestRollingSWACache:
+    def test_decode_loop_state_is_window_sized(self):
+        """Sliding-window decode must CARRY a window-slot ring cache,
+        not a full-length masked buffer — the full buffer would stream
+        O(total) cache bytes every step (the einsum reads the whole
+        buffer; masking happens after). total=80 and window=8 are
+        chosen to be unambiguous in the HLO shape strings."""
+        from paddle_tpu.models import transformer as T
+
+        cfg = T.TransformerConfig(vocab=48, dim=16, n_layers=2,
+                                  n_heads=2, attn_impl="dense",
+                                  attn_window=8)
+        params = T.init_params(jax.random.key(0), cfg)
+        prompt = jnp.zeros((1, 16), jnp.int32)  # + 64 steps = total 80
+        txt = jax.jit(
+            lambda p, toks: T.generate(p, cfg, toks, steps=64)
+        ).lower(params, prompt).compile().as_text()
+        wl = _while_lines(txt)
+        assert wl, "decode did not compile to a while loop"
+        assert any("[1,8," in l for l in wl), (
+            "no window-sized (8-slot) cache in the decode loop state")
+        assert not any("[1,80," in l for l in wl), (
+            "decode loop still carries a full-length (80-slot) buffer")
+
+    def test_rolling_matches_full_buffer_band_mask(self):
+        """The ring layout must not change math: same tokens as the
+        band-masked full buffer, which still serves beam_decode (its
+        greedy-equality is tested in test_transformer, but assert the
+        cross-impl equality here where the ring is the subject)."""
+        from paddle_tpu.models import transformer as T
+
+        cfg = T.TransformerConfig(vocab=32, dim=16, n_layers=2,
+                                  n_heads=2, mlp_ratio=2,
+                                  attn_impl="dense", attn_window=4)
+        params = T.init_params(jax.random.key(1), cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(1).randint(1, 32, (2, 6)), jnp.int32)
+        rolled = T.generate(params, cfg, prompt, steps=7)   # ring path
+        seqs, _ = T.beam_decode(params, cfg, prompt, steps=7,
+                                beam_size=1)                # full buffer
+        np.testing.assert_array_equal(np.asarray(seqs[:, 0]),
+                                      np.asarray(rolled))
+
+
 class TestSWAFlopScaling:
     @staticmethod
     def _bwd_body_flops(T, window):
