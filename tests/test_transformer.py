@@ -721,6 +721,39 @@ class TestDecodeFeatureMatrix:
             np.random.RandomState(moe).randint(1, 32, (2, 5)), jnp.int32)
         assert_decode_matches_teacher_forcing(params, cfg, prompt, 4)
 
+    @pytest.mark.parametrize("kv,moe,window,int8", [
+        (2, 0, 3, False), (1, 4, 4, False), (2, 0, None, True),
+        (1, 0, 3, True), (4, 4, 4, True),
+    ])
+    def test_decode_matrix_window_int8(self, kv, moe, window, int8):
+        """GQA x MoE x sliding-window x int8: window < t0+steps forces
+        the r5 ROLLING ring cache, and int8 forces the in-loop dequant
+        — the teacher-forced reference runs on the SAME dequantized
+        values, so exact equality must survive both."""
+        cfg = T.TransformerConfig(
+            vocab=32, dim=16, n_layers=2, n_heads=4, n_kv_heads=kv,
+            mlp_ratio=2, attn_impl="dense", moe_experts=moe,
+            moe_capacity_factor=8.0, attn_window=window)
+        params = T.init_params(jax.random.key(kv + moe + 17), cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(kv + moe).randint(1, 32, (2, 6)),
+            jnp.int32)
+        if not int8:
+            assert_decode_matches_teacher_forcing(params, cfg, prompt, 5)
+            return
+        from paddle_tpu.serve import quant
+
+        qp = quant.quantize_params(params)
+        out = np.asarray(T.generate(qp, cfg, prompt, steps=5))
+        logits = np.asarray(T.apply(quant.dequantize_params(qp), cfg,
+                                    jnp.asarray(out)))
+        t0 = prompt.shape[1]
+        for s in range(5):
+            col = t0 + s
+            np.testing.assert_array_equal(
+                out[:, col], logits[:, col - 1].argmax(-1),
+                err_msg=f"step {s} (kv={kv} moe={moe} window={window})")
+
 
 class TestSlidingWindowAttention:
     def _cfg(self, window=None):
