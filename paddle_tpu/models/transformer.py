@@ -438,6 +438,29 @@ def make_context_parallel_loss(cfg: TransformerConfig, mesh, *,
     return loss_fn
 
 
+def _int8_step_params(params):
+    """Weight-only int8 streaming hook shared by every decode path:
+    returns (full_params, step_params) where full_params is the
+    dequantized tree for one-shot prefills and step_params(vary)
+    re-traces the dequant INSIDE a loop body. `vary` must be a
+    loop-VARYING array (the current token(s)): the optimization_barrier
+    keyed on it makes the dequant non-invariant, so XLA's while-loop
+    LICM cannot hoist the size-inflating convert back out and the loop
+    streams the s8 weights (1/4 the bytes — the decode bottleneck).
+    Identity (zero-cost) for unquantized params."""
+    from paddle_tpu.serve import quant as _quant
+
+    if _quant.has_quantized(params):
+        qp = params
+
+        def step_params(vary):
+            return _quant.dequantize_params(
+                jax.lax.optimization_barrier((qp, vary))[0])
+
+        return _quant.dequantize_params(qp), step_params
+    return params, lambda vary: params
+
+
 def _head(params, x_last):
     """Final LN + LM head over the last dim: [..., D] -> [..., V]
     (used on [B, D] last-position activations and [B, W, D] windows —
@@ -557,30 +580,14 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
     rolling = window is not None and window < total
     cache_len = window if rolling else total
     policy = default_policy()
-    # weight-only int8 streaming (serve.quant): params with
-    # QuantizedTensor leaves dequantize ONCE for the prefill (one-shot,
-    # compute-bound) but PER STEP inside the scan body below — the
-    # decode loop then streams the s8 weights from HBM each step (1/4
-    # the bytes of hoisted f32 copies — the decode bottleneck), with
-    # the convert+scale fusing into each matmul's operand read. The
-    # optimization_barrier pins the dequant in the body: WITHOUT it,
-    # XLA's loop-invariant code motion hoists the convert and the loop
-    # carries f32 (observed on the CPU pipeline — the exact failure
-    # docs/PARITY.md:20 asked about). tests/test_compiled_cost.py
-    # asserts the compiled loop body keeps its s8 reads.
-    from paddle_tpu.serve import quant as _quant
-    if _quant.has_quantized(params):
-        qparams = params
-        params = _quant.dequantize_params(qparams)
-
-        def step_params(tok):
-            # the barrier is keyed on the loop-VARYING token: its
-            # outputs are then not loop-invariant, so LICM cannot hoist
-            # the dequant no matter how aggressive the pipeline
-            return _quant.dequantize_params(
-                jax.lax.optimization_barrier((qparams, tok))[0])
-    else:
-        step_params = lambda tok: params
+    # weight-only int8 streaming: prefill uses the hoisted dequant
+    # (one-shot, compute-bound); the scan body below re-dequantizes per
+    # step so the decode loop streams s8 — see _int8_step_params, and
+    # tests/test_compiled_cost.py for the compiled-loop-carries-s8
+    # assertion (without the in-body barrier, XLA's LICM hoists the
+    # convert and the loop streams f32 — the failure docs/PARITY.md:20
+    # asked about, observed on the CPU pipeline)
+    params, step_params = _int8_step_params(params)
     head = lambda x_last: _head(params, x_last)
 
     # prefill: the same _block_parts body as apply() (cfg.attn_impl
@@ -736,6 +743,10 @@ def speculative_generate(params, cfg: TransformerConfig,
                          "the last token seeds the first round)")
     policy = default_policy()
     fill = eos_id if pad_id is None else pad_id
+    # int8 params stream s8 inside the round loop (the target model is
+    # the bandwidth-heavy one; a quantized draft gets the same hook)
+    params, tgt_step_params = _int8_step_params(params)
+    draft_params, dft_step_params = _int8_step_params(draft_params)
     # pad the buffers so the final round may overshoot by a window
     total = t0 + steps + draft_k + 1
 
@@ -796,13 +807,13 @@ def speculative_generate(params, cfg: TransformerConfig,
         last2 = jax.lax.dynamic_slice(
             out1, (jnp.zeros((), t.dtype), t - 2), (1, 2))
         logits2, dft1 = window_forward(
-            draft_params, draft_cfg, dft1, last2, t - 2)
+            dft_step_params(last2), draft_cfg, dft1, last2, t - 2)
         d0 = jnp.argmax(logits2[:, -1], axis=-1).astype(out_row.dtype)
 
         def draft_step(c, i):
             dft, tok = c
             logits, dft = window_forward(
-                draft_params, draft_cfg, dft, tok[:, None], t + i)
+                dft_step_params(tok), draft_cfg, dft, tok[:, None], t + i)
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(out_row.dtype)
             return (dft, nxt), nxt
 
@@ -814,7 +825,8 @@ def speculative_generate(params, cfg: TransformerConfig,
         # --- target verifies the window in one forward --------------
         last = jax.lax.dynamic_slice_in_dim(out1, t - 1, 1, axis=1)
         window = jnp.concatenate([last, drafts], axis=1)   # [1, K+1]
-        logits, tgt1 = window_forward(params, cfg, tgt1, window, t - 1)
+        logits, tgt1 = window_forward(tgt_step_params(window), cfg, tgt1,
+                                      window, t - 1)
         greedy = jnp.argmax(logits, axis=-1).astype(out_row.dtype)
 
         # longest agreeing prefix: drafts[j] == greedy[j] for j < n_acc
@@ -888,7 +900,9 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
     b, t0 = prompt.shape
     total = t0 + steps
     policy = default_policy()
-    head = lambda x_last: _head(params, x_last)
+    # int8 params stream s8 inside the beam-step loop (same hook as
+    # generate/speculative_generate)
+    params, step_params = _int8_step_params(params)
 
     # prefill all but the last prompt token; the engine feeds that last
     # token as each row's first input (bos_tokens). A 1-token prompt
@@ -921,8 +935,9 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
     caches["t"] = jnp.full((b,), t0 - 1, jnp.int32)
 
     def step_fn(toks, dec):
+        p_full = step_params(toks)   # int8: dequant inside the loop
         t = dec["t"][0]  # slot for THIS input token (uniform)
-        x = jnp.take(params["embed"]["table"], toks[:, None], axis=0)
+        x = jnp.take(p_full["embed"]["table"], toks[:, None], axis=0)
         x = x.astype(policy.compute_dtype)
         pos = jnp.broadcast_to(t[None, None], (toks.shape[0], 1))
         new_dec = {"t": dec["t"] + 1}
@@ -931,7 +946,7 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
                                 cfg.attn_window)[None, None, None, :]
         else:
             valid = (jnp.arange(total) <= t)[None, None, None, :]
-        for i in range(len(params["blocks"])):
+        for i in range(len(p_full["blocks"])):
             k_buf, v_buf = dec[f"k{i}"], dec[f"v{i}"]
 
             def cached_attn(q, k, v, k_buf=k_buf, v_buf=v_buf, li=i):
@@ -941,9 +956,9 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
                 new_dec[f"v{li}"] = v_buf
                 return out
 
-            x, _, _, _ = _block_parts(cfg, params["blocks"][i], x, pos,
+            x, _, _, _ = _block_parts(cfg, p_full["blocks"][i], x, pos,
                                       cached_attn)
-        return head(x[:, -1]), new_dec
+        return _head(p_full, x[:, -1]), new_dec
 
     toks, scores, _ = bs.beam_search(
         caches, step_fn, batch_size=b, beam_size=beam_size,

@@ -441,6 +441,21 @@ class TestBeamDecode:
                                      beam_size=1)
         np.testing.assert_array_equal(np.asarray(seqs[:, 0]), greedy)
 
+    def test_beam1_int8_equals_greedy_int8(self):
+        """Quantized params stream s8 through the beam loop (r5 shared
+        _int8_step_params hook); scoring must match int8 greedy
+        exactly (both decode on the same dequantized values)."""
+        from paddle_tpu.serve import quant
+
+        params = T.init_params(jax.random.key(5), self.CFG)
+        qp = quant.quantize_params(params)
+        prompt = jnp.asarray(
+            np.random.RandomState(5).randint(1, 32, (2, 6)), jnp.int32)
+        greedy = np.asarray(T.generate(qp, self.CFG, prompt, steps=5))
+        seqs, _ = T.beam_decode(qp, self.CFG, prompt, steps=5,
+                                beam_size=1)
+        np.testing.assert_array_equal(np.asarray(seqs[:, 0]), greedy)
+
     def test_wider_beam_never_scores_worse(self):
         """The best beam's total log-prob must be >= the greedy
         sequence's (verified with score())."""
@@ -627,6 +642,22 @@ class TestSpeculativeDecode:
         with pytest.raises(ValueError, match="prompt"):
             T.speculative_generate(target, self.CFG, draft, draft_cfg,
                                    jnp.zeros((1, 1), jnp.int32), steps=3)
+
+    def test_int8_target_matches_int8_greedy(self):
+        """A quantized TARGET must still decode exactly its own int8
+        greedy output (s8 streamed through the round loop via the
+        shared _int8_step_params hook); the f32 draft only affects
+        speed."""
+        from paddle_tpu.serve import quant
+
+        target, draft, draft_cfg = self._models()
+        qp = quant.quantize_params(target)
+        prompt = jnp.asarray(
+            np.random.RandomState(6).randint(1, 32, (2, 6)), jnp.int32)
+        want = np.asarray(T.generate(qp, self.CFG, prompt, steps=7))
+        got = np.asarray(T.speculative_generate(
+            qp, self.CFG, draft, draft_cfg, prompt, steps=7, draft_k=3))
+        np.testing.assert_array_equal(got, want)
 
     def test_batched_matches_per_row_greedy(self):
         """Rows accept different prefix lengths (different prompts vs
