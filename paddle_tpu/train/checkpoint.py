@@ -28,13 +28,15 @@ import numpy as np
 from paddle_tpu.train.state import TrainState
 
 
-def _manager(directory: str, max_to_keep: Optional[int]):
+def _manager(directory: str, max_to_keep: Optional[int],
+             async_save: bool):
     import orbax.checkpoint as ocp
 
     return ocp.CheckpointManager(
         os.path.abspath(directory),
         options=ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep, create=True, enable_async_checkpointing=False
+            max_to_keep=max_to_keep, create=True,
+            enable_async_checkpointing=async_save
         ),
     )
 
@@ -43,23 +45,39 @@ class CheckpointManager:
     """Periodic, retention-managed train-state checkpoints (reference:
     saving_period_by_batches + save_dir in trainer/Trainer.cpp:60-89).
 
-    save() is synchronous and atomic; restore() re-shards onto whatever
-    mesh the state template is laid out for (preemption-aware resume).
+    save() is atomic; restore() re-shards onto whatever mesh the state
+    template is laid out for (preemption-aware resume).
+
+    async_save=True (r5) makes save() return as soon as the device
+    buffers are snapshotted to host — the serialization and filesystem
+    write run on orbax's background thread while training continues,
+    so on-chip time stalls only for the device->host copy, not the
+    write. wait() blocks until every pending save is durable;
+    restore()/latest_step()/close() wait automatically so an async
+    manager can never hand back a half-written step.
     """
 
-    def __init__(self, directory: str, *, max_to_keep: int = 3):
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 async_save: bool = False):
         self.directory = os.path.abspath(directory)
-        self._mgr = _manager(directory, max_to_keep)
+        self.async_save = async_save
+        self._mgr = _manager(directory, max_to_keep, async_save)
 
     def save(self, state: TrainState, step: Optional[int] = None) -> int:
         import orbax.checkpoint as ocp
 
         step = int(state.step) if step is None else int(step)
         self._mgr.save(step, args=ocp.args.StandardSave(state._asdict()))
-        self._mgr.wait_until_finished()
+        if not self.async_save:
+            self._mgr.wait_until_finished()
         return step
 
+    def wait(self) -> None:
+        """Block until every pending async save is committed."""
+        self._mgr.wait_until_finished()
+
     def latest_step(self) -> Optional[int]:
+        self._mgr.wait_until_finished()
         return self._mgr.latest_step()
 
     def restore(self, template: TrainState,
@@ -77,6 +95,7 @@ class CheckpointManager:
         return TrainState(**restored)
 
     def all_steps(self):
+        self._mgr.wait_until_finished()
         return list(self._mgr.all_steps())
 
     def close(self):

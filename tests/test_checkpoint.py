@@ -168,3 +168,46 @@ def test_restore_missing_dir(tmp_path):
     with pytest.raises(FileNotFoundError):
         mgr.restore(tr.init_state(ShapeSpec((2, 5))))
     mgr.close()
+
+
+def test_async_checkpoint_roundtrip(tmp_path):
+    """async_save=True: save() returns before the write is durable;
+    wait()/restore() must still hand back exactly what was saved, and
+    back-to-back async saves must not corrupt each other (orbax
+    serializes them on its background thread)."""
+    model = _model()
+    tr = Trainer(model, _loss, optim.adam(1e-3))
+    state = tr.init_state(ShapeSpec((4, 5)))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=3,
+                            async_save=True)
+    mgr.save(state, step=0)
+    params0 = jax.tree.map(np.asarray, state.params)
+
+    rng = np.random.RandomState(1)
+    batch = (rng.rand(4, 5).astype(np.float32), rng.randint(0, 3, 4))
+    state2 = tr.train(state, lambda: iter([batch]), num_passes=1)
+    mgr.save(state2)          # second async save queued immediately
+    mgr.wait()
+
+    assert mgr.latest_step() == int(state2.step)
+    template = tr.init_state(ShapeSpec((4, 5)))
+    restored = mgr.restore(template)
+    _trees_equal(restored.params, state2.params)
+    restored0 = mgr.restore(template, step=0)
+    _trees_equal(restored0.params, params0)
+    mgr.close()
+
+
+def test_async_restore_waits_for_pending_save(tmp_path):
+    """restore() right after an un-waited async save must see the step
+    (latest_step waits internally) — an async manager can never hand
+    back a half-written checkpoint."""
+    model = _model()
+    tr = Trainer(model, _loss, optim.sgd(0.1))
+    state = tr.init_state(ShapeSpec((2, 5)))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), async_save=True)
+    mgr.save(state, step=7)
+    template = tr.init_state(ShapeSpec((2, 5)))
+    restored = mgr.restore(template)   # no explicit wait()
+    _trees_equal(restored.params, state.params)
+    mgr.close()
