@@ -620,6 +620,18 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
             "draft_dim": max(dim // 4, 16), "draft_layers": 2,
             "new_tokens_per_sec": round(batch * steps / dt, 1)}),
             flush=True)
+        # sampled speculative (rejection scheme, r5): distribution-
+        # preserving, so this row is comparable to decode_sample
+        spec_r = jax.jit(lambda p, dp, toks, r: T.speculative_sample(
+            p, cfg, dp, dcfg, toks, steps=steps, rng=r, draft_k=k,
+            temperature=0.8, top_p=0.95))
+        dt = timed("spec_sample", spec_r, params, dparams, prompt,
+                   jax.random.key(11))
+        print(json.dumps({
+            "bench": "decode_spec_sample", **base, "draft_k": k,
+            "temperature": 0.8, "top_p": 0.95,
+            "new_tokens_per_sec": round(batch * steps / dt, 1)}),
+            flush=True)
 
     if "gqa" in modes:
         # same model size, KV heads / 4: the cache (and its per-step

@@ -493,6 +493,37 @@ def _prefill_kv(params, cfg: TransformerConfig, toks, total: int):
     return caches
 
 
+def _window_forward(p, c: TransformerConfig, caches, toks, start, total):
+    """Process `toks` [1, W] at positions start..start+W-1 through the
+    cached stack; returns (logits [1, W, V], new caches). Shared by the
+    speculative decoders (greedy + sampling)."""
+    policy = default_policy()
+    w = toks.shape[1]
+    x = jnp.take(p["embed"]["table"], toks, axis=0)
+    x = x.astype(policy.compute_dtype)
+    pos = start + jnp.arange(w)[None, :]
+    ar = jnp.arange(total)[None, :]
+    # window position j sees cache slots <= start + j (and within the
+    # sliding-attention band when configured)
+    qpos = (start + jnp.arange(w))[None, :, None]
+    if c.attn_window is not None:
+        valid = _band_valid(ar[None, :, :], qpos, c.attn_window)
+    else:
+        valid = ar[None, :, :] <= qpos
+    valid = valid[:, None]                   # [1, 1, W, total]
+    new_caches = []
+    for blk, (k_buf, v_buf) in zip(p["blocks"], caches):
+
+        def cached_attn(q, k, v, k_buf=k_buf, v_buf=v_buf):
+            out, k_buf, v_buf = _cached_attention(
+                q, k, v, k_buf, v_buf, start, valid)
+            new_caches.append((k_buf, v_buf))
+            return out
+
+        x, _, _, _ = _block_parts(c, blk, x, pos, cached_attn)
+    return _head(p, x), new_caches
+
+
 def _band_valid(slots, t, window):
     """The sliding-window band over cache SLOT indices: slot in
     (t - window, t]. ONE definition for every decode path (uniform
@@ -751,32 +782,7 @@ def speculative_generate(params, cfg: TransformerConfig,
     total = t0 + steps + draft_k + 1
 
     def window_forward(p, c, caches, toks, start):
-        """Process `toks` [1, W] at positions start..start+W-1 through
-        the cached stack; returns (logits [1, W, V], new caches)."""
-        w = toks.shape[1]
-        x = jnp.take(p["embed"]["table"], toks, axis=0)
-        x = x.astype(policy.compute_dtype)
-        pos = start + jnp.arange(w)[None, :]
-        ar = jnp.arange(total)[None, :]
-        # window position j sees cache slots <= start + j (and within
-        # the sliding-attention band when configured)
-        qpos = (start + jnp.arange(w))[None, :, None]
-        if c.attn_window is not None:
-            valid = _band_valid(ar[None, :, :], qpos, c.attn_window)
-        else:
-            valid = ar[None, :, :] <= qpos
-        valid = valid[:, None]                   # [1, 1, W, total]
-        new_caches = []
-        for blk, (k_buf, v_buf) in zip(p["blocks"], caches):
-
-            def cached_attn(q, k, v, k_buf=k_buf, v_buf=v_buf):
-                out, k_buf, v_buf = _cached_attention(
-                    q, k, v, k_buf, v_buf, start, valid)
-                new_caches.append((k_buf, v_buf))
-                return out
-
-            x, _, _, _ = _block_parts(c, blk, x, pos, cached_attn)
-        return _head(p, x), new_caches
+        return _window_forward(p, c, caches, toks, start, total)
 
     # prefill slots 0..t0-2 (token t0-1 stays unprocessed: its logits
     # come from the first verify/draft window)
@@ -881,6 +887,169 @@ def speculative_generate(params, cfg: TransformerConfig,
     return out_buf[:, :t_end]
 
 
+def speculative_sample(params, cfg: TransformerConfig,
+                       draft_params, draft_cfg: TransformerConfig,
+                       prompt, steps: int, rng, *, draft_k: int = 4,
+                       temperature: float = 1.0,
+                       top_k: Optional[int] = None,
+                       top_p: Optional[float] = None,
+                       eos_id: Optional[int] = None,
+                       pad_id: Optional[int] = None,
+                       return_stats: bool = False):
+    """SAMPLED speculative decoding via the modified-rejection scheme
+    (Leviathan et al. / Chen et al. 2023): the draft SAMPLES draft_k
+    tokens from its own filtered distribution q, the target scores the
+    window in one forward, and draft token x_i is accepted with
+    probability min(1, p_i(x_i)/q_i(x_i)); at the first rejection the
+    round's last token is drawn from the residual max(p_i - q_i, 0)
+    (renormalized), and after a fully-accepted window from the
+    target's next-position distribution. The output tokens are
+    distributed EXACTLY as sampling token-by-token from the target
+    with the same temperature/top-k/top-p filters — the draft changes
+    only speed, never the distribution (tested empirically, and
+    exactly at top_k=1 where the scheme degenerates to greedy).
+
+    Batched like speculative_generate (per-row pointers under vmap,
+    per-row rng keys), with the same eos/pad semantics. temperature
+    must be > 0 — use speculative_generate for greedy.
+
+    return_stats=True also returns per-row round counts [B].
+    """
+    b, t0 = prompt.shape
+    if t0 < 2:
+        raise ValueError("need a >=2-token prompt (prefill t0-1, then "
+                         "the last token seeds the first round)")
+    if temperature <= 0:
+        raise ValueError("temperature must be > 0 (speculative_generate "
+                         "is the greedy decoder)")
+    _validate_sampler_args(temperature, top_k, top_p)
+    fill = eos_id if pad_id is None else pad_id
+    params, tgt_step_params = _int8_step_params(params)
+    draft_params, dft_step_params = _int8_step_params(draft_params)
+    total = t0 + steps + draft_k + 1
+
+    tgt_caches = _prefill_kv(params, cfg, prompt[:, :-1], total)
+    dft_caches = _prefill_kv(draft_params, draft_cfg, prompt[:, :-1],
+                             total)
+    out_buf = jnp.zeros((b, total), prompt.dtype).at[:, :t0].set(prompt)
+    t_end = t0 + steps
+    karange = jnp.arange(draft_k + 1)
+
+    def filt_logp(logits):
+        """Filtered log-distribution [N, V] — the ONE distribution both
+        models sample/score under, so acceptance preserves it."""
+        return jax.nn.log_softmax(_filter_logits(
+            at_least_f32(logits), temperature, top_k, top_p), axis=-1)
+
+    def row_round(t, done, rounds, key, out_row, tgt_c, dft_c):
+        active = (~done) & (t < t_end)
+        key, k_draft, k_acc, k_res = jax.random.split(key, 4)
+        out1 = out_row[None]
+        tgt1 = jax.tree.map(lambda a: a[None], tgt_c)
+        dft1 = jax.tree.map(lambda a: a[None], dft_c)
+
+        # --- draft SAMPLES draft_k tokens, recording its filtered
+        # log-probs (full rows: the residual needs q_i(·), not just
+        # q_i(x_i)); same 2-token catch-up as the greedy decoder ------
+        last2 = jax.lax.dynamic_slice(
+            out1, (jnp.zeros((), t.dtype), t - 2), (1, 2))
+        logits2, dft1 = _window_forward(
+            dft_step_params(last2), draft_cfg, dft1, last2, t - 2, total)
+        q0 = filt_logp(logits2[:, -1])                     # [1, V]
+        d0 = jax.random.categorical(
+            jax.random.fold_in(k_draft, 0), q0, axis=-1
+        ).astype(out_row.dtype)
+
+        def draft_step(c, i):
+            dft, tok = c
+            logits, dft = _window_forward(
+                dft_step_params(tok), draft_cfg, dft, tok[:, None],
+                t + i, total)
+            q = filt_logp(logits[:, -1])                   # [1, V]
+            nxt = jax.random.categorical(
+                jax.random.fold_in(k_draft, i + 1), q, axis=-1
+            ).astype(out_row.dtype)
+            return (dft, nxt), (nxt, q[0])
+
+        (dft1, _), (more, qmore) = jax.lax.scan(
+            draft_step, (dft1, d0), jnp.arange(draft_k - 1))
+        drafts = jnp.concatenate([d0[None, :], more],
+                                 axis=0).transpose(1, 0)   # [1, K]
+        qdist = jnp.concatenate([q0, qmore], axis=0)       # [K, V]
+
+        # --- target scores the window in one forward ----------------
+        last = jax.lax.dynamic_slice_in_dim(out1, t - 1, 1, axis=1)
+        window = jnp.concatenate([last, drafts], axis=1)   # [1, K+1]
+        logits, tgt1 = _window_forward(tgt_step_params(window), cfg,
+                                       tgt1, window, t - 1, total)
+        pdist = filt_logp(logits[0])                       # [K+1, V]
+
+        # --- modified rejection: accept x_i w.p. min(1, p_i/q_i) ----
+        p_x = jnp.take_along_axis(
+            pdist[:draft_k], drafts[0][:, None], axis=-1)[:, 0]
+        q_x = jnp.take_along_axis(
+            qdist, drafts[0][:, None], axis=-1)[:, 0]
+        u = jax.random.uniform(k_acc, (draft_k,))
+        acc = u < jnp.exp(jnp.minimum(p_x - q_x, 0.0))
+        n_acc = jnp.argmin(jnp.concatenate(
+            [acc, jnp.zeros((1,), bool)]).astype(jnp.int32))
+        # the round's last token: residual (p-q)+ at the rejection
+        # position, or the target's next-position dist when all accept
+        n_sel = jnp.minimum(n_acc, draft_k - 1)
+        p_rej = jnp.exp(jax.lax.dynamic_index_in_dim(
+            pdist, n_sel, axis=0, keepdims=False))
+        q_rej = jnp.exp(jax.lax.dynamic_index_in_dim(
+            qdist, n_sel, axis=0, keepdims=False))
+        res = jnp.maximum(p_rej - q_rej, 0.0)
+        # float-edge fallback: if the residual mass rounds to zero,
+        # sample from p itself (p<=q everywhere means p==q: identical
+        # distributions, any p-sample is correct)
+        res = jnp.where(jnp.sum(res) > 0, res, p_rej)
+        tok_rej = jax.random.categorical(k_res, jnp.log(res + 1e-38))
+        tok_all = jax.random.categorical(k_res, pdist[draft_k])
+        resolved = jnp.where(n_acc < draft_k, tok_rej,
+                             tok_all).astype(out_row.dtype)
+
+        app = jnp.where(karange < n_acc,
+                        jnp.concatenate([drafts[0],
+                                         resolved[None]]), resolved)
+        if eos_id is not None:
+            hit = (app == eos_id) & (karange <= n_acc)
+            found = jnp.any(hit)
+            adv = jnp.where(found, jnp.argmax(hit) + 1, n_acc + 1)
+        else:
+            found = jnp.zeros((), bool)
+            adv = n_acc + 1
+        new_out = jax.lax.dynamic_update_slice(
+            out1, app[None], (jnp.zeros((), t.dtype), t))[0]
+        t = jnp.where(active, (t + adv).astype(t.dtype), t)
+        done = done | (active & found)
+        rounds = rounds + active.astype(rounds.dtype)
+        out_row = jnp.where(active, new_out, out_row)
+        return (t, done, rounds, key, out_row,
+                jax.tree.map(lambda a: a[0], tgt1),
+                jax.tree.map(lambda a: a[0], dft1))
+
+    vround = jax.vmap(row_round)
+
+    def cond(carry):
+        t, done = carry[0], carry[1]
+        return jnp.any((~done) & (t < t_end))
+
+    t, done, rounds, _, out_buf, _, _ = jax.lax.while_loop(
+        cond, lambda c: vround(*c),
+        (jnp.full((b,), t0, jnp.int32), jnp.zeros((b,), bool),
+         jnp.zeros((b,), jnp.int32), jax.random.split(rng, b),
+         out_buf, tgt_caches, dft_caches))
+    if eos_id is not None:
+        col = jnp.arange(total)[None, :]
+        out_buf = jnp.where(done[:, None] & (col >= t[:, None]),
+                            jnp.asarray(fill, out_buf.dtype), out_buf)
+    if return_stats:
+        return out_buf[:, :t_end], rounds
+    return out_buf[:, :t_end]
+
+
 def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
                 beam_size: int = 4, *, eos_id: Optional[int] = None,
                 length_penalty: float = 0.0):
@@ -972,6 +1141,47 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
     return seqs, scores
 
 
+def _validate_sampler_args(temperature, top_k, top_p):
+    if temperature < 0:
+        raise ValueError("temperature must be >= 0")
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
+def _filter_logits(logits, temperature, top_k, top_p):
+    """Temperature scaling, then optional top-k truncation, then
+    optional nucleus (top-p) filtering over [N, V] logits; filtered-out
+    tokens become -inf. Shared by make_sampler and speculative_sample —
+    the SAME filtered distribution is what both sample from and what
+    the rejection rule must preserve. temperature must be > 0 here
+    (the greedy degenerate case is handled by the callers)."""
+    logits = logits / temperature
+    if top_k is not None or top_p is not None:
+        # one descending sort serves both filters; top-k in sorted
+        # space is just position < k, and the nucleus is computed
+        # over the top-k-FILTERED distribution (sequential filter
+        # semantics)
+        desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        if top_k is not None:
+            k_eff = min(top_k, logits.shape[-1])
+            kth = desc[:, k_eff - 1][:, None]
+            logits = jnp.where(logits >= kth, logits, -jnp.inf)
+            desc = jnp.where(jnp.arange(desc.shape[-1])[None, :] <
+                             k_eff, desc, -jnp.inf)
+        if top_p is not None:
+            probs = jax.nn.softmax(desc, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1) - probs
+            # keep every token whose preceding nucleus mass < top_p
+            # (the argmax always survives: its preceding mass is 0)
+            cutoff_logit = jnp.min(jnp.where(
+                cum < top_p, desc, jnp.inf), axis=-1, keepdims=True)
+            logits = jnp.where(logits >= cutoff_logit, logits,
+                               -jnp.inf)
+    return logits
+
+
 def make_sampler(*, temperature: float = 1.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None):
     """Build a select_fn for `generate`: temperature scaling, then
@@ -981,40 +1191,15 @@ def make_sampler(*, temperature: float = 1.0, top_k: Optional[int] = None,
     top_k is clamped to the vocab size (k >= vocab means no filtering),
     and ties at the kth logit all survive (the filter keeps every logit
     >= the kth largest, so more than k tokens can pass)."""
-    if temperature < 0:
-        raise ValueError("temperature must be >= 0")
-    if top_k is not None and top_k < 1:
-        raise ValueError(f"top_k must be >= 1, got {top_k}")
-    if top_p is not None and not 0.0 < top_p <= 1.0:
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    _validate_sampler_args(temperature, top_k, top_p)
 
     def select(logits, rng):
         logits = at_least_f32(logits)
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1)
-        logits = logits / temperature
-        if top_k is not None or top_p is not None:
-            # one descending sort serves both filters; top-k in sorted
-            # space is just position < k, and the nucleus is computed
-            # over the top-k-FILTERED distribution (sequential filter
-            # semantics)
-            desc = jnp.sort(logits, axis=-1)[:, ::-1]
-            if top_k is not None:
-                k_eff = min(top_k, logits.shape[-1])
-                kth = desc[:, k_eff - 1][:, None]
-                logits = jnp.where(logits >= kth, logits, -jnp.inf)
-                desc = jnp.where(jnp.arange(desc.shape[-1])[None, :] <
-                                 k_eff, desc, -jnp.inf)
-            if top_p is not None:
-                probs = jax.nn.softmax(desc, axis=-1)
-                cum = jnp.cumsum(probs, axis=-1) - probs
-                # keep every token whose preceding nucleus mass < top_p
-                # (the argmax always survives: its preceding mass is 0)
-                cutoff_logit = jnp.min(jnp.where(
-                    cum < top_p, desc, jnp.inf), axis=-1, keepdims=True)
-                logits = jnp.where(logits >= cutoff_logit, logits,
-                                   -jnp.inf)
-        return jax.random.categorical(rng, logits, axis=-1)
+        return jax.random.categorical(
+            rng, _filter_logits(logits, temperature, top_k, top_p),
+            axis=-1)
 
     return select
 

@@ -716,6 +716,92 @@ class TestSpeculativeDecode:
         assert int(rounds[0]) < steps  # row 0 stopped early
 
 
+class TestSpeculativeSampling:
+    """Speculative SAMPLING must preserve the target's (filtered)
+    sampling distribution exactly — the draft changes speed only."""
+
+    CFG = T.TransformerConfig(vocab=16, dim=16, n_layers=2, n_heads=2,
+                              mlp_ratio=2, attn_impl="dense")
+
+    def _models(self):
+        target = T.init_params(jax.random.key(0), self.CFG)
+        draft_cfg = T.TransformerConfig(vocab=16, dim=8, n_layers=1,
+                                        n_heads=2, mlp_ratio=2,
+                                        attn_impl="dense")
+        draft = T.init_params(jax.random.key(9), draft_cfg)
+        return target, draft, draft_cfg
+
+    def test_first_token_distribution_matches_target(self):
+        """2000 identical rows, 1 step: the empirical histogram of the
+        first sampled token must match the target's filtered softmax at
+        the prompt's last position (TV noise at N=2000 is ~0.01/token;
+        tolerance 0.05). This is the property the rejection rule
+        exists to guarantee — a naive accept-if-likely rule fails it."""
+        target, draft, draft_cfg = self._models()
+        row = np.random.RandomState(0).randint(1, 16, (1, 4))
+        prompt = jnp.asarray(np.repeat(row, 2000, axis=0), jnp.int32)
+        out = np.asarray(T.speculative_sample(
+            target, self.CFG, draft, draft_cfg, prompt, steps=1,
+            rng=jax.random.key(42), draft_k=2, temperature=0.9))
+        toks = out[:, 4]
+        freq = np.bincount(toks, minlength=16) / 2000.0
+        logits = np.asarray(T.apply(
+            target, self.CFG, jnp.asarray(row, jnp.int32)))[0, -1]
+        want = np.asarray(jax.nn.softmax(
+            jnp.asarray(logits, jnp.float32) / 0.9))
+        assert np.abs(freq - want).max() < 0.05, (freq, want)
+
+    def test_top_k1_equals_greedy_exactly(self):
+        """top_k=1 collapses both filtered distributions to one-hots:
+        the sampler must reproduce the target's greedy decode token for
+        token, whatever the draft proposes."""
+        target, draft, draft_cfg = self._models()
+        prompt = jnp.asarray(
+            np.random.RandomState(1).randint(1, 16, (2, 5)), jnp.int32)
+        want = np.asarray(T.generate(target, self.CFG, prompt, steps=8))
+        got = np.asarray(T.speculative_sample(
+            target, self.CFG, draft, draft_cfg, prompt, steps=8,
+            rng=jax.random.key(3), draft_k=3, top_k=1))
+        np.testing.assert_array_equal(got, want)
+
+    def test_perfect_draft_accepts_everything(self):
+        """draft == target => p == q => acceptance probability 1 per
+        token: steps tokens must take exactly ceil(steps/(k+1)) rounds
+        per row."""
+        target, _, _ = self._models()
+        prompt = jnp.asarray(
+            np.random.RandomState(2).randint(1, 16, (2, 4)), jnp.int32)
+        _, rounds = T.speculative_sample(
+            target, self.CFG, target, self.CFG, prompt, steps=10,
+            rng=jax.random.key(7), draft_k=4, temperature=0.8,
+            return_stats=True)
+        np.testing.assert_array_equal(np.asarray(rounds), [2, 2])
+
+    def test_eos_stops_and_pads(self):
+        target, draft, draft_cfg = self._models()
+        prompt = jnp.asarray(
+            np.random.RandomState(3).randint(1, 16, (2, 4)), jnp.int32)
+        steps = 12
+        out, rounds = T.speculative_sample(
+            target, self.CFG, draft, draft_cfg, prompt, steps=steps,
+            rng=jax.random.key(5), draft_k=3, temperature=1.0,
+            eos_id=3, pad_id=0, return_stats=True)
+        out = np.asarray(out)
+        assert out.shape == (2, 4 + steps)
+        for r in range(2):
+            gen = out[r, 4:]
+            hits = np.flatnonzero(gen == 3)
+            if hits.size:  # everything after the first eos is pad
+                assert (gen[hits[0] + 1:] == 0).all(), gen
+
+    def test_validates_temperature(self):
+        target, draft, draft_cfg = self._models()
+        with pytest.raises(ValueError, match="temperature"):
+            T.speculative_sample(target, self.CFG, draft, draft_cfg,
+                                 jnp.zeros((1, 4), jnp.int32), steps=2,
+                                 rng=jax.random.key(0), temperature=0.0)
+
+
 def assert_decode_matches_teacher_forcing(params, cfg, prompt, steps):
     """Cached token-by-token greedy decode must equal the teacher-forced
     argmax of one full forward — THE decode-correctness invariant, used
