@@ -1,0 +1,26 @@
+# Shared definitions for the r5 watcher + campaign (sourced, not run).
+#
+# STOP_EPOCH: unix time after which no chip work may start (and running
+# stages are capped) so the driver's end-of-round bench owns the claim.
+# Round 5 started 2026-08-01 ~08:26 UTC with a ~12h window; stand down
+# ~1.4h before the expected end.
+export STOP_EPOCH=${STOP_EPOCH:-1785611000}   # 2026-08-01 19:03 UTC
+
+# One liveness criterion everywhere (same as r4_common.sh): the tiny
+# matmul must complete AND the backend must be the chip (platform
+# "axon" through the relay; a silent CPU fallback would otherwise
+# declare a wedged chip alive and launch the next heavy stage into it).
+#
+# 600s probe budget: the r3+r4 wedge persisted 16+ hours under a
+# 150s/5-min prober — consistent with each killed probe grabbing the
+# claim the moment the previous wedge expires and being SIGTERMed
+# mid-init, re-wedging the relay for another window. A probe long
+# enough to ride out a slow grant (+ the ~30s compile) breaks that
+# cycle instead of perpetuating it.
+chip_probe() {
+  timeout 600 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() != 'cpu', jax.default_backend()
+print((jnp.ones((128,128),jnp.bfloat16)@jnp.ones((128,128),jnp.bfloat16))[0,0])
+"
+}

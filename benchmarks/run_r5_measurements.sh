@@ -1,0 +1,113 @@
+#!/bin/bash
+# Round-5 chip measurement campaign — cashes the two-round unmeasured
+# IOU table (VERDICT r4 #1): every committed-but-unmeasured suite row
+# gets a number, cheapest/highest-information first.
+#
+# Inherits the r3b/r4 wedge lessons (run_r3_measurements.sh header):
+# cheap compiles first, A/B probes early, subprocess-isolated stages,
+# big-batch image rows last, STOP_EPOCH cap so a late stage never
+# collides with the driver's own end-of-round bench.
+set -u
+cd "$(dirname "$0")/.."
+. benchmarks/r5_common.sh   # STOP_EPOCH + chip_probe (shared w/ watcher)
+mkdir -p benchmarks/r5_logs
+
+# a stage killed at its timeout may have wedged the relay (the r3
+# hazard: a killed claimant wedges the chip ~2h) — launching the next
+# stage into a wedged chip just burns its full timeout and re-wedges.
+# After any rc=124, hold here re-probing until the chip answers again
+# (or STOP_EPOCH passes, which aborts the campaign).
+wait_alive() {
+  while true; do
+    if [ "$(date +%s)" -ge "$STOP_EPOCH" ]; then
+      echo "=== chip still wedged at STOP_EPOCH — aborting campaign ==="
+      exit 0
+    fi
+    if chip_probe >> benchmarks/r5_logs/realive.log 2>&1; then
+      echo "    (chip alive again $(date +%H:%M:%S))"
+      return
+    fi
+    echo "    (chip not answering, re-probe in 300s)"
+    sleep 300
+  done
+}
+
+run() {  # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  local now=$(date +%s)
+  if [ "$now" -ge "$STOP_EPOCH" ]; then
+    echo "=== $name SKIPPED (past STOP_EPOCH) ==="
+    return
+  fi
+  # cap the stage budget at the deadline: a stage launched shortly
+  # before STOP_EPOCH must not run its full timeout past it and
+  # collide with the driver's own bench on the single chip claim
+  local budget=$(( STOP_EPOCH - now ))
+  if [ "$tmo" -gt "$budget" ]; then tmo=$budget; fi
+  echo "=== $name ($(date +%H:%M:%S), budget ${tmo}s) ==="
+  timeout "$tmo" "$@" > "benchmarks/r5_logs/$name.out" 2> "benchmarks/r5_logs/$name.err"
+  local rc=$?
+  echo "    rc=$rc  (tail of out:)"; tail -3 "benchmarks/r5_logs/$name.out" | sed 's/^/    /'
+  if [ "$rc" = 124 ]; then
+    wait_alive
+  fi
+}
+
+# 0. liveness (same criterion as wait_alive)
+echo "=== probe ($(date +%H:%M:%S)) ==="
+chip_probe > benchmarks/r5_logs/probe.out 2> benchmarks/r5_logs/probe.err \
+  || wait_alive
+
+# 1. the open regression question (two rounds old): tie-split vs
+#    select-and-scatter maxpool backward, resnet bs64
+run probe_pool 1500 python benchmarks/probe_pool.py
+
+# 1b. the HBM-roofline attack at its cheapest shape: remat A/B at bs64
+#     (full bs-256 rows run in stage 6; this early row survives even if
+#     a later compile wedges the chip)
+run probe_remat 2400 python benchmarks/suite.py --only resnet50,resnet50_remat,resnet50_remat_full --batches 64
+
+# 2. lstm benches (fused Pallas kernel) + the h256/h512 inversion probe
+run suite_lstm 1200 python benchmarks/suite.py --only lstm_h256,lstm_h512
+run probe_lstm 1200 python benchmarks/probe_lstm.py
+
+# 3. CTR stage probe (steady-state attribution after the recompile fix)
+run probe_ctr 1200 python benchmarks/probe_ctr.py
+
+# 4. cheap suite rows: smallnet, trainer-loop overhead (a round-1
+#    acceptance criterion), transformer LM at 8k + its SWA variant
+run suite_small 2400 python benchmarks/suite.py --only smallnet,trainer_loop
+run suite_misc 2400 python benchmarks/suite.py --only transformer
+
+# 5. the north stars + decode greedy + headline resnet, driver-format
+#    (bench.py worst case ~6270s incl. its own liveness gate)
+run bench 6300 python bench.py
+
+# 5b. decode modes: greedy/sample/beam/gqa/int8 + long-horizon SWA +
+#     speculative (perfect/small-draft/sampled) — each row prints the
+#     moment it's measured, so a late-mode hang loses nothing
+run suite_decode 3000 python benchmarks/suite.py --only decode
+
+# 6. image suite; big-batch rows are the wedge risk so they go last,
+#    one model per stage
+run suite_alexnet 1800 python benchmarks/suite.py --only alexnet --batches 64,128,256
+run suite_googlenet 1800 python benchmarks/suite.py --only googlenet
+run suite_resnet 1800 python benchmarks/suite.py --only resnet50
+run suite_resnet_s2d 1800 python benchmarks/suite.py --only resnet50_s2d
+run suite_resnet_remat 1800 python benchmarks/suite.py --only resnet50_remat --batches 64,256
+run suite_resnet_remat_full 1800 python benchmarks/suite.py --only resnet50_remat_full --batches 64,256
+run suite_vgg 1800 python benchmarks/suite.py --only vgg19
+
+# 6b. MoE transformer row (opt-in bench)
+run suite_moe 1800 python benchmarks/suite.py --only moe
+
+# 7. refreshed profile traces for PROFILE_NOTES: the headline resnet
+#    step and the googlenet MFU floor (r3 verdict #8: trace or number)
+run profile 1200 python benchmarks/profile_step.py --batch 256 --iters 10
+run profile_googlenet 1200 python benchmarks/profile_step.py --model googlenet --batch 256 --iters 10
+
+# 8. the single biggest compile (alexnet bs512) dead last: if it wedges
+#    the chip nothing is behind it
+run suite_alexnet512 1800 python benchmarks/suite.py --only alexnet --batches 512
+
+echo "=== done ($(date +%H:%M:%S)) — logs in benchmarks/r5_logs/ ==="
