@@ -323,7 +323,7 @@ def bench_ctr_sparse(batch: int = 4096, *, slots: int = 32,
 def bench_transformer_lm(seq_len: int = 8192, *, batch: int = 4,
                          dim: int = 512, n_layers: int = 8, n_heads: int = 8,
                          vocab: int = 32000, iters: int = 10,
-                         window=None):
+                         window=None, fused_ce_chunk=None):
     """Long-context transformer-LM training throughput (tokens/sec) —
     the framework's modern long-sequence story: Pallas flash attention +
     per-block remat. No reference counterpart (the reference predates
@@ -334,7 +334,8 @@ def bench_transformer_lm(seq_len: int = 8192, *, batch: int = 4,
 
     cfg = T.TransformerConfig(vocab=vocab, dim=dim, n_layers=n_layers,
                               n_heads=n_heads, attn_impl="auto",
-                              attn_window=window, remat=True)
+                              attn_window=window, remat=True,
+                              fused_ce_chunk=fused_ce_chunk)
     params = T.init_params(jax.random.key(0), cfg)
     opt = optim.adam(1e-3)
     opt_state = opt.init(params)
@@ -375,10 +376,12 @@ def bench_transformer_lm(seq_len: int = 8192, *, batch: int = 4,
     dt = (time.perf_counter() - t0) / iters
     progress(f"transformer: done ({1000*dt:.1f} ms/batch)")
     rec = {
-        "bench": "transformer_lm" if window is None else
-                 "transformer_lm_swa",
+        "bench": ("transformer_lm_fused_ce" if fused_ce_chunk else
+                  "transformer_lm" if window is None else
+                  "transformer_lm_swa"),
         "window": window, "batch": batch, "seq_len": seq_len,
         "dim": dim, "n_layers": n_layers,
+        **({"fused_ce_chunk": fused_ce_chunk} if fused_ce_chunk else {}),
         "ms_per_batch": round(1000 * dt, 2),
         "tokens_per_sec": round(batch * seq_len / dt, 1),
     }
@@ -750,6 +753,18 @@ def main():
             n_layers=2 if quick else 8, n_heads=2 if quick else 8,
             vocab=500 if quick else 32000, iters=2 if quick else 5,
             **({"modes": ("greedy",)} if "decode" not in only else {}))
+
+    if only and "transformer_fused_ce" in only:  # opt-in A/B row
+        # same shape as the default transformer row; the delta is the
+        # chunked fused cross-entropy (losses.chunked_lm_head_nll)
+        # dropping the 4.19 GiB f32 logits round-trip (-81% residual
+        # set, tests/test_compiled_cost.py::TestFusedCEResiduals)
+        rec = bench_transformer_lm(
+            seq_len=128 if quick else 8192, batch=2 if quick else 4,
+            dim=64 if quick else 512, n_layers=2 if quick else 8,
+            n_heads=2 if quick else 8, vocab=500 if quick else 32000,
+            iters=iters, fused_ce_chunk=512 if quick else 2048)
+        print(json.dumps(rec))
 
     if only and "moe" in only:  # opt-in (not in the default campaign)
         rec = bench_moe_lm(

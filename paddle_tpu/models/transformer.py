@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from paddle_tpu.core.dtypes import at_least_f32, default_policy
 from paddle_tpu.nn import initializers
 from paddle_tpu.ops import linalg
+from paddle_tpu.ops import losses as losses_ops
 from paddle_tpu.ops import norm as norm_ops
 from paddle_tpu.ops.flash_attention import flash_attention
 from paddle_tpu.parallel.sharding import MEGATRON_RULES, MODEL_AXIS
@@ -86,6 +87,13 @@ class TransformerConfig:
     # speculative decode keep full-length band-masked buffers.
     attn_window: Optional[int] = None
     remat: bool = False
+    # fused chunked cross-entropy: loss() folds the LM-head matmul into
+    # a checkpointed scan over `fused_ce_chunk`-position slices so the
+    # [B*T, vocab] logits tensor never exists (forward keeps only the
+    # per-position nll; backward recomputes each chunk's logits on the
+    # MXU). None = plain path. Affects loss() only — apply()/score()/
+    # decode still materialize logits where callers consume them.
+    fused_ce_chunk: Optional[int] = None
     # sparsely-activated FFN (GLaM-style): every `moe_every`-th block
     # swaps its dense MLP for `moe_experts` experts with top-`moe_k`
     # routing; 0 experts = all-dense
@@ -332,11 +340,14 @@ def _block(cfg: TransformerConfig, p, x, positions, token_mask=None,
 
 
 def _forward(params, cfg: TransformerConfig, tokens, positions=None,
-             token_mask=None, attn_fn=None):
+             token_mask=None, attn_fn=None, return_hidden=False):
     """tokens [B,T] int32 -> (logits [B,T,V], summed MoE aux loss).
     token_mask [B,T] bool marks real (non-padding) positions for MoE
     capacity accounting. attn_fn overrides the config's attention (the
-    context-parallel builder injects ring/Ulysses attention here)."""
+    context-parallel builder injects ring/Ulysses attention here).
+    return_hidden=True skips the LM-head matmul and returns the final
+    post-norm hidden [B,T,D] instead (the fused-CE loss path folds the
+    head into its chunked scan)."""
     policy = default_policy()
     x = jnp.take(params["embed"]["table"], tokens, axis=0)
     x = x.astype(policy.compute_dtype)
@@ -353,6 +364,8 @@ def _forward(params, cfg: TransformerConfig, tokens, positions=None,
         aux = aux + a
     x = norm_ops.layer_norm(x, params["ln_f"]["scale"],
                             params["ln_f"]["offset"])
+    if return_hidden:
+        return x, aux
     return linalg.matmul(x, params["lm_head"]["kernel"]), aux
 
 
@@ -369,13 +382,20 @@ def loss(params, cfg: TransformerConfig, tokens, lengths=None,
     tmask = None
     if lengths is not None:
         tmask = jnp.arange(tokens.shape[1] - 1)[None, :] < lengths[:, None]
-    logits, aux = _forward(params, cfg, tokens[:, :-1], token_mask=tmask,
-                           attn_fn=attn_fn)
     targets = tokens[:, 1:]
-    lse = jax.nn.logsumexp(at_least_f32(logits), axis=-1)
-    gold = jnp.take_along_axis(
-        at_least_f32(logits), targets[..., None], axis=-1)[..., 0]
-    nll = lse - gold
+    if cfg.fused_ce_chunk:
+        hid, aux = _forward(params, cfg, tokens[:, :-1], token_mask=tmask,
+                            attn_fn=attn_fn, return_hidden=True)
+        nll = losses_ops.chunked_lm_head_nll(
+            hid, params["lm_head"]["kernel"], targets,
+            chunk=cfg.fused_ce_chunk)
+    else:
+        logits, aux = _forward(params, cfg, tokens[:, :-1],
+                               token_mask=tmask, attn_fn=attn_fn)
+        lse = jax.nn.logsumexp(at_least_f32(logits), axis=-1)
+        gold = jnp.take_along_axis(
+            at_least_f32(logits), targets[..., None], axis=-1)[..., 0]
+        nll = lse - gold
     if lengths is None:
         ce = jnp.mean(nll)
     else:

@@ -181,3 +181,54 @@ def l1_norm(x):
 def squared_l2_norm(x):
     """sum x^2 (reference: operators/squared_l2_norm_op.cc)."""
     return jnp.sum(jnp.square(at_least_f32(x)))
+
+
+def chunked_lm_head_nll(hidden, kernel, targets, *, chunk: int = 2048):
+    """Next-token NLL fused with the LM-head matmul, never holding the
+    full [N, V] logits.
+
+    The plain path (models/transformer.loss) computes
+    `logits = h @ W` for all N = B*T positions, then logsumexp —
+    at the flagship bench shape (B4 T8191 V32000) that is a 4.2 GiB
+    f32 tensor written by the forward, saved as a backward residual,
+    and swept twice more by the softmax VJP: pure HBM traffic on a
+    bandwidth-bound chip. Here the positions are processed in
+    `chunk`-row slices inside a `lax.scan` whose body is
+    `jax.checkpoint`ed: the forward keeps only the per-position nll
+    (N floats), and the backward recomputes each chunk's logits on the
+    MXU right before consuming them — trading cheap recompute FLOPs
+    for the dominant HBM bytes, the same exchange `jax.checkpoint`
+    makes for block activations (reference analog: the reference
+    fuses softmax into its CE op for the same reason,
+    softmax_with_cross_entropy_op.cc — one pass instead of two; this
+    takes it one step further by folding in the projection).
+
+    hidden [B, T, D] (compute dtype), kernel [D, V], targets [B, T]
+    int. Returns per-position nll [B, T] f32. Bit-compatibility with
+    the unfused path is to matmul-accumulation order only (same ops,
+    chunked lhs), so values match to ~1e-6 relative.
+    """
+    from paddle_tpu.ops import linalg
+
+    b, t, d = hidden.shape
+    n = b * t
+    h = hidden.reshape(n, d)
+    y = targets.reshape(n)
+    n_chunks = -(-n // chunk)
+    pad = n_chunks * chunk - n
+    if pad:
+        h = jnp.concatenate([h, jnp.zeros((pad, d), h.dtype)])
+        y = jnp.concatenate([y, jnp.zeros((pad,), y.dtype)])
+    h = h.reshape(n_chunks, chunk, d)
+    y = y.reshape(n_chunks, chunk)
+
+    @jax.checkpoint
+    def body(carry, hy):
+        hc, yc = hy
+        logits = at_least_f32(linalg.matmul(hc, kernel))
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, yc[:, None], axis=-1)[:, 0]
+        return carry, lse - gold
+
+    _, nll = jax.lax.scan(body, None, (h, y))
+    return nll.reshape(n_chunks * chunk)[:n].reshape(b, t)

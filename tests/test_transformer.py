@@ -1019,3 +1019,62 @@ class TestScore:
         # consistency with loss() (unmasked row)
         full_nll = float(T.loss(params, cfg, toks[:1]))
         np.testing.assert_allclose(float(nll[0]), full_nll, rtol=1e-5)
+
+
+class TestFusedCE:
+    """fused_ce_chunk folds the LM-head matmul into a checkpointed
+    chunked scan (ops/losses.chunked_lm_head_nll): loss and grads must
+    match the plain materialized-logits path exactly (same matmul, just
+    chunked lhs), including ragged lengths, non-divisible chunk sizes,
+    and the MoE aux term."""
+
+    def _cfg(self, **kw):
+        import dataclasses
+        return dataclasses.replace(CFG, **kw)
+
+    @pytest.mark.parametrize("chunk", [4, 7, 64])
+    def test_loss_and_grads_match_plain(self, params, chunk):
+        toks = jnp.asarray(
+            np.random.RandomState(1).randint(0, 61, (3, 13)), jnp.int32)
+        fcfg = self._cfg(fused_ce_chunk=chunk)
+        for lens in (None, jnp.asarray([13, 6, 1])):
+            a = T.loss(params, CFG, toks, lens)
+            b = T.loss(params, fcfg, toks, lens)
+            np.testing.assert_allclose(float(a), float(b), rtol=1e-6)
+            ga = jax.grad(lambda p: T.loss(p, CFG, toks, lens))(params)
+            gb = jax.grad(lambda p: T.loss(p, fcfg, toks, lens))(params)
+            for la, lb in zip(jax.tree.leaves(ga), jax.tree.leaves(gb)):
+                np.testing.assert_allclose(la, lb, atol=5e-7)
+
+    def test_with_moe_aux(self):
+        import dataclasses
+        cfg = dataclasses.replace(CFG, moe_experts=4, moe_every=2,
+                                  n_layers=2)
+        fcfg = dataclasses.replace(cfg, fused_ce_chunk=8)
+        p = T.init_params(jax.random.key(2), cfg)
+        toks = jnp.asarray(
+            np.random.RandomState(2).randint(0, 61, (2, 9)), jnp.int32)
+        np.testing.assert_allclose(float(T.loss(p, cfg, toks)),
+                                   float(T.loss(p, fcfg, toks)),
+                                   rtol=1e-6)
+
+    def test_trains(self, params):
+        from paddle_tpu import optim
+        fcfg = self._cfg(fused_ce_chunk=16)
+        opt = optim.adam(1e-2)
+        state = opt.init(params)
+        toks = jnp.asarray(
+            np.random.RandomState(3).randint(0, 61, (4, 12)), jnp.int32)
+
+        @jax.jit
+        def step(p, s):
+            l, g = jax.value_and_grad(
+                lambda p: T.loss(p, fcfg, toks))(p)
+            p, s = opt.update(g, s, p, jnp.zeros((), jnp.int32))
+            return p, s, l
+
+        p = params
+        p, state, l0 = step(p, state)
+        for _ in range(30):
+            p, state, l = step(p, state)
+        assert float(l) < float(l0) - 0.5
