@@ -1,0 +1,62 @@
+#!/bin/bash
+# Round-5 addendum: stages added AFTER the main campaign fired (it
+# launched minutes into the round, the moment the chip answered).
+# Waits for the main campaign to release the chip claim, then runs the
+# new rows under the same wedge discipline (r5_common.sh probe +
+# STOP_EPOCH cap).
+set -u
+cd "$(dirname "$0")/.."
+. benchmarks/r5_common.sh
+mkdir -p benchmarks/r5_logs
+
+# wait for the main campaign to finish (its console gains "=== done")
+while ! grep -q "=== done" benchmarks/r5_logs/campaign_console.txt 2>/dev/null; do
+  if [ "$(date +%s)" -ge "$STOP_EPOCH" ]; then
+    echo "=== main campaign still running at STOP_EPOCH — addendum aborted ==="
+    exit 0
+  fi
+  sleep 60
+done
+
+wait_alive() {
+  while true; do
+    if [ "$(date +%s)" -ge "$STOP_EPOCH" ]; then
+      echo "=== chip still wedged at STOP_EPOCH — aborting addendum ==="
+      exit 0
+    fi
+    if chip_probe >> benchmarks/r5_logs/realive.log 2>&1; then
+      echo "    (chip alive again $(date +%H:%M:%S))"
+      return
+    fi
+    echo "    (chip not answering, re-probe in 300s)"
+    sleep 300
+  done
+}
+
+run() {  # name timeout cmd...  (same contract as run_r5_measurements.sh)
+  local name=$1 tmo=$2; shift 2
+  local now=$(date +%s)
+  if [ "$now" -ge "$STOP_EPOCH" ]; then
+    echo "=== $name SKIPPED (past STOP_EPOCH) ==="
+    return
+  fi
+  local budget=$(( STOP_EPOCH - now ))
+  if [ "$tmo" -gt "$budget" ]; then tmo=$budget; fi
+  echo "=== $name ($(date +%H:%M:%S), budget ${tmo}s) ==="
+  timeout "$tmo" "$@" > "benchmarks/r5_logs/$name.out" 2> "benchmarks/r5_logs/$name.err"
+  local rc=$?
+  echo "    rc=$rc  (tail of out:)"; tail -3 "benchmarks/r5_logs/$name.out" | sed 's/^/    /'
+  if [ "$rc" = 124 ]; then
+    wait_alive
+  fi
+}
+
+echo "=== addendum probe ($(date +%H:%M:%S)) ==="
+chip_probe > benchmarks/r5_logs/add_probe.out 2> benchmarks/r5_logs/add_probe.err \
+  || wait_alive
+
+# fused chunked cross-entropy A/B vs the transformer row suite_misc
+# measured (same shape; the delta is the 4.19 GiB logits round-trip)
+run suite_fused_ce 2400 python benchmarks/suite.py --only transformer_fused_ce
+
+echo "=== addendum done ($(date +%H:%M:%S)) ==="

@@ -415,10 +415,23 @@ def score(params, cfg: TransformerConfig, tokens, lengths=None):
     if lengths is not None:
         # pads must not claim MoE expert capacity (same as loss())
         tmask = jnp.arange(tokens.shape[1] - 1)[None, :] < lengths[:, None]
-    logits, _ = _forward(params, cfg, tokens[:, :-1], token_mask=tmask)
     targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(at_least_f32(logits), axis=-1)
-    gold = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if cfg.fused_ce_chunk:
+        # gold log-prob is exactly -(nll): the chunked scan gives it
+        # without materializing [B, T, V] log-probs (long-document
+        # rescoring at 8k+ otherwise pays the same 4 GiB round-trip
+        # the fused loss() avoids)
+        hid, _ = _forward(params, cfg, tokens[:, :-1], token_mask=tmask,
+                          return_hidden=True)
+        gold = -losses_ops.chunked_lm_head_nll(
+            hid, params["lm_head"]["kernel"], targets,
+            chunk=cfg.fused_ce_chunk)
+    else:
+        logits, _ = _forward(params, cfg, tokens[:, :-1],
+                             token_mask=tmask)
+        logp = jax.nn.log_softmax(at_least_f32(logits), axis=-1)
+        gold = jnp.take_along_axis(
+            logp, targets[..., None], axis=-1)[..., 0]
     if lengths is None:
         mask = jnp.ones_like(gold, bool)
     else:

@@ -1078,3 +1078,47 @@ class TestFusedCE:
         for _ in range(30):
             p, state, l = step(p, state)
         assert float(l) < float(l0) - 0.5
+
+
+class TestFusedCEComposition:
+    """fused_ce_chunk must compose with the other loss-path features:
+    score() (gold logp = -nll) and context parallelism (loss_fn
+    delegates to loss(), so the chunked scan runs over the
+    sequence-sharded hidden)."""
+
+    def test_score_matches_plain(self, params):
+        import dataclasses
+        fcfg = dataclasses.replace(CFG, fused_ce_chunk=8)
+        toks = jnp.asarray(
+            np.random.RandomState(5).randint(0, 61, (3, 14)), jnp.int32)
+        lens = jnp.asarray([14, 9, 4])
+        ga, na = T.score(params, CFG, toks, lens)
+        gb, nb = T.score(params, fcfg, toks, lens)
+        np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
+                                   atol=5e-6)
+        np.testing.assert_allclose(np.asarray(na), np.asarray(nb),
+                                   atol=5e-6)
+
+    def test_cp_fused_matches_dense_plain(self):
+        import dataclasses
+
+        from paddle_tpu.core import mesh as mesh_lib
+
+        cfg = T.TransformerConfig(vocab=64, dim=16, n_layers=2,
+                                  n_heads=2, mlp_ratio=2,
+                                  attn_impl="dense")
+        fcfg = dataclasses.replace(cfg, fused_ce_chunk=8)
+        params = T.init_params(jax.random.key(0), cfg)
+        mesh = mesh_lib.build_mesh(
+            mesh_lib.MeshConfig(data=2, model=1, seq=4),
+            devices=jax.devices()[:8])
+        toks_h = np.random.RandomState(0).randint(0, 64, (4, 17)) \
+            .astype(np.int32)
+        toks = jax.device_put(
+            toks_h, jax.NamedSharding(mesh, jax.sharding.PartitionSpec(
+                mesh_lib.DATA_AXIS, None)))
+        cp_loss = T.make_context_parallel_loss(
+            fcfg, mesh, batch_axis=mesh_lib.DATA_AXIS)
+        dense = float(T.loss(params, cfg, jnp.asarray(toks_h)))
+        cp = float(jax.jit(cp_loss)(params, toks))
+        assert abs(dense - cp) < 1e-4, (dense, cp)
