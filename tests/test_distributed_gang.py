@@ -145,6 +145,60 @@ D.sync_hosts("done")
 """
 
 
+MOE_CHILD = r"""
+import json, os, sys
+import scripts.cpu_guard  # pins cpu; config-only, backend stays cold
+
+from paddle_tpu.parallel import distributed as D
+
+addr, pid = sys.argv[1], int(sys.argv[2])
+D.initialize(coordinator_address=addr, num_processes=2, process_id=pid)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from paddle_tpu.core import mesh as mesh_lib
+from paddle_tpu.parallel import moe
+
+devs = jax.devices()
+assert len(devs) == 2, devs
+gmesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=1, model=2),
+                            devices=devs)
+
+# 4 experts over the 2-process model axis: the shard_map EP dispatch's
+# all-to-all token exchange crosses a real process boundary
+t, d, e, f = 16, 8, 4, 16
+params = moe.init_moe_params(jax.random.key(3), e, d, f)
+sharded = moe.shard_moe_params(params, gmesh)
+x = jnp.asarray(np.random.RandomState(4).randn(t, d), jnp.float32)
+
+ep = moe.make_expert_parallel_ffn(gmesh, k=2, capacity_factor=8.0)
+
+@jax.jit
+def fwd_and_grad(p, x):
+    def loss(p):
+        out = ep(p, x)
+        return jnp.mean(out.y ** 2) + 0.01 * out.aux_loss, out
+    (l, out), grads = jax.value_and_grad(loss, has_aux=True)(p)
+    return l, out.y, grads
+
+l, y, grads = fwd_and_grad(sharded, x)
+D.sync_hosts("after-step")
+
+rsum = jax.jit(lambda t: jnp.sum(jnp.abs(t)),
+               out_shardings=NamedSharding(gmesh, P()))
+# SPMD: every process runs the reductions; only the print is primary's
+y_sum = float(rsum(y))
+g_sum = float(sum(rsum(g) for g in jax.tree.leaves(grads)))
+if D.is_primary():
+    print(json.dumps({"loss": float(l), "y_sum": y_sum,
+                      "g_sum": g_sum}), flush=True)
+D.sync_hosts("done")
+"""
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -253,3 +307,33 @@ def test_ctr_sparse_alltoall_gang_matches_single_process(tmp_path):
     np.testing.assert_allclose(
         rec["wide_sum"], float(jnp.sum(jnp.abs(params["wide"][:65]))),
         rtol=1e-5)
+
+
+def test_moe_expert_parallel_gang_matches_single_process(tmp_path):
+    """Third gang case: the MoE expert-parallel shard_map (all-to-all
+    token dispatch + combine, and its BACKWARD) across a real
+    2-process model-axis mesh must reproduce the single-device
+    moe_ffn's loss, outputs, and gradient magnitudes."""
+    rec = _run_gang(tmp_path, MOE_CHILD)
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.parallel import moe
+
+    t, d, e, f = 16, 8, 4, 16
+    params = moe.init_moe_params(jax.random.key(3), e, d, f)
+    x = jnp.asarray(
+        np.random.RandomState(4).randn(t, d), jnp.float32)
+
+    def loss(p):
+        out = moe.moe_ffn(p, x, k=2, capacity_factor=8.0)
+        return jnp.mean(out.y ** 2) + 0.01 * out.aux_loss, out
+
+    (l, out), grads = jax.value_and_grad(loss, has_aux=True)(params)
+    np.testing.assert_allclose(rec["loss"], float(l), rtol=1e-5)
+    np.testing.assert_allclose(
+        rec["y_sum"], float(jnp.sum(jnp.abs(out.y))), rtol=1e-4)
+    np.testing.assert_allclose(
+        rec["g_sum"],
+        float(sum(jnp.sum(jnp.abs(g)) for g in jax.tree.leaves(grads))),
+        rtol=1e-4)
