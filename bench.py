@@ -203,9 +203,9 @@ def bench_resnet(batch_override=None, iters_override=None, emit_fn=None) -> None
          "imgs/sec", round(imgs_per_sec / baseline, 2), **extra)
 
 
-def run_resnet_child(batch, timeout_s: int) -> bool:
+def run_resnet_child(batch, timeout_s: int):
     """Run the headline ResNet bench in a subprocess (`--resnet-only`),
-    re-printing its JSON line. Returns True iff a line was produced.
+    returning its JSON lines (empty list = no number produced).
 
     Isolation matters on the chip: the relay's remote-compile endpoint
     can drop a long bs-256 compile mid-flight (seen 2026-07-31 — an
@@ -217,12 +217,7 @@ def run_resnet_child(batch, timeout_s: int) -> bool:
     if batch:
         cmd.append(str(batch))
     _, lines = run_child(f"resnet child (batch={batch})", cmd, timeout_s)
-    got = False
-    for line in lines:
-        if line.strip().startswith("{"):
-            print(line.strip(), flush=True)
-            got = True
-    return got
+    return [l.strip() for l in lines if l.strip().startswith("{")]
 
 
 def main():
@@ -251,6 +246,12 @@ def main():
             sys.exit(3)
         log("chip alive — running all stages")
 
+    # stage order is empirical, not hypothetical: in the r3 windows the
+    # cheap-compile seq2seq/ctr children completed and a resnet bs-256
+    # remote compile is what wedged the relay — so the heavy resnet
+    # child stays LAST (both north stars are banked before the one
+    # stage that has actually wedged a chip runs), which also matches
+    # the driver's parse-final-line contract without buffering.
     for rec in run_suite_only("seq2seq", timeout):
         if rec.get("bench") == "seq2seq_attn":
             v = rec["tgt_tokens_per_sec"]
@@ -274,14 +275,21 @@ def main():
             emit("decode_new_tokens_per_sec", rec["new_tokens_per_sec"],
                  "tokens/sec", None)
 
-    # headline last; retry once (relay compile-cache may save the rerun),
-    # then fall back to batch 128 — an honest lower number beats none
-    if not run_resnet_child(None, resnet_timeout):
+    # headline last; retry once (relay compile-cache may save the
+    # rerun), then fall back to batch 128 — an honest lower number
+    # beats none. Lines print the moment each attempt returns, so a
+    # later teardown hang can't lose a produced metric.
+    def _print(lines):
+        for line in lines:
+            print(line, flush=True)
+        return bool(lines)
+
+    if not _print(run_resnet_child(None, resnet_timeout)):
         log("resnet: retrying (a finished server-side compile may now "
             "be cached)")
-        if not run_resnet_child(None, resnet_timeout):
+        if not _print(run_resnet_child(None, resnet_timeout)):
             log("resnet: falling back to batch 128")
-            run_resnet_child(128, resnet_timeout)
+            _print(run_resnet_child(128, resnet_timeout))
 
 
 if __name__ == "__main__":

@@ -14,6 +14,23 @@ Usage: python benchmarks/probe_lstm.py [--iters 20]
 
 from __future__ import annotations
 
+# --- r5 campaign guard -------------------------------------------------
+# suite_lstm (the bench this probe explains) HUNG through the axon relay
+# at 08:36-08:55 UTC and its SIGTERM re-wedged the chip (r3 hazard).
+# Until the hang is localized (instrumented bench_lstm progress lines),
+# this probe must not repeat the same claim-and-hang: it would re-wedge
+# the relay right as wait_alive recovers it, ahead of the north-star and
+# headline stages. The lstm diagnostics are requeued in
+# run_r5_tail.sh AFTER every other stage has its number.
+import os as _os
+if _os.environ.get("PROBE_LSTM_ARMED") != "1":
+    print("probe_lstm: DISARMED for the r5 main campaign "
+          "(suite_lstm wedged the relay; see results_v5e1.md r5). "
+          "Set PROBE_LSTM_ARMED=1 to run.", flush=True)
+    raise SystemExit(0)
+# -----------------------------------------------------------------------
+
+
 import argparse
 import os
 import sys

@@ -1,0 +1,83 @@
+#!/bin/bash
+# Round-5 tail: the LSTM measurements, requeued AFTER every other stage
+# has its number. suite_lstm hung >20 min through the relay at 08:36
+# (first-ever remote Mosaic compile of the fused Pallas LSTM kernel —
+# the GRU kernel and flash attention compiled fine in r1/r3) and its
+# timeout kill wedged the chip, so the LSTM rows now run:
+#   1. with PADDLE_TPU_RNN_IMPL=xla — the safe scan path (r1 parity,
+#      answers the h256/h512 inversion question, cannot hang),
+#   2. ONE guarded fused-kernel attempt DEAD LAST: if it wedges the
+#      relay, nothing is behind it.
+set -u
+cd "$(dirname "$0")/.."
+. benchmarks/r5_common.sh
+mkdir -p benchmarks/r5_logs
+
+# wait for the addendum (which itself waits for the main campaign)
+while ! grep -q "addendum done\|addendum aborted\|still running at STOP_EPOCH" \
+        benchmarks/r5_logs/addendum_console.txt 2>/dev/null; do
+  if [ "$(date +%s)" -ge "$STOP_EPOCH" ]; then
+    echo "=== addendum still waiting at STOP_EPOCH — tail aborted ==="
+    exit 0
+  fi
+  sleep 60
+done
+
+wait_alive() {
+  while true; do
+    if [ "$(date +%s)" -ge "$STOP_EPOCH" ]; then
+      echo "=== chip still wedged at STOP_EPOCH — aborting tail ==="
+      exit 0
+    fi
+    if chip_probe >> benchmarks/r5_logs/realive.log 2>&1; then
+      echo "    (chip alive again $(date +%H:%M:%S))"
+      return
+    fi
+    echo "    (chip not answering, re-probe in 300s)"
+    sleep 300
+  done
+}
+
+run() {  # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  local now=$(date +%s)
+  if [ "$now" -ge "$STOP_EPOCH" ]; then
+    echo "=== $name SKIPPED (past STOP_EPOCH) ==="
+    return
+  fi
+  local budget=$(( STOP_EPOCH - now ))
+  if [ "$tmo" -gt "$budget" ]; then tmo=$budget; fi
+  echo "=== $name ($(date +%H:%M:%S), budget ${tmo}s) ==="
+  timeout "$tmo" "$@" > "benchmarks/r5_logs/$name.out" 2> "benchmarks/r5_logs/$name.err"
+  local rc=$?
+  echo "    rc=$rc  (tail of out:)"; tail -3 "benchmarks/r5_logs/$name.out" | sed 's/^/    /'
+  if [ "$rc" = 124 ]; then
+    wait_alive
+  fi
+}
+
+echo "=== tail probe ($(date +%H:%M:%S)) ==="
+chip_probe > benchmarks/r5_logs/tail_probe.out 2> benchmarks/r5_logs/tail_probe.err \
+  || wait_alive
+
+# 1. lstm suite rows on the scan path (r1-comparable; the instrumented
+#    bench_lstm progress lines localize any residual hang)
+run suite_lstm_xla 1500 env PADDLE_TPU_RNN_IMPL=xla \
+  python benchmarks/suite.py --only lstm_h256,lstm_h512
+
+# 2. the h256/h512 inversion probe, scan path (the r1 inversion was
+#    measured on this path, so this is the diagnosis that matters)
+run probe_lstm_xla 1500 env PADDLE_TPU_RNN_IMPL=xla PROBE_LSTM_ARMED=1 \
+  python benchmarks/probe_lstm.py
+
+# 3. the big lstm rows from the published table (h1280, b128)
+run suite_lstm_big_xla 1500 env PADDLE_TPU_RNN_IMPL=xla \
+  python benchmarks/suite.py --only lstm_h1280
+
+# 4. ONE fused-kernel attempt, dead last, generous budget: either the
+#    remote Mosaic compile finishes (and the fused-vs-scan A/B lands)
+#    or this wedges the relay with nothing behind it
+run suite_lstm_pallas 2400 env PADDLE_TPU_RNN_IMPL=pallas \
+  python benchmarks/suite.py --only lstm_h256
+
+echo "=== tail done ($(date +%H:%M:%S)) ==="

@@ -161,8 +161,11 @@ def bench_lstm(hidden: int, batch: int, *, seq_len: int = 100,
         nn.Dense(2, name="fc"),
     ])
     rng = jax.random.key(0)
+    progress(f"lstm: eager param init (hidden={hidden})")
     params, mstate = model.init(
         rng, ShapeSpec((batch, seq_len), jnp.int32))
+    jax.block_until_ready(params)
+    progress("lstm: params ready; building train state")
     opt = optim.adam(1e-3)
     state = TrainState.create(params, mstate, opt)
     step = make_train_step(

@@ -100,12 +100,31 @@ def _carry_dtype():
     return jnp.promote_types(default_policy().accum_dtype, jnp.float32)
 
 
+def _resolve_impl(impl: str) -> str:
+    """Apply the PADDLE_TPU_RNN_IMPL env override (see
+    _use_fused_kernel). Every pre-dispatch guard that branches on the
+    impl string must read the RESOLVED value, or an env-forced path
+    would disagree with the guard (e.g. simple_rnn's tanh check)."""
+    import os
+
+    return os.environ.get("PADDLE_TPU_RNN_IMPL", impl)
+
+
 def _use_fused_kernel(impl: str, name: str, mod, b: int, hdim: int) -> bool:
     """Shared impl dispatch for lstm()/gru(): 'pallas' forces the fused
     kernel and fails loudly when it can't apply; 'auto' takes it on TPU
-    when the shape fits the kernel's VMEM budget; 'xla' keeps the scan."""
+    when the shape fits the kernel's VMEM budget; 'xla' keeps the scan.
+
+    PADDLE_TPU_RNN_IMPL=auto|pallas|xla overrides the per-call impl
+    for callers that don't expose it (nn.LSTM/GRU layers, the bench
+    suite): the r5 on-chip campaign found the fused LSTM kernel can
+    hang the relay's remote Mosaic compile (>20 min on a kernel that
+    compiles in seconds on CPU interpret), and a timeout-killed
+    claimant wedges the single-claim relay — the override lets a
+    measurement run pin the safe scan path without code edits."""
     from paddle_tpu.core.errors import enforce
 
+    impl = _resolve_impl(impl)
     enforce(impl in ("auto", "pallas", "xla"),
             f"{name} impl must be auto|pallas|xla, got {impl!r}")
     if impl == "pallas":
@@ -265,6 +284,7 @@ def simple_rnn(params, x, lengths=None, *, activation=jnp.tanh,
     from paddle_tpu.ops import pallas_lstm as PL
     from paddle_tpu.ops import pallas_rnn as PR
 
+    impl = _resolve_impl(impl)
     if impl == "pallas":
         enforce(activation is jnp.tanh,
                 "the fused simple_rnn kernel supports only tanh")
