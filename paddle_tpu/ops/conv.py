@@ -327,11 +327,10 @@ def max_pool2d(x, window: IntOr2 = 2, *, stride: Optional[IntOr2] = None,
     differentiability, which custom_vjp functions reject. The default
     (None) reads env PADDLE_TPU_POOL_TIE_SPLIT so the two backward
     formulations can be A/B-benchmarked on the chip without a code
-    edit. Default OFF: the only suite rows ever measured with the
-    custom VJP active were ~25% SLOWER than round 1 (resnet bs64
-    40.4 vs 31.3 ms — results_v5e1.md), and an unmeasured suspect must
-    not sit in the headline path; benchmarks/probe_pool.py's on-chip
-    A/B is the evidence that flips this back.
+    edit. Default OFF, now MEASURED (r5 probe_pool A/B, resnet bs64
+    same-protocol: select_and_scatter 28.17 ms vs tie-split 40.18 ms
+    — the custom VJP costs +43% on the full step on v5e, so the
+    default is the faster formulation, results_v5e1.md r5).
     """
     if tie_split is None:
         tie_split = os.environ.get("PADDLE_TPU_POOL_TIE_SPLIT", "0") != "0"
