@@ -270,3 +270,38 @@ class TestFusedCEResiduals:
         # saving is (to within 10%) exactly the [N, V] f32 logits
         assert base - fused > 0.9 * logits_bytes, (base, fused)
         assert fused < 0.35 * base, (fused, base)
+
+
+class TestGQACacheState:
+    def test_decode_loop_cache_shrinks_with_kv_heads(self):
+        """Claim (e): GQA's win is decode bandwidth — the KV cache the
+        while loop CARRIES (and re-reads every step, the decode
+        bottleneck) must shrink by n_heads/n_kv_heads, and the compact
+        cache must never be expanded back to n_heads inside the loop.
+        H=4 heads, head_dim 8, total length 24: the MHA loop state
+        carries [1,24,4,8] K/V buffers; with n_kv_heads=1 it must carry
+        [1,24,1,8] and no [1,24,4,8] tensor may appear in the loop."""
+        import dataclasses
+
+        from paddle_tpu.models import transformer as T
+
+        base = T.TransformerConfig(vocab=48, dim=32, n_layers=1,
+                                   n_heads=4, attn_impl="dense")
+        prompt = jnp.zeros((1, 8), jnp.int32)  # + 16 steps = total 24
+
+        def while_text(cfg):
+            params = T.init_params(jax.random.key(0), cfg)
+            txt = jax.jit(
+                lambda p, toks: T.generate(p, cfg, toks, steps=16)
+            ).lower(params, prompt).compile().as_text()
+            wl = _while_lines(txt)
+            assert wl, "decode did not compile to a while loop"
+            return "\n".join(wl)
+
+        mha = while_text(base)
+        gqa = while_text(dataclasses.replace(base, n_kv_heads=1))
+        assert "[1,24,4,8]" in mha, mha[:400]
+        assert "[1,24,1,8]" in gqa, gqa[:400]
+        assert "[1,24,4,8]" not in gqa, (
+            "GQA decode loop materializes a full-head cache — the "
+            "4x bandwidth win is lost")
