@@ -494,7 +494,7 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
                  dim: int = 512, n_layers: int = 8, n_heads: int = 8,
                  vocab: int = 32000, iters: int = 5,
                  modes=("greedy", "sample", "beam", "gqa", "int8",
-                        "spec", "swa")):
+                        "int8kv", "spec", "swa")):
     """KV-cache decode throughput (new tokens/sec) per decode mode —
     the serving latency analog of the reference's C-API forward path
     (reference: capi/gradient_machine.h; the SequenceGenerator is the
@@ -572,6 +572,22 @@ def bench_decode(*, batch: int = 8, prompt_len: int = 128, steps: int = 128,
         dt = timed("int8", gen_q, qp, prompt)
         print(json.dumps({
             "bench": "decode_int8", **base,
+            "new_tokens_per_sec": round(batch * steps / dt, 1)}),
+            flush=True)
+
+    if "int8kv" in modes:
+        # int8 KV cache (r5): the cache is the decode-bandwidth term
+        # that GROWS with context (weights are constant) — s8+scale
+        # halves the bf16 cache bytes per step. Loop-state evidence:
+        # tests/test_compiled_cost.py::TestInt8KVCacheState
+        import dataclasses as _dc
+
+        qkv_cfg = _dc.replace(cfg, kv_cache_dtype="int8")
+        gen_kv = jax.jit(lambda p, toks: T.generate(
+            p, qkv_cfg, toks, steps=steps))
+        dt = timed("int8kv", gen_kv, params, prompt)
+        print(json.dumps({
+            "bench": "decode_int8kv", **base,
             "new_tokens_per_sec": round(batch * steps / dt, 1)}),
             flush=True)
 

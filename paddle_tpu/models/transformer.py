@@ -94,6 +94,15 @@ class TransformerConfig:
     # MXU). None = plain path. Affects loss() only — apply()/score()/
     # decode still materialize logits where callers consume them.
     fused_ce_chunk: Optional[int] = None
+    # decode KV-cache precision: "compute" stores K/V in the compute
+    # dtype; "int8" stores s8 data + one scale per (position, kv-head)
+    # (absmax over head_dim, LOSSY), quantized at write and dequantized
+    # fused into each step's attention reads — the cache is the decode
+    # bandwidth bottleneck that GROWS with context (weights are
+    # constant), and s8+scale is ~1/2 the bytes of a bf16 cache at
+    # head_dim 64. generate()/sample() only; beam and speculative
+    # decode raise (their window-attention path reads fp buffers).
+    kv_cache_dtype: str = "compute"
     # sparsely-activated FFN (GLaM-style): every `moe_every`-th block
     # swaps its dense MLP for `moe_experts` experts with top-`moe_k`
     # routing; 0 experts = all-dense
@@ -564,6 +573,22 @@ def _band_valid(slots, t, window):
     return (slots <= t) & (slots > t - window)
 
 
+def _kv_quantize(x):
+    """[B, T, Hkv, Dh] fp -> (s8 data, f32 scale [B, T, Hkv]): absmax
+    symmetric per (position, kv-head) — one scale per cached vector, so
+    dequant is an elementwise mul XLA fuses into the attention einsum's
+    operand read (the same fusion the int8 weight streaming relies on,
+    tests/test_compiled_cost.py::TestInt8DecodeLoop)."""
+    xf = at_least_f32(x)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def _kv_dequantize(q, scale, dtype):
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def _cached_attention(q, k, v, k_buf, v_buf, t, valid):
     """THE single-position decode attention: write this step's K/V at
     cache slot t, attend the 1-position q over `valid` cache keys
@@ -574,21 +599,39 @@ def _cached_attention(q, k, v, k_buf, v_buf, t, valid):
     Under GQA the buffers hold COMPACT [B, total, Hkv, Dh] K/V; the
     grouped einsums read them directly (q reshaped to [.., Hkv, G, ..])
     so the per-step HBM read — the decode bottleneck — stays 1/G of the
-    MHA cache, which is the entire point of GQA."""
+    MHA cache, which is the entire point of GQA.
+
+    k_buf/v_buf may be `(s8 data, scale)` pairs (cfg.kv_cache_dtype
+    "int8"): this step's K/V are quantized before the write and the
+    buffers dequantize inside the einsum reads, so the loop state — and
+    the per-step HBM traffic — stays s8."""
     b, tq, h, dh = q.shape
-    hkv = k_buf.shape[2]
+    quantized = isinstance(k_buf, tuple)
+    if quantized:
+        kq, ks = k_buf
+        vq, vs = v_buf
+        knew, knew_s = _kv_quantize(k)
+        vnew, vnew_s = _kv_quantize(v)
+        upd = jax.lax.dynamic_update_slice_in_dim
+        k_buf = (upd(kq, knew, t, axis=1), upd(ks, knew_s, t, axis=1))
+        v_buf = (upd(vq, vnew, t, axis=1), upd(vs, vnew_s, t, axis=1))
+        k_read = _kv_dequantize(*k_buf, q.dtype)
+        v_read = _kv_dequantize(*v_buf, q.dtype)
+    else:
+        k_buf = jax.lax.dynamic_update_slice_in_dim(k_buf, k, t, axis=1)
+        v_buf = jax.lax.dynamic_update_slice_in_dim(v_buf, v, t, axis=1)
+        k_read, v_read = k_buf, v_buf
+    hkv = k_read.shape[2]
     g = h // hkv  # 1 for MHA — the grouped path IS the only path
-    k_buf = jax.lax.dynamic_update_slice_in_dim(k_buf, k, t, axis=1)
-    v_buf = jax.lax.dynamic_update_slice_in_dim(v_buf, v, t, axis=1)
     scale = jnp.sqrt(jnp.asarray(dh, q.dtype))
     qg = q.reshape(b, tq, hkv, g, dh)
-    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_buf) / scale
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_read) / scale
     # [B, Hkv, G, Tq, Tk] -> flatten head groups for the shared mask
     scores = at_least_f32(scores).reshape(b, h, tq, -1)
     scores = jnp.where(valid, scores, -1e30)
     w = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     wg = w.reshape(b, hkv, g, tq, -1)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", wg, v_buf)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", wg, v_read)
     return out.reshape(b, tq, h, dh), k_buf, v_buf
 
 
@@ -625,6 +668,10 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
             "attn_window with variable-length prompts is unsupported: "
             "cache slots and rope positions disagree for padded rows, "
             "so a slot-index window band would be wrong")
+    if cfg.kv_cache_dtype not in ("compute", "int8"):
+        raise ValueError(
+            f"kv_cache_dtype must be compute|int8, got "
+            f"{cfg.kv_cache_dtype!r}")
     if select_fn is None:
         select_fn = lambda logits, r: jnp.argmax(logits, axis=-1)
     if rng is None:
@@ -690,6 +737,10 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
                 .at[:, :t0].set(k)
             v_buf = jnp.zeros((b, total) + v.shape[2:], v.dtype) \
                 .at[:, :t0].set(v)
+        if cfg.kv_cache_dtype == "int8":
+            # quantize the whole prefilled buffer once (zero slots
+            # quantize to 0); from here the scan carries s8 + scales
+            k_buf, v_buf = _kv_quantize(k_buf), _kv_quantize(v_buf)
         caches.append((k_buf, v_buf))
     # only the last REAL position's logits matter
     rng, first_rng = jax.random.split(rng)
@@ -801,6 +852,12 @@ def speculative_generate(params, cfg: TransformerConfig,
     finishes `steps` tokens in ceil(steps / (draft_k+1)) rounds, a
     hopeless one in `steps`.
     """
+    if cfg.kv_cache_dtype != "compute" or \
+            draft_cfg.kv_cache_dtype != "compute":
+        raise ValueError(
+            "kv_cache_dtype='int8' is supported by generate()/sample() "
+            "only: the beam/speculative window path reads fp buffers; "
+            "decode with generate, or clear kv_cache_dtype")
     b, t0 = prompt.shape
     if t0 < 2:
         raise ValueError("need a >=2-token prompt (prefill t0-1, then "
@@ -948,6 +1005,12 @@ def speculative_sample(params, cfg: TransformerConfig,
 
     return_stats=True also returns per-row round counts [B].
     """
+    if cfg.kv_cache_dtype != "compute" or \
+            draft_cfg.kv_cache_dtype != "compute":
+        raise ValueError(
+            "kv_cache_dtype='int8' is supported by generate()/sample() "
+            "only: the beam/speculative window path reads fp buffers; "
+            "decode with generate, or clear kv_cache_dtype")
     b, t0 = prompt.shape
     if t0 < 2:
         raise ValueError("need a >=2-token prompt (prefill t0-1, then "
@@ -1097,6 +1160,11 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
     [B, K, T0+steps], scores [B, K]) sorted best-first; without an
     eos_id every beam runs the full `steps`.
     """
+    if cfg.kv_cache_dtype != "compute":
+        raise ValueError(
+            "kv_cache_dtype='int8' is supported by generate()/sample() "
+            "only: the beam/speculative window path reads fp buffers; "
+            "decode with generate, or clear kv_cache_dtype")
     from paddle_tpu.ops import beam_search as bs
 
     b, t0 = prompt.shape

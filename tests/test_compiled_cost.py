@@ -305,3 +305,39 @@ class TestGQACacheState:
         assert "[1,24,4,8]" not in gqa, (
             "GQA decode loop materializes a full-head cache — the "
             "4x bandwidth win is lost")
+
+
+class TestInt8KVCacheState:
+    def test_decode_loop_carries_s8_kv(self):
+        """Claim (f), r5: with kv_cache_dtype="int8" the decode while
+        loop's carried state holds the KV cache as s8 (+ small scale
+        tensors), and no full-size fp KV buffer remains in the loop —
+        the per-step cache read (the bandwidth term that GROWS with
+        context) drops to ~half the bf16 bytes at head_dim-64 serving shapes (+1 scale per vector), 4x vs f32. Shapes chosen unambiguous: total=24 slots,
+        2 kv-heads, head_dim 16."""
+        import dataclasses
+
+        from paddle_tpu.models import transformer as T
+
+        cfg = T.TransformerConfig(vocab=48, dim=32, n_layers=1,
+                                  n_heads=2, attn_impl="dense")
+        prompt = jnp.zeros((1, 8), jnp.int32)  # + 16 steps = total 24
+
+        def while_text(c):
+            params = T.init_params(jax.random.key(0), c)
+            txt = jax.jit(
+                lambda p, toks: T.generate(p, c, toks, steps=16)
+            ).lower(params, prompt).compile().as_text()
+            wl = _while_lines(txt)
+            assert wl, "decode did not compile to a while loop"
+            return "\n".join(wl)
+
+        fp = while_text(cfg)
+        q8 = while_text(dataclasses.replace(cfg, kv_cache_dtype="int8"))
+        assert "s8[1,24,2,16]" in q8, q8[:500]
+        assert "s8[" not in fp
+        # the fp-size cache must not ALSO ride the loop (that would be
+        # dequant-hoisting — the cache analog of the weights failure)
+        for fp_kind in ("f32[1,24,2,16]", "bf16[1,24,2,16]",
+                        "f64[1,24,2,16]"):
+            assert fp_kind not in q8, fp_kind

@@ -1122,3 +1122,71 @@ class TestFusedCEComposition:
         dense = float(T.loss(params, cfg, jnp.asarray(toks_h)))
         cp = float(jax.jit(cp_loss)(params, toks))
         assert abs(dense - cp) < 1e-4, (dense, cp)
+
+
+class TestInt8KVCache:
+    """kv_cache_dtype="int8": the decode cache stores s8 + per-(pos,
+    kv-head) scales, quantized at write, dequantized inside the
+    attention reads. Lossy by design — the tests assert near-exact
+    token agreement at small configs plus composition with the other
+    decode features; the loop-state evidence lives in
+    test_compiled_cost.py::TestInt8KVCacheState."""
+
+    def _gen(self, cfg, params, prompt, steps=16, **kw):
+        import dataclasses
+        q = dataclasses.replace(cfg, kv_cache_dtype="int8")
+        a = T.generate(params, cfg, prompt, steps=steps, **kw)
+        b = T.generate(params, q, prompt, steps=steps, **kw)
+        return a, b
+
+    def test_tokens_agree_with_fp_cache(self, params):
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(0, 61, (3, 9)), jnp.int32)
+        a, b = self._gen(CFG, params, prompt)
+        assert a.shape == b.shape
+        agree = float(jnp.mean((a == b).astype(jnp.float32)))
+        assert agree >= 0.95, agree
+
+    def test_composes_with_gqa_and_window(self):
+        import dataclasses
+        cfg = T.TransformerConfig(vocab=61, dim=32, n_layers=2,
+                                  n_heads=4, n_kv_heads=2,
+                                  attn_window=6, attn_impl="dense")
+        p = T.init_params(jax.random.key(3), cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(3).randint(0, 61, (2, 5)), jnp.int32)
+        a, b = self._gen(cfg, p, prompt, steps=12)  # rolling ring cache
+        agree = float(jnp.mean((a == b).astype(jnp.float32)))
+        assert agree >= 0.9, agree
+
+    def test_composes_with_varlen_prompts_and_int8_weights(self, params):
+        from paddle_tpu.serve import quant
+        qp = quant.quantize_params(params)
+        prompt = jnp.asarray(
+            np.random.RandomState(4).randint(0, 61, (3, 8)), jnp.int32)
+        lens = jnp.asarray([8, 5, 2])
+        a, b = self._gen(CFG, qp, prompt, steps=10, prompt_lens=lens)
+        agree = float(jnp.mean((a == b).astype(jnp.float32)))
+        assert agree >= 0.9, agree
+
+    def test_sample_path_runs(self, params):
+        import dataclasses
+        q = dataclasses.replace(CFG, kv_cache_dtype="int8")
+        prompt = jnp.asarray(
+            np.random.RandomState(5).randint(0, 61, (2, 6)), jnp.int32)
+        out = T.sample(params, q, prompt, steps=8,
+                       rng=jax.random.key(1), temperature=0.8)
+        assert out.shape == (2, 14)
+
+    def test_beam_and_spec_raise(self, params):
+        import dataclasses
+        q = dataclasses.replace(CFG, kv_cache_dtype="int8")
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        with pytest.raises(ValueError, match="generate"):
+            T.beam_decode(params, q, prompt, steps=2)
+        with pytest.raises(ValueError, match="generate"):
+            T.speculative_generate(params, q, params, q, prompt, steps=2)
+        with pytest.raises(ValueError, match="compute|int8"):
+            T.generate(params,
+                       dataclasses.replace(CFG, kv_cache_dtype="fp4"),
+                       prompt, steps=2)
