@@ -593,8 +593,15 @@ def _cached_attention(q, k, v, k_buf, v_buf, t, valid):
     """THE single-position decode attention: write this step's K/V at
     cache slot t, attend the 1-position q over `valid` cache keys
     ([..., total] bool, broadcastable over [B, H, 1, total]). Returns
-    (out, k_buf, v_buf). Every decode path (greedy/sampled/beam) runs
-    THIS math so a scoring change cannot diverge between them.
+    (out, k_buf, v_buf). Every decode path (greedy/sampled/beam/the
+    serving engine) runs THIS math so a scoring change cannot diverge
+    between them.
+
+    t may be a SCALAR (all rows write the same slot — generate/beam's
+    lockstep scan) or a [B] VECTOR of per-row slots (serve.engine's
+    continuous batching, where slots are deliberately NOT in lockstep);
+    vector writes use scatter mode="drop", so an out-of-range sentinel
+    slot (the engine's inactive-row convention) skips the write.
 
     Under GQA the buffers hold COMPACT [B, total, Hkv, Dh] K/V; the
     grouped einsums read them directly (q reshaped to [.., Hkv, G, ..])
@@ -606,20 +613,32 @@ def _cached_attention(q, k, v, k_buf, v_buf, t, valid):
     buffers dequantize inside the einsum reads, so the loop state — and
     the per-step HBM traffic — stays s8."""
     b, tq, h, dh = q.shape
+    if getattr(t, "ndim", 0) == 1:
+        assert tq == 1, "per-row slot writes require single-position q"
+        rows = jnp.arange(b)
+
+        def write(buf, new):
+            return buf.at[rows, t].set(
+                new[:, 0].astype(buf.dtype), mode="drop")
+    else:
+
+        def write(buf, new):
+            return jax.lax.dynamic_update_slice_in_dim(
+                buf, new.astype(buf.dtype), t, axis=1)
+
     quantized = isinstance(k_buf, tuple)
     if quantized:
         kq, ks = k_buf
         vq, vs = v_buf
         knew, knew_s = _kv_quantize(k)
         vnew, vnew_s = _kv_quantize(v)
-        upd = jax.lax.dynamic_update_slice_in_dim
-        k_buf = (upd(kq, knew, t, axis=1), upd(ks, knew_s, t, axis=1))
-        v_buf = (upd(vq, vnew, t, axis=1), upd(vs, vnew_s, t, axis=1))
+        k_buf = (write(kq, knew), write(ks, knew_s))
+        v_buf = (write(vq, vnew), write(vs, vnew_s))
         k_read = _kv_dequantize(*k_buf, q.dtype)
         v_read = _kv_dequantize(*v_buf, q.dtype)
     else:
-        k_buf = jax.lax.dynamic_update_slice_in_dim(k_buf, k, t, axis=1)
-        v_buf = jax.lax.dynamic_update_slice_in_dim(v_buf, v, t, axis=1)
+        k_buf = write(k_buf, k)
+        v_buf = write(v_buf, v)
         k_read, v_read = k_buf, v_buf
     hkv = k_read.shape[2]
     g = h // hkv  # 1 for MHA — the grouped path IS the only path

@@ -1,0 +1,291 @@
+"""Continuous-batching decode engine: slot-based serving.
+
+The reference's serving surface decodes one fixed batch to completion
+(reference: api/PaddleAPI.h:1025 SequenceGenerator;
+gserver/gradientmachines/RecurrentGradientMachine.cpp:964 generates a
+whole batch in lockstep). Real serving traffic is a STREAM: requests
+arrive and finish at different times, and a lockstep batch leaves the
+chip idle on every finished row until the whole batch drains. This
+engine keeps a fixed pool of S decode slots — static shapes, so the
+jitted step never recompiles — and the host loop admits a queued
+request into a slot the moment one finishes (continuous batching).
+
+TPU-first choices:
+- ONE jitted `decode_step` advances every active slot a token: the
+  per-slot KV caches are [S, max_len, Hkv, Dh] buffers written with
+  per-row scatters at each slot's own position (slots are NOT in
+  lockstep — that is the point), read under a per-row validity mask.
+- Prefill is a separate jitted function per prompt-length bucket
+  (pad prompts host-side to a few bucket lengths to bound compiles);
+  it runs the SAME `_block_parts` body as training/`generate()`, so
+  model changes cannot diverge between paths.
+- Inactive slots still compute (static shapes) but their writes are
+  dropped (scatter mode="drop" via an out-of-range position sentinel)
+  and their reads masked.
+
+Consistency contract, tested in tests/test_serve_engine.py: a request
+served through the engine yields EXACTLY the tokens of
+`transformer.generate()` on the same prompt — regardless of which
+other requests share the pool or when it was admitted.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.core.dtypes import default_policy
+from paddle_tpu.models import transformer as T
+
+
+class EngineState(NamedTuple):
+    """Device-resident pool state. caches: per layer (k_buf, v_buf),
+    each [S, max_len, Hkv, Dh]. pos[s] = number of cache slots row s
+    has filled (== the next write position); the sentinel pos=max_len
+    on an inactive row makes its scatter writes drop."""
+
+    caches: tuple
+    pos: jnp.ndarray        # [S] int32
+    active: jnp.ndarray     # [S] bool
+    last_tok: jnp.ndarray   # [S] int32
+
+
+class DecodeEngine:
+    """make once per (params, cfg, pool geometry); drive with
+    `init_state` / `prefill` / `decode_step`, or the batteries-included
+    `serve()` host loop."""
+
+    def __init__(self, params, cfg: T.TransformerConfig, *, slots: int,
+                 max_len: int, eos_id: Optional[int] = None):
+        if cfg.attn_window is not None:
+            raise ValueError(
+                "DecodeEngine does not support sliding-window configs "
+                "yet — serve with generate() (rolling cache) instead")
+        if cfg.kv_cache_dtype != "compute":
+            raise ValueError(
+                "DecodeEngine holds fp caches; kv_cache_dtype='int8' "
+                "is a generate()/sample() feature")
+        if cfg.moe_experts > 0:
+            raise ValueError(
+                "DecodeEngine does not support MoE configs yet")
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.max_len = max_len
+        self.eos_id = eos_id
+        self._prefill_jit = jax.jit(self._prefill_impl,
+                                    static_argnames=("t0",))
+        self._step_jit = jax.jit(self._step_impl)
+
+    # -- state ------------------------------------------------------------
+
+    def init_state(self) -> EngineState:
+        cfg, s, L = self.cfg, self.slots, self.max_len
+        policy = default_policy()
+        hkv, dh = cfg.kv_heads, cfg.head_dim
+        caches = tuple(
+            (jnp.zeros((s, L, hkv, dh), policy.compute_dtype),
+             jnp.zeros((s, L, hkv, dh), policy.compute_dtype))
+            for _ in self.params["blocks"])
+        return EngineState(
+            caches=caches,
+            pos=jnp.full((s,), L, jnp.int32),   # sentinel: writes drop
+            active=jnp.zeros((s,), bool),
+            last_tok=jnp.zeros((s,), jnp.int32))
+
+    # -- prefill (one request into one slot) ------------------------------
+
+    def _prefill_impl(self, state: EngineState, slot, prompt, true_len,
+                      t0: int):
+        """prompt [t0] int32 (real tokens in [:true_len], rest padding)
+        -> state with slot's cache rows 0..true_len-1 filled, pos=
+        true_len, active, last_tok = greedy first token. true_len is
+        TRACED, so one compile per padded bucket length serves every
+        real length (the padded tail's cache rows hold garbage that the
+        decode mask never reads: reads stop at pos, and a row is
+        overwritten the step before it first becomes readable)."""
+        cfg, params = self.cfg, self.params
+        policy = default_policy()
+        toks = prompt[None, :]                       # [1, t0]
+        x = jnp.take(params["embed"]["table"], toks, axis=0)
+        x = x.astype(policy.compute_dtype)
+        pos = jnp.arange(t0)[None, :]
+        # pad keys masked out exactly like generate(prompt_lens=...)
+        attn = lambda q, k, v: T._attention(
+            cfg, q, k, v, causal=True, key_lens=true_len[None])
+        caches = []
+        for p, (k_buf, v_buf) in zip(params["blocks"], state.caches):
+            x, k, v, _ = T._block_parts(cfg, p, x, pos, attn)
+            # write this request's K/V rows into its slot
+            k_buf = jax.lax.dynamic_update_slice(
+                k_buf, k.astype(k_buf.dtype),
+                (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0)))
+            v_buf = jax.lax.dynamic_update_slice(
+                v_buf, v.astype(v_buf.dtype),
+                (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0)))
+            caches.append((k_buf, v_buf))
+        # first token reads the LAST REAL position's logits
+        x_last = jax.lax.dynamic_index_in_dim(
+            x[0], true_len - 1, axis=0, keepdims=False)
+        first = jnp.argmax(T._head(params, x_last[None]), axis=-1)[0] \
+            .astype(jnp.int32)
+        return EngineState(
+            caches=tuple(caches),
+            pos=state.pos.at[slot].set(true_len),
+            active=state.active.at[slot].set(True),
+            last_tok=state.last_tok.at[slot].set(first))
+
+    def prefill(self, state: EngineState, slot: int, prompt,
+                true_len: Optional[int] = None) -> EngineState:
+        """Admit a request: fill `slot` from `prompt` [t0]. t0 is
+        STATIC per distinct length (one compile each) — pad prompts
+        host-side to a few bucket lengths and pass the real length as
+        `true_len` (traced: no recompile across real lengths within a
+        bucket; decode matches generate() on the unpadded prompt).
+        The slot's first generated token is in .last_tok[slot]."""
+        t0 = int(prompt.shape[-1])
+        if t0 >= self.max_len:
+            raise ValueError(f"prompt len {t0} >= max_len {self.max_len}")
+        if true_len is None:
+            true_len = t0
+        elif not (1 <= true_len <= t0):
+            raise ValueError(f"true_len {true_len} not in [1, {t0}]")
+        return self._prefill_jit(state, jnp.int32(slot),
+                                 jnp.asarray(prompt, jnp.int32),
+                                 jnp.int32(true_len), t0=t0)
+
+    # -- the batched decode step ------------------------------------------
+
+    def _step_impl(self, state: EngineState):
+        cfg, params = self.cfg, self.params
+        s, L = self.slots, self.max_len
+        policy = default_policy()
+        tok = state.last_tok
+        x = jnp.take(params["embed"]["table"], tok[:, None], axis=0)
+        x = x.astype(policy.compute_dtype)
+        pos = state.pos[:, None]                      # [S, 1] per-row rope
+        # row r attends cache slots < pos[r]+1 (incl. the one written now)
+        valid = (jnp.arange(L)[None, :] <= state.pos[:, None]) \
+            & state.active[:, None]
+        valid4 = valid[:, None, None, :]
+        new_caches = []
+
+        for p, (k_buf, v_buf) in zip(params["blocks"], state.caches):
+
+            def attn(q, k, v, k_buf=k_buf, v_buf=v_buf):
+                # THE shared decode attention (_cached_attention) with
+                # a per-row slot VECTOR: each row writes its own pos[r]
+                # (sentinel pos=L on inactive rows -> scatter drops)
+                out, k_buf, v_buf = T._cached_attention(
+                    q, k, v, k_buf, v_buf, state.pos, valid4)
+                new_caches.append((k_buf, v_buf))
+                return out
+
+            x, _, _, _ = T._block_parts(cfg, p, x, pos, attn)
+        nxt = jnp.argmax(T._head(params, x[:, -1]), axis=-1) \
+            .astype(jnp.int32)
+        # emitted token per row = the token CONSUMED this step (matches
+        # generate(): its scan emits the carry token). A row finishes
+        # when the token it just EMITTED is eos (so eos is part of its
+        # output, like generate), or when it consumed its last cache
+        # slot (nxt could never be processed).
+        emitted = state.last_tok
+        fin = jnp.zeros_like(state.active)
+        if self.eos_id is not None:
+            fin = state.active & (emitted == self.eos_id)
+        fin = fin | (state.active & (state.pos + 1 >= L))
+        cont = state.active & ~fin
+        new_state = EngineState(
+            caches=tuple(new_caches),
+            pos=jnp.where(cont, state.pos + 1, jnp.int32(L)),
+            active=cont,
+            last_tok=nxt)
+        return new_state, emitted, state.active, fin
+
+    def decode_step(self, state: EngineState):
+        """Advance every active slot one token. Returns (state,
+        emitted [S] int32, was_active [S] bool, finished [S] bool):
+        emitted[r] is meaningful where was_active[r]; finished rows
+        have just emitted their final token (eos or cache-full) and
+        their slot is free for the next prefill."""
+        return self._step_jit(state)
+
+    # -- batteries-included host scheduler --------------------------------
+
+    def serve(self, prompts, *, max_new: int, buckets=None):
+        """Serve a list of 1-D int32 prompts through the S-slot pool:
+        admit while slots free, step, collect, refill — the continuous
+        part. Returns per-request generated-token lists (eos included,
+        like generate()); each equals the generate() tokens for that
+        prompt (engine consistency test). max_new bounds every request
+        (cache capacity bounds it too).
+
+        buckets: optional ascending prompt-length buckets (e.g.
+        (32, 128, 512)): each prompt is padded to the smallest bucket
+        >= its length, so prefill compiles once PER BUCKET instead of
+        per distinct length; the real length rides through `true_len`,
+        so the decode is still exactly the unpadded generate()."""
+        import numpy as np
+
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+
+        def bucketed(p):
+            t0 = int(p.shape[-1])
+            if buckets is None:
+                return p, t0
+            fits = [b for b in sorted(buckets) if b >= t0]
+            if not fits:
+                raise ValueError(
+                    f"prompt len {t0} exceeds largest bucket "
+                    f"{max(buckets)}")
+            pad = fits[0] - t0
+            return np.pad(np.asarray(p), (0, pad)), t0
+
+        state = self.init_state()
+        queue = list(range(len(prompts)))
+        slot_req = [-1] * self.slots          # which request owns a slot
+        emitted: dict[int, list] = {i: [] for i in range(len(prompts))}
+        remaining = [max_new] * len(prompts)
+
+        def admit():
+            nonlocal state
+            for slot in range(self.slots):
+                if slot_req[slot] == -1 and queue:
+                    req = queue.pop(0)
+                    padded, true_len = bucketed(prompts[req])
+                    state = self.prefill(state, slot, padded,
+                                         true_len=true_len)
+                    slot_req[slot] = req
+
+        admit()
+        while any(r != -1 for r in slot_req):
+            state, toks, was_active, fin = self.decode_step(state)
+            # ONE host sync per step (the admission decision needs it);
+            # three separate np.asarray calls would each round-trip
+            toks, was_active_h, fin_h = jax.device_get(
+                (toks, was_active, fin))
+            freed = False
+            for slot in range(self.slots):
+                req = slot_req[slot]
+                if req == -1 or not was_active_h[slot]:
+                    continue
+                emitted[req].append(int(toks[slot]))
+                remaining[req] -= 1
+                if fin_h[slot] or remaining[req] <= 0:
+                    if not fin_h[slot]:
+                        # host-side retire (token budget): deactivate
+                        # the device row too so the slot really frees
+                        # (device-finished rows already are)
+                        state = state._replace(
+                            active=state.active.at[slot].set(False),
+                            pos=state.pos.at[slot].set(
+                                jnp.int32(self.max_len)))
+                    slot_req[slot] = -1
+                    freed = True
+            if freed:
+                admit()
+        return [emitted[i] for i in range(len(prompts))]
