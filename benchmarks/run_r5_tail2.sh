@@ -1,0 +1,41 @@
+#!/bin/bash
+# Round-5 tail-2: rows for features built after the tail was armed —
+# the continuous-batching serving engine A/B. Chains behind
+# run_r5_tail.sh; same wedge discipline.
+set -u
+cd "$(dirname "$0")/.."
+. benchmarks/r5_common.sh
+mkdir -p benchmarks/r5_logs
+
+while ! grep -q "tail done\|aborting tail\|tail aborted" \
+        benchmarks/r5_logs/tail_console.txt 2>/dev/null; do
+  if [ "$(date +%s)" -ge "$STOP_EPOCH" ]; then
+    echo "=== tail still waiting at STOP_EPOCH — tail2 aborted ==="
+    exit 0
+  fi
+  sleep 60
+done
+
+run() {  # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  local now=$(date +%s)
+  if [ "$now" -ge "$STOP_EPOCH" ]; then
+    echo "=== $name SKIPPED (past STOP_EPOCH) ==="
+    return
+  fi
+  local budget=$(( STOP_EPOCH - now ))
+  if [ "$tmo" -gt "$budget" ]; then tmo=$budget; fi
+  echo "=== $name ($(date +%H:%M:%S), budget ${tmo}s) ==="
+  timeout "$tmo" "$@" > "benchmarks/r5_logs/$name.out" 2> "benchmarks/r5_logs/$name.err"
+  local rc=$?
+  echo "    rc=$rc  (tail of out:)"; tail -3 "benchmarks/r5_logs/$name.out" | sed 's/^/    /'
+}
+
+echo "=== tail2 probe ($(date +%H:%M:%S)) ==="
+chip_probe > benchmarks/r5_logs/tail2_probe.out 2> benchmarks/r5_logs/tail2_probe.err \
+  || { echo "chip not answering — tail2 aborted"; exit 0; }
+
+# continuous-batching engine vs lockstep baseline (serving throughput)
+run suite_engine 2400 python benchmarks/suite.py --only engine
+
+echo "=== tail2 done ($(date +%H:%M:%S)) ==="
