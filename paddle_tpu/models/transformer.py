@@ -100,8 +100,9 @@ class TransformerConfig:
     # fused into each step's attention reads — the cache is the decode
     # bandwidth bottleneck that GROWS with context (weights are
     # constant), and s8+scale is ~1/2 the bytes of a bf16 cache at
-    # head_dim 64. generate()/sample() only; beam and speculative
-    # decode raise (their window-attention path reads fp buffers).
+    # head_dim 64. Covers generate()/sample() and the serving engine's
+    # slot pool (serve.DecodeEngine); beam and speculative decode raise
+    # (their window-attention path reads fp buffers).
     kv_cache_dtype: str = "compute"
     # sparsely-activated FFN (GLaM-style): every `moe_every`-th block
     # swaps its dense MLP for `moe_experts` experts with top-`moe_k`
@@ -874,9 +875,10 @@ def speculative_generate(params, cfg: TransformerConfig,
     if cfg.kv_cache_dtype != "compute" or \
             draft_cfg.kv_cache_dtype != "compute":
         raise ValueError(
-            "kv_cache_dtype='int8' is supported by generate()/sample() "
-            "only: the beam/speculative window path reads fp buffers; "
-            "decode with generate, or clear kv_cache_dtype")
+            "kv_cache_dtype='int8' covers generate()/sample() and the "
+            "serving engine's slot pool only: the beam/speculative "
+            "window path reads fp buffers; decode with generate or "
+            "serve.DecodeEngine, or clear kv_cache_dtype")
     b, t0 = prompt.shape
     if t0 < 2:
         raise ValueError("need a >=2-token prompt (prefill t0-1, then "
@@ -1027,9 +1029,10 @@ def speculative_sample(params, cfg: TransformerConfig,
     if cfg.kv_cache_dtype != "compute" or \
             draft_cfg.kv_cache_dtype != "compute":
         raise ValueError(
-            "kv_cache_dtype='int8' is supported by generate()/sample() "
-            "only: the beam/speculative window path reads fp buffers; "
-            "decode with generate, or clear kv_cache_dtype")
+            "kv_cache_dtype='int8' covers generate()/sample() and the "
+            "serving engine's slot pool only: the beam/speculative "
+            "window path reads fp buffers; decode with generate or "
+            "serve.DecodeEngine, or clear kv_cache_dtype")
     b, t0 = prompt.shape
     if t0 < 2:
         raise ValueError("need a >=2-token prompt (prefill t0-1, then "
@@ -1181,9 +1184,10 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
     """
     if cfg.kv_cache_dtype != "compute":
         raise ValueError(
-            "kv_cache_dtype='int8' is supported by generate()/sample() "
-            "only: the beam/speculative window path reads fp buffers; "
-            "decode with generate, or clear kv_cache_dtype")
+            "kv_cache_dtype='int8' covers generate()/sample() and the "
+            "serving engine's slot pool only: the beam/speculative "
+            "window path reads fp buffers; decode with generate or "
+            "serve.DecodeEngine, or clear kv_cache_dtype")
     from paddle_tpu.ops import beam_search as bs
 
     b, t0 = prompt.shape

@@ -64,14 +64,19 @@ class DecodeEngine:
             raise ValueError(
                 "DecodeEngine does not support sliding-window configs "
                 "yet — serve with generate() (rolling cache) instead")
-        if cfg.kv_cache_dtype != "compute":
+        if cfg.kv_cache_dtype not in ("compute", "int8"):
             raise ValueError(
-                "DecodeEngine holds fp caches; kv_cache_dtype='int8' "
-                "is a generate()/sample() feature")
+                f"kv_cache_dtype must be compute|int8, got "
+                f"{cfg.kv_cache_dtype!r}")
         if cfg.moe_experts > 0:
             raise ValueError(
                 "DecodeEngine does not support MoE configs yet")
-        self.params = params
+        # weight-only int8 params (serve.quant) use the SAME split as
+        # generate(): prefill reads the hoisted dequant (one-shot,
+        # compute-bound), the per-token step re-traces the dequant
+        # in-body keyed on the loop-varying tokens so the decode
+        # streams s8 weights. Identity (zero cost) for fp params.
+        self.params, self._step_params = T._int8_step_params(params)
         self.cfg = cfg
         self.slots = slots
         self.max_len = max_len
@@ -86,10 +91,18 @@ class DecodeEngine:
         cfg, s, L = self.cfg, self.slots, self.max_len
         policy = default_policy()
         hkv, dh = cfg.kv_heads, cfg.head_dim
-        caches = tuple(
-            (jnp.zeros((s, L, hkv, dh), policy.compute_dtype),
-             jnp.zeros((s, L, hkv, dh), policy.compute_dtype))
-            for _ in self.params["blocks"])
+        def buf():
+            if cfg.kv_cache_dtype == "int8":
+                # (s8 data, per-vector scale) — the SAME quantized-pair
+                # format _cached_attention streams in generate();
+                # constructed directly (zeros quantize to data=0 with
+                # the eps-floor scale) rather than materializing a fp
+                # pool just to quantize known zeros
+                return (jnp.zeros((s, L, hkv, dh), jnp.int8),
+                        jnp.full((s, L, hkv), 1e-8 / 127.0, jnp.float32))
+            return jnp.zeros((s, L, hkv, dh), policy.compute_dtype)
+
+        caches = tuple((buf(), buf()) for _ in self.params["blocks"])
         return EngineState(
             caches=caches,
             pos=jnp.full((s,), L, jnp.int32),   # sentinel: writes drop
@@ -116,17 +129,28 @@ class DecodeEngine:
         # pad keys masked out exactly like generate(prompt_lens=...)
         attn = lambda q, k, v: T._attention(
             cfg, q, k, v, causal=True, key_lens=true_len[None])
+        z = jnp.int32(0)
+
+        def write_slot(buf, new):
+            """Write this request's [1, t0, ...] K/V rows into its
+            slot — quantizing first when the pool holds (s8, scale)
+            pairs (the padded tail quantizes to garbage the decode
+            mask never reads, same as the fp path)."""
+            if isinstance(buf, tuple):
+                d, sc = buf
+                nd, nsc = T._kv_quantize(new)
+                d = jax.lax.dynamic_update_slice(
+                    d, nd, (slot, z, z, z))
+                sc = jax.lax.dynamic_update_slice(
+                    sc, nsc.astype(sc.dtype), (slot, z, z))
+                return (d, sc)
+            return jax.lax.dynamic_update_slice(
+                buf, new.astype(buf.dtype), (slot, z, z, z))
+
         caches = []
         for p, (k_buf, v_buf) in zip(params["blocks"], state.caches):
             x, k, v, _ = T._block_parts(cfg, p, x, pos, attn)
-            # write this request's K/V rows into its slot
-            k_buf = jax.lax.dynamic_update_slice(
-                k_buf, k.astype(k_buf.dtype),
-                (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0)))
-            v_buf = jax.lax.dynamic_update_slice(
-                v_buf, v.astype(v_buf.dtype),
-                (slot, jnp.int32(0), jnp.int32(0), jnp.int32(0)))
-            caches.append((k_buf, v_buf))
+            caches.append((write_slot(k_buf, k), write_slot(v_buf, v)))
         # first token reads the LAST REAL position's logits
         x_last = jax.lax.dynamic_index_in_dim(
             x[0], true_len - 1, axis=0, keepdims=False)
@@ -160,7 +184,8 @@ class DecodeEngine:
     # -- the batched decode step ------------------------------------------
 
     def _step_impl(self, state: EngineState):
-        cfg, params = self.cfg, self.params
+        cfg = self.cfg
+        params = self._step_params(state.last_tok)
         s, L = self.slots, self.max_len
         policy = default_policy()
         tok = state.last_tok

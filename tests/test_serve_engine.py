@@ -92,10 +92,28 @@ class TestEngineConsistency:
 
     def test_unsupported_configs_raise(self, params):
         for bad in (dataclasses.replace(CFG, attn_window=8),
-                    dataclasses.replace(CFG, kv_cache_dtype="int8"),
+                    dataclasses.replace(CFG, kv_cache_dtype="fp4"),
                     dataclasses.replace(CFG, moe_experts=2)):
             with pytest.raises(ValueError):
                 DecodeEngine(params, bad, slots=2, max_len=16)
+
+    def test_int8_kv_pool_matches_int8_generate(self, params):
+        """The int8-KV slot pool must reproduce generate()'s int8-KV
+        decode: both quantize the same vectors with the same
+        per-vector scales, so tokens agree (bit-identical quant data;
+        only float-accum order differs)."""
+        cfg = dataclasses.replace(CFG, kv_cache_dtype="int8")
+        eng = DecodeEngine(params, cfg, slots=2, max_len=32)
+        ps = prompts_rng(3, [5, 8, 6], seed=11)
+        got = eng.serve(ps, max_new=10)
+        agree_total = n_total = 0
+        for p, g in zip(ps, got):
+            out = T.generate(params, cfg, jnp.asarray(p)[None, :],
+                             steps=10)
+            ref = [int(t) for t in np.asarray(out[0, len(p):])]
+            agree_total += sum(a == b for a, b in zip(g, ref))
+            n_total += len(ref)
+        assert agree_total / n_total >= 0.95, (agree_total, n_total)
 
     def test_gqa_pool(self):
         cfg = dataclasses.replace(CFG, n_kv_heads=2)
@@ -129,3 +147,17 @@ class TestBuckets:
         eng = DecodeEngine(params, CFG, slots=1, max_len=16)
         with pytest.raises(ValueError, match="max_new"):
             eng.serve(prompts_rng(1, [4], seed=9), max_new=0)
+
+
+def test_int8_weights_pool(params):
+    """Quantized WEIGHTS through the engine (the generate() streaming
+    split: hoisted dequant for prefill, in-body for the step): tokens
+    match the quantized generate()."""
+    from paddle_tpu.serve import quant
+    qp = quant.quantize_params(params)
+    eng = DecodeEngine(qp, CFG, slots=2, max_len=24)
+    ps = prompts_rng(3, [4, 6, 5], seed=17)
+    got = eng.serve(ps, max_new=6)
+    for p, g in zip(ps, got):
+        out = T.generate(qp, CFG, jnp.asarray(p)[None, :], steps=6)
+        assert g == [int(t) for t in np.asarray(out[0, len(p):])], p
