@@ -23,10 +23,15 @@ TPU-first choices:
   dropped (scatter mode="drop" via an out-of-range position sentinel)
   and their reads masked.
 
-Consistency contract, tested in tests/test_serve_engine.py: a request
-served through the engine yields EXACTLY the tokens of
-`transformer.generate()` on the same prompt — regardless of which
-other requests share the pool or when it was admitted.
+Consistency contract, tested in tests/test_serve_engine.py: a GREEDY
+(default select_fn) request served through the engine yields EXACTLY
+the tokens of `transformer.generate()` on the same prompt — regardless
+of which other requests share the pool or when it was admitted.
+SAMPLED serving (select_fn=make_sampler(...)) is reproducible per
+(seed, admission order) but is its own rng stream: the split schedule
+and a request's slot row both feed its draws, so tokens intentionally
+differ from `transformer.sample()` and can depend on co-tenancy —
+temperature=0 degenerates to the exact greedy contract.
 """
 
 from __future__ import annotations
@@ -45,12 +50,15 @@ class EngineState(NamedTuple):
     """Device-resident pool state. caches: per layer (k_buf, v_buf),
     each [S, max_len, Hkv, Dh]. pos[s] = number of cache slots row s
     has filled (== the next write position); the sentinel pos=max_len
-    on an inactive row makes its scatter writes drop."""
+    on an inactive row makes its scatter writes drop. rng advances one
+    split per prefill/step so sampled serving is reproducible per
+    (seed, admission order)."""
 
     caches: tuple
     pos: jnp.ndarray        # [S] int32
     active: jnp.ndarray     # [S] bool
     last_tok: jnp.ndarray   # [S] int32
+    rng: jnp.ndarray        # key
 
 
 class DecodeEngine:
@@ -59,7 +67,13 @@ class DecodeEngine:
     `serve()` host loop."""
 
     def __init__(self, params, cfg: T.TransformerConfig, *, slots: int,
-                 max_len: int, eos_id: Optional[int] = None):
+                 max_len: int, eos_id: Optional[int] = None,
+                 select_fn=None, seed: int = 0):
+        """select_fn(logits [B, V], rng) -> [B] picks each next token
+        for EVERY pooled request (transformer.make_sampler builds
+        temperature/top-k/top-p selectors; None = greedy). Sampling is
+        reproducible per (seed, admission order); per-REQUEST sampler
+        params would need per-slot parameter arrays — not yet built."""
         if cfg.attn_window is not None:
             raise ValueError(
                 "DecodeEngine does not support sliding-window configs "
@@ -81,6 +95,10 @@ class DecodeEngine:
         self.slots = slots
         self.max_len = max_len
         self.eos_id = eos_id
+        if select_fn is None:
+            select_fn = lambda logits, rng: jnp.argmax(logits, axis=-1)
+        self.select_fn = select_fn
+        self.seed = seed
         self._prefill_jit = jax.jit(self._prefill_impl,
                                     static_argnames=("t0",))
         self._step_jit = jax.jit(self._step_impl)
@@ -107,7 +125,8 @@ class DecodeEngine:
             caches=caches,
             pos=jnp.full((s,), L, jnp.int32),   # sentinel: writes drop
             active=jnp.zeros((s,), bool),
-            last_tok=jnp.zeros((s,), jnp.int32))
+            last_tok=jnp.zeros((s,), jnp.int32),
+            rng=jax.random.key(self.seed))
 
     # -- prefill (one request into one slot) ------------------------------
 
@@ -154,13 +173,15 @@ class DecodeEngine:
         # first token reads the LAST REAL position's logits
         x_last = jax.lax.dynamic_index_in_dim(
             x[0], true_len - 1, axis=0, keepdims=False)
-        first = jnp.argmax(T._head(params, x_last[None]), axis=-1)[0] \
+        rng, sub = jax.random.split(state.rng)
+        first = self.select_fn(T._head(params, x_last[None]), sub)[0] \
             .astype(jnp.int32)
         return EngineState(
             caches=tuple(caches),
             pos=state.pos.at[slot].set(true_len),
             active=state.active.at[slot].set(True),
-            last_tok=state.last_tok.at[slot].set(first))
+            last_tok=state.last_tok.at[slot].set(first),
+            rng=rng)
 
     def prefill(self, state: EngineState, slot: int, prompt,
                 true_len: Optional[int] = None) -> EngineState:
@@ -210,7 +231,8 @@ class DecodeEngine:
                 return out
 
             x, _, _, _ = T._block_parts(cfg, p, x, pos, attn)
-        nxt = jnp.argmax(T._head(params, x[:, -1]), axis=-1) \
+        rng, sub = jax.random.split(state.rng)
+        nxt = self.select_fn(T._head(params, x[:, -1]), sub) \
             .astype(jnp.int32)
         # emitted token per row = the token CONSUMED this step (matches
         # generate(): its scan emits the carry token). A row finishes
@@ -227,7 +249,8 @@ class DecodeEngine:
             caches=tuple(new_caches),
             pos=jnp.where(cont, state.pos + 1, jnp.int32(L)),
             active=cont,
-            last_tok=nxt)
+            last_tok=nxt,
+            rng=rng)
         return new_state, emitted, state.active, fin
 
     def decode_step(self, state: EngineState):
