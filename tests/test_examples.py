@@ -23,6 +23,8 @@ CASES = {
     "transformer_lm.py --moe": ["--steps", "20", "--batch", "4", "--seq-len",
                                 "16", "--dim", "32", "--layers", "1",
                                 "--moe"],
+    "serving.py": ["--steps", "30"],
+    "serving.py --no-quant": ["--steps", "30", "--no-quant"],
 }
 
 
