@@ -65,16 +65,17 @@ def main():
     rng = np.random.RandomState(0)
 
     @jax.jit
-    def step(p, s, toks):
+    def step(p, s, toks, i):
         loss, grads = jax.value_and_grad(
             lambda p: T.loss(p, cfg, toks))(p)
-        p, s = opt.update(grads, s, p, jnp.zeros((), jnp.int32))
+        p, s = opt.update(grads, s, p, i)
         return p, s, loss
 
     print(f"[1/4] training {args.steps} steps ...")
     for i in range(args.steps):
         params, opt_state, loss = step(params, opt_state,
-                                       make_batch(rng, 16, 33))
+                                       make_batch(rng, 16, 33),
+                                       jnp.int32(i))
         if i % 40 == 0:
             print(f"   step {i:4d}  loss {float(loss):.3f}")
     print(f"   final loss {float(loss):.3f}")

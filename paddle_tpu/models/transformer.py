@@ -1306,6 +1306,39 @@ def _filter_logits(logits, temperature, top_k, top_p):
     return logits
 
 
+def per_row_filter_logits(logits, temperature, top_k, top_p):
+    """_filter_logits with PER-ROW parameters (the serving engine's
+    per-request sampling): logits [N, V]; temperature [N] f32 (>0 —
+    the temp=0 greedy degenerate is per_row_sample's job), top_k [N]
+    int (>= V means no truncation), top_p [N] f32 (1.0 = no nucleus).
+    Same sequential-filter semantics as _filter_logits — temperature,
+    then top-k, then nucleus over the top-k-filtered distribution —
+    and exactly equal to it when every row carries the same values."""
+    v = logits.shape[-1]
+    x = at_least_f32(logits) / jnp.maximum(temperature, 1e-6)[:, None]
+    desc = jnp.sort(x, axis=-1)[:, ::-1]
+    k_eff = jnp.clip(top_k, 1, v)
+    kth = jnp.take_along_axis(desc, (k_eff - 1)[:, None], axis=-1)
+    x = jnp.where(x >= kth, x, -jnp.inf)
+    desc = jnp.where(jnp.arange(v)[None, :] < k_eff[:, None], desc,
+                     -jnp.inf)
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    cutoff = jnp.min(jnp.where(cum < top_p[:, None], desc, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(x >= cutoff, x, -jnp.inf)
+
+
+def per_row_sample(logits, temperature, top_k, top_p, rng):
+    """Per-row sampled next tokens [N]: rows with temperature 0 take
+    argmax (exact greedy), the rest draw from their own
+    temperature/top-k/top-p-filtered distribution."""
+    filtered = per_row_filter_logits(logits, temperature, top_k, top_p)
+    draw = jax.random.categorical(rng, filtered, axis=-1)
+    greedy = jnp.argmax(at_least_f32(logits), axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, draw)
+
+
 def make_sampler(*, temperature: float = 1.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None):
     """Build a select_fn for `generate`: temperature scaling, then

@@ -27,11 +27,13 @@ Consistency contract, tested in tests/test_serve_engine.py: a GREEDY
 (default select_fn) request served through the engine yields EXACTLY
 the tokens of `transformer.generate()` on the same prompt — regardless
 of which other requests share the pool or when it was admitted.
-SAMPLED serving (select_fn=make_sampler(...)) is reproducible per
-(seed, admission order) but is its own rng stream: the split schedule
-and a request's slot row both feed its draws, so tokens intentionally
-differ from `transformer.sample()` and can depend on co-tenancy —
-temperature=0 degenerates to the exact greedy contract.
+SAMPLED serving — per request via `serve(sampling=[...])` (per-slot
+temperature/top_k/top_p arrays through one compiled step) or pool-wide
+via select_fn — is reproducible per (seed, admission order) but is its
+own rng stream: the split schedule and a request's slot row both feed
+its draws, so tokens intentionally differ from `transformer.sample()`
+and can depend on co-tenancy — temperature 0 (the per-request default)
+keeps the exact greedy contract, even beside sampled co-tenants.
 """
 
 from __future__ import annotations
@@ -59,6 +61,10 @@ class EngineState(NamedTuple):
     active: jnp.ndarray     # [S] bool
     last_tok: jnp.ndarray   # [S] int32
     rng: jnp.ndarray        # key
+    # per-REQUEST sampler params, set at admission (temp 0 = greedy)
+    temp: jnp.ndarray       # [S] f32
+    top_k: jnp.ndarray      # [S] int32
+    top_p: jnp.ndarray      # [S] f32
 
 
 class DecodeEngine:
@@ -69,11 +75,13 @@ class DecodeEngine:
     def __init__(self, params, cfg: T.TransformerConfig, *, slots: int,
                  max_len: int, eos_id: Optional[int] = None,
                  select_fn=None, seed: int = 0):
-        """select_fn(logits [B, V], rng) -> [B] picks each next token
-        for EVERY pooled request (transformer.make_sampler builds
-        temperature/top-k/top-p selectors; None = greedy). Sampling is
-        reproducible per (seed, admission order); per-REQUEST sampler
-        params would need per-slot parameter arrays — not yet built."""
+        """Sampling, two ways: per REQUEST via serve(sampling=[...])/
+        prefill(sampling={...}) — temperature/top_k/top_p ride
+        per-slot arrays through ONE compiled step (temp 0 = greedy,
+        the default) — or a pool-wide select_fn(logits [B, V], rng)
+        -> [B] override applied to every request (mutually exclusive
+        with per-request sampling). Draws are reproducible per (seed,
+        admission order)."""
         if cfg.attn_window is not None:
             raise ValueError(
                 "DecodeEngine does not support sliding-window configs "
@@ -95,8 +103,6 @@ class DecodeEngine:
         self.slots = slots
         self.max_len = max_len
         self.eos_id = eos_id
-        if select_fn is None:
-            select_fn = lambda logits, rng: jnp.argmax(logits, axis=-1)
         self.select_fn = select_fn
         self.seed = seed
         self._prefill_jit = jax.jit(self._prefill_impl,
@@ -126,12 +132,15 @@ class DecodeEngine:
             pos=jnp.full((s,), L, jnp.int32),   # sentinel: writes drop
             active=jnp.zeros((s,), bool),
             last_tok=jnp.zeros((s,), jnp.int32),
-            rng=jax.random.key(self.seed))
+            rng=jax.random.key(self.seed),
+            temp=jnp.zeros((s,), jnp.float32),
+            top_k=jnp.full((s,), cfg.vocab, jnp.int32),
+            top_p=jnp.ones((s,), jnp.float32))
 
     # -- prefill (one request into one slot) ------------------------------
 
     def _prefill_impl(self, state: EngineState, slot, prompt, true_len,
-                      t0: int):
+                      temp, top_k, top_p, t0: int):
         """prompt [t0] int32 (real tokens in [:true_len], rest padding)
         -> state with slot's cache rows 0..true_len-1 filled, pos=
         true_len, active, last_tok = greedy first token. true_len is
@@ -174,23 +183,38 @@ class DecodeEngine:
         x_last = jax.lax.dynamic_index_in_dim(
             x[0], true_len - 1, axis=0, keepdims=False)
         rng, sub = jax.random.split(state.rng)
-        first = self.select_fn(T._head(params, x_last[None]), sub)[0] \
-            .astype(jnp.int32)
+        logits = T._head(params, x_last[None])
+        if self.select_fn is not None:
+            first = self.select_fn(logits, sub)[0]
+        else:
+            first = T.per_row_sample(logits, temp[None], top_k[None],
+                                     top_p[None], sub)[0]
         return EngineState(
             caches=tuple(caches),
             pos=state.pos.at[slot].set(true_len),
             active=state.active.at[slot].set(True),
-            last_tok=state.last_tok.at[slot].set(first),
-            rng=rng)
+            last_tok=state.last_tok.at[slot].set(
+                first.astype(jnp.int32)),
+            rng=rng,
+            temp=state.temp.at[slot].set(temp),
+            top_k=state.top_k.at[slot].set(top_k),
+            top_p=state.top_p.at[slot].set(top_p))
 
     def prefill(self, state: EngineState, slot: int, prompt,
-                true_len: Optional[int] = None) -> EngineState:
+                true_len: Optional[int] = None,
+                sampling: Optional[dict] = None) -> EngineState:
         """Admit a request: fill `slot` from `prompt` [t0]. t0 is
         STATIC per distinct length (one compile each) — pad prompts
         host-side to a few bucket lengths and pass the real length as
         `true_len` (traced: no recompile across real lengths within a
         bucket; decode matches generate() on the unpadded prompt).
-        The slot's first generated token is in .last_tok[slot]."""
+        The slot's first generated token is in .last_tok[slot].
+
+        sampling: THIS request's sampler params — a dict with any of
+        temperature/top_k/top_p (missing = greedy/no-filter). The
+        values are traced (set into per-slot arrays), so requests with
+        different sampling share one compiled step. Incompatible with
+        a pool-wide select_fn override."""
         t0 = int(prompt.shape[-1])
         if t0 >= self.max_len:
             raise ValueError(f"prompt len {t0} >= max_len {self.max_len}")
@@ -198,9 +222,24 @@ class DecodeEngine:
             true_len = t0
         elif not (1 <= true_len <= t0):
             raise ValueError(f"true_len {true_len} not in [1, {t0}]")
-        return self._prefill_jit(state, jnp.int32(slot),
-                                 jnp.asarray(prompt, jnp.int32),
-                                 jnp.int32(true_len), t0=t0)
+        sampling = sampling or {}
+        if sampling and self.select_fn is not None:
+            raise ValueError(
+                "per-request sampling and a pool-wide select_fn are "
+                "mutually exclusive — drop one")
+        unknown = set(sampling) - {"temperature", "top_k", "top_p"}
+        if unknown:
+            raise ValueError(f"unknown sampling keys {sorted(unknown)}")
+        temp = sampling.get("temperature", 0.0)
+        top_k = sampling.get("top_k")        # None-vs-0 must not blur:
+        top_p = sampling.get("top_p")        # 0 values are ERRORS below
+        T._validate_sampler_args(temp, top_k, top_p)
+        return self._prefill_jit(
+            state, jnp.int32(slot), jnp.asarray(prompt, jnp.int32),
+            jnp.int32(true_len),
+            jnp.float32(temp),
+            jnp.int32(self.cfg.vocab if top_k is None else top_k),
+            jnp.float32(1.0 if top_p is None else top_p), t0=t0)
 
     # -- the batched decode step ------------------------------------------
 
@@ -232,8 +271,21 @@ class DecodeEngine:
 
             x, _, _, _ = T._block_parts(cfg, p, x, pos, attn)
         rng, sub = jax.random.split(state.rng)
-        nxt = self.select_fn(T._head(params, x[:, -1]), sub) \
-            .astype(jnp.int32)
+        logits = T._head(params, x[:, -1])
+        if self.select_fn is not None:
+            nxt = self.select_fn(logits, sub).astype(jnp.int32)
+        else:
+            # all-greedy pools (the default) must not pay the sampled
+            # branch's O(S*V log V) sort per token: cond executes only
+            # the taken branch, and temp is loop state, so a pool that
+            # never admits a sampled request runs pure argmax
+            nxt = jax.lax.cond(
+                jnp.any(state.temp > 0.0),
+                lambda lg, r: T.per_row_sample(
+                    lg, state.temp, state.top_k, state.top_p, r),
+                lambda lg, r: jnp.argmax(
+                    T.at_least_f32(lg), axis=-1),
+                logits, sub).astype(jnp.int32)
         # emitted token per row = the token CONSUMED this step (matches
         # generate(): its scan emits the carry token). A row finishes
         # when the token it just EMITTED is eos (so eos is part of its
@@ -250,7 +302,10 @@ class DecodeEngine:
             pos=jnp.where(cont, state.pos + 1, jnp.int32(L)),
             active=cont,
             last_tok=nxt,
-            rng=rng)
+            rng=rng,
+            temp=state.temp,
+            top_k=state.top_k,
+            top_p=state.top_p)
         return new_state, emitted, state.active, fin
 
     def decode_step(self, state: EngineState):
@@ -263,7 +318,8 @@ class DecodeEngine:
 
     # -- batteries-included host scheduler --------------------------------
 
-    def serve(self, prompts, *, max_new: int, buckets=None):
+    def serve(self, prompts, *, max_new: int, buckets=None,
+              sampling=None):
         """Serve a list of 1-D int32 prompts through the S-slot pool:
         admit while slots free, step, collect, refill — the continuous
         part. Returns per-request generated-token lists (eos included,
@@ -275,11 +331,18 @@ class DecodeEngine:
         (32, 128, 512)): each prompt is padded to the smallest bucket
         >= its length, so prefill compiles once PER BUCKET instead of
         per distinct length; the real length rides through `true_len`,
-        so the decode is still exactly the unpadded generate()."""
+        so the decode is still exactly the unpadded generate().
+
+        sampling: optional per-request sampler params — one dict per
+        prompt (see prefill()); None = greedy for every request."""
         import numpy as np
 
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if sampling is not None and len(sampling) != len(prompts):
+            raise ValueError(
+                f"sampling has {len(sampling)} entries for "
+                f"{len(prompts)} prompts")
 
         def bucketed(p):
             t0 = int(p.shape[-1])
@@ -305,8 +368,9 @@ class DecodeEngine:
                 if slot_req[slot] == -1 and queue:
                     req = queue.pop(0)
                     padded, true_len = bucketed(prompts[req])
-                    state = self.prefill(state, slot, padded,
-                                         true_len=true_len)
+                    state = self.prefill(
+                        state, slot, padded, true_len=true_len,
+                        sampling=(sampling[req] if sampling else None))
                     slot_req[slot] = req
 
         admit()

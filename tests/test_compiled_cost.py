@@ -341,3 +341,35 @@ class TestInt8KVCacheState:
         for fp_kind in ("f32[1,24,2,16]", "bf16[1,24,2,16]",
                         "f64[1,24,2,16]"):
             assert fp_kind not in q8, fp_kind
+
+
+class TestEngineCompiledStep:
+    def test_int8_pool_step_reads_s8(self):
+        """Claim (g): the serving engine's jitted decode step takes the
+        int8 pool as s8 arguments and returns s8 — no fp-size cache
+        tensor appears anywhere in the compiled step, so per-step pool
+        traffic is s8 for the engine exactly as the while-loop state is
+        for generate(). Pool: 3 slots x 24 x 2 kv-heads x 16."""
+        import dataclasses
+
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.serve.engine import DecodeEngine
+
+        cfg = T.TransformerConfig(vocab=48, dim=32, n_layers=1,
+                                  n_heads=2, attn_impl="dense",
+                                  kv_cache_dtype="int8")
+        params = T.init_params(jax.random.key(0), cfg)
+        eng = DecodeEngine(params, cfg, slots=3, max_len=24)
+        state = eng.init_state()
+        txt = eng._step_jit.lower(state).compile().as_text()
+        # the POOL STATE crosses the step boundary as s8: parameters
+        # and the root result carry s8 pool tensors, and no fp-size
+        # pool tensor appears in the entry signature (the per-step
+        # dequant is a transient inside the fused attention reads)
+        sig = [l for l in txt.splitlines()
+               if "ENTRY" in l or "ROOT" in l or " parameter(" in l]
+        sig = "\n".join(sig)
+        assert "s8[3,24,2,16]" in sig, sig[:500]
+        for fp_kind in ("f32[3,24,2,16]", "bf16[3,24,2,16]",
+                        "f64[3,24,2,16]"):
+            assert fp_kind not in sig, (fp_kind, sig[:500])

@@ -182,3 +182,44 @@ class TestEngineSampling:
         a, b, c = mk(0), mk(0), mk(7)
         assert a == b                      # reproducible per seed
         assert a != c                      # and the seed matters
+
+
+class TestPerRequestSampling:
+    def test_greedy_contract_survives_sampled_cotenants(self, params):
+        """Per-request sampling: greedy requests must still match their
+        solo generate() exactly while sampled requests share the
+        pool (the per-slot params isolate them)."""
+        ps = prompts_rng(4, [5, 7, 4, 6], seed=31)
+        sampling = [None, {"temperature": 1.1, "top_p": 0.9},
+                    None, {"temperature": 0.8, "top_k": 10}]
+        eng = DecodeEngine(params, CFG, slots=2, max_len=24)
+        got = eng.serve(ps, max_new=6,
+                        sampling=[s or {} for s in sampling])
+        for i in (0, 2):   # the greedy requests
+            assert got[i] == ref_tokens(params, ps[i], 6), i
+        for i in (1, 3):   # sampled: right length, in-vocab
+            assert len(got[i]) == 6
+            assert all(0 <= t < 61 for t in got[i])
+
+    def test_reproducible_and_seed_sensitive(self, params):
+        ps = prompts_rng(3, [5, 6, 4], seed=32)
+        sampling = [{"temperature": 1.0}] * 3
+        mk = lambda seed: DecodeEngine(
+            params, CFG, slots=2, max_len=24, seed=seed) \
+            .serve(ps, max_new=6, sampling=sampling)
+        assert mk(1) == mk(1)
+        assert mk(1) != mk(5)
+
+    def test_select_fn_conflict_and_bad_keys(self, params):
+        eng = DecodeEngine(params, CFG, slots=1, max_len=16,
+                           select_fn=T.make_sampler(temperature=0.5))
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            eng.serve(prompts_rng(1, [4], seed=33), max_new=2,
+                      sampling=[{"temperature": 1.0}])
+        eng2 = DecodeEngine(params, CFG, slots=1, max_len=16)
+        with pytest.raises(ValueError, match="unknown sampling"):
+            eng2.serve(prompts_rng(1, [4], seed=34), max_new=2,
+                       sampling=[{"temp": 1.0}])
+        with pytest.raises(ValueError, match="entries for"):
+            eng2.serve(prompts_rng(2, [4, 5], seed=35), max_new=2,
+                       sampling=[{}])
