@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-5 tail-3: seq2seq fused-CE A/B against the north-star row
+# (same shapes; the delta is the chunked CE over the 30k-vocab decoder
+# head). Chains behind run_r5_tail2.sh; same wedge discipline.
+set -u
+cd "$(dirname "$0")/.."
+. benchmarks/r5_common.sh
+mkdir -p benchmarks/r5_logs
+
+while ! grep -q "tail2 done\|tail2 aborted\|chip not answering" \
+        benchmarks/r5_logs/tail2_console.txt 2>/dev/null; do
+  if [ "$(date +%s)" -ge "$STOP_EPOCH" ]; then
+    echo "=== tail2 still waiting at STOP_EPOCH — tail3 aborted ==="
+    exit 0
+  fi
+  sleep 60
+done
+
+run() {  # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  local now=$(date +%s)
+  if [ "$now" -ge "$STOP_EPOCH" ]; then
+    echo "=== $name SKIPPED (past STOP_EPOCH) ==="
+    return
+  fi
+  local budget=$(( STOP_EPOCH - now ))
+  if [ "$tmo" -gt "$budget" ]; then tmo=$budget; fi
+  echo "=== $name ($(date +%H:%M:%S), budget ${tmo}s) ==="
+  timeout "$tmo" "$@" > "benchmarks/r5_logs/$name.out" 2> "benchmarks/r5_logs/$name.err"
+  local rc=$?
+  echo "    rc=$rc  (tail of out:)"; tail -3 "benchmarks/r5_logs/$name.out" | sed 's/^/    /'
+}
+
+echo "=== tail3 probe ($(date +%H:%M:%S)) ==="
+chip_probe > benchmarks/r5_logs/tail3_probe.out 2> benchmarks/r5_logs/tail3_probe.err \
+  || { echo "chip not answering — tail3 aborted"; exit 0; }
+
+# seq2seq fused-CE A/B (the plain row re-measures in the same process
+# conditions so the pair is apples-to-apples)
+run suite_seq2seq_fused 2800 python benchmarks/suite.py --only seq2seq,seq2seq_fused_ce
+
+echo "=== tail3 done ($(date +%H:%M:%S)) ==="

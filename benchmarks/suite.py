@@ -187,7 +187,7 @@ def bench_lstm(hidden: int, batch: int, *, seq_len: int = 100,
 
 def bench_seq2seq(batch: int = 64, *, src_len: int = 30, tgt_len: int = 30,
                   hidden: int = 512, embed: int = 256, vocab: int = 30000,
-                  iters: int = 20):
+                  iters: int = 20, fused_ce_chunk=None):
     """Seq2seq-attention NMT training throughput in target tokens/sec —
     the BASELINE.json north star the round-1 suite never measured
     (reference driver analog: benchmark/paddle/rnn/run.sh). Variable-
@@ -212,7 +212,8 @@ def bench_seq2seq(batch: int = 64, *, src_len: int = 30, tgt_len: int = 30,
     @jax.jit
     def step(params, opt_state, src, src_lens, tgt, tgt_lens):
         def loss_fn(p):
-            return seq2seq_attn.loss(p, src, src_lens, tgt, tgt_lens)
+            return seq2seq_attn.loss(p, src, src_lens, tgt, tgt_lens,
+                                     fused_ce_chunk=fused_ce_chunk)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         new_params, new_opt = opt.update(grads, opt_state, params,
@@ -248,7 +249,9 @@ def bench_seq2seq(batch: int = 64, *, src_len: int = 30, tgt_len: int = 30,
     progress(f"seq2seq: done ({1000*dt:.1f} ms/batch)")
     tokens = float(jnp.sum(tgt_lens))
     rec = {
-        "bench": "seq2seq_attn", "batch": batch,
+        "bench": ("seq2seq_attn_fused_ce" if fused_ce_chunk
+                  else "seq2seq_attn"), "batch": batch,
+        **({"fused_ce_chunk": fused_ce_chunk} if fused_ce_chunk else {}),
         "hidden": hidden, "src_len": src_len, "tgt_len": tgt_len,
         "ms_per_batch": round(1000 * dt, 2),
         "tgt_tokens_per_sec": round(tokens / dt, 1),
@@ -834,6 +837,18 @@ def main():
             n_layers=2 if quick else 8, n_heads=2 if quick else 8,
             vocab=500 if quick else 32000, iters=2 if quick else 5,
             **({"modes": ("greedy",)} if "decode" not in only else {}))
+
+    if only and "seq2seq_fused_ce" in only:  # opt-in A/B row (r5)
+        # same shape as the north-star seq2seq row; the delta is the
+        # chunked fused CE over the 30k-vocab decoder head (exact
+        # parity; measured-before-default rule)
+        rec = bench_seq2seq(
+            batch=16 if quick else 64,
+            src_len=8 if quick else 30, tgt_len=8 if quick else 30,
+            hidden=32 if quick else 512, embed=16 if quick else 256,
+            vocab=500 if quick else 30000, iters=iters,
+            fused_ce_chunk=64 if quick else 512)
+        print(json.dumps(rec))
 
     if only and "transformer_fused_ce" in only:  # opt-in A/B row
         # same shape as the default transformer row; the delta is the

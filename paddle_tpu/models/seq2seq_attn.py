@@ -146,9 +146,9 @@ def decoder_group(hidden: int, *, emit: str = "logits") -> RecurrentGroup:
     )
 
 
-def teacher_forced_logits(params, src_tokens, src_lengths, tgt_in):
-    """Training forward: tgt_in [B, T] (bos-prefixed targets) -> logits
-    [B, T, V] via the recurrent-group scan path."""
+def teacher_forced_hidden(params, src_tokens, src_lengths, tgt_in):
+    """Training forward up to the decoder HIDDEN states [B, T, H] —
+    the pre-projection half shared by the plain and fused-CE losses."""
     b, s = src_tokens.shape
     enc_out, h0 = encode(params, src_tokens, src_lengths)
     enc_proj = project_encoder(params, enc_out)  # hoisted
@@ -157,20 +157,42 @@ def teacher_forced_logits(params, src_tokens, src_lengths, tgt_in):
     hs, _ = decoder_group(h0.shape[-1], emit="hidden").run(
         params, emb, boots={"h": h0},
         statics=(enc_out, enc_proj, enc_mask))
+    return hs
+
+
+def teacher_forced_logits(params, src_tokens, src_lengths, tgt_in):
+    """Training forward: tgt_in [B, T] (bos-prefixed targets) -> logits
+    [B, T, V] via the recurrent-group scan path."""
+    hs = teacher_forced_hidden(params, src_tokens, src_lengths, tgt_in)
     # hoisted output projection: one big [B*T, H] x [H, V] matmul
     return linalg.dense(hs, params["out"]["kernel"], params["out"]["bias"])
 
 
 def loss(params, src_tokens, src_lengths, tgt_tokens, tgt_lengths, *,
-         bos_id: int = 1):
-    """Mean per-token CE with teacher forcing."""
+         bos_id: int = 1, fused_ce_chunk=None):
+    """Mean per-token CE with teacher forcing.
+
+    fused_ce_chunk: fold the hoisted [B*T, H] x [H, V] output
+    projection into a checkpointed chunked scan (ops.losses
+    .chunked_lm_head_nll) so the [B, T, V] logits (V=30k dominates the
+    decoder's HBM bytes) never materialize — exact parity with the
+    plain path; OPT-IN until the on-chip A/B row lands a number
+    (`seq2seq_fused_ce` — the measured-before-default rule)."""
     from paddle_tpu.ops import losses
 
     b, t = tgt_tokens.shape
     bos = jnp.full((b, 1), bos_id, tgt_tokens.dtype)
     tgt_in = jnp.concatenate([bos, tgt_tokens[:, :-1]], axis=1)
-    logits = teacher_forced_logits(params, src_tokens, src_lengths, tgt_in)
-    ce = losses.softmax_cross_entropy(logits, tgt_tokens)  # [B, T]
+    if fused_ce_chunk:
+        hs = teacher_forced_hidden(params, src_tokens, src_lengths,
+                                   tgt_in)
+        ce = losses.chunked_lm_head_nll(
+            hs, params["out"]["kernel"], tgt_tokens,
+            chunk=fused_ce_chunk, bias=params["out"]["bias"])
+    else:
+        logits = teacher_forced_logits(params, src_tokens, src_lengths,
+                                       tgt_in)
+        ce = losses.softmax_cross_entropy(logits, tgt_tokens)  # [B, T]
     mask = (jnp.arange(t)[None, :] < tgt_lengths[:, None]).astype(ce.dtype)
     return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 

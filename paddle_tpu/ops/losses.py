@@ -183,7 +183,8 @@ def squared_l2_norm(x):
     return jnp.sum(jnp.square(at_least_f32(x)))
 
 
-def chunked_lm_head_nll(hidden, kernel, targets, *, chunk: int = 2048):
+def chunked_lm_head_nll(hidden, kernel, targets, *, chunk: int = 2048,
+                        bias=None):
     """Next-token NLL fused with the LM-head matmul, never holding the
     full [N, V] logits.
 
@@ -204,9 +205,11 @@ def chunked_lm_head_nll(hidden, kernel, targets, *, chunk: int = 2048):
     takes it one step further by folding in the projection).
 
     hidden [B, T, D] (compute dtype), kernel [D, V], targets [B, T]
-    int. Returns per-position nll [B, T] f32. Bit-compatibility with
-    the unfused path is to matmul-accumulation order only (same ops,
-    chunked lhs), so values match to ~1e-6 relative.
+    int, bias optional [V] (the seq2seq decoder head carries one; the
+    transformer LM head does not). Returns per-position nll [B, T]
+    f32. Bit-compatibility with the unfused path is to
+    matmul-accumulation order only (same ops, chunked lhs), so values
+    match to ~1e-6 relative.
     """
     from paddle_tpu.ops import linalg
 
@@ -226,6 +229,8 @@ def chunked_lm_head_nll(hidden, kernel, targets, *, chunk: int = 2048):
     def body(carry, hy):
         hc, yc = hy
         logits = at_least_f32(linalg.matmul(hc, kernel))
+        if bias is not None:
+            logits = logits + at_least_f32(bias)[None, :]
         lse = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, yc[:, None], axis=-1)[:, 0]
         return carry, lse - gold
