@@ -574,6 +574,19 @@ def _band_valid(slots, t, window):
     return (slots <= t) & (slots > t - window)
 
 
+def _ring_slot_valid(pos, window: int):
+    """THE ring-cache convention, shared by generate()'s rolling scan
+    and the serving engine's per-row pool: position p lives at slot
+    p mod window; after the write at `pos`, ring slot s holds absolute
+    position pos - ((pos - s) mod window), valid iff it exists. pos may
+    be a scalar (lockstep scan) or [S] (per-row pool). Returns
+    (write_slot like pos, valid [..., window])."""
+    p = jnp.asarray(pos)
+    arw = jnp.arange(window)
+    held = p[..., None] - jnp.mod(p[..., None] - arw, window)
+    return jnp.mod(p, window), held >= 0
+
+
 def _kv_quantize(x):
     """[B, T, Hkv, Dh] fp -> (s8 data, f32 scale [B, T, Hkv]): absmax
     symmetric per (position, kv-head) — one scale per cached vector, so
@@ -790,13 +803,11 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
         slot = t
         if prompt_lens is None:
             if rolling:
-                # ring slot s holds absolute position t-((t-s) mod W);
                 # the band (p > t-window) holds by construction, so
-                # validity is just "the position exists"
-                arw = jnp.arange(cache_len)
-                pos_held = t - jnp.mod(t - arw, cache_len)
-                valid = (pos_held >= 0)[None, None, None, :]
-                slot = jnp.mod(t, cache_len)
+                # validity is just "the position exists" — ONE ring
+                # convention shared with the engine (_ring_slot_valid)
+                slot, ring_ok = _ring_slot_valid(t, cache_len)
+                valid = ring_ok[None, None, None, :]
             elif cfg.attn_window is not None:
                 valid = _band_valid(ar, t, cfg.attn_window)[
                     None, None, None, :]

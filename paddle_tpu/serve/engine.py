@@ -14,7 +14,11 @@ TPU-first choices:
 - ONE jitted `decode_step` advances every active slot a token: the
   per-slot KV caches are [S, max_len, Hkv, Dh] buffers written with
   per-row scatters at each slot's own position (slots are NOT in
-  lockstep — that is the point), read under a per-row validity mask.
+  lockstep — that is the point), read under a per-row validity mask;
+  sliding-window configs hold [S, window] RING pools instead (per-row
+  slot = pos mod window — O(window) memory and per-step reads, and a
+  bucketed window prompt still decodes exactly like the unpadded
+  generate(), a combination generate() itself cannot serve).
 - Prefill is a separate jitted function per prompt-length bucket
   (pad prompts host-side to a few bucket lengths to bound compiles);
   it runs the SAME `_block_parts` body as training/`generate()`, so
@@ -82,10 +86,6 @@ class DecodeEngine:
         -> [B] override applied to every request (mutually exclusive
         with per-request sampling). Draws are reproducible per (seed,
         admission order)."""
-        if cfg.attn_window is not None:
-            raise ValueError(
-                "DecodeEngine does not support sliding-window configs "
-                "yet — serve with generate() (rolling cache) instead")
         if cfg.kv_cache_dtype not in ("compute", "int8"):
             raise ValueError(
                 f"kv_cache_dtype must be compute|int8, got "
@@ -112,7 +112,11 @@ class DecodeEngine:
     # -- state ------------------------------------------------------------
 
     def init_state(self) -> EngineState:
-        cfg, s, L = self.cfg, self.slots, self.max_len
+        cfg, s = self.cfg, self.slots
+        # sliding-window configs hold a RING pool: window slots per
+        # row (generate()'s rolling cache, per-row), not max_len
+        L = (cfg.attn_window if cfg.attn_window is not None
+             else self.max_len)
         policy = default_policy()
         hkv, dh = cfg.kv_heads, cfg.head_dim
         def buf():
@@ -175,10 +179,26 @@ class DecodeEngine:
             return jax.lax.dynamic_update_slice(
                 buf, new.astype(buf.dtype), (slot, z, z, z))
 
+        if cfg.attn_window is not None:
+            # ring pool: keep only the last min(true_len, W) REAL
+            # positions, each in its slot p mod W — ring slot s holds
+            # p(s) = (true_len-1) - ((true_len-1 - s) mod W); negative
+            # p(s) (short prompts) gathers a clipped row the decode
+            # mask keeps invalid until overwritten. Padded-bucket rows
+            # never enter the ring: p(s) indexes real positions only.
+            w_ = cfg.attn_window
+            p_slot = (true_len - 1) - jnp.mod(
+                (true_len - 1) - jnp.arange(w_), w_)
+            ring_idx = jnp.clip(p_slot, 0, t0 - 1)
+            ring = lambda kv: jnp.take(kv, ring_idx, axis=1)
+        else:
+            ring = lambda kv: kv
+
         caches = []
         for p, (k_buf, v_buf) in zip(params["blocks"], state.caches):
             x, k, v, _ = T._block_parts(cfg, p, x, pos, attn)
-            caches.append((write_slot(k_buf, k), write_slot(v_buf, v)))
+            caches.append((write_slot(k_buf, ring(k)),
+                           write_slot(v_buf, ring(v))))
         # first token reads the LAST REAL position's logits
         x_last = jax.lax.dynamic_index_in_dim(
             x[0], true_len - 1, axis=0, keepdims=False)
@@ -216,7 +236,9 @@ class DecodeEngine:
         different sampling share one compiled step. Incompatible with
         a pool-wide select_fn override."""
         t0 = int(prompt.shape[-1])
-        if t0 >= self.max_len:
+        if self.cfg.attn_window is None and t0 >= self.max_len:
+            # a physical bound of the full-length cache only — the
+            # windowed ring holds any prompt (it keeps the last W)
             raise ValueError(f"prompt len {t0} >= max_len {self.max_len}")
         if true_len is None:
             true_len = t0
@@ -252,9 +274,21 @@ class DecodeEngine:
         x = jnp.take(params["embed"]["table"], tok[:, None], axis=0)
         x = x.astype(policy.compute_dtype)
         pos = state.pos[:, None]                      # [S, 1] per-row rope
-        # row r attends cache slots < pos[r]+1 (incl. the one written now)
-        valid = (jnp.arange(L)[None, :] <= state.pos[:, None]) \
-            & state.active[:, None]
+        if cfg.attn_window is not None:
+            # rolling ring pool: generate()'s rolling cache per-row —
+            # the slot/validity arithmetic is THE shared convention
+            # (T._ring_slot_valid); softmax is permutation-invariant
+            # over key slots and rope rode in with K.
+            w = cfg.attn_window
+            slots_raw, ring_ok = T._ring_slot_valid(state.pos, w)
+            write_slots = jnp.where(state.active, slots_raw,
+                                    jnp.int32(w))   # sentinel: drop
+            valid = ring_ok & state.active[:, None]
+        else:
+            # row r attends cache slots < pos[r]+1 (incl. this write)
+            write_slots = state.pos
+            valid = (jnp.arange(L)[None, :] <= state.pos[:, None]) \
+                & state.active[:, None]
         valid4 = valid[:, None, None, :]
         new_caches = []
 
@@ -262,10 +296,10 @@ class DecodeEngine:
 
             def attn(q, k, v, k_buf=k_buf, v_buf=v_buf):
                 # THE shared decode attention (_cached_attention) with
-                # a per-row slot VECTOR: each row writes its own pos[r]
-                # (sentinel pos=L on inactive rows -> scatter drops)
+                # a per-row slot VECTOR: each row writes its own slot
+                # (out-of-range sentinel on inactive rows -> drop)
                 out, k_buf, v_buf = T._cached_attention(
-                    q, k, v, k_buf, v_buf, state.pos, valid4)
+                    q, k, v, k_buf, v_buf, write_slots, valid4)
                 new_caches.append((k_buf, v_buf))
                 return out
 
@@ -295,7 +329,11 @@ class DecodeEngine:
         fin = jnp.zeros_like(state.active)
         if self.eos_id is not None:
             fin = state.active & (emitted == self.eos_id)
-        fin = fin | (state.active & (state.pos + 1 >= L))
+        if cfg.attn_window is None:
+            # capacity retirement is a PHYSICAL bound of the full-length
+            # cache only; the ring reuses slots, so windowed requests
+            # are bounded by eos and the caller's max_new alone
+            fin = fin | (state.active & (state.pos + 1 >= L))
         cont = state.active & ~fin
         new_state = EngineState(
             caches=tuple(new_caches),

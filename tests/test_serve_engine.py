@@ -91,8 +91,7 @@ class TestEngineConsistency:
         assert got[0] == ref_tokens(params, p, len(got[0]))
 
     def test_unsupported_configs_raise(self, params):
-        for bad in (dataclasses.replace(CFG, attn_window=8),
-                    dataclasses.replace(CFG, kv_cache_dtype="fp4"),
+        for bad in (dataclasses.replace(CFG, kv_cache_dtype="fp4"),
                     dataclasses.replace(CFG, moe_experts=2)):
             with pytest.raises(ValueError):
                 DecodeEngine(params, bad, slots=2, max_len=16)
@@ -260,3 +259,69 @@ def test_scheduling_efficiency_vs_lockstep(params):
     eng_util = used / (2 * steps)
     lock_util = used / (2 * lock_steps)
     assert eng_util > lock_util + 0.05, (eng_util, lock_util, lens)
+
+
+class TestSlidingWindowPool:
+    """Rolling ring pool (attn_window): per-row ring arithmetic through
+    the shared vector-slot _cached_attention must reproduce
+    generate()'s rolling-cache decode exactly."""
+
+    def _cfg(self, **kw):
+        base = dict(vocab=61, dim=32, n_layers=2, n_heads=4,
+                    attn_impl="dense", attn_window=6)
+        base.update(kw)
+        return T.TransformerConfig(**base)
+
+    def test_pool_matches_generate_rolling(self):
+        cfg = self._cfg()
+        p = T.init_params(jax.random.key(6), cfg)
+        eng = DecodeEngine(p, cfg, slots=2, max_len=40)
+        # prompts BOTH shorter and longer than the window
+        ps = prompts_rng(4, [3, 9, 5, 11], seed=61)
+        got = eng.serve(ps, max_new=10)
+        for pr, g in zip(ps, got):
+            out = T.generate(p, cfg, jnp.asarray(pr)[None, :], steps=10)
+            assert g == [int(t) for t in np.asarray(out[0, len(pr):])], pr
+
+    def test_bucketed_window_matches_unpadded(self):
+        """Bucket padding + window: the ring takes REAL positions only,
+        so the decode matches generate() on the unpadded prompt (a
+        combination generate() itself cannot serve — it raises on
+        attn_window + prompt_lens)."""
+        cfg = self._cfg()
+        p = T.init_params(jax.random.key(6), cfg)
+        eng = DecodeEngine(p, cfg, slots=2, max_len=40)
+        ps = prompts_rng(3, [4, 9, 7], seed=62)
+        got = eng.serve(ps, max_new=8, buckets=(12,))
+        for pr, g in zip(ps, got):
+            out = T.generate(p, cfg, jnp.asarray(pr)[None, :], steps=8)
+            assert g == [int(t) for t in np.asarray(out[0, len(pr):])], pr
+
+    def test_int8_ring_pool(self):
+        cfg = self._cfg(kv_cache_dtype="int8")
+        p = T.init_params(jax.random.key(6), cfg)
+        eng = DecodeEngine(p, cfg, slots=2, max_len=40)
+        ps = prompts_rng(3, [5, 9, 4], seed=63)
+        got = eng.serve(ps, max_new=8)
+        agree = n = 0
+        for pr, g in zip(ps, got):
+            out = T.generate(p, cfg, jnp.asarray(pr)[None, :], steps=8)
+            ref = [int(t) for t in np.asarray(out[0, len(pr):])]
+            agree += sum(a == b for a, b in zip(g, ref)); n += len(ref)
+        assert agree / n >= 0.9, (agree, n)
+
+    def test_window_requests_unbounded_by_max_len(self):
+        """The ring has no physical capacity bound: a windowed request
+        decodes past max_len (bounded by max_new/eos alone), and a
+        prompt LONGER than max_len admits fine (the ring keeps its
+        last W positions) — both match generate()."""
+        cfg = self._cfg()
+        p = T.init_params(jax.random.key(6), cfg)
+        eng = DecodeEngine(p, cfg, slots=1, max_len=10)
+        long_prompt = prompts_rng(1, [14], seed=64)[0]  # > max_len
+        got = eng.serve([long_prompt], max_new=18)      # past max_len
+        out = T.generate(p, cfg, jnp.asarray(long_prompt)[None, :],
+                         steps=18)
+        assert got[0] == [int(t) for t in
+                          np.asarray(out[0, len(long_prompt):])]
+        assert len(got[0]) == 18
