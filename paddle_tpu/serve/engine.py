@@ -90,9 +90,13 @@ class DecodeEngine:
             raise ValueError(
                 f"kv_cache_dtype must be compute|int8, got "
                 f"{cfg.kv_cache_dtype!r}")
-        if cfg.moe_experts > 0:
-            raise ValueError(
-                "DecodeEngine does not support MoE configs yet")
+        # MoE configs ride the shared _block_parts body like every
+        # other decode path. One semantic boundary, inherent to
+        # capacity-based routing: expert capacity is a function of the
+        # step's token count (= slots here, batch in generate()), so a
+        # pathologically imbalanced pool step can drop a token to
+        # capacity where a solo decode would not — same boundary the
+        # reference's capacity semantics impose on any batch.
         # weight-only int8 params (serve.quant) use the SAME split as
         # generate(): prefill reads the hoisted dequant (one-shot,
         # compute-bound), the per-token step re-traces the dequant

@@ -91,10 +91,10 @@ class TestEngineConsistency:
         assert got[0] == ref_tokens(params, p, len(got[0]))
 
     def test_unsupported_configs_raise(self, params):
-        for bad in (dataclasses.replace(CFG, kv_cache_dtype="fp4"),
-                    dataclasses.replace(CFG, moe_experts=2)):
-            with pytest.raises(ValueError):
-                DecodeEngine(params, bad, slots=2, max_len=16)
+        with pytest.raises(ValueError):
+            DecodeEngine(params,
+                         dataclasses.replace(CFG, kv_cache_dtype="fp4"),
+                         slots=2, max_len=16)
 
     def test_int8_kv_pool_matches_int8_generate(self, params):
         """The int8-KV slot pool must reproduce generate()'s int8-KV
@@ -325,3 +325,19 @@ class TestSlidingWindowPool:
         assert got[0] == [int(t) for t in
                           np.asarray(out[0, len(long_prompt):])]
         assert len(got[0]) == 18
+
+
+def test_moe_pool_matches_generate():
+    """MoE configs through the pool: the shared _block_parts body makes
+    the engine's per-request decode match solo generate() (capacity is
+    per-step-token-count in BOTH paths; at test scale no drops)."""
+    cfg = T.TransformerConfig(vocab=61, dim=32, n_layers=2, n_heads=4,
+                              attn_impl="dense", moe_experts=4,
+                              moe_every=2)
+    p = T.init_params(jax.random.key(0), cfg)
+    eng = DecodeEngine(p, cfg, slots=2, max_len=24)
+    ps = prompts_rng(3, [5, 8, 4], seed=81)
+    got = eng.serve(ps, max_new=8)
+    for pr, g in zip(ps, got):
+        out = T.generate(p, cfg, jnp.asarray(pr)[None, :], steps=8)
+        assert g == [int(t) for t in np.asarray(out[0, len(pr):])], pr
