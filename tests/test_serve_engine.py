@@ -341,3 +341,22 @@ def test_moe_pool_matches_generate():
     for pr, g in zip(ps, got):
         out = T.generate(p, cfg, jnp.asarray(pr)[None, :], steps=8)
         assert g == [int(t) for t in np.asarray(out[0, len(pr):])], pr
+
+
+def test_engine_serve_golden():
+    """Golden serving transcript (the seq2seq_gen_golden idiom): a
+    fixed pool + fixed traffic must reproduce the committed outputs
+    byte-for-byte — any decode-math drift (mask, ring, head, eos
+    accounting) fails here even if self-consistency still holds."""
+    import json
+    import pathlib
+
+    golden = json.loads((pathlib.Path(__file__).parent / "golden" /
+                         "engine_serve_golden.json").read_text())
+    params = T.init_params(jax.random.key(0), CFG)
+    eng = DecodeEngine(params, CFG, slots=2, max_len=32,
+                       eos_id=golden["eos_id"])
+    outs = eng.serve([np.asarray(p, np.int32) for p in golden["prompts"]],
+                     max_new=golden["max_new"],
+                     buckets=tuple(golden["buckets"]))
+    assert outs == golden["outputs"], (outs, golden["outputs"])
