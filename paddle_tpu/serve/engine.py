@@ -54,11 +54,12 @@ from paddle_tpu.models import transformer as T
 
 class EngineState(NamedTuple):
     """Device-resident pool state. caches: per layer (k_buf, v_buf),
-    each [S, max_len, Hkv, Dh]. pos[s] = number of cache slots row s
-    has filled (== the next write position); the sentinel pos=max_len
-    on an inactive row makes its scatter writes drop. rng advances one
-    split per prefill/step so sampled serving is reproducible per
-    (seed, admission order)."""
+    each [S, max_len, Hkv, Dh] — [S, window, ...] rings under
+    attn_window, (s8 data, scale) pairs under kv_cache_dtype="int8".
+    pos[s] = the next absolute position row s writes; out-of-range
+    sentinels on inactive rows make their scatter writes drop. rng
+    advances one split per prefill/step so sampled serving is
+    reproducible per (seed, admission order)."""
 
     caches: tuple
     pos: jnp.ndarray        # [S] int32
@@ -151,7 +152,8 @@ class DecodeEngine:
                       temp, top_k, top_p, t0: int):
         """prompt [t0] int32 (real tokens in [:true_len], rest padding)
         -> state with slot's cache rows 0..true_len-1 filled, pos=
-        true_len, active, last_tok = greedy first token. true_len is
+        true_len, active, last_tok = the request's first token
+        (its own sampler params / the pool select_fn). true_len is
         TRACED, so one compile per padded bucket length serves every
         real length (the padded tail's cache rows hold garbage that the
         decode mask never reads: reads stop at pos, and a row is
