@@ -1343,9 +1343,18 @@ def per_row_filter_logits(logits, temperature, top_k, top_p):
 def per_row_sample(logits, temperature, top_k, top_p, rng):
     """Per-row sampled next tokens [N]: rows with temperature 0 take
     argmax (exact greedy), the rest draw from their own
-    temperature/top-k/top-p-filtered distribution."""
+    temperature/top-k/top-p-filtered distribution.
+
+    rng: one key (shared draw, rows split internally by categorical)
+    or a [N] key vector — one INDEPENDENT stream per row (the serving
+    engine's per-slot streams: a row's draw depends only on its own
+    key, so pool co-tenants cannot perturb it)."""
     filtered = per_row_filter_logits(logits, temperature, top_k, top_p)
-    draw = jax.random.categorical(rng, filtered, axis=-1)
+    if jnp.ndim(rng) == 1:
+        draw = jax.vmap(
+            lambda k, lg: jax.random.categorical(k, lg))(rng, filtered)
+    else:
+        draw = jax.random.categorical(rng, filtered, axis=-1)
     greedy = jnp.argmax(at_least_f32(logits), axis=-1)
     return jnp.where(temperature <= 0.0, greedy, draw)
 

@@ -33,11 +33,13 @@ the tokens of `transformer.generate()` on the same prompt — regardless
 of which other requests share the pool or when it was admitted.
 SAMPLED serving — per request via `serve(sampling=[...])` (per-slot
 temperature/top_k/top_p arrays through one compiled step) or pool-wide
-via select_fn — is reproducible per (seed, admission order) but is its
-own rng stream: the split schedule and a request's slot row both feed
-its draws, so tokens intentionally differ from `transformer.sample()`
-and can depend on co-tenancy — temperature 0 (the per-request default)
-keeps the exact greedy contract, even beside sampled co-tenants.
+via select_fn — runs ONE rng stream PER SLOT, seeded at admission from
+the request's own identity: with an explicit `"seed"` a request's
+draws are fully deterministic and co-tenancy/admission-order INVARIANT
+(tested); the default identity is this engine's admission counter
+(reproducible per engine seed + admission order). Tokens are the
+engine's own stream (not `transformer.sample()`'s); temperature 0 (the
+default) keeps the exact greedy contract beside sampled co-tenants.
 """
 
 from __future__ import annotations
@@ -57,15 +59,17 @@ class EngineState(NamedTuple):
     each [S, max_len, Hkv, Dh] — [S, window, ...] rings under
     attn_window, (s8 data, scale) pairs under kv_cache_dtype="int8".
     pos[s] = the next absolute position row s writes; out-of-range
-    sentinels on inactive rows make their scatter writes drop. rng
-    advances one split per prefill/step so sampled serving is
-    reproducible per (seed, admission order)."""
+    sentinels on inactive rows make their scatter writes drop. rng is
+    a PER-SLOT key vector: each request's stream is seeded at its own
+    admission and advances one split per step, so a sampled request's
+    draws depend only on its seed and its own step index — co-tenants
+    cannot perturb them."""
 
     caches: tuple
     pos: jnp.ndarray        # [S] int32
     active: jnp.ndarray     # [S] bool
     last_tok: jnp.ndarray   # [S] int32
-    rng: jnp.ndarray        # key
+    rng: jnp.ndarray        # [S] keys — ONE stream per slot
     # per-REQUEST sampler params, set at admission (temp 0 = greedy)
     temp: jnp.ndarray       # [S] f32
     top_k: jnp.ndarray      # [S] int32
@@ -110,6 +114,7 @@ class DecodeEngine:
         self.eos_id = eos_id
         self.select_fn = select_fn
         self.seed = seed
+        self._admissions = 0   # default per-request stream identity
         self._prefill_jit = jax.jit(self._prefill_impl,
                                     static_argnames=("t0",))
         self._step_jit = jax.jit(self._step_impl)
@@ -136,12 +141,19 @@ class DecodeEngine:
             return jnp.zeros((s, L, hkv, dh), policy.compute_dtype)
 
         caches = tuple((buf(), buf()) for _ in self.params["blocks"])
+        # default stream identities restart with the pool: two serve()
+        # calls on one engine replay identically (the counter is host
+        # state, NOT part of EngineState — a restored state needs its
+        # engine's counter to continue default-identity admissions;
+        # explicit per-request seeds sidestep this entirely)
+        self._admissions = 0
         return EngineState(
             caches=caches,
             pos=jnp.full((s,), L, jnp.int32),   # sentinel: writes drop
             active=jnp.zeros((s,), bool),
             last_tok=jnp.zeros((s,), jnp.int32),
-            rng=jax.random.key(self.seed),
+            rng=jax.random.split(jax.random.key(self.seed),
+                                 self.slots),
             temp=jnp.zeros((s,), jnp.float32),
             top_k=jnp.full((s,), cfg.vocab, jnp.int32),
             top_p=jnp.ones((s,), jnp.float32))
@@ -149,7 +161,7 @@ class DecodeEngine:
     # -- prefill (one request into one slot) ------------------------------
 
     def _prefill_impl(self, state: EngineState, slot, prompt, true_len,
-                      temp, top_k, top_p, t0: int):
+                      temp, top_k, top_p, req_tag, req_seed, t0: int):
         """prompt [t0] int32 (real tokens in [:true_len], rest padding)
         -> state with slot's cache rows 0..true_len-1 filled, pos=
         true_len, active, last_tok = the request's first token
@@ -208,7 +220,11 @@ class DecodeEngine:
         # first token reads the LAST REAL position's logits
         x_last = jax.lax.dynamic_index_in_dim(
             x[0], true_len - 1, axis=0, keepdims=False)
-        rng, sub = jax.random.split(state.rng)
+        # this request's OWN stream, seeded at admission: draws depend
+        # only on (engine seed, request seed) and step index
+        req_key = jax.random.fold_in(jax.random.fold_in(
+            jax.random.key(self.seed), req_tag), req_seed)
+        req_key, sub = jax.random.split(req_key)
         logits = T._head(params, x_last[None])
         if self.select_fn is not None:
             first = self.select_fn(logits, sub)[0]
@@ -221,7 +237,7 @@ class DecodeEngine:
             active=state.active.at[slot].set(True),
             last_tok=state.last_tok.at[slot].set(
                 first.astype(jnp.int32)),
-            rng=rng,
+            rng=state.rng.at[slot].set(req_key),
             temp=state.temp.at[slot].set(temp),
             top_k=state.top_k.at[slot].set(top_k),
             top_p=state.top_p.at[slot].set(top_p))
@@ -237,8 +253,11 @@ class DecodeEngine:
         The slot's first generated token is in .last_tok[slot].
 
         sampling: THIS request's sampler params — a dict with any of
-        temperature/top_k/top_p (missing = greedy/no-filter). The
-        values are traced (set into per-slot arrays), so requests with
+        temperature/top_k/top_p (missing = greedy/no-filter) and an
+        optional "seed": the request's own rng stream identity, making
+        its draws independent of pool co-tenants and admission order
+        (default: this engine's admission counter). All values are
+        traced (set into per-slot arrays/keys), so requests with
         different sampling share one compiled step. Incompatible with
         a pool-wide select_fn override."""
         t0 = int(prompt.shape[-1])
@@ -255,19 +274,32 @@ class DecodeEngine:
             raise ValueError(
                 "per-request sampling and a pool-wide select_fn are "
                 "mutually exclusive — drop one")
-        unknown = set(sampling) - {"temperature", "top_k", "top_p"}
+        unknown = set(sampling) - {"temperature", "top_k", "top_p",
+                                   "seed"}
         if unknown:
             raise ValueError(f"unknown sampling keys {sorted(unknown)}")
         temp = sampling.get("temperature", 0.0)
         top_k = sampling.get("top_k")        # None-vs-0 must not blur:
         top_p = sampling.get("top_p")        # 0 values are ERRORS below
         T._validate_sampler_args(temp, top_k, top_p)
+        # the request's OWN stream identity: an explicit seed makes its
+        # draws fully request-deterministic (pool/admission invariant);
+        # default = this engine's admission counter. The two live in
+        # DISJOINT domains (tag bit) so an explicit seed can never
+        # collide with a counter value and correlate two streams.
+        req_seed = sampling.get("seed")
+        if req_seed is None:
+            req_tag, req_seed = 0, self._admissions
+        else:
+            req_tag = 1
+        self._admissions += 1
         return self._prefill_jit(
             state, jnp.int32(slot), jnp.asarray(prompt, jnp.int32),
             jnp.int32(true_len),
             jnp.float32(temp),
             jnp.int32(self.cfg.vocab if top_k is None else top_k),
-            jnp.float32(1.0 if top_p is None else top_p), t0=t0)
+            jnp.float32(1.0 if top_p is None else top_p),
+            jnp.int32(req_tag), jnp.int32(req_seed), t0=t0)
 
     # -- the batched decode step ------------------------------------------
 
@@ -310,10 +342,14 @@ class DecodeEngine:
                 return out
 
             x, _, _, _ = T._block_parts(cfg, p, x, pos, attn)
-        rng, sub = jax.random.split(state.rng)
+        keys = jax.vmap(jax.random.split)(state.rng)   # [S, 2] keys
+        rng, sub = keys[:, 0], keys[:, 1]
         logits = T._head(params, x[:, -1])
         if self.select_fn is not None:
-            nxt = self.select_fn(logits, sub).astype(jnp.int32)
+            # pool-wide select_fn keeps its scalar-key contract; it
+            # consumes slot 0's stream (every slot's stream advances
+            # each step regardless)
+            nxt = self.select_fn(logits, sub[0]).astype(jnp.int32)
         else:
             # all-greedy pools (the default) must not pay the sampled
             # branch's O(S*V log V) sort per token: cond executes only

@@ -360,3 +360,24 @@ def test_engine_serve_golden():
                      max_new=golden["max_new"],
                      buckets=tuple(golden["buckets"]))
     assert outs == golden["outputs"], (outs, golden["outputs"])
+
+
+def test_explicit_seed_is_cotenancy_invariant(params):
+    """A sampled request with an explicit per-request seed draws from
+    its OWN stream: identical tokens whether served alone, in a busy
+    pool, or admitted in a different order — the guarantee per-slot
+    rng streams exist for."""
+    target = prompts_rng(1, [6], seed=91)[0]
+    spec = {"temperature": 1.0, "top_p": 0.95, "seed": 1234}
+    mk = lambda: DecodeEngine(params, CFG, slots=2, max_len=24, seed=5)
+
+    solo = mk().serve([target], max_new=6, sampling=[spec])[0]
+
+    others = prompts_rng(3, [4, 8, 5], seed=92)
+    crowd = [{"temperature": 0.8, "seed": 7}, {}, {"top_k": 9,
+             "temperature": 1.3, "seed": 8}]
+    first = mk().serve([target] + others, max_new=6,
+                       sampling=[spec] + crowd)[0]
+    last = mk().serve(others + [target], max_new=6,
+                      sampling=crowd + [spec])[-1]
+    assert solo == first == last, (solo, first, last)
