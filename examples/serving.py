@@ -93,13 +93,23 @@ def main():
                for l in (5, 9, 13, 6, 11, 5, 8, 14, 7)]
     eng = DecodeEngine(params, serve_cfg, slots=3, max_len=48,
                        eos_id=EOS)
-    outs = eng.serve(prompts, max_new=12, buckets=(8, 16))
+    # greedy requests (the consistency check below relies on them)
+    # beside two seeded sampled ones — per-request sampling shares the
+    # same compiled step, and the seeds make those two reproducible
+    # regardless of pool co-tenancy
+    sampling = [{}] * 9
+    sampling[2] = {"temperature": 0.9, "top_p": 0.95, "seed": 7}
+    sampling[6] = {"temperature": 0.7, "top_k": 12, "seed": 8}
+    outs = eng.serve(prompts, max_new=12, buckets=(8, 16),
+                     sampling=sampling)
     for i, (p, o) in enumerate(zip(prompts, outs)):
         print(f"   req{i} (len {len(p):2d}): +{len(o)} tokens "
               f"{o[:6]}{'...' if len(o) > 6 else ''}")
 
-    print("[4/4] consistency check vs solo generate()")
-    for p, o in zip(prompts, outs):
+    print("[4/4] consistency check vs solo generate() (greedy rows)")
+    for i, (p, o) in enumerate(zip(prompts, outs)):
+        if i in (2, 6):      # the sampled requests follow their own
+            continue         # seeded streams, not the greedy path
         ref = T.generate(params, serve_cfg, jnp.asarray(p)[None, :],
                          steps=12, eos_id=EOS)
         ref = [int(t) for t in np.asarray(ref[0, len(p):])]
