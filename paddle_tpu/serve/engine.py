@@ -74,6 +74,10 @@ class EngineState(NamedTuple):
     temp: jnp.ndarray       # [S] f32
     top_k: jnp.ndarray      # [S] int32
     top_p: jnp.ndarray      # [S] f32
+    # log p(last_tok | its prefix) under the FULL softmax (the
+    # rescoring convention, = transformer.score()), captured when the
+    # token was selected
+    last_lp: jnp.ndarray    # [S] f32
 
 
 class DecodeEngine:
@@ -156,7 +160,8 @@ class DecodeEngine:
                                  self.slots),
             temp=jnp.zeros((s,), jnp.float32),
             top_k=jnp.full((s,), cfg.vocab, jnp.int32),
-            top_p=jnp.ones((s,), jnp.float32))
+            top_p=jnp.ones((s,), jnp.float32),
+            last_lp=jnp.zeros((s,), jnp.float32))
 
     # -- prefill (one request into one slot) ------------------------------
 
@@ -231,6 +236,8 @@ class DecodeEngine:
         else:
             first = T.per_row_sample(logits, temp[None], top_k[None],
                                      top_p[None], sub)[0]
+        first_lp = jax.nn.log_softmax(
+            T.at_least_f32(logits), axis=-1)[0, first]
         return EngineState(
             caches=tuple(caches),
             pos=state.pos.at[slot].set(true_len),
@@ -240,7 +247,9 @@ class DecodeEngine:
             rng=state.rng.at[slot].set(req_key),
             temp=state.temp.at[slot].set(temp),
             top_k=state.top_k.at[slot].set(top_k),
-            top_p=state.top_p.at[slot].set(top_p))
+            top_p=state.top_p.at[slot].set(top_p),
+            last_lp=state.last_lp.at[slot].set(
+                first_lp.astype(jnp.float32)))
 
     def prefill(self, state: EngineState, slot: int, prompt,
                 true_len: Optional[int] = None,
@@ -362,12 +371,16 @@ class DecodeEngine:
                 lambda lg, r: jnp.argmax(
                     T.at_least_f32(lg), axis=-1),
                 logits, sub).astype(jnp.int32)
+        nxt_lp = jnp.take_along_axis(
+            jax.nn.log_softmax(T.at_least_f32(logits), axis=-1),
+            nxt[:, None], axis=-1)[:, 0].astype(jnp.float32)
         # emitted token per row = the token CONSUMED this step (matches
         # generate(): its scan emits the carry token). A row finishes
         # when the token it just EMITTED is eos (so eos is part of its
         # output, like generate), or when it consumed its last cache
         # slot (nxt could never be processed).
         emitted = state.last_tok
+        emitted_lp = state.last_lp
         fin = jnp.zeros_like(state.active)
         if self.eos_id is not None:
             fin = state.active & (emitted == self.eos_id)
@@ -385,21 +398,25 @@ class DecodeEngine:
             rng=rng,
             temp=state.temp,
             top_k=state.top_k,
-            top_p=state.top_p)
-        return new_state, emitted, state.active, fin
+            top_p=state.top_p,
+            last_lp=nxt_lp)
+        return new_state, emitted, emitted_lp, state.active, fin
 
     def decode_step(self, state: EngineState):
         """Advance every active slot one token. Returns (state,
-        emitted [S] int32, was_active [S] bool, finished [S] bool):
-        emitted[r] is meaningful where was_active[r]; finished rows
-        have just emitted their final token (eos or cache-full) and
-        their slot is free for the next prefill."""
+        emitted [S] int32, emitted_lp [S] f32, was_active [S] bool,
+        finished [S] bool): emitted[r]/emitted_lp[r] are meaningful
+        where was_active[r] (emitted_lp is log p(token | prefix) under
+        the full softmax — transformer.score()'s convention, whatever
+        the sampler); finished rows have just emitted their final
+        token (eos or cache-full) and their slot is free for the next
+        prefill."""
         return self._step_jit(state)
 
     # -- batteries-included host scheduler --------------------------------
 
     def serve(self, prompts, *, max_new: int, buckets=None,
-              sampling=None):
+              sampling=None, return_logprobs: bool = False):
         """Serve a list of 1-D int32 prompts through the S-slot pool:
         admit while slots free, step, collect, refill — the continuous
         part. Returns per-request generated-token lists (eos included,
@@ -414,7 +431,12 @@ class DecodeEngine:
         so the decode is still exactly the unpadded generate().
 
         sampling: optional per-request sampler params — one dict per
-        prompt (see prefill()); None = greedy for every request."""
+        prompt (see prefill()); None = greedy for every request.
+
+        return_logprobs: also return per-request per-token
+        log p(token | prefix) lists (full-softmax convention — the
+        reference's SequenceGenerator returns sequence scores the
+        same way, api/PaddleAPI.h:1025)."""
         import numpy as np
 
         if max_new < 1:
@@ -440,6 +462,7 @@ class DecodeEngine:
         queue = list(range(len(prompts)))
         slot_req = [-1] * self.slots          # which request owns a slot
         emitted: dict[int, list] = {i: [] for i in range(len(prompts))}
+        lps: dict[int, list] = {i: [] for i in range(len(prompts))}
         remaining = [max_new] * len(prompts)
 
         def admit():
@@ -455,17 +478,18 @@ class DecodeEngine:
 
         admit()
         while any(r != -1 for r in slot_req):
-            state, toks, was_active, fin = self.decode_step(state)
-            # ONE host sync per step (the admission decision needs it);
-            # three separate np.asarray calls would each round-trip
-            toks, was_active_h, fin_h = jax.device_get(
-                (toks, was_active, fin))
+            state, toks, tok_lps, was_active, fin = \
+                self.decode_step(state)
+            # ONE host sync per step (the admission decision needs it)
+            toks, tok_lps, was_active_h, fin_h = jax.device_get(
+                (toks, tok_lps, was_active, fin))
             freed = False
             for slot in range(self.slots):
                 req = slot_req[slot]
                 if req == -1 or not was_active_h[slot]:
                     continue
                 emitted[req].append(int(toks[slot]))
+                lps[req].append(float(tok_lps[slot]))
                 remaining[req] -= 1
                 if fin_h[slot] or remaining[req] <= 0:
                     if not fin_h[slot]:
@@ -480,4 +504,7 @@ class DecodeEngine:
                     freed = True
             if freed:
                 admit()
-        return [emitted[i] for i in range(len(prompts))]
+        toks_out = [emitted[i] for i in range(len(prompts))]
+        if return_logprobs:
+            return toks_out, [lps[i] for i in range(len(prompts))]
+        return toks_out

@@ -381,3 +381,20 @@ def test_explicit_seed_is_cotenancy_invariant(params):
     last = mk().serve(others + [target], max_new=6,
                       sampling=crowd + [spec])[-1]
     assert solo == first == last, (solo, first, last)
+
+
+def test_logprobs_match_score(params):
+    """serve(return_logprobs=True): each emitted token's logprob must
+    equal transformer.score()'s gold log-probability at the same
+    position of the full (prompt + generated) sequence — the engine
+    reports the same rescoring quantity the reference's
+    SequenceGenerator scores carry."""
+    ps = prompts_rng(3, [5, 8, 4], seed=71)
+    eng = DecodeEngine(params, CFG, slots=2, max_len=24)
+    toks, lps = eng.serve(ps, max_new=6, return_logprobs=True)
+    for p, g, lp in zip(ps, toks, lps):
+        full = jnp.asarray(np.concatenate([p, np.asarray(g)]),
+                           jnp.int32)[None, :]
+        gold, _ = T.score(params, CFG, full)
+        want = np.asarray(gold[0, len(p) - 1:len(p) - 1 + len(g)])
+        np.testing.assert_allclose(np.asarray(lp), want, atol=2e-5)
