@@ -8,6 +8,7 @@ Subcommands:
   dump-config  — print a config script's resolved topology as JSON
   merge-model  — config + trained params -> single compiled artifact
   infer        — run a compiled artifact on .npy inputs
+  serve        — continuous-batching LM serving (token ids in/out)
   master       — serve a task-queue master over a recordio dataset
   bench        — run the benchmark entry
 
@@ -216,6 +217,64 @@ def cmd_infer(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Continuous-batching LM serving from the command line: a config
+    script supplies the model (cfg + params), prompts come one
+    whitespace-separated token-id sequence per line, completions leave
+    the same way (the framework is tokenizer-agnostic, like the
+    reference's id-based SequenceGenerator)."""
+    import numpy as np
+
+    from paddle_tpu.serve import DecodeEngine
+
+    ns = runpy.run_path(args.config)
+    if "get_serve_config" not in ns:
+        raise SystemExit(
+            f"{args.config} must define get_serve_config() -> dict "
+            "with keys: cfg (TransformerConfig), params; optional: "
+            "eos_id, slots, max_len")
+    sc = ns["get_serve_config"]()
+    missing = {"cfg", "params"} - set(sc)
+    if missing:
+        raise SystemExit(
+            f"get_serve_config() is missing {sorted(missing)}")
+    eng = DecodeEngine(
+        sc["params"], sc["cfg"],
+        slots=sc.get("slots", 8) if args.slots is None else args.slots,
+        max_len=(sc.get("max_len", 2048) if args.max_len is None
+                 else args.max_len),
+        eos_id=sc.get("eos_id"), seed=args.seed)
+
+    with open(args.prompts) as f:
+        prompts = [np.asarray([int(t) for t in line.split()], np.int32)
+                   for line in f if line.strip()]
+    # `is not None`, not truthiness: explicit zeros must REACH the
+    # engine's sampler validation and fail loudly, not vanish
+    one = {k: v for k, v in (("temperature", args.temperature),
+                             ("top_k", args.top_k),
+                             ("top_p", args.top_p)) if v is not None}
+    sampling = [dict(one) for _ in prompts] if one else None
+    # open the sink BEFORE the (possibly long) serve run: an
+    # unwritable --output must fail fast, not discard the decode work
+    sink = open(args.output, "w") if args.output else sys.stdout
+    out = eng.serve(prompts, max_new=args.max_new,
+                    buckets=tuple(int(b) for b in args.buckets.split(","))
+                    if args.buckets else None,
+                    sampling=sampling,
+                    return_logprobs=args.logprobs)
+    toks, lps = out if args.logprobs else (out, None)
+    try:
+        for i, g in enumerate(toks):
+            print(" ".join(str(t) for t in g), file=sink)
+            if lps is not None:
+                print("# logprobs " +
+                      " ".join(f"{x:.4f}" for x in lps[i]), file=sink)
+    finally:
+        if sink is not sys.stdout:
+            sink.close()
+    return 0
+
+
 def cmd_master(args) -> int:
     from paddle_tpu.native import MasterServer, TaskQueue
 
@@ -344,6 +403,27 @@ def build_parser() -> argparse.ArgumentParser:
     i.add_argument("--output-prefix", default=None)
     i.add_argument("inputs", nargs="+", help=".npy input files")
     i.set_defaults(fn=cmd_infer)
+
+    sv = sub.add_parser(
+        "serve", help="continuous-batching LM serving (token ids in, "
+        "token ids out; see cmd_serve)")
+    sv.add_argument("--config", required=True,
+                    help="script defining get_serve_config()")
+    sv.add_argument("--prompts", required=True,
+                    help="file: one whitespace-separated id sequence "
+                    "per line")
+    sv.add_argument("--max-new", type=int, default=128)
+    sv.add_argument("--slots", type=int, default=None)
+    sv.add_argument("--max-len", type=int, default=None)
+    sv.add_argument("--buckets", default=None,
+                    help="comma-separated prompt-length buckets")
+    sv.add_argument("--temperature", type=float, default=None)
+    sv.add_argument("--top-k", type=int, default=None)
+    sv.add_argument("--top-p", type=float, default=None)
+    sv.add_argument("--seed", type=int, default=0)
+    sv.add_argument("--logprobs", action="store_true")
+    sv.add_argument("--output", default=None)
+    sv.set_defaults(fn=cmd_serve)
 
     ms = sub.add_parser("master")
     ms.add_argument("--port", type=int, default=0)

@@ -200,3 +200,45 @@ def test_export_native_and_serve(config_file, tmp_path, capsys):
     want, _ = model.apply(params, mstate, jnp.asarray(x), training=False)
     np.testing.assert_allclose(got, np.asarray(want), rtol=2e-4,
                                atol=2e-4)
+
+
+def test_serve_verb(tmp_path, capsys):
+    """`paddle_tpu serve`: config script -> engine pool -> id-in/id-out
+    completions matching generate() (greedy default)."""
+    cfg_src = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+
+def get_serve_config():
+    from paddle_tpu.models import transformer as T
+    cfg = T.TransformerConfig(vocab=61, dim=32, n_layers=2, n_heads=4,
+                              attn_impl="dense")
+    return {"cfg": cfg,
+            "params": T.init_params(jax.random.key(0), cfg),
+            "slots": 2, "max_len": 24}
+"""
+    cfg_file = tmp_path / "serve_cfg.py"
+    cfg_file.write_text(cfg_src)
+    prompts = tmp_path / "prompts.txt"
+    prompts.write_text("1 2 3 4 5\n7 8 9\n")
+    out = tmp_path / "out.txt"
+    assert main(["serve", "--config", str(cfg_file),
+                 "--prompts", str(prompts), "--max-new", "6",
+                 "--logprobs", "--output", str(out)]) == 0
+    lines = out.read_text().strip().splitlines()
+    assert len(lines) == 4  # 2 completions + 2 logprob comments
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.models import transformer as T
+    cfg = T.TransformerConfig(vocab=61, dim=32, n_layers=2, n_heads=4,
+                              attn_impl="dense")
+    params = T.init_params(jax.random.key(0), cfg)
+    for line, p in zip(lines[::2], ([1, 2, 3, 4, 5], [7, 8, 9])):
+        got = [int(t) for t in line.split()]
+        ref = T.generate(params, cfg,
+                         jnp.asarray(p, jnp.int32)[None, :], steps=6)
+        assert got == [int(t) for t in np.asarray(ref[0, len(p):])]
+    assert lines[1].startswith("# logprobs ")
