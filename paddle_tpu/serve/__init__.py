@@ -8,7 +8,8 @@ from paddle_tpu.serve.artifact import (
     load_compiled_model,
 )
 from paddle_tpu.serve import quant
-from paddle_tpu.serve.engine import DecodeEngine, EngineState
+from paddle_tpu.serve.engine import (DecodeEngine, EngineState,
+                                     PoolStats)
 from paddle_tpu.serve.quant import (
     QuantizedTensor,
     dequantize_params,

@@ -47,6 +47,8 @@ from __future__ import annotations
 import dataclasses
 from typing import NamedTuple, Optional
 
+from dataclasses import dataclass
+
 import jax
 import jax.numpy as jnp
 
@@ -78,6 +80,24 @@ class EngineState(NamedTuple):
     # rescoring convention, = transformer.score()), captured when the
     # token was selected
     last_lp: jnp.ndarray    # [S] f32
+
+
+@dataclass
+class PoolStats:
+    """Host-side accounting for one serve() run (PARITY §5
+    observability): steps = jitted decode_step invocations (each a
+    fixed [S]-wide batch of device work); tokens = emitted real
+    tokens; utilization = tokens / (steps * slots) — the fraction of
+    issued row-steps that produced a kept token (lockstep batching's
+    idle finished rows show up here directly)."""
+
+    steps: int = 0
+    tokens: int = 0
+    prefills: int = 0
+    requests: int = 0
+
+    def utilization(self, slots: int) -> float:
+        return self.tokens / max(self.steps * slots, 1)
 
 
 class DecodeEngine:
@@ -459,6 +479,7 @@ class DecodeEngine:
             return np.pad(np.asarray(p), (0, pad)), t0
 
         state = self.init_state()
+        stats = PoolStats(requests=len(prompts))
         queue = list(range(len(prompts)))
         slot_req = [-1] * self.slots          # which request owns a slot
         emitted: dict[int, list] = {i: [] for i in range(len(prompts))}
@@ -474,12 +495,14 @@ class DecodeEngine:
                     state = self.prefill(
                         state, slot, padded, true_len=true_len,
                         sampling=(sampling[req] if sampling else None))
+                    stats.prefills += 1
                     slot_req[slot] = req
 
         admit()
         while any(r != -1 for r in slot_req):
             state, toks, tok_lps, was_active, fin = \
                 self.decode_step(state)
+            stats.steps += 1
             # ONE host sync per step (the admission decision needs it)
             toks, tok_lps, was_active_h, fin_h = jax.device_get(
                 (toks, tok_lps, was_active, fin))
@@ -490,6 +513,7 @@ class DecodeEngine:
                     continue
                 emitted[req].append(int(toks[slot]))
                 lps[req].append(float(tok_lps[slot]))
+                stats.tokens += 1
                 remaining[req] -= 1
                 if fin_h[slot] or remaining[req] <= 0:
                     if not fin_h[slot]:
@@ -505,6 +529,7 @@ class DecodeEngine:
             if freed:
                 admit()
         toks_out = [emitted[i] for i in range(len(prompts))]
+        self.last_stats = stats
         if return_logprobs:
             return toks_out, [lps[i] for i in range(len(prompts))]
         return toks_out

@@ -398,3 +398,16 @@ def test_logprobs_match_score(params):
         gold, _ = T.score(params, CFG, full)
         want = np.asarray(gold[0, len(p) - 1:len(p) - 1 + len(g)])
         np.testing.assert_allclose(np.asarray(lp), want, atol=2e-5)
+
+
+def test_pool_stats(params):
+    """serve() leaves a PoolStats on the engine: token/step accounting
+    consistent with the outputs, utilization in (0, 1]."""
+    ps = prompts_rng(5, [4, 6, 5, 7, 4], seed=85)
+    eng = DecodeEngine(params, CFG, slots=2, max_len=24)
+    got = eng.serve(ps, max_new=6)
+    st = eng.last_stats
+    assert st.requests == 5 and st.prefills == 5
+    assert st.tokens == sum(len(g) for g in got)
+    assert st.steps >= max(len(g) for g in got)
+    assert 0 < st.utilization(2) <= 1
