@@ -411,3 +411,14 @@ def test_pool_stats(params):
     assert st.tokens == sum(len(g) for g in got)
     assert st.steps >= max(len(g) for g in got)
     assert 0 < st.utilization(2) <= 1
+
+
+def test_edge_empty_and_single_token(params):
+    """Edge traffic: an empty request list returns immediately; a
+    single-token prompt (t0=1) prefills and decodes correctly."""
+    eng = DecodeEngine(params, CFG, slots=2, max_len=16)
+    assert eng.serve([], max_new=4) == []
+    one = np.asarray([7], np.int32)
+    got = eng.serve([one], max_new=5)
+    out = T.generate(params, CFG, jnp.asarray(one)[None, :], steps=5)
+    assert got[0] == [int(t) for t in np.asarray(out[0, 1:])]
