@@ -141,8 +141,29 @@ def cmd_train(args) -> int:
     # explicit --num-passes wins over the config's num_passes
     num_passes = (args.num_passes if args.num_passes is not None
                   else cfg.get("num_passes", 1))
-    state = trainer.train(
-        state, batches, num_passes=num_passes, event_handler=handler)
+    if args.checkpoint_dir:
+        # fault-tolerant path: auto-restore + preemption drain +
+        # divergence guard + optional watchdog (docs/RELIABILITY.md)
+        from paddle_tpu.train.resilience import (Preempted,
+                                                 ResilientTrainer)
+
+        rt = ResilientTrainer(
+            trainer, args.checkpoint_dir,
+            checkpoint_every_n_batches=args.checkpoint_every,
+            bad_step_policy=args.bad_step_policy,
+            max_bad_steps=args.max_bad_steps,
+            lr_backoff=args.lr_backoff,
+            watchdog_timeout_s=args.watchdog_timeout)
+        try:
+            state = rt.run(state, batches, num_passes=num_passes,
+                           event_handler=handler)
+        except Preempted as p:
+            print(f"preempted: checkpoint saved at step {p.step}; "
+                  f"re-run to resume")
+            return 143   # 128 + SIGTERM: the scheduler restarts us
+    else:
+        state = trainer.train(
+            state, batches, num_passes=num_passes, event_handler=handler)
     if args.save_dir:
         import os
 
@@ -372,6 +393,21 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--learning-rate", type=float, default=0.01)
     t.add_argument("--log-period", type=int, default=10)
     t.add_argument("--save-dir", default=None)
+    t.add_argument("--checkpoint-dir", default=None,
+                   help="enable the fault-tolerant runtime: orbax "
+                        "checkpoints here, auto-resume, SIGTERM drain, "
+                        "divergence guard (docs/RELIABILITY.md)")
+    t.add_argument("--checkpoint-every", type=int, default=None,
+                   help="save every N batches (plus every pass end)")
+    t.add_argument("--bad-step-policy", choices=("skip", "rollback"),
+                   default="rollback")
+    t.add_argument("--max-bad-steps", type=int, default=3)
+    t.add_argument("--lr-backoff", type=float, default=None,
+                   help="multiply the effective LR by this on each "
+                        "rollback (0 < x < 1)")
+    t.add_argument("--watchdog-timeout", type=float, default=None,
+                   help="abort (exit 75) if no step completes for this "
+                        "many seconds — bounds wedged-collective hangs")
     t.add_argument("--seed", type=int, default=0)
     t.add_argument("--coordinator", default=None,
                    help="host:port of process 0 for multi-host jobs")

@@ -189,6 +189,52 @@ def xmap_readers(mapper: Callable, reader: Reader, process_num: int,
     return new_reader
 
 
+def retrying(reader: Reader, *, max_retries: int = 3,
+             backoff_base: float = 0.05, backoff_max: float = 2.0,
+             seed=None, retryable=(Exception,),
+             on_retry: Callable[[int, BaseException], None] = None
+             ) -> Reader:
+    """Restart the stream on failure instead of killing the pass, with
+    exponential backoff + seeded jitter between attempts.
+
+    Designed for master-backed readers (MasterClient.record_reader):
+    there a restart RE-PULLS only unfinished task leases — finished
+    tasks are never re-served and the failed task had yielded nothing
+    (buffer-then-finish), so the retried pass sees no lost or
+    duplicated records. For a plain in-memory reader a restart replays
+    from the start — compose with the master reader (or something
+    equally resumable) when exactly-once matters.
+
+    The retry budget is per-stream and CONSECUTIVE-failure based: any
+    successfully yielded sample resets it, so a long pass with
+    scattered transient faults is not capped at `max_retries` total.
+    `on_retry(attempt, exc)` observes each recovery (tests, metrics).
+    """
+
+    def new_reader():
+        import time as _time
+
+        rng = random_mod.Random(seed)
+        attempt = 0
+        while True:
+            try:
+                for item in reader():
+                    attempt = 0
+                    yield item
+                return
+            except retryable as e:
+                attempt += 1
+                if attempt > max_retries:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                ceiling = min(backoff_base * (2 ** (attempt - 1)),
+                              backoff_max)
+                _time.sleep(rng.uniform(0, ceiling))
+
+    return new_reader
+
+
 def cache(reader: Reader) -> Reader:
     """Materialize once, then replay from memory."""
     data: List[Any] = []

@@ -11,6 +11,7 @@ import ctypes
 import enum
 import json
 import os
+import random as _random
 import re
 import socket
 import struct
@@ -202,17 +203,101 @@ _OP_SAVE_ELECT, _OP_ADD, _OP_START, _OP_PASS = 6, 7, 8, 9
 
 
 class MasterClient:
-    """Socket client for MasterServer (reference: go/master/client.go)."""
+    """Socket client for MasterServer (reference: go/master/client.go).
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self._sock = socket.create_connection((host, port))
+    Hardened against master death (the reference survives it via etcd
+    re-discovery + gRPC retry; here the restarted master — HAMaster —
+    comes back on the same address): every socket op carries a DEFAULT
+    TIMEOUT (no call can block in recv forever on a dead peer), and
+    `_call` retries with exponential backoff + jitter, reconnecting a
+    fresh socket each attempt (a timeout mid-frame desyncs the framing,
+    so the old socket is never reused). Idempotent ops retry freely:
+    get_task re-issues a lease (a lost one expires), finish/fail on an
+    already-resolved lease are tolerated no-ops server-side, and the
+    rest of the retried set are reads. add_task and next_pass are NOT
+    idempotent (a lost response + re-send would register a duplicate
+    task / trip the next pass's drain check), so they get connection
+    setup with retry but a SINGLE send attempt — a lost response
+    surfaces as ConnectionError for the caller to resolve.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, *,
+                 timeout: float = 30.0, retries: int = 5,
+                 backoff_base: float = 0.05, backoff_max: float = 2.0,
+                 seed: Optional[int] = None):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.retries = retries
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self._rng = _random.Random(seed)
+        self._sock: Optional[socket.socket] = None
+        # eager connect, but through the same bounded backoff schedule
+        # as every RPC: a master mid-restart is a normal condition
+        self._with_retry(lambda: None)
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout)
+        self._sock.settimeout(self.timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
-    def _call(self, payload: bytes) -> bytes:
-        self._sock.sendall(struct.pack("<I", len(payload)) + payload)
-        hdr = self._recv_full(4)
-        (n,) = struct.unpack("<I", hdr)
-        return self._recv_full(n)
+    def _drop_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _backoff(self, attempt: int) -> float:
+        # full jitter: uniform in (0, base * 2^attempt], capped
+        ceiling = min(self.backoff_base * (2 ** attempt),
+                      self.backoff_max)
+        return self._rng.uniform(0, ceiling) or ceiling / 2
+
+    def _with_retry(self, fn):
+        import time as _time
+
+        last: Optional[BaseException] = None
+        for attempt in range(self.retries + 1):
+            if attempt:
+                _time.sleep(self._backoff(attempt - 1))
+            try:
+                if self._sock is None:
+                    self._connect()
+                return fn()
+            except (ConnectionError, socket.timeout, OSError) as e:
+                last = e
+                self._drop_sock()
+        raise ConnectionError(
+            f"master at {self.host}:{self.port} unreachable after "
+            f"{self.retries + 1} attempts: {last}") from last
+
+    def _call(self, payload: bytes, idempotent: bool = True) -> bytes:
+        def send_recv():
+            self._sock.sendall(
+                struct.pack("<I", len(payload)) + payload)
+            hdr = self._recv_full(4)
+            (n,) = struct.unpack("<I", hdr)
+            return self._recv_full(n)
+
+        if idempotent:
+            return self._with_retry(send_recv)
+        # non-idempotent: RECONNECTING is safe, RE-SENDING is not (the
+        # server may have processed the op and only the response was
+        # lost) — retry connection setup, then one send attempt
+        if self._sock is None:
+            self._with_retry(lambda: None)
+        try:
+            return send_recv()
+        except (ConnectionError, socket.timeout, OSError) as e:
+            self._drop_sock()
+            raise ConnectionError(
+                f"non-idempotent op to {self.host}:{self.port} failed "
+                f"mid-flight ({e}); NOT retried — the master may or "
+                f"may not have applied it") from e
 
     def _recv_full(self, n: int) -> bytes:
         chunks = []
@@ -225,7 +310,7 @@ class MasterClient:
         return b"".join(chunks)
 
     def add_task(self, payload: bytes) -> int:
-        resp = self._call(bytes([_OP_ADD]) + payload)
+        resp = self._call(bytes([_OP_ADD]) + payload, idempotent=False)
         if resp[0] != 0:
             raise ValueError("task payload rejected (exceeds size cap)")
         return struct.unpack_from("<Q", resp, 1)[0]
@@ -252,7 +337,7 @@ class MasterClient:
             raise KeyError(f"unknown task id {task_id}")
 
     def next_pass(self) -> int:
-        resp = self._call(bytes([_OP_NEXT_PASS]))
+        resp = self._call(bytes([_OP_NEXT_PASS]), idempotent=False)
         (p,) = struct.unpack_from("<q", resp, 1)
         if p < 0:
             raise RuntimeError("pass not drained: tasks still outstanding")
@@ -274,23 +359,48 @@ class MasterClient:
         return struct.unpack_from("<q", resp, 1)[0]
 
     def close(self):
-        self._sock.close()
+        self._drop_sock()
 
     # -- record streaming (go/master/client.go NextRecord equivalent) --
 
-    def record_reader(self):
+    def record_reader(self, *, max_task_failures: int = 3,
+                      poll_s: float = 0.05, exactly_once: bool = True):
         """Reader over the master's recordio-chunk tasks: pulls a task,
-        streams its records, marks it finished; yields until PASS_END."""
+        reads ALL its records, then yields them; repeats until
+        PASS_END. A read error fails the lease and moves on instead of
+        killing the pass (reference: go/master/client.go taskFailed),
+        up to `max_task_failures` consecutive failures; master death
+        mid-pass is carried by `_call`'s reconnect. Tasks are chunk
+        ranges, so the buffer is bounded.
+
+        `exactly_once` picks the delivery tradeoff — buffering means a
+        failure DURING the read never yields a partial task either way,
+        the choice is when the lease is finished:
+
+        - True (default): finish-then-yield. This consumer sees each
+          record at most once (re-pulls after a failed read re-serve a
+          task that yielded nothing) — but if the worker dies between
+          finish and the consumer draining the buffer, those records
+          are lost to the pass (the master counts the task done).
+          Right for single-worker streams and restarts driven by
+          `data.reader.retrying`, where re-yield would double-train.
+        - False: yield-then-finish, the reference Go client's
+          at-least-once. A worker death mid-yield lets the lease
+          expire and ANOTHER worker re-serves the full task — no loss,
+          but records yielded before the death are seen twice by the
+          pass. Right for multi-worker pools that tolerate duplicates.
+        """
         def reader():
+            import time as _time
+
+            failures = 0
             while True:
                 status, tid, payload = self.get_task()
                 if status == TaskStatus.PASS_END:
                     return
                 if status in (TaskStatus.PENDING_WAIT,
                               TaskStatus.NOT_STARTED):
-                    import time
-
-                    time.sleep(0.05)
+                    _time.sleep(poll_s)
                     continue
                 try:
                     spec = json.loads(payload.decode())
@@ -298,12 +408,28 @@ class MasterClient:
 
                     with RecordReader(spec["path"], spec["chunk_begin"],
                                       spec["chunk_end"]) as rr:
-                        for rec in rr:
-                            yield rec
+                        recs = list(rr)
                 except Exception:
-                    self.fail_task(tid)
-                    raise
-                self.finish_task(tid)
+                    failures += 1
+                    try:
+                        self.fail_task(tid)
+                    except (KeyError, ConnectionError):
+                        pass    # stale lease / dead master: requeues
+                                # via lease timeout anyway
+                    if failures > max_task_failures:
+                        raise
+                    continue
+                failures = 0
+                # a stale finish is a tolerated no-op server-side (the
+                # task was re-served elsewhere after a lease timeout)
+                if exactly_once:
+                    self.finish_task(tid)
+                    for rec in recs:
+                        yield rec
+                else:
+                    for rec in recs:
+                        yield rec
+                    self.finish_task(tid)
 
         return reader
 

@@ -14,6 +14,9 @@ a safe no-op there.
 
 from __future__ import annotations
 
+import logging
+import os
+import sys
 from typing import Optional
 
 import jax
@@ -117,6 +120,26 @@ def broadcast_from_primary(pytree):
     from jax.experimental import multihost_utils
 
     return multihost_utils.broadcast_one_to_all(pytree)
+
+
+def abort(reason: str, exit_code: int = 75) -> None:
+    """Force-exit THIS process immediately (`os._exit` — no atexit, no
+    flushing of device work). The clean abort for a wedged collective:
+    the main thread is blocked in an uninterruptible device wait, so
+    exceptions and signals cannot reach it; process death is the only
+    unstick, and under gang scheduling (k8s JobSet restartPolicy — the
+    etcd-lease-expiry analog, reference: go/master/etcd_client.go) a
+    non-zero exit restarts the whole job into the checkpoint-resume
+    path. Used by train.resilience.Watchdog as the default timeout
+    action."""
+    logging.getLogger(__name__).critical(
+        "aborting process %d: %s", os.getpid(), reason)
+    try:
+        sys.stderr.write(f"paddle_tpu ABORT: {reason}\n")
+        sys.stderr.flush()
+    except Exception:
+        pass
+    os._exit(exit_code)
 
 
 def replicated_agree(value) -> bool:

@@ -204,6 +204,10 @@ class DecodeEngine:
         # pad keys masked out exactly like generate(prompt_lens=...)
         attn = lambda q, k, v: T._attention(
             cfg, q, k, v, causal=True, key_lens=true_len[None])
+        # bucket-pad tokens must not claim MoE expert capacity either —
+        # the same key_ok mask generate()/loss()/score() pass through
+        # to the router (transformer.py _forward token_mask)
+        tok_mask = (jnp.arange(t0) < true_len)[None, :]
         z = jnp.int32(0)
 
         def write_slot(buf, new):
@@ -239,7 +243,7 @@ class DecodeEngine:
 
         caches = []
         for p, (k_buf, v_buf) in zip(params["blocks"], state.caches):
-            x, k, v, _ = T._block_parts(cfg, p, x, pos, attn)
+            x, k, v, _ = T._block_parts(cfg, p, x, pos, attn, tok_mask)
             caches.append((write_slot(k_buf, ring(k)),
                            write_slot(v_buf, ring(v))))
         # first token reads the LAST REAL position's logits
@@ -290,14 +294,25 @@ class DecodeEngine:
         different sampling share one compiled step. Incompatible with
         a pool-wide select_fn override."""
         t0 = int(prompt.shape[-1])
-        if self.cfg.attn_window is None and t0 >= self.max_len:
-            # a physical bound of the full-length cache only — the
-            # windowed ring holds any prompt (it keeps the last W)
-            raise ValueError(f"prompt len {t0} >= max_len {self.max_len}")
         if true_len is None:
             true_len = t0
         elif not (1 <= true_len <= t0):
             raise ValueError(f"true_len {true_len} not in [1, {t0}]")
+        if self.cfg.attn_window is None:
+            # physical bounds of the full-length cache only — the
+            # windowed ring holds any prompt (it keeps the last W).
+            # The REAL length is what must leave room for >= 1
+            # generated token; padded bucket length merely has to fit
+            # the cache rows (a short prompt in a max_len-sized bucket
+            # is fine — its pad tail is never read).
+            if t0 > self.max_len:
+                raise ValueError(
+                    f"padded prompt len {t0} exceeds cache max_len "
+                    f"{self.max_len}")
+            if true_len >= self.max_len:
+                raise ValueError(
+                    f"prompt true_len {true_len} >= max_len "
+                    f"{self.max_len}: no room for a generated token")
         sampling = sampling or {}
         if sampling and self.select_fn is not None:
             raise ValueError(
@@ -370,7 +385,13 @@ class DecodeEngine:
                 new_caches.append((k_buf, v_buf))
                 return out
 
-            x, _, _, _ = T._block_parts(cfg, p, x, pos, attn)
+            # inactive slots must not claim MoE expert capacity: their
+            # compute is dead (writes drop, reads masked) but without a
+            # token_mask the router would still count them against the
+            # per-expert budget and could evict REAL tokens under a
+            # tight capacity_factor
+            x, _, _, _ = T._block_parts(cfg, p, x, pos, attn,
+                                        state.active[:, None])
         keys = jax.vmap(jax.random.split)(state.rng)   # [S, 2] keys
         rng, sub = keys[:, 0], keys[:, 1]
         logits = T._head(params, x[:, -1])
@@ -465,6 +486,15 @@ class DecodeEngine:
             raise ValueError(
                 f"sampling has {len(sampling)} entries for "
                 f"{len(prompts)} prompts")
+        if buckets is not None and self.cfg.attn_window is None:
+            # fail BEFORE any decode work: a bucket the cache cannot
+            # hold would otherwise surface as a mid-run ValueError from
+            # admit() after earlier requests already burned chip time
+            too_big = [b for b in buckets if b > self.max_len]
+            if too_big:
+                raise ValueError(
+                    f"buckets {too_big} exceed max_len {self.max_len}: "
+                    f"padded prefills cannot fit the cache")
 
         def bucketed(p):
             t0 = int(p.shape[-1])

@@ -1,0 +1,4 @@
+"""Test-support utilities: deterministic fault injection for the
+resilience layer (see testing.faults)."""
+
+from paddle_tpu.testing.faults import FaultError, FaultPlan

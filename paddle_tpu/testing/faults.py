@@ -1,0 +1,193 @@
+"""Deterministic, seed-driven fault injection.
+
+The resilience layer (train.resilience, the hardened MasterClient and
+reader path) is only trustworthy if every recovery path is provable
+end-to-end — the reference proved its Go runtime the same way, with
+in-process fault tests rather than chaos in production (reference:
+go/master/service_internal_test.go kills trainers mid-pass;
+trainer/tests run real pservers on localhost). `FaultPlan` is the one
+switchboard: a test declares WHERE faults strike (sample index, global
+batch index, nth checkpoint save, nth master RPC) and wraps the real
+component; every fault fires deterministically (and exactly once by
+default), is recorded in `plan.fired`, and the wrapped component
+otherwise behaves identically — so a passing recovery test means the
+recovery path ran, not that the fault missed.
+
+Fault classes covered, mapping to docs/RELIABILITY.md's fault model:
+- reader exceptions at sample k, or at a seeded random rate
+  (`wrap_reader`) — the flaky-input-pipeline case;
+- an injected all-NaN batch at global step k (`wrap_batches`) — a real
+  poisoned update: the NaN flows through forward/backward into loss
+  AND gradients, so detection and rollback are exercised honestly,
+  not simulated;
+- a simulated preemption: SIGTERM to this process right before batch
+  k is consumed (`wrap_batches`) — exercises the drain-save path;
+- checkpoint-write OSError on the nth save (`wrap_checkpoint_manager`);
+- master-connection drop before the nth RPC (`wrap_master_client`) —
+  exercises MasterClient's backoff-reconnect.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import random
+import signal
+from typing import Any, Callable, List, Optional
+
+
+class FaultError(RuntimeError):
+    """The exception injected faults raise — distinct from real errors
+    so tests can assert the failure they caused is the one handled."""
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """Declarative fault schedule. All indices are 0-based; `once=True`
+    (default) makes each fault fire a single time — the recovery path
+    must then succeed against an otherwise healthy component."""
+
+    seed: int = 0
+    reader_error_at: Optional[int] = None     # sample index
+    reader_error_rate: float = 0.0            # seeded per-sample chance
+    nan_batch_at: Optional[int] = None        # global batch index
+    preempt_at: Optional[int] = None          # global batch index
+    preempt_signal: int = signal.SIGTERM
+    checkpoint_error_at: Optional[int] = None  # nth save() call
+    master_drop_at: Optional[int] = None      # nth MasterClient RPC
+    once: bool = True
+    fired: List[str] = dataclasses.field(default_factory=list)
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+        self._batch_counter = 0
+        self._save_counter = 0
+        self._call_counter = 0
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def _note(self, kind: str, detail: Any) -> None:
+        self.fired.append(f"{kind}@{detail}")
+
+    def count(self, kind: str) -> int:
+        return sum(1 for f in self.fired if f.startswith(f"{kind}@"))
+
+    def _spent(self, kind: str) -> bool:
+        return self.once and self.count(kind) > 0
+
+    # -- reader faults ----------------------------------------------------
+
+    def wrap_reader(self, reader: Callable) -> Callable:
+        """Wrap a data.reader-style reader (zero-arg callable returning
+        an iterator): raises FaultError at sample `reader_error_at`
+        and/or at a seeded `reader_error_rate` per sample. The faulted
+        sample is NOT consumed from the inner reader — a retried stream
+        sees it again (no silent loss)."""
+        plan = self
+
+        def new_reader():
+            for i, item in enumerate(reader()):
+                hit = (plan.reader_error_at == i
+                       and not plan._spent("reader"))
+                if not hit and plan.reader_error_rate > 0:
+                    hit = (plan._rng.random() < plan.reader_error_rate
+                           and not plan._spent("reader"))
+                if hit:
+                    plan._note("reader", i)
+                    raise FaultError(f"injected reader fault at "
+                                     f"sample {i}")
+                yield item
+
+        return new_reader
+
+    # -- batch-level faults (NaN poisoning, preemption) -------------------
+
+    def wrap_batches(self, batch_iter_factory: Callable) -> Callable:
+        """Wrap a batch_iter_factory (what Trainer/ResilientTrainer
+        consume). The batch counter is GLOBAL across factory calls, so
+        `nan_batch_at`/`preempt_at` address the training run's step
+        index even across passes and rollback replays (a replayed index
+        is only poisoned again with once=False)."""
+        plan = self
+
+        def factory():
+            for batch in batch_iter_factory():
+                idx = plan._batch_counter
+                plan._batch_counter += 1
+                if idx == plan.preempt_at and not plan._spent("preempt"):
+                    plan._note("preempt", idx)
+                    os.kill(os.getpid(), plan.preempt_signal)
+                if idx == plan.nan_batch_at and not plan._spent("nan"):
+                    plan._note("nan", idx)
+                    batch = _poison_batch(batch)
+                yield batch
+
+        return factory
+
+    # -- checkpoint faults ------------------------------------------------
+
+    def wrap_checkpoint_manager(self, manager) -> "_FlakyCheckpoints":
+        return _FlakyCheckpoints(manager, self)
+
+    # -- master-connection faults -----------------------------------------
+
+    def wrap_master_client(self, client):
+        """Monkeypatch a native.MasterClient so its socket is torn down
+        right before the `master_drop_at`-th RPC — the client's
+        backoff-reconnect path must then carry the call."""
+        plan = self
+        inner_call = client._call
+
+        def flaky_call(payload, idempotent=True):
+            idx = plan._call_counter
+            plan._call_counter += 1
+            if idx == plan.master_drop_at and not plan._spent("drop"):
+                plan._note("drop", idx)
+                try:
+                    client._sock.close()
+                except (OSError, AttributeError):
+                    pass    # already dropped — the fault still "fired"
+            return inner_call(payload, idempotent=idempotent)
+
+        client._call = flaky_call
+        return client
+
+
+def _poison_batch(batch):
+    """Replace every float array in the batch with NaNs — a genuinely
+    divergent step (NaN forward, NaN loss, NaN grads), not a cosmetic
+    one."""
+    import numpy as np
+
+    def poison(x):
+        arr = np.asarray(x)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return x
+
+    if isinstance(batch, tuple):
+        return tuple(poison(x) for x in batch)
+    return poison(batch)
+
+
+class _FlakyCheckpoints:
+    """CheckpointManager proxy: the `checkpoint_error_at`-th save()
+    raises OSError (the full-disk / flaky-NFS case); everything else
+    delegates."""
+
+    def __init__(self, manager, plan: FaultPlan):
+        self._manager = manager
+        self._plan = plan
+
+    def save(self, state, step: Optional[int] = None):
+        idx = self._plan._save_counter
+        self._plan._save_counter += 1
+        if (idx == self._plan.checkpoint_error_at
+                and not self._plan._spent("ckpt")):
+            self._plan._note("ckpt", idx)
+            raise OSError(f"injected checkpoint-write failure on "
+                          f"save #{idx}")
+        return self._manager.save(state, step)
+
+    def __getattr__(self, name):
+        return getattr(self._manager, name)

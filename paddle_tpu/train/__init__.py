@@ -1,4 +1,4 @@
-"""Training drivers, events, state."""
+"""Training drivers, events, state, fault-tolerant runtime."""
 
 from paddle_tpu.train import events
 from paddle_tpu.train.state import TrainState
@@ -9,4 +9,12 @@ from paddle_tpu.train.checkpoint import (
     load_inference_artifact,
     load_parameters_tar,
     save_parameters_tar,
+)
+from paddle_tpu.train.resilience import (
+    DivergenceError,
+    Preempted,
+    ResilientTrainer,
+    Watchdog,
+    restore_with_fallback,
+    run_resilient,
 )

@@ -104,6 +104,35 @@ class CheckpointManager:
 
 # ---- v2 Parameters tar parity (reference: v2/parameters.py:328,358) ----
 
+def _tar_member(tar: tarfile.TarFile, name: str, path: str) -> bytes:
+    """Fetch one member with a CLEAR error for the corruption cases a
+    torn write produces: missing member, truncated archive, unreadable
+    data — a garbage restore must never get past here."""
+    try:
+        f = tar.extractfile(name)
+    except KeyError:
+        f = None
+    except tarfile.TarError as e:
+        raise ValueError(f"{path}: corrupt tar while reading {name!r}: "
+                         f"{e}") from e
+    if f is None:
+        raise ValueError(
+            f"{path}: member {name!r} missing — not a paddle_tpu "
+            f"checkpoint tar, or a half-written one")
+    try:
+        return f.read()
+    except (tarfile.TarError, EOFError, OSError) as e:
+        raise ValueError(f"{path}: member {name!r} unreadable "
+                         f"(truncated write?): {e}") from e
+
+
+def _tar_manifest(tar: tarfile.TarFile, path: str) -> dict:
+    raw = _tar_member(tar, "manifest.json", path)
+    try:
+        return json.loads(raw)
+    except (json.JSONDecodeError, UnicodeDecodeError) as e:
+        raise ValueError(f"{path}: corrupt manifest.json: {e}") from e
+
 def save_parameters_tar(params: Any, path: str) -> None:
     """Serialize a parameter pytree to a tar of raw .npy members + a JSON
     manifest — the portable, mesh-independent format (reference:
@@ -133,9 +162,17 @@ def load_parameters_tar(template: Any, path: str) -> Any:
     `template` (reference: Parameters.from_tar
     python/paddle/v2/parameters.py:358)."""
     flat_kp, treedef = jax.tree_util.tree_flatten_with_path(template)
-    with tarfile.open(path, "r") as tar:
-        manifest = json.loads(tar.extractfile("manifest.json").read())
-        entries = manifest["params"]
+    try:
+        tar_ctx = tarfile.open(path, "r")
+    except (tarfile.TarError, EOFError) as e:
+        raise ValueError(f"{path}: not a readable checkpoint tar "
+                         f"(truncated or corrupt): {e}") from e
+    with tar_ctx as tar:
+        manifest = _tar_manifest(tar, path)
+        entries = manifest.get("params")
+        if entries is None:
+            raise ValueError(f"{path}: manifest.json has no 'params' — "
+                             f"not a parameters tar")
         if len(entries) != len(flat_kp):
             raise ValueError(
                 f"checkpoint has {len(entries)} params, template has "
@@ -147,7 +184,12 @@ def load_parameters_tar(template: Any, path: str) -> Any:
                 raise ValueError(
                     f"param {i}: saved key {entry['key']!r} != template key "
                     f"{name!r} — parameter order/naming mismatch")
-            arr = np.load(io.BytesIO(tar.extractfile(f"param_{i}.npy").read()))
+            raw = _tar_member(tar, f"param_{i}.npy", path)
+            try:
+                arr = np.load(io.BytesIO(raw))
+            except (ValueError, EOFError, OSError) as e:
+                raise ValueError(f"{path}: param_{i}.npy is not a valid "
+                                 f".npy (torn write?): {e}") from e
             if tuple(arr.shape) != tuple(np.shape(tmpl)):
                 raise ValueError(
                     f"param {entry['key']}: saved shape {arr.shape} != "
@@ -187,9 +229,17 @@ def load_inference_artifact(params_template: Any, model_state_template: Any,
     """Restore (params, model_state, meta) from an inference artifact."""
     bundle = {"params": params_template, "model_state": model_state_template}
     flat_kp, treedef = jax.tree_util.tree_flatten_with_path(bundle)
-    with tarfile.open(path, "r") as tar:
-        manifest = json.loads(tar.extractfile("manifest.json").read())
-        entries = manifest["tensors"]
+    try:
+        tar_ctx = tarfile.open(path, "r")
+    except (tarfile.TarError, EOFError) as e:
+        raise ValueError(f"{path}: not a readable inference artifact "
+                         f"(truncated or corrupt): {e}") from e
+    with tar_ctx as tar:
+        manifest = _tar_manifest(tar, path)
+        entries = manifest.get("tensors")
+        if entries is None:
+            raise ValueError(f"{path}: manifest.json has no 'tensors' — "
+                             f"not an inference artifact")
         if len(entries) != len(flat_kp):
             raise ValueError(
                 f"artifact has {len(entries)} tensors, template has "
@@ -201,7 +251,8 @@ def load_inference_artifact(params_template: Any, model_state_template: Any,
                 raise ValueError(
                     f"tensor {i}: saved key {entry['key']!r} != template key "
                     f"{name!r} — architecture mismatch")
-            arr = np.load(io.BytesIO(tar.extractfile(f"tensor_{i}.npy").read()))
+            arr = np.load(io.BytesIO(_tar_member(tar, f"tensor_{i}.npy",
+                                                 path)))
             if tuple(arr.shape) != tuple(np.shape(tmpl)):
                 raise ValueError(
                     f"tensor {entry['key']}: saved shape {arr.shape} != "

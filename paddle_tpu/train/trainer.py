@@ -188,6 +188,10 @@ class Trainer:
         self.optimizer = optimizer
         self.metrics_fn = metrics_fn
         self.num_inputs = num_inputs
+        # kept so wrappers (train.resilience) can rebuild an equivalent
+        # step with different donation/optimizer settings
+        self.remat = remat
+        self.aux_loss_weight = aux_loss_weight
         self._rng = jax.random.key(seed)
         self._train_step = make_train_step(
             model, loss_fn, optimizer, metrics_fn=metrics_fn, remat=remat,

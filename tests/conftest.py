@@ -31,6 +31,16 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 fast gate "
+        "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "faults: fault-injection resilience suite "
+        "(testing.faults) — fast and CPU-only, runs IN tier-1; the "
+        "marker exists so `-m faults` can run recovery paths alone")
+
+
 @pytest.fixture
 def rng():
     return jax.random.key(0)

@@ -170,6 +170,134 @@ def test_restore_missing_dir(tmp_path):
     mgr.close()
 
 
+# ---- corruption: torn writes must fail loudly or fall back ----------
+
+
+def test_tar_truncated_is_clear_error(tmp_path):
+    """A truncated tar (torn write / partial upload) must produce a
+    clear ValueError naming the file, never a garbage restore."""
+    model = _model()
+    params, _ = model.init(jax.random.key(0), ShapeSpec((4, 5)))
+    path = str(tmp_path / "params.tar")
+    save_parameters_tar(params, path)
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[: len(data) // 3])     # tear the write
+    with pytest.raises(ValueError, match="params.tar"):
+        load_parameters_tar(jax.tree.map(jnp.zeros_like, params), path)
+
+
+def test_tar_missing_manifest_is_clear_error(tmp_path):
+    import io
+    import tarfile
+
+    path = str(tmp_path / "bogus.tar")
+    with tarfile.open(path, "w") as tar:
+        info = tarfile.TarInfo(name="param_0.npy")
+        info.size = 4
+        tar.addfile(info, io.BytesIO(b"\0\0\0\0"))
+    model = _model()
+    params, _ = model.init(jax.random.key(0), ShapeSpec((4, 5)))
+    with pytest.raises(ValueError, match="manifest.json"):
+        load_parameters_tar(params, path)
+
+
+def test_tar_corrupt_manifest_is_clear_error(tmp_path):
+    import io
+    import tarfile
+
+    path = str(tmp_path / "bad-manifest.tar")
+    with tarfile.open(path, "w") as tar:
+        blob = b"{not json"
+        info = tarfile.TarInfo(name="manifest.json")
+        info.size = len(blob)
+        tar.addfile(info, io.BytesIO(blob))
+    model = _model()
+    params, _ = model.init(jax.random.key(0), ShapeSpec((4, 5)))
+    with pytest.raises(ValueError, match="manifest"):
+        load_parameters_tar(params, path)
+
+
+def test_tar_manifest_mismatch_is_clear_error(tmp_path):
+    """manifest.json from a DIFFERENT model (wrong count / keys) must
+    be rejected with the mismatch named."""
+    model = _model()
+    params, _ = model.init(jax.random.key(0), ShapeSpec((4, 5)))
+    other = nn.Sequential([nn.Dense(8, name="zz", activation="relu"),
+                           nn.Dense(3, name="out")])
+    oparams, _ = other.init(jax.random.key(0), ShapeSpec((4, 5)))
+    path = str(tmp_path / "other.tar")
+    save_parameters_tar(oparams, path)
+    with pytest.raises(ValueError, match="key"):
+        load_parameters_tar(params, path)
+
+
+def test_inference_artifact_truncated_is_clear_error(tmp_path):
+    model = _model()
+    params, mstate = model.init(jax.random.key(0), ShapeSpec((4, 5)))
+    path = str(tmp_path / "model.tar")
+    export_inference_artifact(params, mstate, path)
+    data = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(data[: 100])
+    with pytest.raises(ValueError, match="model.tar"):
+        load_inference_artifact(params, mstate, path)
+
+
+@pytest.mark.faults
+def test_resilient_restore_falls_back_past_corrupt_step(tmp_path):
+    """A half-written/corrupt orbax step (newest) must not poison
+    resume: restore_with_fallback walks back to the previous intact
+    step — the ResilientTrainer startup path."""
+    import os
+    import shutil
+
+    from paddle_tpu.train import restore_with_fallback
+
+    model = _model()
+    tr = Trainer(model, _loss, optim.sgd(0.1))
+    state = tr.init_state(ShapeSpec((4, 5)))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=5)
+    mgr.save(state, step=1)
+    # the train step donates its input buffers — keep a host copy
+    params1 = jax.tree.map(np.asarray, state.params)
+    rng = np.random.RandomState(0)
+    batch = (rng.rand(4, 5).astype(np.float32), rng.randint(0, 3, 4))
+    state2 = tr.train(state, lambda: iter([batch]), num_passes=1)
+    mgr.save(state2, step=9)
+
+    # corrupt the NEWEST committed step: empty every array file under
+    # it (the half-written-then-power-cut shape orbax's commit marker
+    # cannot catch, because the marker is already there)
+    step_dir = os.path.join(str(tmp_path / "ckpt"), "9")
+    assert os.path.isdir(step_dir)
+    for root, dirs, files in os.walk(step_dir):
+        for fn in files:
+            if fn.endswith((".json", "metadata")):
+                continue
+            with open(os.path.join(root, fn), "wb"):
+                pass
+    template = tr.init_state(ShapeSpec((4, 5)))
+    restored, step = restore_with_fallback(mgr, template)
+    assert step == 1
+    _trees_equal(restored.params, params1)
+    mgr.close()
+
+
+@pytest.mark.faults
+def test_resilient_restore_nothing_restorable(tmp_path):
+    from paddle_tpu.train import restore_with_fallback
+
+    model = _model()
+    tr = Trainer(model, _loss, optim.sgd(0.1))
+    template = tr.init_state(ShapeSpec((2, 5)))
+    mgr = CheckpointManager(str(tmp_path / "none"))
+    restored, step = restore_with_fallback(mgr, template)
+    assert step is None
+    assert restored is template
+    mgr.close()
+
+
 def test_async_checkpoint_roundtrip(tmp_path):
     """async_save=True: save() returns before the write is durable;
     wait()/restore() must still hand back exactly what was saved, and

@@ -343,6 +343,68 @@ def test_moe_pool_matches_generate():
         assert g == [int(t) for t in np.asarray(out[0, len(pr):])], pr
 
 
+def test_moe_buckets_tight_capacity_matches_generate():
+    """MoE + bucket padding + inactive slots under a TIGHT capacity
+    factor: bucket-pad tokens (prefill) and inactive slots (decode)
+    must claim NO expert capacity — with the masks missing, padding
+    would evict real tokens at capacity_factor=1.0 and the engine
+    would diverge from the documented exact-greedy generate() parity
+    (ADVICE r5 medium finding)."""
+    cfg = T.TransformerConfig(vocab=61, dim=32, n_layers=2, n_heads=4,
+                              attn_impl="dense", moe_experts=4,
+                              moe_every=2, moe_capacity_factor=1.0)
+    p = T.init_params(jax.random.key(0), cfg)
+    eng = DecodeEngine(p, cfg, slots=2, max_len=24)
+    # short prompts in a 16-wide bucket: most prefill tokens are pads
+    ps = prompts_rng(3, [4, 9, 6], seed=83)
+    got = eng.serve(ps, max_new=6, buckets=(16,))
+    for pr, g in zip(ps, got):
+        out = T.generate(p, cfg, jnp.asarray(pr)[None, :], steps=6)
+        assert g == [int(t) for t in np.asarray(out[0, len(pr):])], pr
+    # decode with an INACTIVE co-slot (solo request in a 2-slot pool):
+    # the dead slot must not eat capacity from the live one
+    solo = eng.serve([ps[0]], max_new=6, buckets=(16,))
+    out = T.generate(p, cfg, jnp.asarray(ps[0])[None, :], steps=6)
+    assert solo[0] == [int(t) for t in np.asarray(out[0, len(ps[0]):])]
+
+
+class TestPrefillLengthValidation:
+    """ADVICE r5 low finding: validate the REAL length, not the padded
+    bucket length, and reject impossible buckets before any decode."""
+
+    def test_bucket_equal_to_max_len_serves_short_prompts(self, params):
+        """serve(buckets=(max_len,)) used to raise mid-run for every
+        prompt (padded t0 >= max_len); short prompts physically fit
+        and must decode exactly like generate()."""
+        eng = DecodeEngine(params, CFG, slots=2, max_len=16)
+        ps = prompts_rng(3, [3, 7, 5], seed=87)
+        got = eng.serve(ps, max_new=4, buckets=(16,))
+        for p, g in zip(ps, got):
+            assert g == ref_tokens(params, p, 4), (p, g)
+
+    def test_bucket_beyond_max_len_fails_up_front(self, params):
+        """An unservable bucket is rejected in serve() BEFORE any
+        prefill/decode work, not mid-run from admit()."""
+        eng = DecodeEngine(params, CFG, slots=2, max_len=16)
+        with pytest.raises(ValueError, match="max_len"):
+            eng.serve(prompts_rng(2, [3, 5], seed=88), max_new=4,
+                      buckets=(24,))
+
+    def test_true_len_at_capacity_rejected(self, params):
+        """A REAL length with no room for even one generated token is
+        still an error (the physical bound that remains)."""
+        eng = DecodeEngine(params, CFG, slots=1, max_len=16)
+        with pytest.raises(ValueError, match="true_len"):
+            eng.prefill(eng.init_state(), 0,
+                        np.arange(16, dtype=np.int32))
+
+    def test_padded_len_beyond_cache_rejected(self, params):
+        eng = DecodeEngine(params, CFG, slots=1, max_len=16)
+        with pytest.raises(ValueError, match="padded"):
+            eng.prefill(eng.init_state(), 0,
+                        np.arange(20, dtype=np.int32), true_len=4)
+
+
 def test_engine_serve_golden():
     """Golden serving transcript (the seq2seq_gen_golden idiom): a
     fixed pool + fixed traffic must reproduce the committed outputs
