@@ -275,16 +275,21 @@ def cmd_serve(args) -> int:
                              ("top_k", args.top_k),
                              ("top_p", args.top_p)) if v is not None}
     sampling = [dict(one) for _ in prompts] if one else None
+    buckets = (tuple(int(b) for b in args.buckets.split(","))
+               if args.buckets else None)
     # open the sink BEFORE the (possibly long) serve run: an
     # unwritable --output must fail fast, not discard the decode work
     sink = open(args.output, "w") if args.output else sys.stdout
-    out = eng.serve(prompts, max_new=args.max_new,
-                    buckets=tuple(int(b) for b in args.buckets.split(","))
-                    if args.buckets else None,
-                    sampling=sampling,
-                    return_logprobs=args.logprobs)
-    toks, lps = out if args.logprobs else (out, None)
+    reliable = (args.max_queue is not None
+                or args.default_deadline_ms is not None)
     try:
+        if reliable:
+            return _serve_reliable(args, eng, prompts, sampling,
+                                   buckets, sink)
+        out = eng.serve(prompts, max_new=args.max_new, buckets=buckets,
+                        sampling=sampling,
+                        return_logprobs=args.logprobs)
+        toks, lps = out if args.logprobs else (out, None)
         for i, g in enumerate(toks):
             print(" ".join(str(t) for t in g), file=sink)
             if lps is not None:
@@ -293,6 +298,74 @@ def cmd_serve(args) -> int:
     finally:
         if sink is not sys.stdout:
             sink.close()
+    return 0
+
+
+def _serve_reliable(args, eng, prompts, sampling, buckets, sink):
+    """`serve` with the reliability layer (docs/RELIABILITY.md
+    "Serving fault model"): bounded admission queue + load shedding,
+    per-request deadlines, slot retry, SIGTERM graceful drain. One
+    output line per request IN ORDER — completed requests print their
+    token ids, everything else a `# req <i> <outcome>: <reason>`
+    comment — plus one `# outcomes ...` counters trailer, so a caller
+    can reconcile the whole run from the transcript alone."""
+    from paddle_tpu.serve.server import QueueFullError, ServingServer
+
+    server = ServingServer(
+        eng,
+        max_queue=(args.max_queue if args.max_queue is not None
+                   else 64),
+        default_deadline_ms=args.default_deadline_ms,
+        max_retries=args.max_retries,
+        buckets=buckets,
+        drain_grace_s=args.drain_grace,
+        drain_report_path=args.drain_report,
+        install_signal_handlers=True)
+    # feed the batch AS THE QUEUE DRAINS, like a well-behaved client:
+    # submitting everything up-front would force the shed path on any
+    # batch larger than max_queue even though the pool is idle and the
+    # work is known (the queue bound is for live overload, not a cap
+    # on how much a batch run may serve)
+    ids = {}
+    cursor = [0]
+
+    def feed(_srv=None, _step=None):
+        while (cursor[0] < len(prompts) and server.queue_space > 0
+               and not server.draining):
+            i = cursor[0]
+            cursor[0] += 1
+            try:
+                ids[i] = server.submit(
+                    prompts[i], max_new=args.max_new,
+                    sampling=(sampling[i] if sampling else None))
+            except (ValueError, QueueFullError) as e:
+                # recorded in server.results under its assigned id
+                ids[i] = e.req_id
+
+    server.on_step.append(feed)
+    feed()
+    results = server.run()
+    while cursor[0] < len(prompts) and not server.draining:
+        # the pool drained before the feeder saw a step (e.g. every
+        # queued request expired at admission) — feed the rest
+        feed()
+        results = server.run()
+    for i in range(len(prompts)):
+        if i not in ids:
+            print(f"# req {i} shed: not submitted (draining)",
+                  file=sink)
+            continue
+        res = results[ids[i]]
+        if res.outcome == "completed":
+            print(" ".join(str(t) for t in res.tokens), file=sink)
+            if args.logprobs:
+                print("# logprobs " + " ".join(
+                    f"{x:.4f}" for x in res.logprobs), file=sink)
+        else:
+            print(f"# req {i} {res.outcome}: {res.error}", file=sink)
+    c = server.counters()
+    print("# outcomes " + " ".join(f"{k}={v}" for k, v in c.items()),
+          file=sink)
     return 0
 
 
@@ -459,6 +532,25 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--seed", type=int, default=0)
     sv.add_argument("--logprobs", action="store_true")
     sv.add_argument("--output", default=None)
+    # reliability layer (serve.server): any of --max-queue /
+    # --default-deadline-ms routes through the admission-controlled
+    # scheduler with load shedding, deadlines, retry, SIGTERM drain
+    sv.add_argument("--max-queue", type=int, default=None,
+                    help="bound the admission queue; overflow sheds "
+                         "the cheapest-to-retry request (enables the "
+                         "reliability layer)")
+    sv.add_argument("--default-deadline-ms", type=float, default=None,
+                    help="per-request deadline: expired requests free "
+                         "their slot mid-generation (enables the "
+                         "reliability layer)")
+    sv.add_argument("--drain-grace", type=float, default=30.0,
+                    help="seconds SIGTERM drain waits for in-flight "
+                         "requests before expiring them")
+    sv.add_argument("--max-retries", type=int, default=1,
+                    help="transient-fault requeue budget per request")
+    sv.add_argument("--drain-report", default=None,
+                    help="write the drain report JSON here on "
+                         "graceful shutdown")
     sv.set_defaults(fn=cmd_serve)
 
     ms = sub.add_parser("master")

@@ -233,15 +233,24 @@ class MasterClient:
         self.backoff_max = backoff_max
         self._rng = _random.Random(seed)
         self._sock: Optional[socket.socket] = None
+        self._closed = False
         # eager connect, but through the same bounded backoff schedule
         # as every RPC: a master mid-restart is a normal condition
         self._with_retry(lambda: None)
 
     def _connect(self) -> None:
-        self._sock = socket.create_connection(
+        # build fully configured before publishing to self._sock: a
+        # failure between create and configure must release the fd
+        # here, not leak it behind a half-initialized attribute
+        sock = socket.create_connection(
             (self.host, self.port), timeout=self.timeout)
-        self._sock.settimeout(self.timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        try:
+            sock.settimeout(self.timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except BaseException:
+            sock.close()
+            raise
+        self._sock = sock
 
     def _drop_sock(self) -> None:
         if self._sock is not None:
@@ -260,16 +269,31 @@ class MasterClient:
     def _with_retry(self, fn):
         import time as _time
 
+        if self._closed:
+            raise RuntimeError(
+                "MasterClient is closed — create a new client to "
+                "reconnect")
         last: Optional[BaseException] = None
-        for attempt in range(self.retries + 1):
-            if attempt:
-                _time.sleep(self._backoff(attempt - 1))
-            try:
-                if self._sock is None:
-                    self._connect()
-                return fn()
-            except (ConnectionError, socket.timeout, OSError) as e:
-                last = e
+        ok = False
+        try:
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    _time.sleep(self._backoff(attempt - 1))
+                try:
+                    if self._sock is None:
+                        self._connect()
+                    result = fn()
+                    ok = True
+                    return result
+                except (ConnectionError, socket.timeout, OSError) as e:
+                    last = e
+                    self._drop_sock()
+        finally:
+            # ANY exit other than success — retries exhausted, or a
+            # non-retried exception (KeyboardInterrupt, a bug in fn)
+            # mid-attempt — must not strand an open socket on a
+            # possibly-desynced frame boundary
+            if not ok:
                 self._drop_sock()
         raise ConnectionError(
             f"master at {self.host}:{self.port} unreachable after "
@@ -359,7 +383,26 @@ class MasterClient:
         return struct.unpack_from("<q", resp, 1)[0]
 
     def close(self):
+        """Release the socket and retire the client. Idempotent — safe
+        to call any number of times, from __del__, or after a failed
+        connect (the half-built client holds no socket then). A closed
+        client refuses further RPCs with RuntimeError instead of
+        silently reconnecting: reconnect-after-close was how leaked
+        sockets escaped the drop path."""
+        self._closed = True
         self._drop_sock()
+
+    def __enter__(self) -> "MasterClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     # -- record streaming (go/master/client.go NextRecord equivalent) --
 

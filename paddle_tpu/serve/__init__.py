@@ -10,6 +10,9 @@ from paddle_tpu.serve.artifact import (
 from paddle_tpu.serve import quant
 from paddle_tpu.serve.engine import (DecodeEngine, EngineState,
                                      PoolStats)
+from paddle_tpu.serve.server import (CircuitBreaker, QueueFullError,
+                                     Request, RequestResult,
+                                     ServingServer)
 from paddle_tpu.serve.quant import (
     QuantizedTensor,
     dequantize_params,

@@ -89,15 +89,49 @@ class PoolStats:
     fixed [S]-wide batch of device work); tokens = emitted real
     tokens; utilization = tokens / (steps * slots) — the fraction of
     issued row-steps that produced a kept token (lockstep batching's
-    idle finished rows show up here directly)."""
+    idle finished rows show up here directly).
+
+    The outcome counters are the serving reliability layer's
+    per-request ledger (serve.server, docs/RELIABILITY.md "Serving
+    fault model"): every submitted request lands in EXACTLY ONE of
+    completed/expired/shed/failed; `admitted` counts requests that won
+    a slot (prefilled at least once) and `retried` counts requeue
+    events (not requests). The plain engine.serve() loop — which never
+    sheds, expires, or retries — fills admitted/completed so the
+    ledger reconciles on either path."""
 
     steps: int = 0
     tokens: int = 0
     prefills: int = 0
     requests: int = 0
+    # per-request outcome ledger (serve.server's counters)
+    admitted: int = 0
+    completed: int = 0
+    expired: int = 0
+    shed: int = 0
+    failed: int = 0
+    retried: int = 0
 
     def utilization(self, slots: int) -> float:
         return self.tokens / max(self.steps * slots, 1)
+
+
+def pad_to_bucket(prompt, buckets):
+    """(padded_prompt, true_len) for the smallest bucket >= the real
+    length — THE bucket-padding convention shared by engine.serve()
+    and the reliability server (serve.server), so prefill compile
+    keying cannot drift between the two schedulers. Raises ValueError
+    when no bucket fits; buckets=None passes through unpadded."""
+    import numpy as np
+
+    t0 = int(prompt.shape[-1])
+    if buckets is None:
+        return prompt, t0
+    fits = [b for b in sorted(buckets) if b >= t0]
+    if not fits:
+        raise ValueError(
+            f"prompt len {t0} exceeds largest bucket {max(buckets)}")
+    return np.pad(np.asarray(prompt), (0, fits[0] - t0)), t0
 
 
 class DecodeEngine:
@@ -454,6 +488,17 @@ class DecodeEngine:
         prefill."""
         return self._step_jit(state)
 
+    def release_slot(self, state: EngineState, slot: int) -> EngineState:
+        """Host-side retire of one slot mid-generation: deactivate the
+        row and park its pos on the out-of-range sentinel so the next
+        step's writes drop and its reads stay masked. THE one retire
+        convention — serve()'s token-budget retire and the reliability
+        server's deadline/drain evictions (serve.server) both route
+        here, so the sentinel arithmetic cannot drift between them."""
+        return state._replace(
+            active=state.active.at[slot].set(False),
+            pos=state.pos.at[slot].set(jnp.int32(self.max_len)))
+
     # -- batteries-included host scheduler --------------------------------
 
     def serve(self, prompts, *, max_new: int, buckets=None,
@@ -478,8 +523,6 @@ class DecodeEngine:
         log p(token | prefix) lists (full-softmax convention — the
         reference's SequenceGenerator returns sequence scores the
         same way, api/PaddleAPI.h:1025)."""
-        import numpy as np
-
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         if sampling is not None and len(sampling) != len(prompts):
@@ -495,18 +538,22 @@ class DecodeEngine:
                 raise ValueError(
                     f"buckets {too_big} exceed max_len {self.max_len}: "
                     f"padded prefills cannot fit the cache")
-
-        def bucketed(p):
+        # per-prompt bounds, ALSO at entry: an unservable prompt must
+        # reject before any other request burns chip time, not from
+        # deep inside a mid-run prefill
+        for i, p in enumerate(prompts):
             t0 = int(p.shape[-1])
-            if buckets is None:
-                return p, t0
-            fits = [b for b in sorted(buckets) if b >= t0]
-            if not fits:
+            if t0 < 1:
                 raise ValueError(
-                    f"prompt len {t0} exceeds largest bucket "
+                    f"prompt {i} is empty (need >= 1 token)")
+            if buckets is not None and t0 > max(buckets):
+                raise ValueError(
+                    f"prompt {i} len {t0} exceeds largest bucket "
                     f"{max(buckets)}")
-            pad = fits[0] - t0
-            return np.pad(np.asarray(p), (0, pad)), t0
+            if self.cfg.attn_window is None and t0 >= self.max_len:
+                raise ValueError(
+                    f"prompt {i} true_len {t0} >= max_len "
+                    f"{self.max_len}: no room for a generated token")
 
         state = self.init_state()
         stats = PoolStats(requests=len(prompts))
@@ -521,11 +568,13 @@ class DecodeEngine:
             for slot in range(self.slots):
                 if slot_req[slot] == -1 and queue:
                     req = queue.pop(0)
-                    padded, true_len = bucketed(prompts[req])
+                    padded, true_len = pad_to_bucket(prompts[req],
+                                                     buckets)
                     state = self.prefill(
                         state, slot, padded, true_len=true_len,
                         sampling=(sampling[req] if sampling else None))
                     stats.prefills += 1
+                    stats.admitted += 1
                     slot_req[slot] = req
 
         admit()
@@ -550,11 +599,9 @@ class DecodeEngine:
                         # host-side retire (token budget): deactivate
                         # the device row too so the slot really frees
                         # (device-finished rows already are)
-                        state = state._replace(
-                            active=state.active.at[slot].set(False),
-                            pos=state.pos.at[slot].set(
-                                jnp.int32(self.max_len)))
+                        state = self.release_slot(state, slot)
                     slot_req[slot] = -1
+                    stats.completed += 1
                     freed = True
             if freed:
                 admit()

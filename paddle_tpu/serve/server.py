@@ -1,0 +1,654 @@
+"""Serving reliability layer: the scheduler around DecodeEngine.
+
+`DecodeEngine.serve()` assumes a benevolent world: every request is
+well-formed, every caller waits forever, and the engine never faults.
+Under the ROADMAP's "heavy traffic from millions of users" none of
+that holds, and TPU LLM serving work (Ragged Paged Attention,
+arXiv:2604.15464) locates the availability bottleneck in the request
+scheduler, not the kernel. `ServingServer` is that scheduler — the
+serving counterpart of `train.resilience.ResilientTrainer`, with the
+same prove-it-with-fault-injection discipline (`testing.faults`
+serving plan, `tests/test_serve_server.py`):
+
+- **Bounded admission queue with load shedding.** `submit()` is the
+  explicit-backpressure boundary: malformed requests (garbage/
+  oversized prompts, bad max_new) are rejected synchronously with
+  `ValueError` and never enter the queue; when the queue is full the
+  CHEAPEST-TO-RETRY request (fewest prompt tokens to re-prefill, then
+  most deadline slack, then newest) is shed — dropping the incoming
+  request raises `QueueFullError`, displacing a queued one records it
+  shed and admits the newcomer. Every shed carries the documented
+  "load shed" error text.
+- **Per-request deadlines, enforced mid-generation.** A deadline is
+  fixed at submit time; the host loop checks it at every step
+  boundary, so an expired request frees its slot for queued work
+  instead of finishing dead tokens, and a request that expires while
+  still queued never costs a prefill at all. Partial tokens are kept
+  in the result (outcome "expired").
+- **Slot-level retry/requeue.** A transient fault (FaultError, native
+  bridge error, any non-ValueError) during prefill requeues THAT
+  request at the queue front; during a decode step it requeues every
+  in-flight request — prefill/decode are pure functions of the state,
+  so the held state is never half-mutated and retry is exact. Each
+  requeue spends one unit of the request's retry budget; an exhausted
+  budget ends the request "failed". ValueError is deterministic
+  rejection, never retried.
+- **Graceful drain.** `drain()` (or SIGTERM/SIGINT with
+  `install_signal_handlers=True`, mirroring `train/resilience.py`'s
+  drain-at-the-next-boundary semantics) stops admission, sheds the
+  queue, finishes in-flight requests within `drain_grace_s`, expires
+  whatever is still running past the grace, and persists a drain
+  report (counters + per-request outcomes) to `drain_report_path`.
+- **Circuit breaker over the native path.** When a `native_backend`
+  engine (e.g. the capi_bridge / native_export-served path) is
+  supplied, pool work runs through it until `CircuitBreaker` sees
+  `failure_threshold` consecutive faults — then the server falls back
+  to the pure-JAX engine and keeps serving; after `cooldown_s` the
+  breaker half-opens and the next empty-pool moment probes the native
+  side again (closed on success, re-opened on failure).
+
+Accounting contract (the chaos test's reconciliation invariant): every
+submitted request ends in EXACTLY ONE of completed / expired / shed /
+failed, `stats` counters equal the tally over `results`, and the pool
+keeps serving after any mix of the above.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import signal
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.serve.engine import PoolStats, pad_to_bucket
+
+log = logging.getLogger(__name__)
+
+#: terminal request outcomes — exactly one per submitted request
+COMPLETED = "completed"
+EXPIRED = "expired"
+SHED = "shed"
+FAILED = "failed"
+OUTCOMES = (COMPLETED, EXPIRED, SHED, FAILED)
+
+
+class QueueFullError(RuntimeError):
+    """The admission queue is full and the INCOMING request was the
+    cheapest to retry — the explicit-backpressure signal. The request
+    is recorded shed; the caller should back off and resubmit."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker (closed -> open ->
+    half-open -> closed). `allow()` gates calls: closed and half-open
+    permit them, open refuses until `cooldown_s` has passed on the
+    injected clock (then half-open: ONE probe decides — success closes,
+    failure re-opens for another cooldown). `trips` counts
+    closed->open transitions for observability."""
+
+    def __init__(self, *, failure_threshold: int = 3,
+                 cooldown_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError(f"failure_threshold must be >= 1, got "
+                             f"{failure_threshold}")
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self.clock = clock
+        self.failures = 0
+        self.trips = 0
+        self._opened_at: Optional[float] = None
+        self._half_open = False
+
+    @property
+    def state(self) -> str:
+        if self._opened_at is None:
+            return "closed"
+        if (self._half_open
+                or self.clock() - self._opened_at >= self.cooldown_s):
+            return "half-open"
+        return "open"
+
+    def allow(self) -> bool:
+        st = self.state
+        if st == "half-open":
+            self._half_open = True   # sticky until the probe resolves
+        return st != "open"
+
+    def record_success(self) -> None:
+        self.failures = 0
+        self._opened_at = None
+        self._half_open = False
+
+    def record_failure(self) -> None:
+        self.failures += 1
+        if self._half_open or (self._opened_at is None
+                               and self.failures >= self.failure_threshold):
+            if self._opened_at is None:
+                self.trips += 1
+            self._opened_at = self.clock()
+            self._half_open = False
+
+
+@dataclasses.dataclass
+class Request:
+    """One admitted unit of work. `deadline` is ABSOLUTE on the
+    server's clock (None = wait forever); `retries_left` is the
+    remaining transient-fault budget."""
+
+    req_id: int
+    prompt: np.ndarray
+    true_len: int
+    max_new: int
+    sampling: Optional[dict]
+    deadline: Optional[float]
+    submitted_at: float
+    retries_left: int
+
+    @property
+    def retry_cost(self) -> tuple:
+        """Shed-victim ordering: CHEAPEST first. Cheapest to retry =
+        least prefill work to redo (prompt tokens), then the most
+        deadline slack left (an unconstrained request can always wait),
+        then the newest arrival (least queue time invested)."""
+        slack = -(self.deadline if self.deadline is not None
+                  else float("inf"))
+        return (self.true_len, slack, -self.req_id)
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Terminal record for one submitted request. `tokens` holds
+    whatever was generated before the outcome landed (the full
+    completion for COMPLETED, a partial prefix for EXPIRED, empty
+    otherwise); `error` is the human-readable reason for every
+    non-completed outcome; `backend` names which engine served it."""
+
+    req_id: int
+    outcome: str
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    logprobs: List[float] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    retries: int = 0
+    backend: Optional[str] = None
+    submitted_at: float = 0.0
+    done_at: float = 0.0
+
+
+class ServingServer:
+    """Reliability scheduler over one (or two: native + fallback)
+    DecodeEngine-compatible backends. Drive it synchronously:
+    `submit()` traffic, then `run()` until the queue and pool drain;
+    `on_step` hooks (called after every decode step with
+    `(server, step_index)`) let tests and operators inject mid-run
+    events — more traffic, `drain()`, clock advances."""
+
+    def __init__(self, engine, *, max_queue: int = 64,
+                 default_deadline_ms: Optional[float] = None,
+                 max_retries: int = 1,
+                 buckets: Optional[tuple] = None,
+                 drain_grace_s: float = 30.0,
+                 native_backend=None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 drain_report_path: Optional[str] = None,
+                 install_signal_handlers: bool = False):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got "
+                             f"{max_retries}")
+        if buckets is not None and engine.cfg.attn_window is None:
+            too_big = [b for b in buckets if b > engine.max_len]
+            if too_big:
+                raise ValueError(
+                    f"buckets {too_big} exceed max_len "
+                    f"{engine.max_len}: padded prefills cannot fit "
+                    f"the cache")
+        self.engine = engine              # the pure-JAX fallback
+        self.native_backend = native_backend
+        self.max_queue = max_queue
+        self.default_deadline_ms = default_deadline_ms
+        self.max_retries = max_retries
+        self.buckets = tuple(sorted(buckets)) if buckets else None
+        self.drain_grace_s = drain_grace_s
+        self.clock = clock
+        self.drain_report_path = drain_report_path
+        self.breaker = breaker or (CircuitBreaker(clock=clock)
+                                   if native_backend is not None
+                                   else None)
+        self.install_signal_handlers = install_signal_handlers
+        self.on_step: List[Callable] = []
+
+        self.stats = PoolStats()
+        self.results: Dict[int, RequestResult] = {}
+        self.queue: List[Request] = []
+        self._next_id = 0
+        self._draining = False
+        self._drain_deadline: Optional[float] = None
+        self._drain_reason: Optional[str] = None
+        self.drain_report: Optional[dict] = None
+
+        # active backend + its device pool (rebuilt on backend switch)
+        self._backend = (native_backend if native_backend is not None
+                         else engine)
+        self._state = None
+        self._slot_req: List[Optional[Request]] = []
+        self._emitted: Dict[int, List[int]] = {}
+        self._lps: Dict[int, List[float]] = {}
+
+    @property
+    def draining(self) -> bool:
+        """True once drain() (or a handled SIGTERM/SIGINT) stopped
+        admission — feeders should stop submitting."""
+        return self._draining
+
+    @property
+    def queue_space(self) -> int:
+        """Free admission-queue capacity right now — a well-behaved
+        batch client submits at most this many before the next run()/
+        step instead of forcing the shed path."""
+        return max(self.max_queue - len(self.queue), 0)
+
+    # -- admission ---------------------------------------------------------
+
+    def _validate(self, prompt, max_new: int) -> np.ndarray:
+        cfg = self.engine.cfg
+        arr = np.asarray(prompt)
+        if arr.ndim != 1:
+            raise ValueError(
+                f"prompt must be 1-D token ids, got shape "
+                f"{arr.shape}")
+        if arr.size < 1:
+            raise ValueError("prompt is empty (need >= 1 token)")
+        if not np.issubdtype(arr.dtype, np.integer):
+            raise ValueError(
+                f"prompt must be integer token ids, got dtype "
+                f"{arr.dtype}")
+        if arr.min() < 0 or arr.max() >= cfg.vocab:
+            raise ValueError(
+                f"prompt ids must be in [0, {cfg.vocab}), got range "
+                f"[{arr.min()}, {arr.max()}]")
+        t0 = int(arr.size)
+        if self.buckets is not None and t0 > self.buckets[-1]:
+            raise ValueError(
+                f"prompt len {t0} exceeds largest bucket "
+                f"{self.buckets[-1]}")
+        if cfg.attn_window is None and t0 >= self.engine.max_len:
+            raise ValueError(
+                f"prompt len {t0} >= max_len {self.engine.max_len}: "
+                f"no room for a generated token")
+        if max_new < 1:
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
+        return arr.astype(np.int32)
+
+    def _finish(self, req: Request, outcome: str, *,
+                error: Optional[str] = None,
+                retries: int = 0) -> RequestResult:
+        res = RequestResult(
+            req_id=req.req_id, outcome=outcome,
+            tokens=list(self._emitted.pop(req.req_id, [])),
+            logprobs=list(self._lps.pop(req.req_id, [])),
+            error=error, retries=retries,
+            backend=self._backend_name(),
+            submitted_at=req.submitted_at, done_at=self.clock())
+        self.results[req.req_id] = res
+        setattr(self.stats, outcome, getattr(self.stats, outcome) + 1)
+        return res
+
+    def _backend_name(self) -> str:
+        return ("native" if self._backend is not None
+                and self._backend is self.native_backend else "jax")
+
+    def submit(self, prompt, *, max_new: int,
+               deadline_ms: Optional[float] = -1,
+               sampling: Optional[dict] = None) -> int:
+        """Enqueue one request; returns its req_id. `deadline_ms` is
+        relative to now (-1 = the server default, None = no deadline).
+
+        Raises ValueError for malformed input (recorded FAILED — it
+        never enters the queue) and QueueFullError when the queue is
+        full and the incoming request is the shed victim (recorded
+        SHED). Both are also visible in `results`, so burst callers
+        can reconcile without catching."""
+        req_id = self._next_id
+        self._next_id += 1
+        self.stats.requests += 1
+        now = self.clock()
+        try:
+            arr = self._validate(prompt, max_new)
+        except ValueError as e:
+            self.results[req_id] = RequestResult(
+                req_id=req_id, outcome=FAILED, error=str(e),
+                submitted_at=now, done_at=now)
+            self.stats.failed += 1
+            e.req_id = req_id       # burst callers reconcile by id
+            raise
+        if deadline_ms == -1:
+            deadline_ms = self.default_deadline_ms
+        deadline = (None if deadline_ms is None
+                    else now + float(deadline_ms) / 1000.0)
+        req = Request(req_id=req_id, prompt=arr, true_len=int(arr.size),
+                      max_new=max_new, sampling=sampling,
+                      deadline=deadline, submitted_at=now,
+                      retries_left=self.max_retries)
+        if self._draining:
+            self._finish(req, SHED,
+                         error="load shed: server is draining")
+            err = QueueFullError(
+                f"request {req_id} shed: server is draining")
+            err.req_id = req_id
+            raise err
+        if len(self.queue) >= self.max_queue:
+            victim = min(self.queue + [req], key=lambda r: r.retry_cost)
+            if victim is req:
+                self._finish(req, SHED, error=(
+                    f"load shed: queue full (max_queue="
+                    f"{self.max_queue}), request is cheapest to retry"))
+                err = QueueFullError(
+                    f"request {req_id} shed: queue full "
+                    f"(max_queue={self.max_queue})")
+                err.req_id = req_id
+                raise err
+            self.queue.remove(victim)
+            self._finish(victim, SHED, error=(
+                f"load shed: queue full (max_queue={self.max_queue}), "
+                f"displaced as cheapest to retry"))
+        self.queue.append(req)
+        return req_id
+
+    # -- drain -------------------------------------------------------------
+
+    def drain(self, *, grace_s: Optional[float] = None,
+              reason: str = "drain requested") -> None:
+        """Stop admitting; `run()` finishes in-flight work within the
+        grace, sheds the queue, and persists the drain report."""
+        if not self._draining:
+            self._draining = True
+            self._drain_reason = reason
+            self._drain_deadline = self.clock() + (
+                self.drain_grace_s if grace_s is None else grace_s)
+            log.warning("serving drain: %s (grace %.1fs)", reason,
+                        self._drain_deadline - self.clock())
+
+    def _install_signals(self):
+        def handler(signum, frame):
+            self.drain(reason=f"signal {signum}")
+
+        try:
+            return {s: signal.signal(s, handler)
+                    for s in (signal.SIGTERM, signal.SIGINT)}
+        except ValueError:          # not the main thread
+            return None
+
+    def _write_drain_report(self) -> dict:
+        report = {
+            "reason": self._drain_reason,
+            "counters": self.counters(),
+            "steps": self.stats.steps,
+            "tokens": self.stats.tokens,
+            "requests": [
+                {"req_id": r.req_id, "outcome": r.outcome,
+                 "tokens": len(r.tokens), "retries": r.retries,
+                 "error": r.error}
+                for _, r in sorted(self.results.items())
+            ],
+        }
+        self.drain_report = report
+        if self.drain_report_path:
+            tmp = f"{self.drain_report_path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(report, f, indent=1)
+            import os
+
+            os.replace(tmp, self.drain_report_path)
+        return report
+
+    # -- pool plumbing -----------------------------------------------------
+
+    def _reset_pool(self) -> None:
+        self._state = self._backend.init_state()
+        self._slot_req = [None] * self._backend.slots
+
+    def _bucketed(self, req: Request) -> np.ndarray:
+        # the engine's own padding convention; _validate already
+        # guaranteed a bucket fits, so this cannot raise here
+        padded, _ = pad_to_bucket(req.prompt, self.buckets)
+        return padded
+
+    def _requeue_or_fail(self, req: Request, why: str) -> None:
+        """The slot-level retry path: transient faults requeue at the
+        FRONT (the request already waited its turn) until the budget
+        is spent."""
+        if req.retries_left > 0:
+            req.retries_left -= 1
+            self.stats.retried += 1
+            self._emitted.pop(req.req_id, None)
+            self._lps.pop(req.req_id, None)
+            self.queue.insert(0, req)
+            log.warning("request %d requeued after %s (%d retries "
+                        "left)", req.req_id, why, req.retries_left)
+        else:
+            self._finish(req, FAILED, error=(
+                f"transient-fault retry budget exhausted: {why}"),
+                retries=self.max_retries)
+
+    def _evict_in_flight(self, why: str) -> None:
+        """Pull every in-flight request back into the queue (or fail
+        it) and reset the device pool — the backend-fault path."""
+        inflight = [r for r in self._slot_req if r is not None]
+        # queue-front order: keep the original admission order
+        for req in reversed(inflight):
+            self._requeue_or_fail(req, why)
+        self._reset_pool()
+
+    def _native_fault(self, exc: Exception) -> None:
+        """Record a native-backend fault with the breaker; switch to
+        the pure-JAX fallback once it opens."""
+        if self._backend is not self.native_backend:
+            return
+        self.breaker.record_failure()
+        if not self.breaker.allow() or self.breaker.state != "closed":
+            log.warning("circuit breaker %s after native fault (%s); "
+                        "falling back to the pure-JAX engine",
+                        self.breaker.state, exc)
+            self._backend = self.engine
+            self._evict_in_flight(f"native backend fault: {exc}")
+
+    def _maybe_probe_native(self) -> None:
+        """Empty-pool moment + half-open breaker => route the next
+        admissions through the native backend again (the probe)."""
+        if (self.native_backend is None
+                or self._backend is self.native_backend
+                or any(r is not None for r in self._slot_req)):
+            return
+        if self.breaker.allow():
+            log.info("circuit breaker %s: probing the native backend",
+                     self.breaker.state)
+            self._backend = self.native_backend
+            self._reset_pool()
+
+    def _retire_slot(self, slot: int) -> None:
+        """Host-side slot free via the engine's own retire convention
+        (release_slot) — the deadline/drain eviction and serve()'s
+        token-budget retire share one sentinel arithmetic."""
+        self._state = self._backend.release_slot(self._state, slot)
+        self._slot_req[slot] = None
+
+    # -- the drive loop ----------------------------------------------------
+
+    def _expire_queued(self) -> None:
+        now = self.clock()
+        for req in [r for r in self.queue
+                    if r.deadline is not None and now >= r.deadline]:
+            self.queue.remove(req)
+            self._finish(req, EXPIRED, error=(
+                f"deadline expired after {now - req.submitted_at:.3f}s "
+                f"in queue (never admitted)"))
+
+    def _admit(self) -> None:
+        while not self._draining and self.queue and any(
+                r is None for r in self._slot_req):
+            slot = self._slot_req.index(None)
+            req = self.queue.pop(0)
+            now = self.clock()
+            if req.deadline is not None and now >= req.deadline:
+                self._finish(req, EXPIRED, error=(
+                    "deadline expired at admission (prefill skipped)"))
+                continue
+            try:
+                self._state = self._backend.prefill(
+                    self._state, slot, self._bucketed(req),
+                    true_len=req.true_len, sampling=req.sampling)
+            except ValueError as e:
+                # deterministic rejection — retrying cannot help
+                self._finish(req, FAILED, error=f"prefill rejected: {e}")
+                continue
+            except Exception as e:
+                # transient fault: the held state is untouched
+                # (prefill is pure), so only THIS request is suspect —
+                # unless the breaker opens, which evicts the pool and
+                # switches backends first
+                if self._backend is self.native_backend:
+                    self._native_fault(e)
+                self._requeue_or_fail(req, f"prefill fault: {e}")
+                continue
+            if self._backend is self.native_backend:
+                self.breaker.record_success()
+            self.stats.prefills += 1
+            self.stats.admitted += 1
+            self._slot_req[slot] = req
+            self._emitted[req.req_id] = []
+            self._lps[req.req_id] = []
+
+    def _expire_in_flight(self) -> None:
+        now = self.clock()
+        for slot, req in enumerate(self._slot_req):
+            if req is None or req.deadline is None:
+                continue
+            if now >= req.deadline:
+                self._finish(req, EXPIRED, error=(
+                    f"deadline expired mid-generation after "
+                    f"{len(self._emitted.get(req.req_id, []))} tokens"))
+                self._retire_slot(slot)
+
+    def _drain_expired(self) -> bool:
+        return (self._draining and self._drain_deadline is not None
+                and self.clock() >= self._drain_deadline)
+
+    def run(self) -> Dict[int, RequestResult]:
+        """Serve until the queue and pool are empty (or the drain
+        grace ends). Safe to call repeatedly — new `submit()`s between
+        runs (or from `on_step` hooks during one) extend the same
+        accounting. Returns `self.results`."""
+        import jax
+
+        prev_handlers = (self._install_signals()
+                         if self.install_signal_handlers else None)
+        if self._state is None:
+            self._reset_pool()
+        try:
+            while True:
+                if self._draining:
+                    for req in list(self.queue):
+                        self.queue.remove(req)
+                        self._finish(req, SHED, error=(
+                            f"load shed: draining "
+                            f"({self._drain_reason})"))
+                self._expire_queued()
+                self._maybe_probe_native()
+                self._admit()
+                inflight = [r for r in self._slot_req if r is not None]
+                if not inflight:
+                    if not self.queue or self._draining:
+                        break
+                    continue
+                if self._drain_expired():
+                    for slot, req in enumerate(self._slot_req):
+                        if req is not None:
+                            self._finish(req, EXPIRED, error=(
+                                f"drain grace expired "
+                                f"({self._drain_reason})"))
+                            self._retire_slot(slot)
+                    continue
+                try:
+                    (self._state, toks, tok_lps, was_active,
+                     fin) = self._backend.decode_step(self._state)
+                except Exception as e:
+                    if self._backend is self.native_backend:
+                        self._native_fault(e)
+                        if self._backend is self.native_backend:
+                            # breaker still closed: retry on native
+                            self._evict_in_flight(
+                                f"decode fault: {e}")
+                    else:
+                        self._evict_in_flight(f"decode fault: {e}")
+                    continue
+                if self._backend is self.native_backend:
+                    self.breaker.record_success()
+                self.stats.steps += 1
+                toks, tok_lps, was_active_h, fin_h = jax.device_get(
+                    (toks, tok_lps, was_active, fin))
+                for slot, req in enumerate(self._slot_req):
+                    if req is None or not was_active_h[slot]:
+                        continue
+                    self._emitted[req.req_id].append(int(toks[slot]))
+                    self._lps[req.req_id].append(float(tok_lps[slot]))
+                    self.stats.tokens += 1
+                    done = (bool(fin_h[slot]) or
+                            len(self._emitted[req.req_id])
+                            >= req.max_new)
+                    if done:
+                        if not fin_h[slot]:
+                            self._retire_slot(slot)
+                        else:
+                            self._slot_req[slot] = None
+                        self._finish(
+                            req, COMPLETED,
+                            retries=self.max_retries - req.retries_left)
+                self._expire_in_flight()
+                for hook in list(self.on_step):
+                    hook(self, self.stats.steps)
+        finally:
+            if prev_handlers:
+                for s, h in prev_handlers.items():
+                    signal.signal(s, h)
+        if self._draining:
+            self._write_drain_report()
+        return self.results
+
+    # -- observability -----------------------------------------------------
+
+    def counters(self) -> Dict[str, int]:
+        """The structured outcome counters (PoolStats fields):
+        admitted/shed/expired/retried/completed/failed + requests."""
+        return {
+            "requests": self.stats.requests,
+            "admitted": self.stats.admitted,
+            "completed": self.stats.completed,
+            "expired": self.stats.expired,
+            "shed": self.stats.shed,
+            "failed": self.stats.failed,
+            "retried": self.stats.retried,
+        }
+
+    def reconcile(self) -> None:
+        """Assert the accounting contract: every submitted request has
+        exactly one terminal outcome and the counters match the
+        request log. Raises AssertionError on any silent drop — the
+        chaos harness calls this after every burst."""
+        assert len(self.results) == self.stats.requests, (
+            len(self.results), self.stats.requests)
+        assert not self.queue and not any(
+            r is not None for r in self._slot_req), "work still pending"
+        tally: Dict[str, int] = {o: 0 for o in OUTCOMES}
+        for res in self.results.values():
+            assert res.outcome in OUTCOMES, res
+            tally[res.outcome] += 1
+        for o in OUTCOMES:
+            assert tally[o] == getattr(self.stats, o), (
+                o, tally[o], getattr(self.stats, o))

@@ -25,6 +25,20 @@ Fault classes covered, mapping to docs/RELIABILITY.md's fault model:
 - checkpoint-write OSError on the nth save (`wrap_checkpoint_manager`);
 - master-connection drop before the nth RPC (`wrap_master_client`) —
   exercises MasterClient's backoff-reconnect.
+
+Serving faults (the serve.server chaos harness, docs/RELIABILITY.md
+"Serving fault model") ride the same switchboard:
+- a transient engine fault on the nth prefill / nth decode step
+  (`wrap_engine`) — exercises the server's slot requeue/retry path;
+- a native-backend failure BURST: the first N wrapped-engine calls all
+  raise (`serve_error_first_n`) — repeated faults trip the circuit
+  breaker, and the healed engine afterwards proves recovery;
+- a slot stall: the nth decode step burns `serve_stall_s` seconds of
+  the server's (injected, `ManualClock`) clock without progress —
+  deadline storms without wall-clock sleeps;
+- oversized/garbage prompts (`garbage_prompts`) — canonical malformed
+  traffic the admission validators must reject without crashing the
+  pool.
 """
 
 from __future__ import annotations
@@ -55,6 +69,12 @@ class FaultPlan:
     preempt_signal: int = signal.SIGTERM
     checkpoint_error_at: Optional[int] = None  # nth save() call
     master_drop_at: Optional[int] = None      # nth MasterClient RPC
+    # -- serving faults (serve.server, via wrap_engine) --
+    serve_prefill_error_at: Optional[int] = None  # nth prefill call
+    serve_decode_error_at: Optional[int] = None   # nth decode_step call
+    serve_error_first_n: Optional[int] = None     # first N engine calls
+    serve_stall_at: Optional[int] = None          # nth decode_step
+    serve_stall_s: float = 0.0                    # clock burned per stall
     once: bool = True
     fired: List[str] = dataclasses.field(default_factory=list)
 
@@ -63,6 +83,9 @@ class FaultPlan:
         self._batch_counter = 0
         self._save_counter = 0
         self._call_counter = 0
+        self._serve_prefill_counter = 0
+        self._serve_decode_counter = 0
+        self._serve_call_counter = 0
 
     # -- bookkeeping ------------------------------------------------------
 
@@ -129,6 +152,31 @@ class FaultPlan:
     def wrap_checkpoint_manager(self, manager) -> "_FlakyCheckpoints":
         return _FlakyCheckpoints(manager, self)
 
+    # -- serving faults (engine-level) ------------------------------------
+
+    def wrap_engine(self, engine, clock: Optional["ManualClock"] = None):
+        """Wrap a serve.DecodeEngine (or anything with prefill /
+        decode_step) so serving faults fire deterministically:
+
+        - `serve_prefill_error_at` / `serve_decode_error_at`: the nth
+          prefill / decode_step call raises FaultError BEFORE touching
+          the engine — a transient device/native fault at a precise
+          point in the schedule (the state the caller holds stays
+          valid, which is exactly the contract a retry path must rely
+          on);
+        - `serve_error_first_n`: the first N calls (prefill and decode
+          combined) ALL raise — the repeated-failure shape that must
+          trip a circuit breaker, after which the engine is healthy
+          again so recovery is provable;
+        - `serve_stall_at` (+ `serve_stall_s`): the nth decode step
+          advances `clock` by `serve_stall_s` before running — a slot
+          stall that burns request deadlines with no wall-clock sleep
+          (pass the same ManualClock the server schedules with).
+
+        Everything else delegates, so a wrapped engine is otherwise
+        bit-identical to the real one."""
+        return _FaultyEngine(engine, self, clock)
+
     # -- master-connection faults -----------------------------------------
 
     def wrap_master_client(self, client):
@@ -168,6 +216,99 @@ def _poison_batch(batch):
     if isinstance(batch, tuple):
         return tuple(poison(x) for x in batch)
     return poison(batch)
+
+
+class ManualClock:
+    """Deterministic monotonic clock for serving chaos tests: pass it
+    as the server's `clock` and advance it explicitly (or let
+    `wrap_engine`'s stall faults advance it) — deadline storms without
+    real sleeps, so the chaos suite stays fast and exact."""
+
+    def __init__(self, start: float = 0.0):
+        self._t = float(start)
+
+    def __call__(self) -> float:
+        return self._t
+
+    def advance(self, dt: float) -> None:
+        if dt < 0:
+            raise ValueError(f"clock cannot run backwards ({dt})")
+        self._t += float(dt)
+
+
+class _FaultyEngine:
+    """DecodeEngine proxy with FaultPlan-scheduled serving faults.
+    Faults raise BEFORE delegating, so the caller's EngineState is
+    never half-mutated (prefill/decode_step are pure functions of it —
+    the property every requeue path leans on)."""
+
+    def __init__(self, engine, plan: "FaultPlan",
+                 clock: Optional[ManualClock]):
+        self._engine = engine
+        self._plan = plan
+        self._clock = clock
+
+    def _burst(self) -> bool:
+        plan = self._plan
+        idx = plan._serve_call_counter
+        plan._serve_call_counter += 1
+        if (plan.serve_error_first_n is not None
+                and idx < plan.serve_error_first_n):
+            plan._note("nativeburst", idx)
+            return True
+        return False
+
+    def prefill(self, *args, **kwargs):
+        plan = self._plan
+        burst = self._burst()
+        idx = plan._serve_prefill_counter
+        plan._serve_prefill_counter += 1
+        if burst:
+            raise FaultError(f"injected native fault (burst) on "
+                             f"prefill #{idx}")
+        if (idx == plan.serve_prefill_error_at
+                and not plan._spent("sprefill")):
+            plan._note("sprefill", idx)
+            raise FaultError(f"injected prefill fault #{idx}")
+        return self._engine.prefill(*args, **kwargs)
+
+    def decode_step(self, state):
+        plan = self._plan
+        burst = self._burst()
+        idx = plan._serve_decode_counter
+        plan._serve_decode_counter += 1
+        if burst:
+            raise FaultError(f"injected native fault (burst) on "
+                             f"decode step #{idx}")
+        if idx == plan.serve_stall_at and not plan._spent("stall"):
+            plan._note("stall", idx)
+            if self._clock is not None:
+                self._clock.advance(plan.serve_stall_s)
+        if (idx == plan.serve_decode_error_at
+                and not plan._spent("sdecode")):
+            plan._note("sdecode", idx)
+            raise FaultError(f"injected decode fault #{idx}")
+        return self._engine.decode_step(state)
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+
+def garbage_prompts(vocab: int, max_prompt_len: int) -> dict:
+    """Canonical malformed serving inputs, keyed by failure mode. The
+    admission validator (serve.server / engine.serve entry checks)
+    must reject every one with a clear ValueError — none may reach
+    prefill or crash the pool."""
+    import numpy as np
+
+    return {
+        "empty": np.zeros((0,), np.int32),
+        "oversized": np.zeros((max_prompt_len + 1,), np.int32),
+        "out_of_vocab": np.asarray([0, vocab + 7, 1], np.int32),
+        "negative_id": np.asarray([3, -1, 2], np.int32),
+        "float_dtype": np.asarray([0.5, 1.5], np.float32),
+        "not_1d": np.zeros((2, 3), np.int32),
+    }
 
 
 class _FlakyCheckpoints:

@@ -1,0 +1,15 @@
+#!/usr/bin/env sh
+# Chaos smoke lane: run ONLY the fault-injection tests (marker
+# `faults` — training resilience in tests/test_resilience.py plus the
+# serving chaos harness in tests/test_serve_server.py), so degradation
+# coverage is cheap to invoke standalone:
+#
+#     scripts/fault_smoke.sh            # the whole faults lane
+#     scripts/fault_smoke.sh -k serve   # just the serving chaos suite
+#
+# CPU-only and deterministic (testing.faults FaultPlan + ManualClock);
+# extra args pass through to pytest.
+set -e
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults \
+    -p no:cacheprovider "$@"

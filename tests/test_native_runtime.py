@@ -300,6 +300,77 @@ def test_server_stop_with_open_client_connection():
     cli.close()
 
 
+class TestMasterClientClose:
+    """close() lifecycle hardening (ISSUE 2 satellite): idempotent
+    close, closed-state RPC refusal, and socket release on every
+    reconnect-failure path — a leaked fd per dead master would bleed a
+    long-lived trainer dry."""
+
+    def test_close_is_idempotent_and_releases_socket(self):
+        q = TaskQueue()
+        with MasterServer(q) as srv:
+            cli = MasterClient(port=srv.port)
+            sock = cli._sock
+            assert sock is not None
+            cli.close()
+            assert cli._sock is None
+            assert sock.fileno() == -1          # really released
+            cli.close()                         # second close: no-op
+            cli.close()
+            assert cli._sock is None
+
+    def test_closed_client_refuses_rpcs(self):
+        """A closed client must NOT silently reconnect (that path is
+        how sockets escaped the drop bookkeeping) — it fails loudly."""
+        q = TaskQueue()
+        with MasterServer(q) as srv:
+            cli = MasterClient(port=srv.port)
+            cli.close()
+            with pytest.raises(RuntimeError, match="closed"):
+                cli.counts()
+            with pytest.raises(RuntimeError, match="closed"):
+                cli.get_task()
+
+    def test_context_manager_closes(self):
+        q = TaskQueue()
+        with MasterServer(q) as srv:
+            with MasterClient(port=srv.port) as cli:
+                cli.add_task(b"t")
+                sock = cli._sock
+            assert cli._sock is None and sock.fileno() == -1
+            with pytest.raises(RuntimeError, match="closed"):
+                cli.counts()
+
+    def test_reconnect_failure_releases_socket(self):
+        """Master death mid-conversation: the exhausted-retries path
+        must leave NO socket behind (and close() afterwards is safe)."""
+        q = TaskQueue()
+        srv = MasterServer(q)
+        cli = MasterClient(port=srv.port, retries=1, timeout=0.5,
+                           backoff_base=0.01, backoff_max=0.02)
+        cli.add_task(b"t")
+        srv.stop()
+        with pytest.raises(ConnectionError):
+            cli.counts()
+        assert cli._sock is None                # released, not leaked
+        cli.close()                             # safe after failure
+        cli.close()
+
+    def test_eager_connect_failure_leaves_no_socket(self):
+        """Constructor against a dead port: bounded ConnectionError,
+        and the half-built client holds no socket."""
+        import socket as _socket
+
+        # grab a port with no listener
+        probe = _socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        dead_port = probe.getsockname()[1]
+        probe.close()
+        with pytest.raises(ConnectionError):
+            MasterClient(port=dead_port, retries=1, timeout=0.2,
+                         backoff_base=0.01, backoff_max=0.02)
+
+
 def test_malformed_frame_rejected():
     import socket
     import struct as st

@@ -405,6 +405,48 @@ class TestPrefillLengthValidation:
                         np.arange(20, dtype=np.int32), true_len=4)
 
 
+class TestServeEntryValidation:
+    """ISSUE 2 satellite: unservable traffic must reject AT serve()
+    ENTRY — before ANY request burns prefill/decode work — not deep in
+    prefill mid-run."""
+
+    def test_oversized_prompt_rejected_before_any_work(self, params):
+        """Prompt longer than the largest bucket: entry ValueError,
+        zero prefills — even when OTHER prompts are servable."""
+        eng = DecodeEngine(params, CFG, slots=2, max_len=32)
+        ps = prompts_rng(2, [5, 4], seed=41) + \
+            prompts_rng(1, [12], seed=42)       # last one oversized
+        with pytest.raises(ValueError, match="largest bucket"):
+            eng.serve(ps, max_new=4, buckets=(8,))
+        assert not hasattr(eng, "last_stats")   # no serve work ran
+
+    def test_prompt_at_max_len_rejected_at_entry(self, params):
+        """A full-cache prompt with no room for one generated token is
+        an entry error (was: mid-run, from prefill)."""
+        eng = DecodeEngine(params, CFG, slots=2, max_len=8)
+        ps = prompts_rng(1, [4], seed=43) + prompts_rng(1, [8], seed=44)
+        with pytest.raises(ValueError, match="max_len"):
+            eng.serve(ps, max_new=4)
+
+    def test_empty_prompt_rejected_at_entry(self, params):
+        eng = DecodeEngine(params, CFG, slots=1, max_len=16)
+        with pytest.raises(ValueError, match="empty"):
+            eng.serve([np.zeros((0,), np.int32)], max_new=4)
+
+    def test_windowed_long_prompts_still_admitted(self, params):
+        """The ring pool has no physical length bound — entry checks
+        must NOT reject what the window can serve."""
+        cfg = dataclasses.replace(CFG, attn_window=6)
+        p_ = T.init_params(jax.random.key(6), cfg)
+        eng = DecodeEngine(p_, cfg, slots=1, max_len=10)
+        long_prompt = prompts_rng(1, [14], seed=45)[0]
+        got = eng.serve([long_prompt], max_new=4)
+        out = T.generate(p_, cfg, jnp.asarray(long_prompt)[None, :],
+                         steps=4)
+        assert got[0] == [int(t) for t in
+                          np.asarray(out[0, len(long_prompt):])]
+
+
 def test_engine_serve_golden():
     """Golden serving transcript (the seq2seq_gen_golden idiom): a
     fixed pool + fixed traffic must reproduce the committed outputs
