@@ -28,6 +28,51 @@ from paddle_tpu.core import mesh as mesh_lib
 from paddle_tpu.models.ctr import CTRModel
 
 
+def run_pserver_demo(args):
+    """The pserver-tier variant of the sparse tail: the table lives in
+    host RAM on replicated `native.pserver` shards (leases, exactly-once
+    push epochs, chain replication), and the trainer looks up / pushes
+    through `PServerEmbedding` — the same call surface as
+    ShardedEmbedding. Midway, the primary of shard 0 is KILLED to show
+    the failover: training finishes through the replica with no lost or
+    duplicated row updates (docs/RELIABILITY.md "Parameter-server fault
+    model")."""
+    from paddle_tpu.native.pserver import PServerGroup
+    from paddle_tpu.parallel.pserver_client import (PServerClient,
+                                                    PServerEmbedding)
+
+    vocab = (args.vocab // 4) * 4
+    with PServerGroup(vocab, args.dim, n_shards=4) as group:
+        with PServerClient(group.specs, args.dim, trainer_id=0) as client:
+            client.register()
+            emb = PServerEmbedding(client)
+            table = emb.init(jax.random.key(0))
+            rs = np.random.RandomState(0)
+            w = np.zeros(args.dim, np.float32)
+            for i in range(args.steps):
+                ids = rs.randint(0, vocab, args.batch).astype(np.int64)
+                labels = (ids < vocab // 5).astype(np.float32)
+                vecs = np.asarray(emb.lookup(table, ids))
+                logits = vecs @ w
+                p = 1.0 / (1.0 + np.exp(-logits))
+                g = (p - labels)[:, None]
+                w -= 0.05 * (g * vecs).mean(0)
+                emb.apply_row_grads(table, ids, g * w[None, :] / len(ids),
+                                    lr=0.05)
+                if i == args.steps // 2:
+                    group.primaries[0].kill()
+                    print(f"step {i}: killed shard 0 primary — failing "
+                          f"over to its replica")
+                if i % 10 == 0:
+                    loss = float(np.mean(
+                        -labels * np.log(p + 1e-7)
+                        - (1 - labels) * np.log(1 - p + 1e-7)))
+                    print(f"step {i} logloss {loss:.4f}")
+            client.finish_pass()
+            print(f"pass finished through the failover; client stats "
+                  f"{client.stats}")
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=60)
@@ -35,7 +80,15 @@ def main():
     ap.add_argument("--slots", type=int, default=16)
     ap.add_argument("--vocab", type=int, default=100_000)
     ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--pserver", action="store_true",
+                    help="train the sparse tail against a local "
+                         "fault-tolerant parameter-server tier (and "
+                         "kill a primary midway to show failover)")
     args = ap.parse_args()
+
+    if args.pserver:
+        run_pserver_demo(args)
+        return
 
     n_dev = len(jax.devices())
     mesh = mesh_lib.build_mesh(mesh_lib.MeshConfig(data=1, model=n_dev))

@@ -16,3 +16,10 @@ from paddle_tpu.native.taskqueue import (
     TaskQueue,
     TaskStatus,
 )
+from paddle_tpu.native.pserver import (
+    PServerGroup,
+    PServerShard,
+    ShardSpec,
+    ShardState,
+    start_shard_pair,
+)

@@ -30,6 +30,12 @@ from paddle_tpu.parallel.sparse import (
     sharded_lookup,
     unique_rows_grad,
 )
+from paddle_tpu.parallel.pserver_client import (
+    PServerClient,
+    PServerEmbedding,
+    PServerError,
+    ShardConn,
+)
 from paddle_tpu.parallel import distributed
 from paddle_tpu.parallel import moe
 from paddle_tpu.parallel.moe import (

@@ -1,0 +1,582 @@
+"""Trainer-side parameter-server client: failover, epochs, adapter.
+
+The counterpart of `native.pserver` (reference:
+trainer/RemoteParameterUpdater.cpp + go/pserver/client — trainers talk
+to every shard, pull touched rows, push sparse gradients, and survive
+server death via etcd re-discovery; here the replacement discovery is
+the `ShardSpec` endpoint list, primary first). Three layers:
+
+- `ShardConn`: one shard's socket, hardened exactly like
+  `native.MasterClient` — default timeout on every op, exponential
+  backoff with seeded jitter, a fresh socket per attempt (a timeout
+  mid-frame desyncs the framing; the old socket is never reused). On
+  top of that: **failover** — a connection that cannot even be
+  ESTABLISHED advances to the next endpoint (primary died → replica),
+  while a mid-flight send/recv failure retries the SAME endpoint first
+  (a lost ACK from a live server must be re-asked there, where the
+  epoch watermark answers DUP).
+- `PServerClient`: routes rows to owning shards by the `ShardSpec` row
+  ranges (the `shard_rows` layout), numbers every push with a per-shard
+  monotonic epoch so ANY retry — reconnect, failover, lost ACK — is
+  applied exactly once server-side, and transparently re-registers when
+  a push/finish lands on a server that never saw this trainer's lease
+  (the failover target, or a server that expired us).
+- `PServerEmbedding`: the swap-in adapter for the existing sparse call
+  sites — same `init / lookup / apply_row_grads / alltoall_lookup /
+  alltoall_push_row_grads` surface as `ShardedEmbedding` and
+  `HostOffloadEmbedding`, with the table living server-side (the
+  "table" argument is an opaque handle), so `ResilientTrainer` keeps
+  training through a killed shard.
+"""
+
+from __future__ import annotations
+
+import random as _random
+import socket
+import struct
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from paddle_tpu.native.pserver import (
+    OP_FINISH_PASS,
+    OP_GET_ROWS,
+    OP_HEARTBEAT,
+    OP_LOAD,
+    OP_PASS_STATE,
+    OP_PUSH,
+    OP_REGISTER,
+    OP_STATS,
+    ST_DUP,
+    ST_LEASE_EXPIRED,
+    ST_OK,
+    ShardSpec,
+    recv_frame,
+    send_frame,
+)
+
+
+class PServerError(RuntimeError):
+    """A shard answered with a server-side error (protocol misuse or an
+    internal failure) — distinct from ConnectionError, which means no
+    answer arrived at all."""
+
+
+class ShardConn:
+    """Failover socket client for ONE shard's endpoint chain.
+
+    `call()` walks a bounded backoff schedule; endpoint choice is
+    sticky (keep talking to whoever answered last). Failure handling
+    follows where the failure happened:
+
+    - connect refused/timeout: the endpoint is DOWN — advance to the
+      next one immediately (primary → replica failover);
+    - send/recv failure on an established connection: the server may be
+      alive and may have APPLIED the op (lost ACK) — reconnect the SAME
+      endpoint once so the retry lands where the epoch watermark can
+      answer DUP; only if it cannot be re-established does the chain
+      advance.
+
+    Every pserver op is safe to retry through this path: reads are
+    idempotent, pushes carry epochs (server dedupes), register re-grants
+    and finish_pass re-marks.
+    """
+
+    def __init__(self, endpoints: Sequence[Tuple[str, int]], *,
+                 timeout: float = 30.0, retries: int = 8,
+                 backoff_base: float = 0.02, backoff_max: float = 1.0,
+                 seed: Optional[int] = None):
+        if not endpoints:
+            raise ValueError("ShardConn needs at least one endpoint")
+        self.endpoints = [tuple(e) for e in endpoints]
+        self.timeout = timeout
+        self.retries = retries
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self._rng = _random.Random(seed)
+        self._active = 0
+        self._sock: Optional[socket.socket] = None
+        self._closed = False
+
+    @property
+    def active_endpoint(self) -> Tuple[str, int]:
+        return self.endpoints[self._active]
+
+    def _advance(self) -> None:
+        self._active = (self._active + 1) % len(self.endpoints)
+
+    def _connect(self) -> None:
+        sock = socket.create_connection(self.active_endpoint,
+                                        timeout=self.timeout)
+        try:
+            sock.settimeout(self.timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except BaseException:
+            sock.close()
+            raise
+        self._sock = sock
+
+    def _drop(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _backoff(self, attempt: int) -> float:
+        ceiling = min(self.backoff_base * (2 ** attempt),
+                      self.backoff_max)
+        return self._rng.uniform(0, ceiling) or ceiling / 2
+
+    def call(self, payload: bytes) -> bytes:
+        if self._closed:
+            raise RuntimeError("ShardConn is closed")
+        last: Optional[BaseException] = None
+        same_endpoint_retry = False
+        ok = False
+        try:
+            for attempt in range(self.retries + 1):
+                if attempt:
+                    time.sleep(self._backoff(attempt - 1))
+                try:
+                    if self._sock is None:
+                        self._connect()
+                except (ConnectionError, socket.timeout, OSError) as e:
+                    # endpoint down: fail over along the chain
+                    last = e
+                    self._advance()
+                    same_endpoint_retry = False
+                    continue
+                try:
+                    send_frame(self._sock, payload)
+                    resp = recv_frame(self._sock)
+                    ok = True
+                    return resp
+                except (ConnectionError, socket.timeout, OSError) as e:
+                    last = e
+                    self._drop()
+                    # mid-flight failure: one fresh-socket retry on the
+                    # SAME endpoint (lost-ACK / restarted server), then
+                    # fail over
+                    if same_endpoint_retry:
+                        self._advance()
+                        same_endpoint_retry = False
+                    else:
+                        same_endpoint_retry = True
+        finally:
+            if not ok:
+                self._drop()
+        raise ConnectionError(
+            f"no pserver endpoint of {self.endpoints} answered after "
+            f"{self.retries + 1} attempts: {last}") from last
+
+    def close(self) -> None:
+        self._closed = True
+        self._drop()
+
+
+class PServerClient:
+    """One trainer's connection fabric to every shard of a sparse table.
+
+    `trainer_id` must be unique per trainer process — it keys both the
+    lease and the exactly-once epoch watermark. Pushes are serialized
+    per shard by `_lock` (the epoch order IS the apply order)."""
+
+    def __init__(self, specs: Sequence[ShardSpec], dim: int, *,
+                 trainer_id: int = 0,
+                 lease_ttl_s: float = 30.0, timeout: float = 30.0,
+                 retries: int = 8, backoff_base: float = 0.02,
+                 backoff_max: float = 1.0, seed: Optional[int] = None):
+        self.dim = int(dim)
+        specs = sorted(specs, key=lambda s: s.row_lo)
+        for a, b in zip(specs, specs[1:]):
+            if a.row_hi != b.row_lo:
+                raise ValueError(
+                    f"shard specs leave a row gap/overlap at "
+                    f"[{a.row_hi}, {b.row_lo})")
+        if not specs or specs[0].row_lo != 0:
+            raise ValueError("shard specs must start at row 0")
+        self.specs = specs
+        self.num_rows = specs[-1].row_hi
+        self.trainer_id = trainer_id
+        self.lease_ttl_s = lease_ttl_s
+        self._bounds = np.asarray([s.row_hi for s in specs], np.int64)
+        self._conns = [ShardConn(s.endpoints, timeout=timeout,
+                                 retries=retries,
+                                 backoff_base=backoff_base,
+                                 backoff_max=backoff_max,
+                                 seed=None if seed is None else seed + i)
+                       for i, s in enumerate(specs)]
+        self._tokens: List[Optional[int]] = [None] * len(specs)
+        self._epochs = [0] * len(specs)
+        # REENTRANT: every public RPC entry point takes it (the
+        # heartbeat thread shares the per-shard sockets with the caller
+        # — an unlocked send/recv pair would desync the framing), and
+        # public methods compose (fetch_table -> get_rows)
+        self._lock = threading.RLock()
+        self._last_hb = time.monotonic()
+        self._hb_stop = threading.Event()
+        self._hb_thread: Optional[threading.Thread] = None
+        self.stats = {"pushes": 0, "duplicate_acks": 0,
+                      "reregistrations": 0}
+
+    # -- leases ----------------------------------------------------------
+
+    def register(self) -> None:
+        with self._lock:
+            for s in range(len(self.specs)):
+                self._register_shard(s)
+
+    def _register_shard(self, s: int) -> None:
+        resp = self._conns[s].call(
+            bytes([OP_REGISTER])
+            + struct.pack("<qd", self.trainer_id, self.lease_ttl_s))
+        self._check(resp, "register")
+        token, _pass, watermark = struct.unpack_from("<QqQ", resp, 1)
+        self._tokens[s] = token
+        # adopt the server's applied-epoch watermark: a RESTARTED
+        # trainer (fresh client, epochs at 0) must number its next push
+        # PAST what the shard already applied, or every push until the
+        # watermark would be silently DUP-discarded. max() keeps an
+        # in-flight retried epoch valid on failover re-registration.
+        self._epochs[s] = max(self._epochs[s], int(watermark))
+
+    def heartbeat(self) -> None:
+        """Renew every shard lease; a shard that no longer knows us
+        (expired, or a failover target) gets a fresh registration."""
+        with self._lock:
+            for s in range(len(self.specs)):
+                if self._tokens[s] is None:
+                    self._register_shard(s)
+                    continue
+                resp = self._conns[s].call(
+                    bytes([OP_HEARTBEAT])
+                    + struct.pack("<qQ", self.trainer_id,
+                                  self._tokens[s]))
+                if resp[0] == ST_LEASE_EXPIRED:
+                    self.stats["reregistrations"] += 1
+                    self._register_shard(s)
+                else:
+                    self._check(resp, "heartbeat")
+            self._last_hb = time.monotonic()
+
+    def start_heartbeats(self, interval_s: float) -> None:
+        if self._hb_thread is not None:
+            return
+        self._hb_stop.clear()
+
+        def loop():
+            while not self._hb_stop.wait(interval_s):
+                try:
+                    self.heartbeat()
+                except (ConnectionError, PServerError):
+                    pass    # next RPC surfaces a real outage
+
+        self._hb_thread = threading.Thread(
+            target=loop, name="pserver-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    # -- routing ---------------------------------------------------------
+
+    def _owner_of(self, ids: np.ndarray) -> np.ndarray:
+        """Owning-shard index per id; invalid ids map to -1 (zero rows
+        on read, dropped on push — the padding-id contract shared with
+        sharded_lookup / masked_row_delta)."""
+        owner = np.searchsorted(self._bounds, ids, side="right")
+        owner[(ids < 0) | (ids >= self.num_rows)] = -1
+        return owner
+
+    # -- data plane ------------------------------------------------------
+
+    def get_rows(self, ids) -> np.ndarray:
+        """[K] global ids -> [K, D] rows; out-of-range ids give ZERO
+        vectors (sharded_lookup's contract)."""
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        dim = self.dim
+        out = np.zeros((ids.shape[0], dim), np.float32)
+        owner = self._owner_of(ids)
+        with self._lock:
+            for s in range(len(self.specs)):
+                sel = np.flatnonzero(owner == s)
+                if sel.size == 0:
+                    continue
+                sub = np.ascontiguousarray(ids[sel])
+                resp = self._conns[s].call(
+                    bytes([OP_GET_ROWS]) + struct.pack("<I", sub.size)
+                    + sub.tobytes())
+                self._check(resp, "get_rows")
+                (n,) = struct.unpack_from("<I", resp, 1)
+                rows = np.frombuffer(resp, np.float32, n * dim,
+                                     offset=5).reshape(n, dim)
+                out[sel] = rows
+        return out
+
+    def push_row_grads(self, ids, row_grads, lr: float) -> None:
+        """Route sparse row gradients to their owning shards, exactly
+        once each: every per-shard push gets the next epoch, and the
+        retry loop (reconnect, failover, lost ACK) re-sends the SAME
+        epoch until some replica ACKs — OK (applied now) and DUP
+        (applied earlier, ACK lost) are both success."""
+        ids = np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64)
+        grads = np.ascontiguousarray(np.asarray(row_grads, np.float32))
+        if grads.shape != (ids.shape[0], self.dim):
+            raise ValueError(f"row_grads shape {grads.shape} != "
+                             f"({ids.shape[0]}, {self.dim})")
+        owner = self._owner_of(ids)
+        with self._lock:
+            for s in range(len(self.specs)):
+                sel = np.flatnonzero(owner == s)
+                if sel.size == 0:
+                    continue
+                self._epochs[s] += 1
+                self._push_shard(s, self._epochs[s],
+                                 np.ascontiguousarray(ids[sel]),
+                                 np.ascontiguousarray(grads[sel]), lr)
+
+    def _push_shard(self, s: int, epoch: int, ids: np.ndarray,
+                    grads: np.ndarray, lr: float) -> None:
+        payload = (bytes([OP_PUSH])
+                   + struct.pack("<qQdI", self.trainer_id, epoch, lr,
+                                 ids.size)
+                   + ids.tobytes() + grads.tobytes())
+        while True:
+            if self._tokens[s] is None:
+                self._register_shard(s)
+            resp = self._conns[s].call(payload)
+            if resp[0] == ST_OK:
+                self.stats["pushes"] += 1
+                return
+            if resp[0] == ST_DUP:
+                # applied on an earlier attempt whose ACK was lost —
+                # exactly-once held, count it for observability
+                self.stats["duplicate_acks"] += 1
+                return
+            if resp[0] == ST_LEASE_EXPIRED:
+                # the answering server (failover target, or one that
+                # expired us) has no lease for this trainer: register
+                # there and re-send the SAME epoch
+                self.stats["reregistrations"] += 1
+                self._tokens[s] = None
+                continue
+            self._check(resp, "push")
+
+    # -- pass barrier ----------------------------------------------------
+
+    def finish_pass(self, *, wait: bool = True, poll_s: float = 0.01,
+                    timeout_s: float = 60.0) -> int:
+        """Vote this trainer's pass finished on every shard; with
+        `wait`, block until each shard's pass counter advances past its
+        pre-vote value (all live-leased trainers finished — an expired
+        peer is released by its lease, so a dead trainer cannot wedge
+        this barrier). Returns the new pass number of shard 0.
+
+        The poll loop does NOT hold the client lock between polls (the
+        heartbeat thread must keep running under a long barrier) and
+        renews this trainer's own leases every `lease_ttl_s / 3` while
+        waiting — a waiting trainer must never expire out of the very
+        barrier it is waiting on. A vote lives on the server that took
+        it: if this shard's lease TOKEN changes mid-wait (failover to
+        the replica, or an expiry + re-registration), the vote is gone
+        there — the loop detects the token change and RE-VOTES on the
+        now-active server, rebasing its target on that server's pass
+        counter."""
+        with self._lock:
+            start = [self._finish_shard(s)
+                     for s in range(len(self.specs))]
+            vote_tokens = list(self._tokens)
+        if not wait:
+            return start[0][0] + (1 if start[0][1] else 0)
+        deadline = time.monotonic() + timeout_s
+        pass_nums = []
+        for s, (before, done) in enumerate(start):
+            target = before + 1
+            current = before + 1 if done else before
+            while current < target:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"pass barrier on shard {s} not reached in "
+                        f"{timeout_s}s (pass {current} < {target})")
+                time.sleep(poll_s)
+                if (time.monotonic() - self._last_hb
+                        > self.lease_ttl_s / 3):
+                    self.heartbeat()
+                with self._lock:
+                    if self._tokens[s] != vote_tokens[s]:
+                        # new lease => new server or fresh registration:
+                        # our vote did not travel — re-assert it and
+                        # rebase on that server's own counter
+                        before, done = self._finish_shard(s)
+                        vote_tokens[s] = self._tokens[s]
+                        target = before + 1
+                        current = before + 1 if done else before
+                        continue
+                current = self.pass_state(s)
+            pass_nums.append(current)
+        return pass_nums[0]
+
+    def pass_state(self, s: int = 0) -> int:
+        """Shard `s`'s current pass number (also ticks its lease-expiry
+        sweep — any RPC does)."""
+        with self._lock:
+            resp = self._conns[s].call(bytes([OP_PASS_STATE]))
+        self._check(resp, "pass_state")
+        return struct.unpack_from("<q", resp, 1)[0]
+
+    def _finish_shard(self, s: int) -> Tuple[int, bool]:
+        while True:
+            if self._tokens[s] is None:
+                self._register_shard(s)
+            resp = self._conns[s].call(
+                bytes([OP_FINISH_PASS])
+                + struct.pack("<qQ", self.trainer_id, self._tokens[s]))
+            if resp[0] == ST_LEASE_EXPIRED:
+                self.stats["reregistrations"] += 1
+                self._tokens[s] = None
+                continue
+            self._check(resp, "finish_pass")
+            pass_num, = struct.unpack_from("<q", resp, 1)
+            done = bool(resp[9])
+            # pass_num is POST-advance when done; report pre-vote base
+            return (pass_num - 1, True) if done else (pass_num, False)
+
+    # -- table init / dump ----------------------------------------------
+
+    def load_table(self, table, *, chunk_rows: int = 8192) -> None:
+        """SET the full table across shards (once-only init — the
+        FinishInitParams analog). Idempotent; replicates to backups."""
+        table = np.ascontiguousarray(np.asarray(table, np.float32))
+        if table.shape != (self.num_rows, self.dim):
+            raise ValueError(f"table shape {table.shape} != "
+                             f"({self.num_rows}, {self.dim})")
+        with self._lock:
+            for s, spec in enumerate(self.specs):
+                for lo in range(spec.row_lo, spec.row_hi, chunk_rows):
+                    hi = min(lo + chunk_rows, spec.row_hi)
+                    resp = self._conns[s].call(
+                        bytes([OP_LOAD])
+                        + struct.pack("<qI", lo, hi - lo)
+                        + table[lo:hi].tobytes())
+                    self._check(resp, "load")
+
+    def fetch_table(self, *, chunk_rows: int = 8192) -> np.ndarray:
+        """Assemble the full [num_rows, dim] table from the shards (for
+        checks and exports — row traffic, not a hot path)."""
+        out = np.zeros((self.num_rows, self.dim), np.float32)
+        for lo in range(0, self.num_rows, chunk_rows):
+            hi = min(lo + chunk_rows, self.num_rows)
+            out[lo:hi] = self.get_rows(np.arange(lo, hi, dtype=np.int64))
+        return out
+
+    def shard_stats(self) -> List[dict]:
+        import json
+
+        stats = []
+        with self._lock:
+            for c in self._conns:
+                resp = c.call(bytes([OP_STATS]))
+                self._check(resp, "stats")
+                stats.append(json.loads(resp[1:].decode()))
+        return stats
+
+    # -- plumbing --------------------------------------------------------
+
+    @staticmethod
+    def _check(resp: bytes, what: str) -> None:
+        if not resp:
+            raise PServerError(f"{what}: empty response")
+        if resp[0] not in (ST_OK, ST_DUP):
+            if resp[0] == ST_LEASE_EXPIRED:
+                raise PServerError(f"{what}: lease expired (register "
+                                   f"first)")
+            raise PServerError(f"{what}: {resp[1:].decode(errors='replace')}")
+
+    def close(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5)
+            self._hb_thread = None
+        for c in self._conns:
+            c.close()
+
+    def __enter__(self) -> "PServerClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class PServerEmbedding:
+    """Embedding adapter whose table lives on the parameter-server tier.
+
+    Same call surface as `ShardedEmbedding`/`HostOffloadEmbedding`
+    (init / lookup / apply_row_grads + the alltoall_* aliases the CTR
+    call sites use), so it swaps into existing models: the dense update
+    stays wherever it was (sharded on-chip), the sparse tail trains
+    through `PServerClient` — and therefore through shard death,
+    failover and retry, with exactly-once row updates.
+
+    The `table` argument threaded through the surface is an opaque
+    handle (the real rows are server-side); it is returned unchanged by
+    the update ops so functional-style call sites keep composing.
+    """
+
+    class Handle:
+        """Opaque stand-in for the on-device table."""
+
+        def __init__(self, vocab: int, dim: int):
+            self.shape = (vocab, dim)
+
+        def __repr__(self):
+            return f"PServerEmbedding.Handle{self.shape}"
+
+    def __init__(self, client: PServerClient, *, init_scale: float = 0.01,
+                 name: str = "pserver_embedding"):
+        self.client = client
+        self.vocab = client.num_rows
+        self.dim = client.dim
+        self.init_scale = init_scale
+        self.name = name
+
+    def init(self, rng) -> "PServerEmbedding.Handle":
+        """Generate the table host-side (numpy seeded from the jax key,
+        the HostOffloadEmbedding idiom — a pserver-scale table must
+        never materialize in device memory) and LOAD it onto the
+        shards; replication carries it to the backups."""
+        import jax
+
+        seed = np.asarray(jax.random.key_data(rng)).ravel()
+        host_rng = np.random.default_rng([int(s) for s in seed])
+        table = (host_rng.standard_normal(
+            (self.vocab, self.dim), np.float32) * self.init_scale)
+        self.client.load_table(table)
+        return PServerEmbedding.Handle(self.vocab, self.dim)
+
+    def lookup(self, table, ids):
+        """ids [K] -> [K, D] rows on device; out-of-range ids (e.g. -1
+        padding) give ZERO vectors — the shared sparse-lookup contract."""
+        import jax.numpy as jnp
+
+        rows = self.client.get_rows(np.asarray(ids))
+        return jnp.asarray(rows)
+
+    def apply_row_grads(self, table, ids, row_grads, lr):
+        self.client.push_row_grads(np.asarray(ids),
+                                   np.asarray(row_grads), lr)
+        return table
+
+    # aliases matching the ShardedEmbedding call sites
+    def alltoall_lookup(self, table, ids, *, capacity=None,
+                        return_overflow: bool = False):
+        out = self.lookup(table, ids)
+        if return_overflow:
+            import jax.numpy as jnp
+
+            return out, jnp.zeros((), jnp.int32)
+        return out
+
+    def alltoall_push_row_grads(self, table, ids, row_grads, lr, *,
+                                capacity=None):
+        return self.apply_row_grads(table, ids, row_grads, lr)

@@ -39,6 +39,22 @@ Serving faults (the serve.server chaos harness, docs/RELIABILITY.md
 - oversized/garbage prompts (`garbage_prompts`) — canonical malformed
   traffic the admission validators must reject without crashing the
   pool.
+
+Parameter-server faults (native.pserver + parallel.pserver_client,
+docs/RELIABILITY.md "Parameter-server fault model") use the shard's
+`fault_hook` seam (`wrap_pserver_shard`):
+- a shard KILLED on receipt of the nth push (`pserver_kill_push_at`) —
+  the update is never applied there, the client's connect failure fails
+  over to the replica, and the retried epoch applies exactly once;
+- a LOST ACK: the nth push is fully applied AND replicated, then the
+  connection drops before the reply (`pserver_lost_ack_at`) — the
+  client's same-endpoint retry must get DUP, not a second apply;
+- a SLOW replica: the nth replicated record stalls
+  (`pserver_replica_delay_at` + `pserver_replica_delay_s`) — chain
+  replication slows but never reorders or loses;
+- a snapshot-write OSError on the nth snapshot
+  (`pserver_snapshot_error_at`) — the shard keeps serving, the
+  durability gap stays visible in `last_snapshot_error`.
 """
 
 from __future__ import annotations
@@ -75,6 +91,12 @@ class FaultPlan:
     serve_error_first_n: Optional[int] = None     # first N engine calls
     serve_stall_at: Optional[int] = None          # nth decode_step
     serve_stall_s: float = 0.0                    # clock burned per stall
+    # -- parameter-server faults (native.pserver, via wrap_pserver_shard) --
+    pserver_kill_push_at: Optional[int] = None    # nth push received
+    pserver_lost_ack_at: Optional[int] = None     # nth push ACK dropped
+    pserver_replica_delay_at: Optional[int] = None  # nth repl record
+    pserver_replica_delay_s: float = 0.0          # stall per delayed record
+    pserver_snapshot_error_at: Optional[int] = None  # nth snapshot write
     once: bool = True
     fired: List[str] = dataclasses.field(default_factory=list)
 
@@ -86,6 +108,10 @@ class FaultPlan:
         self._serve_prefill_counter = 0
         self._serve_decode_counter = 0
         self._serve_call_counter = 0
+        self._pserver_push_counter = 0
+        self._pserver_ack_counter = 0
+        self._pserver_repl_counter = 0
+        self._pserver_snap_counter = 0
 
     # -- bookkeeping ------------------------------------------------------
 
@@ -176,6 +202,69 @@ class FaultPlan:
         Everything else delegates, so a wrapped engine is otherwise
         bit-identical to the real one."""
         return _FaultyEngine(engine, self, clock)
+
+    # -- parameter-server faults ------------------------------------------
+
+    def wrap_pserver_shard(self, shard):
+        """Install this plan on a `native.pserver.PServerShard` via its
+        `fault_hook` seam. Counters are plan-global across every shard
+        wrapped by the same plan, so a test wrapping one shard gets
+        exact indices; wrapping several interleaves them in arrival
+        order. Fault points:
+
+        - "push_recv" (before the update is applied): the
+          `pserver_kill_push_at`-th push KILLS the shard — listener
+          and connections close, no ACK, nothing applied there;
+        - "push_pre_ack" (applied + replicated, reply unsent): the
+          `pserver_lost_ack_at`-th push drops the connection — the
+          lost-ACK shape whose retry the epoch watermark must DUP;
+        - "repl_apply" (backup side): the `pserver_replica_delay_at`-th
+          replicated record sleeps `pserver_replica_delay_s` — a slow
+          replica stretches the chain without breaking it;
+        - "snapshot": the `pserver_snapshot_error_at`-th snapshot write
+          raises OSError — the flaky-NFS shape, shard must keep
+          serving."""
+        from paddle_tpu.native import pserver as _ps
+
+        plan = self
+
+        def hook(event: str) -> None:
+            if event == "push_recv":
+                idx = plan._pserver_push_counter
+                plan._pserver_push_counter += 1
+                if (idx == plan.pserver_kill_push_at
+                        and not plan._spent("pskill")):
+                    plan._note("pskill", idx)
+                    raise _ps.KillShard(f"injected shard kill on push "
+                                        f"#{idx}")
+            elif event == "push_pre_ack":
+                idx = plan._pserver_ack_counter
+                plan._pserver_ack_counter += 1
+                if (idx == plan.pserver_lost_ack_at
+                        and not plan._spent("pslostack")):
+                    plan._note("pslostack", idx)
+                    raise _ps.DropConnection(f"injected lost ACK on "
+                                             f"push #{idx}")
+            elif event == "repl_apply":
+                idx = plan._pserver_repl_counter
+                plan._pserver_repl_counter += 1
+                if (idx == plan.pserver_replica_delay_at
+                        and not plan._spent("psslowrepl")):
+                    plan._note("psslowrepl", idx)
+                    import time as _time
+
+                    _time.sleep(plan.pserver_replica_delay_s)
+            elif event == "snapshot":
+                idx = plan._pserver_snap_counter
+                plan._pserver_snap_counter += 1
+                if (idx == plan.pserver_snapshot_error_at
+                        and not plan._spent("pssnap")):
+                    plan._note("pssnap", idx)
+                    raise OSError(f"injected snapshot-write failure "
+                                  f"#{idx}")
+
+        shard.fault_hook = hook
+        return shard
 
     # -- master-connection faults -----------------------------------------
 
@@ -320,7 +409,7 @@ class _FlakyCheckpoints:
         self._manager = manager
         self._plan = plan
 
-    def save(self, state, step: Optional[int] = None):
+    def save(self, state, step: Optional[int] = None, **kwargs):
         idx = self._plan._save_counter
         self._plan._save_counter += 1
         if (idx == self._plan.checkpoint_error_at
@@ -328,7 +417,7 @@ class _FlakyCheckpoints:
             self._plan._note("ckpt", idx)
             raise OSError(f"injected checkpoint-write failure on "
                           f"save #{idx}")
-        return self._manager.save(state, step)
+        return self._manager.save(state, step, **kwargs)
 
     def __getattr__(self, name):
         return getattr(self._manager, name)

@@ -64,9 +64,18 @@ class CheckpointManager:
         self._mgr = _manager(directory, max_to_keep, async_save)
 
     def save(self, state: TrainState, step: Optional[int] = None) -> int:
+        """Saving onto an EXISTING step always deletes and rewrites it:
+        orbax's own policy would otherwise SKIP the write silently —
+        save() would return as if durable while the directory still
+        holds the old (possibly corrupt) state. A caller re-saving a
+        step means "make THIS state durable at this step", never "keep
+        whatever is there" (the resume-past-corruption drain save and
+        the rollback-replay cadence save both depend on this)."""
         import orbax.checkpoint as ocp
 
         step = int(state.step) if step is None else int(step)
+        if step in self._mgr.all_steps():
+            self._mgr.delete(step)
         self._mgr.save(step, args=ocp.args.StandardSave(state._asdict()))
         if not self.async_save:
             self._mgr.wait_until_finished()

@@ -40,12 +40,17 @@ class EndIteration:
     trainer/TrainerInternal.cpp:70-111, log_period utils/Flags.cpp).
     """
 
-    __slots__ = ("pass_id", "batch_id", "_cost", "_metrics")
+    __slots__ = ("pass_id", "batch_id", "outcome", "_cost", "_metrics")
 
     def __init__(self, pass_id: int, batch_id: int, cost: Any,
-                 metrics: Optional[Dict[str, Any]] = None):
+                 metrics: Optional[Dict[str, Any]] = None,
+                 outcome: str = "ok"):
         self.pass_id = pass_id
         self.batch_id = batch_id
+        # "ok" for a healthy step; the divergence guard closes a bad
+        # iteration with the fault's disposition instead of leaving the
+        # BeginIteration unmatched: "skip" | "rollback" | "fail"
+        self.outcome = outcome
         self._cost = cost
         self._metrics = metrics or {}
 
@@ -59,7 +64,8 @@ class EndIteration:
 
     def __repr__(self):
         return (f"EndIteration(pass_id={self.pass_id}, "
-                f"batch_id={self.batch_id}, <lazy cost/metrics>)")
+                f"batch_id={self.batch_id}, outcome={self.outcome!r}, "
+                f"<lazy cost/metrics>)")
 
 
 @dataclasses.dataclass

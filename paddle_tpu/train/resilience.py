@@ -185,7 +185,8 @@ class Watchdog:
 
 
 def restore_with_fallback(manager: CheckpointManager,
-                          template: TrainState):
+                          template: TrainState, *,
+                          bad_steps: Optional[List[int]] = None):
     """Restore the NEWEST restorable step, walking backwards past
     corrupt ones (a half-written orbax step, a munged array file). The
     reference's Go pserver did the md5-over-gob equivalent (reference:
@@ -196,7 +197,12 @@ def restore_with_fallback(manager: CheckpointManager,
     no checkpoints at all. Raises RuntimeError when checkpoints EXIST
     but none restores — that shape is a template/directory mismatch,
     and silently starting over would let retention garbage-collect the
-    real run."""
+    real run.
+
+    `bad_steps`, when given, collects the step numbers that FAILED to
+    restore — the caller's save path must treat those as NOT durable
+    (a replay that reaches a known-corrupt newest step must overwrite
+    it, not dedupe against its step number)."""
     try:
         steps = sorted(manager.all_steps(), reverse=True)
     except FileNotFoundError:
@@ -211,6 +217,8 @@ def restore_with_fallback(manager: CheckpointManager,
             return manager.restore(template, step=step), step
         except Exception as e:
             errors.append((step, e))
+            if bad_steps is not None:
+                bad_steps.append(step)
             log.warning("checkpoint step %d unrestorable (%s); falling "
                         "back to the previous step", step, e)
     if steps:
@@ -329,6 +337,9 @@ class ResilientTrainer:
         self._bad_used = 0
         self._progress_since_bad = 0
         self._max_step_reached = 0
+        # steps whose checkpoints exist but FAILED to restore: the
+        # latest-step save dedupe must not treat them as durable
+        self._corrupt_steps: set = set()
         self._watchdog: Optional[Watchdog] = None
         self._build_step()
 
@@ -385,12 +396,17 @@ class ResilientTrainer:
         watchdog on both sides gives the save its own full deadline
         instead of whatever the last step left over."""
         self._pet()
-        if self.manager.latest_step() == int(state.step):
+        step = int(state.step)
+        if (self.manager.latest_step() == step
+                and step not in self._corrupt_steps):
             return      # this step is already durable
         attempts = 3 if drain else 1
         for i in range(attempts):
             try:
+                # save() replaces an existing step directory, so a
+                # known-corrupt one is overwritten here, not kept
                 self.manager.save(state)
+                self._corrupt_steps.discard(step)
                 self._pet()
                 return
             except OSError as e:
@@ -457,7 +473,10 @@ class ResilientTrainer:
             log.warning("LR backoff: grad scale now %.4g", self._lr_scale)
             self._build_step()
         self._pet()     # restore + possible re-jit get a fresh deadline
-        restored, step = restore_with_fallback(self.manager, prev_state)
+        bad: List[int] = []
+        restored, step = restore_with_fallback(self.manager, prev_state,
+                                               bad_steps=bad)
+        self._corrupt_steps.update(bad)
         if step is None:
             raise DivergenceError(self.bad_steps)
         self._pet()
@@ -480,7 +499,10 @@ class ResilientTrainer:
         steps draw identical randomness and a resumed run's params are
         bit-identical to an uninterrupted one's.
         """
-        restored, step = restore_with_fallback(self.manager, state)
+        bad_restore_steps: List[int] = []
+        restored, step = restore_with_fallback(
+            self.manager, state, bad_steps=bad_restore_steps)
+        self._corrupt_steps.update(bad_restore_steps)
         if step is not None:
             log.info("resuming from checkpoint step %d under %s", step,
                      getattr(self.manager, "directory", "?"))
@@ -553,9 +575,21 @@ class ResilientTrainer:
                 lossf = float(loss)
                 reason = self._classify(lossf, ema)
                 if reason is not None:
-                    state = self._handle_bad_step(
-                        state, prev_state, pass_id, batch_id, lossf,
-                        reason)
+                    # event parity: every BeginIteration gets a closing
+                    # EndIteration even on the fault paths — carrying
+                    # the disposition ("skip"/"rollback"/"fail") so
+                    # stream consumers never see an unclosed iteration
+                    try:
+                        state = self._handle_bad_step(
+                            state, prev_state, pass_id, batch_id, lossf,
+                            reason)
+                    except (_Rollback, DivergenceError):
+                        handler(E.EndIteration(
+                            pass_id, batch_id, cost=loss,
+                            outcome=self.bad_steps[-1].action))
+                        raise
+                    handler(E.EndIteration(pass_id, batch_id, cost=loss,
+                                           outcome="skip"))
                     gidx += 1
                     self._pet()
                     continue

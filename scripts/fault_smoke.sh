@@ -1,15 +1,24 @@
 #!/usr/bin/env sh
 # Chaos smoke lane: run ONLY the fault-injection tests (marker
-# `faults` — training resilience in tests/test_resilience.py plus the
-# serving chaos harness in tests/test_serve_server.py), so degradation
-# coverage is cheap to invoke standalone:
+# `faults` — training resilience in tests/test_resilience.py, the
+# serving chaos harness in tests/test_serve_server.py, and the
+# parameter-server fault suite in tests/test_pserver.py), so
+# degradation coverage is cheap to invoke standalone:
 #
 #     scripts/fault_smoke.sh            # the whole faults lane
+#     scripts/fault_smoke.sh pserver    # just the pserver lane
+#                                       #   (leases/replication/failover)
 #     scripts/fault_smoke.sh -k serve   # just the serving chaos suite
 #
-# CPU-only and deterministic (testing.faults FaultPlan + ManualClock);
-# extra args pass through to pytest.
+# CPU-only and deterministic (testing.faults FaultPlan + ManualClock;
+# pserver faults via the shard fault_hook seam); extra args pass
+# through to pytest.
 set -e
 cd "$(dirname "$0")/.."
-exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m faults \
+marker=faults
+if [ "$1" = "pserver" ]; then
+    marker=pserver
+    shift
+fi
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "$marker" \
     -p no:cacheprovider "$@"

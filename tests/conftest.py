@@ -39,6 +39,11 @@ def pytest_configure(config):
         "markers", "faults: fault-injection resilience suite "
         "(testing.faults) — fast and CPU-only, runs IN tier-1; the "
         "marker exists so `-m faults` can run recovery paths alone")
+    config.addinivalue_line(
+        "markers", "pserver: parameter-server fault-tolerance suite "
+        "(native.pserver leases/replication/failover) — a subset of "
+        "the faults lane, runs IN tier-1; `-m pserver` (or "
+        "`scripts/fault_smoke.sh pserver`) runs it alone")
 
 
 @pytest.fixture

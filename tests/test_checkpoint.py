@@ -339,3 +339,22 @@ def test_async_restore_waits_for_pending_save(tmp_path):
     restored = mgr.restore(template)   # no explicit wait()
     _trees_equal(restored.params, state.params)
     mgr.close()
+
+
+def test_save_onto_existing_step_overwrites(tmp_path):
+    """Re-saving an existing step must WRITE, not silently skip:
+    orbax's own save-decision policy skips existing steps without an
+    error, which would hand back a false durability signal (the drain
+    save after a fallback-restore replay depends on the overwrite)."""
+    model = _model()
+    tr = Trainer(model, _loss, optim.adam(1e-3))
+    state = tr.init_state(ShapeSpec((4, 5)))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=3)
+    mgr.save(state, step=4)
+    bumped = state._replace(
+        params=jax.tree.map(lambda x: x + 1.0, state.params))
+    mgr.save(bumped, step=4)            # same step, different state
+    restored = mgr.restore(state, step=4)
+    _trees_equal(restored.params, bumped.params)
+    assert mgr.all_steps() == [4]
+    mgr.close()

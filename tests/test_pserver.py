@@ -1,0 +1,692 @@
+"""Parameter-server fault-tolerance suite (native.pserver +
+parallel.pserver_client + the pserver faults in testing.faults).
+
+Every test proves a recovery path end-to-end against a deterministic
+injected fault, in-process on localhost — the reference proved its Go
+pserver the same way (reference: go/pserver/client/client_test.go runs
+real pservers on localhost; trainer/tests kill them mid-run). The
+acceptance chaos scenario: kill the primary of one shard MID-PASS,
+fail over to its chain replica, finish the pass, and the final table
+is bit-identical to an unfaulted run — with a lost-ACK retried push
+applied exactly once, asserted by row values, not counters.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.native.pserver import (
+    PServerGroup,
+    PServerShard,
+    ShardState,
+    start_shard_pair,
+)
+from paddle_tpu.parallel.pserver_client import (
+    PServerClient,
+    PServerEmbedding,
+)
+from paddle_tpu.testing import FaultPlan
+from paddle_tpu.testing.faults import ManualClock
+
+pytestmark = [pytest.mark.faults, pytest.mark.pserver]
+
+DIM = 4
+
+
+def _client(specs, trainer_id=0, **kw):
+    kw.setdefault("backoff_base", 0.005)
+    kw.setdefault("backoff_max", 0.1)
+    kw.setdefault("timeout", 5.0)
+    return PServerClient(specs, DIM, trainer_id=trainer_id, **kw)
+
+
+def _table(vocab, seed=0):
+    return (np.random.RandomState(seed)
+            .rand(vocab, DIM).astype(np.float32))
+
+
+def _restart_shard_on(port, vocab, **kw):
+    """Bring a shard back on a just-killed shard's port: the dead
+    listener's fd release can lag its kill() by a scheduler tick, so
+    retry the bind briefly."""
+    deadline = time.monotonic() + 5.0
+    while True:
+        try:
+            return PServerShard(0, 0, vocab, DIM, port=port, **kw)
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.02)
+
+
+def _push_schedule(vocab, steps, seed=3):
+    """Deterministic (ids, grads) per step — the mini training pass the
+    chaos runs replay identically with and without faults."""
+    r = np.random.RandomState(seed)
+    return [(r.randint(0, vocab, 5).astype(np.int64),
+             r.rand(5, DIM).astype(np.float32))
+            for _ in range(steps)]
+
+
+def _run_pass(client, table, schedule, lr=0.1):
+    client.register()
+    client.load_table(table)
+    for ids, grads in schedule:
+        client.push_row_grads(ids, grads, lr)
+    client.finish_pass(timeout_s=10.0)
+    return client.fetch_table()
+
+
+def _reference_apply(table, schedule, lr=0.1):
+    out = table.astype(np.float32).copy()
+    for ids, grads in schedule:
+        np.add.at(out, ids, (-lr * grads).astype(np.float32))
+    return out
+
+
+# ---- basics ------------------------------------------------------------
+
+def test_roundtrip_push_and_padding_contract():
+    """get_rows/push_row_grads against a 2-shard group: reads assemble
+    across shards, out-of-range ids give ZERO rows (the sharded_lookup
+    contract), pushes land only on owned rows."""
+    vocab = 16
+    with PServerGroup(vocab, DIM, n_shards=2, replicated=False) as g:
+        with _client(g.specs) as c:
+            c.register()
+            table = _table(vocab)
+            c.load_table(table)
+            ids = np.asarray([0, 7, 8, 15, -1, vocab + 3], np.int64)
+            rows = c.get_rows(ids)
+            expect = np.zeros((6, DIM), np.float32)
+            expect[:4] = table[[0, 7, 8, 15]]
+            assert np.array_equal(rows, expect)
+
+            sched = _push_schedule(vocab, 3)
+            for ids, grads in sched:
+                c.push_row_grads(ids, grads, 0.1)
+            assert np.array_equal(c.fetch_table(),
+                                  _reference_apply(table, sched))
+
+
+def test_duplicate_epoch_not_reapplied():
+    """The exactly-once primitive in isolation: replaying an epoch the
+    shard has applied is a DUP-ACK no-op, by row values."""
+    st = ShardState(0, 8, DIM)
+    ids = np.asarray([1, 1, 5], np.int64)
+    grads = np.ones((3, DIM), np.float32)
+    assert st.apply_push(7, 1, ids, grads, lr=1.0)
+    once = st.rows.copy()
+    assert once[1, 0] == -2.0       # in-push duplicates accumulate
+    assert not st.apply_push(7, 1, ids, grads, lr=1.0)   # replay
+    assert np.array_equal(st.rows, once)
+    assert st.apply_push(7, 2, ids, grads, lr=1.0)       # next epoch
+
+
+def test_chain_replication_keeps_backup_identical():
+    vocab = 8
+    primary, backup, spec = start_shard_pair(0, 0, vocab, DIM)
+    try:
+        with _client([spec]) as c:
+            c.register()
+            c.load_table(_table(vocab))
+            for ids, grads in _push_schedule(vocab, 4):
+                c.push_row_grads(ids, grads, 0.2)
+        assert backup.state.version == primary.state.version
+        assert np.array_equal(backup.state.rows, primary.state.rows)
+        assert backup.state.epochs == primary.state.epochs
+    finally:
+        primary.stop()
+        backup.stop()
+
+
+# ---- lost ACK: exactly-once by row values ------------------------------
+
+def test_lost_ack_retried_push_applied_exactly_once():
+    """The nth push is applied AND replicated, then the connection dies
+    before the ACK. The client retries the SAME epoch on the same
+    endpoint; the shard answers DUP. Exactly-once is asserted by final
+    row equality with a single application — not by counters."""
+    vocab = 8
+    primary, backup, spec = start_shard_pair(0, 0, vocab, DIM)
+    plan = FaultPlan(pserver_lost_ack_at=1)
+    plan.wrap_pserver_shard(primary)
+    try:
+        with _client([spec]) as c:
+            table = _table(vocab)
+            sched = _push_schedule(vocab, 3)
+            got = _run_pass(c, table, sched)
+            assert plan.count("pslostack") == 1
+            assert c.stats["duplicate_acks"] == 1
+            assert np.array_equal(got, _reference_apply(table, sched))
+        # the replica saw each update exactly once too
+        assert np.array_equal(backup.state.rows, primary.state.rows)
+    finally:
+        primary.stop()
+        backup.stop()
+
+
+def test_restarted_trainer_resumes_epoch_sequence():
+    """A trainer that crashes and comes back (fresh client, epochs at
+    0) must have its NEW pushes applied: register() hands back the
+    shard's applied-epoch watermark and the client numbers past it —
+    without that, the first N pushes would be DUP-discarded against
+    the dead incarnation's watermark."""
+    vocab = 8
+    with PServerGroup(vocab, DIM, n_shards=1, replicated=False) as g:
+        table = _table(vocab)
+        sched = _push_schedule(vocab, 3)
+        with _client(g.specs, trainer_id=7) as c1:
+            c1.register()
+            c1.load_table(table)
+            for ids, grads in sched:
+                c1.push_row_grads(ids, grads, 0.1)
+        after_first = _reference_apply(table, sched)
+
+        with _client(g.specs, trainer_id=7) as c2:   # the restart
+            c2.register()
+            extra = _push_schedule(vocab, 2, seed=9)
+            for ids, grads in extra:
+                c2.push_row_grads(ids, grads, 0.1)
+            assert c2.stats["duplicate_acks"] == 0   # nothing discarded
+            assert np.array_equal(c2.fetch_table(),
+                                  _reference_apply(after_first, extra))
+
+
+def test_replica_outage_triggers_full_resync():
+    """A backup that missed records while unreachable must NOT be
+    trusted with later increments (it would apply over the gap and
+    silently diverge): the first replication after the link degrades
+    ships the FULL state, so a restarted backup is exact again."""
+    vocab = 8
+    primary, backup, spec = start_shard_pair(0, 0, vocab, DIM)
+    try:
+        with _client([spec]) as c:
+            table = _table(vocab)
+            sched = _push_schedule(vocab, 4)
+            c.register()
+            c.load_table(table)
+            c.push_row_grads(*sched[0], 0.1)
+            backup.kill()
+            # applied + ACKed while unreplicated (degrade, not block)
+            c.push_row_grads(*sched[1], 0.1)
+            assert primary.stats()["replica_lost"]
+            # backup returns on the SAME address
+            backup2 = _restart_shard_on(backup.addr[1], vocab)
+            try:
+                c.push_row_grads(*sched[2], 0.1)    # triggers resync
+                c.push_row_grads(*sched[3], 0.1)    # incremental again
+                assert backup2.stats()["repl_resyncs"] == 1
+                assert backup2.state.version == primary.state.version
+                assert np.array_equal(backup2.state.rows,
+                                      primary.state.rows)
+                assert np.array_equal(
+                    primary.state.rows,
+                    _reference_apply(table, sched))
+            finally:
+                backup2.stop()
+    finally:
+        primary.stop()
+        backup.stop()
+
+
+def test_backup_fast_restart_gap_refused_then_resynced():
+    """A backup that restarts FAST (reachable again within the repl
+    link's in-call reconnect) must not accept an incremental record
+    over the gap it just acquired — it refuses with NEED_RESYNC and
+    the next replication ships the full state, making it exact."""
+    vocab = 8
+    primary, backup, spec = start_shard_pair(0, 0, vocab, DIM,
+                                             repl_retry_s=0.0)
+    backup2 = None
+    try:
+        with _client([spec]) as c:
+            table = _table(vocab)
+            sched = _push_schedule(vocab, 4)
+            c.register()
+            c.load_table(table)
+            c.push_row_grads(*sched[0], 0.1)
+            backup.kill()
+            # fresh backup on the SAME address, version 0 — a gap
+            backup2 = _restart_shard_on(backup.addr[1], vocab)
+            c.push_row_grads(*sched[1], 0.1)    # incremental REFUSED
+            assert backup2.state.version == 0   # nothing applied over it
+            c.push_row_grads(*sched[2], 0.1)    # full-state resync
+            c.push_row_grads(*sched[3], 0.1)    # incremental again
+            assert backup2.stats()["repl_resyncs"] == 1
+            assert backup2.state.version == primary.state.version
+            assert np.array_equal(backup2.state.rows,
+                                  primary.state.rows)
+            assert np.array_equal(primary.state.rows,
+                                  _reference_apply(table, sched))
+    finally:
+        if backup2 is not None:
+            backup2.stop()
+        primary.stop()
+        backup.stop()
+
+
+# ---- the acceptance chaos run ------------------------------------------
+
+def test_chaos_primary_kill_midpass_failover_bit_identical():
+    """Kill the primary of shard 0 on its 3rd push, MID-PASS, while a
+    lost ACK hits shard 1 — the client fails over to shard 0's chain
+    replica, re-registers, retries the in-flight epoch, finishes the
+    pass, and the final table is BIT-identical to an unfaulted run of
+    the same schedule."""
+    vocab = 32
+    n_shards = 2
+    sched = _push_schedule(vocab, 8)
+    table = _table(vocab)
+
+    with PServerGroup(vocab, DIM, n_shards=n_shards) as ref_group:
+        with _client(ref_group.specs) as c:
+            unfaulted = _run_pass(c, table, sched)
+
+    with PServerGroup(vocab, DIM, n_shards=n_shards) as group:
+        kill_plan = FaultPlan(pserver_kill_push_at=2)
+        ack_plan = FaultPlan(pserver_lost_ack_at=4)
+        kill_plan.wrap_pserver_shard(group.primaries[0])
+        ack_plan.wrap_pserver_shard(group.primaries[1])
+        with _client(group.specs) as c:
+            faulted = _run_pass(c, table, sched)
+            assert kill_plan.count("pskill") == 1
+            assert ack_plan.count("pslostack") == 1
+            assert group.primaries[0].killed
+            # failover re-registered on the replica; the lost-ACK retry
+            # was answered DUP somewhere
+            assert c.stats["reregistrations"] >= 1
+            assert c.stats["duplicate_acks"] >= 1
+        assert np.array_equal(faulted, unfaulted)
+        # shard 0 survives on its replica: it holds every update
+        # exactly once despite never seeing the killed primary again
+        assert np.array_equal(
+            group.backups[0].state.rows,
+            unfaulted[group.specs[0].row_lo:group.specs[0].row_hi])
+
+
+# ---- leases ------------------------------------------------------------
+
+def test_lease_expiry_releases_in_flight_pass():
+    """Trainer A registers, pushes, then dies silently. Trainer B
+    finishes — the pass must NOT wedge on A: once A's lease expires,
+    A is released from the barrier and B's pass completes. A's later
+    push transparently re-registers (fresh lease, same epochs)."""
+    vocab = 8
+    clock = ManualClock()
+    shard = PServerShard(0, 0, vocab, DIM, lease_ttl_s=5.0, clock=clock)
+    from paddle_tpu.native.pserver import ShardSpec
+
+    spec = ShardSpec(0, 0, vocab, [shard.addr])
+    try:
+        # leases renew with the TTL each trainer REGISTERED — A's short
+        # lease dies with it while B (longer lease, the survivor)
+        # keeps the pass
+        a = _client([spec], trainer_id=1, lease_ttl_s=5.0)
+        b = _client([spec], trainer_id=2, lease_ttl_s=50.0)
+        a.register()
+        b.register()
+        a.push_row_grads(np.asarray([3], np.int64),
+                         np.ones((1, DIM), np.float32), 0.1)
+        assert b.finish_pass(wait=False) == 0       # A still holds it
+        assert b.pass_state() == 0
+        clock.advance(6.0)                          # A's lease expires
+        assert b.pass_state() == 1                  # pass released
+        assert shard.stats()["lease_expirations"] == 1
+        # A is gone from the barrier but its epoch watermark survives:
+        # a re-registered A cannot double-apply an old epoch
+        before = shard.state.rows.copy()
+        a._tokens[0] = None      # simulate A noticing via LEASE_EXPIRED
+        a._epochs[0] -= 1        # replay the last epoch
+        a.push_row_grads(np.asarray([3], np.int64),
+                         np.ones((1, DIM), np.float32), 0.1)
+        assert np.array_equal(shard.state.rows, before)
+        assert a.stats["duplicate_acks"] == 1
+        a.close()
+        b.close()
+    finally:
+        shard.stop()
+
+
+def test_finish_pass_barrier_survives_primary_death():
+    """A finish vote lives on the server that took it. Trainer A votes
+    on the primary and waits; the primary dies; trainer B's vote fails
+    over to the replica. A's poll must detect its lease token changing
+    (the heartbeat re-registers on the replica) and RE-VOTE there —
+    the barrier completes on the replica instead of stranding A in
+    TimeoutError against a dead server's pass counter."""
+    vocab = 8
+    primary, backup, spec = start_shard_pair(0, 0, vocab, DIM)
+    a = _client([spec], trainer_id=1, lease_ttl_s=0.5)
+    b = _client([spec], trainer_id=2, lease_ttl_s=0.5)
+    try:
+        a.register()
+        b.register()
+        a.push_row_grads(np.asarray([1], np.int64),
+                         np.ones((1, DIM), np.float32), 0.1)
+        result = {}
+
+        def wait_a():
+            try:
+                result["pass"] = a.finish_pass(poll_s=0.02,
+                                               timeout_s=15.0)
+            except Exception as e:          # surfaced on the main thread
+                result["err"] = e
+
+        t = threading.Thread(target=wait_a, daemon=True)
+        t.start()
+        time.sleep(0.3)                 # A's vote lands on the primary
+        primary.kill()                  # ...and dies with it
+        got_b = b.finish_pass(poll_s=0.02, timeout_s=15.0)
+        t.join(timeout=20.0)
+        assert not t.is_alive()
+        assert "err" not in result, result.get("err")
+        assert result["pass"] == got_b >= 1
+    finally:
+        a.close()
+        b.close()
+        primary.stop()
+        backup.stop()
+
+
+def test_push_without_lease_reregisters():
+    """A push landing on a server that never granted this trainer a
+    lease (the failover target) gets LEASE_EXPIRED and the client
+    re-registers + retries the same epoch — no manual intervention."""
+    vocab = 8
+    with PServerGroup(vocab, DIM, n_shards=1, replicated=False) as g:
+        with _client(g.specs) as c:
+            # a token the server never granted — the state a client is
+            # in right after failing over to a replica
+            c._tokens[0] = 12345
+            c.push_row_grads(np.asarray([1], np.int64),
+                             np.ones((1, DIM), np.float32), 1.0)
+            assert c.stats["reregistrations"] == 1
+            assert g.primaries[0].state.rows[1, 0] == -1.0
+            # no manual register() at all: the first push registers
+            # lazily and applies exactly once
+            assert g.primaries[0].stats()["live_trainers"] == 1
+
+
+# ---- snapshots + restart catch-up --------------------------------------
+
+def test_snapshot_restart_resumes_plus_replica_catchup(tmp_path):
+    """Snapshot, keep pushing, kill the primary abruptly. The restarted
+    shard loads its (stale) snapshot, then adopts the replica's newer
+    state — resuming at the exact row values the pair had."""
+    vocab = 8
+    primary, backup, spec = start_shard_pair(
+        0, 0, vocab, DIM, snapshot_dir=str(tmp_path), name="s0")
+    try:
+        with _client([spec]) as c:
+            table = _table(vocab)
+            sched = _push_schedule(vocab, 4)
+            c.register()
+            c.load_table(table)
+            c.push_row_grads(*sched[0], 0.1)
+            primary.snapshot()
+            for ids, grads in sched[1:]:
+                c.push_row_grads(ids, grads, 0.1)
+        expected = _reference_apply(table, sched)
+        primary.kill()          # abrupt: no final snapshot
+
+        restarted = PServerShard(
+            0, 0, vocab, DIM, name="s0-primary",
+            snapshot_dir=str(tmp_path), sync_from=backup.addr,
+            replica_addr=backup.addr)
+        try:
+            assert restarted.restored_from is not None
+            assert restarted.synced_from_peer
+            assert restarted.state.version == backup.state.version
+            assert np.array_equal(restarted.state.rows, expected)
+            # epochs came along: a replayed client epoch still dedupes
+            assert restarted.state.epochs == backup.state.epochs
+        finally:
+            restarted.stop()
+    finally:
+        primary.stop()
+        backup.stop()
+
+
+def test_snapshot_write_oserror_keeps_serving(tmp_path):
+    """The flaky-NFS shape: a snapshot-write OSError must not take the
+    shard down — the gap stays visible in last_snapshot_error and the
+    next snapshot clears it."""
+    vocab = 8
+    shard = PServerShard(0, 0, vocab, DIM, snapshot_dir=str(tmp_path),
+                         name="flaky")
+    plan = FaultPlan(pserver_snapshot_error_at=0)
+    plan.wrap_pserver_shard(shard)
+    from paddle_tpu.native.pserver import ShardSpec
+
+    spec = ShardSpec(0, 0, vocab, [shard.addr])
+    try:
+        with _client([spec]) as c:
+            c.register()
+            c.load_table(_table(vocab))
+            with pytest.raises(OSError):
+                shard.snapshot()
+            assert shard.last_snapshot_error is not None
+            assert plan.count("pssnap") == 1
+            # still serving
+            assert c.get_rows(np.asarray([2], np.int64)).shape == (1, DIM)
+            shard.snapshot()            # fault spent (once=True)
+            assert shard.last_snapshot_error is None
+            assert ShardState.load(shard.snapshot_path, DIM).version \
+                == shard.state.version
+    finally:
+        shard.stop()
+
+
+def test_slow_replica_stretches_chain_without_losing_it():
+    """A stalled replica apply delays the ACK (chain replication waits
+    for the tail) but neither reorders nor drops updates."""
+    vocab = 8
+    primary, backup, spec = start_shard_pair(0, 0, vocab, DIM)
+    plan = FaultPlan(pserver_replica_delay_at=1,
+                     pserver_replica_delay_s=0.05)
+    plan.wrap_pserver_shard(backup)
+    try:
+        with _client([spec]) as c:
+            table = _table(vocab)
+            sched = _push_schedule(vocab, 3)
+            got = _run_pass(c, table, sched)
+        assert plan.count("psslowrepl") == 1
+        assert np.array_equal(got, _reference_apply(table, sched))
+        assert np.array_equal(backup.state.rows, primary.state.rows)
+    finally:
+        primary.stop()
+        backup.stop()
+
+
+# ---- the embedding adapter + the resilient trainer ---------------------
+
+def test_pserver_embedding_matches_rowwise_reference():
+    """PServerEmbedding's lookup/apply_row_grads agree with the local
+    rowwise_sgd_update semantics (padding ids contribute zero and are
+    never applied)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.parallel.sparse import rowwise_sgd_update
+
+    vocab = 16
+    with PServerGroup(vocab, DIM, n_shards=2, replicated=False) as g:
+        with _client(g.specs) as c:
+            c.register()
+            emb = PServerEmbedding(c)
+            handle = emb.init(jax.random.key(1))
+            server_table = c.fetch_table()
+
+            ids = jnp.asarray([0, 9, 15, -1], jnp.int32)
+            vecs = emb.lookup(handle, ids)
+            assert np.array_equal(np.asarray(vecs[3]), np.zeros(DIM))
+            assert np.array_equal(np.asarray(vecs[:3]),
+                                  server_table[[0, 9, 15]])
+
+            grads = jnp.asarray(
+                np.random.RandomState(5).rand(4, DIM), jnp.float32)
+            emb.apply_row_grads(handle, ids, grads, 0.3)
+            ref = rowwise_sgd_update(jnp.asarray(server_table),
+                                     ids, grads, 0.3)
+            np.testing.assert_allclose(c.fetch_table(), np.asarray(ref),
+                                       rtol=1e-6)
+
+
+def test_resilient_trainer_through_shard_kill(tmp_path):
+    """The tentpole integration: a ResilientTrainer run whose data path
+    looks rows up from the pserver tier and pushes row grads after
+    every iteration — with the shard's PRIMARY killed mid-pass. The
+    run must complete through the failover with final dense params AND
+    final sparse table identical to an unfaulted twin run."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import nn, optim
+    from paddle_tpu.nn.module import ShapeSpec
+    from paddle_tpu.ops import losses
+    from paddle_tpu.train import ResilientTrainer, Trainer
+    from paddle_tpu.train import events as E
+
+    vocab, steps = 8, 6
+    id_sched = [np.random.RandomState(100 + i).randint(0, vocab, 4)
+                .astype(np.int64) for i in range(steps)]
+
+    def run(specs, ckpt_dir):
+        with _client(specs) as c:
+            c.register()
+            emb = PServerEmbedding(c)
+            handle = emb.init(jax.random.key(2))
+
+            def factory():
+                for i in range(steps):
+                    vecs = np.asarray(emb.lookup(handle, id_sched[i]))
+                    yield (vecs,
+                           (id_sched[i] % 3).astype(np.int64))
+
+            def on_event(ev):
+                if isinstance(ev, E.EndIteration):
+                    i = ev.batch_id
+                    g = np.full((4, DIM), (i + 1) / 10.0, np.float32)
+                    emb.apply_row_grads(handle, id_sched[i], g, 0.5)
+
+            model = nn.Sequential([nn.Dense(3, name="out")])
+            tr = Trainer(model,
+                         lambda o, y: jnp.mean(
+                             losses.softmax_cross_entropy(o, y)),
+                         optim.sgd(0.1))
+            state = tr.init_state(ShapeSpec((4, DIM)))
+            rt = ResilientTrainer(tr, str(ckpt_dir))
+            final = rt.run(state, factory, num_passes=1,
+                           event_handler=on_event)
+            c.finish_pass(timeout_s=10.0)
+            return (jax.tree.map(np.asarray, final.params),
+                    c.fetch_table())
+
+    with PServerGroup(vocab, DIM, n_shards=1) as ref_group:
+        ref_params, ref_table = run(ref_group.specs, tmp_path / "ref")
+
+    with PServerGroup(vocab, DIM, n_shards=1) as group:
+        plan = FaultPlan(pserver_kill_push_at=2)
+        plan.wrap_pserver_shard(group.primaries[0])
+        params, table = run(group.specs, tmp_path / "chaos")
+        assert plan.count("pskill") == 1
+        assert group.primaries[0].killed
+
+    for a, b in zip(jax.tree_util.tree_leaves(params),
+                    jax.tree_util.tree_leaves(ref_params)):
+        np.testing.assert_array_equal(a, b)
+    assert np.array_equal(table, ref_table)
+
+
+# ---- the MasterClient contract the epoch scheme leans on ---------------
+
+class _FrameSink:
+    """Accepts connections, reads ONE length-prefixed frame per
+    connection, counts it, then closes WITHOUT replying — the
+    lost-response shape that separates idempotent (retried) from
+    non-idempotent (single-send) MasterClient ops."""
+
+    def __init__(self):
+        import socket as _socket
+
+        self._sock = _socket.socket()
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self.frames = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        import struct as _struct
+
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            try:
+                conn.settimeout(2.0)
+                hdr = b""
+                while len(hdr) < 4:
+                    b = conn.recv(4 - len(hdr))
+                    if not b:
+                        raise ConnectionError
+                    hdr += b
+                (n,) = _struct.unpack("<I", hdr)
+                got = 0
+                while got < n:
+                    b = conn.recv(n - got)
+                    if not b:
+                        raise ConnectionError
+                    got += len(b)
+                self.frames += 1
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    def close(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+@pytest.mark.parametrize("op,expected_frames", [
+    ("add_task", 1),      # non-idempotent: ONE send, no silent replay
+    ("next_pass", 1),     # non-idempotent: drain-check must not double
+    ("counts", 4),        # idempotent read: retried (retries + 1)
+])
+def test_masterclient_send_policy(op, expected_frames):
+    """add_task/next_pass get exactly ONE send attempt when the
+    response is lost (a re-send could register a duplicate task / trip
+    the drain check — the failure class the pserver push epochs exist
+    to remove), while idempotent ops retry through the same outage."""
+    from paddle_tpu.native.taskqueue import MasterClient
+
+    sink = _FrameSink()
+    try:
+        client = MasterClient(port=sink.port, timeout=1.0, retries=3,
+                              backoff_base=0.001, backoff_max=0.01,
+                              seed=0)
+        with pytest.raises(ConnectionError):
+            if op == "add_task":
+                client.add_task(b"payload")
+            elif op == "next_pass":
+                client.next_pass()
+            else:
+                client.counts()
+        deadline = time.monotonic() + 2.0
+        while sink.frames < expected_frames \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sink.frames == expected_frames
+        client.close()
+    finally:
+        sink.close()

@@ -54,13 +54,14 @@ def _batches(n=6, seed=0):
             for _ in range(n)]
 
 
-def _run(ckpt_dir, factory, *, num_passes=2, **kw):
+def _run(ckpt_dir, factory, *, num_passes=2, event_handler=None, **kw):
     """Fresh Trainer (same seed) + ResilientTrainer over `factory` —
     the restart-the-process idiom, minus the process."""
     tr = Trainer(_model(), _loss, optim.sgd(0.1))
     state = tr.init_state(ShapeSpec((4, 5)))
     rt = ResilientTrainer(tr, str(ckpt_dir), **kw)
-    return rt, rt.run(state, factory, num_passes=num_passes)
+    return rt, rt.run(state, factory, num_passes=num_passes,
+                      event_handler=event_handler)
 
 
 def _trees_equal(a, b):
@@ -648,3 +649,109 @@ def test_cli_exposes_resilience_flags():
     assert args.max_bad_steps == 7
     assert args.lr_backoff == 0.5
     assert args.watchdog_timeout == 120.0
+
+
+# ---- PR 3 satellites: event parity + corrupt-latest drain save ---------
+
+def _collect_events(tmp_path, plan, policy, subdir):
+    from paddle_tpu.train import events as E
+
+    events = []
+    rt, out = _run(tmp_path / subdir,
+                   plan.wrap_batches(lambda: iter(_batches())),
+                   num_passes=1, bad_step_policy=policy,
+                   checkpoint_every_n_batches=2,
+                   event_handler=events.append)
+    begins = [(e.pass_id, e.batch_id) for e in events
+              if isinstance(e, E.BeginIteration)]
+    ends = [(e.pass_id, e.batch_id, e.outcome) for e in events
+            if isinstance(e, E.EndIteration)]
+    return begins, ends, out
+
+
+def test_bad_step_skip_closes_iteration_events(tmp_path):
+    """Event parity: the skip path must emit a closing EndIteration
+    (carrying the fault outcome) — consumers never see an unclosed
+    iteration."""
+    begins, ends, _ = _collect_events(
+        tmp_path, FaultPlan(nan_batch_at=2), "skip", "ev-skip")
+    assert len(begins) == len(ends) == 6
+    assert [(p, b) for p, b, _ in ends] == begins
+    assert [o for _, b, o in ends if b == 2] == ["skip"]
+    assert all(o == "ok" for _, b, o in ends if b != 2)
+
+
+def test_bad_step_rollback_closes_iteration_events(tmp_path):
+    """Rollback unwinds the drive loop — but not before closing the
+    iteration whose step went bad. Replayed iterations get their own
+    Begin/End pairs, so counts stay equal."""
+    begins, ends, _ = _collect_events(
+        tmp_path, FaultPlan(nan_batch_at=2), "rollback", "ev-rb")
+    assert len(begins) == len(ends)
+    assert [(p, b) for p, b, _ in ends] == begins
+    outcomes = [o for _, b, o in ends if b == 2]
+    # first visit rolled back, the replay (fault spent) is healthy
+    assert outcomes == ["rollback", "ok"]
+
+
+def test_divergence_failure_closes_iteration_events(tmp_path):
+    """Even the hard-fail arm (budget spent -> DivergenceError) closes
+    its iteration with outcome 'fail'."""
+    from paddle_tpu.train import events as E
+
+    events = []
+    plan = FaultPlan(nan_batch_at=1, once=False)   # every replay is bad
+    with pytest.raises(DivergenceError):
+        _run(tmp_path / "ev-fail",
+             plan.wrap_batches(lambda: iter(_batches())),
+             num_passes=1, bad_step_policy="skip", max_bad_steps=0,
+             event_handler=events.append)
+    begins = [e for e in events if isinstance(e, E.BeginIteration)]
+    ends = [e for e in events if isinstance(e, E.EndIteration)]
+    assert len(begins) == len(ends)
+    assert ends[-1].outcome == "fail"
+
+
+def test_drain_save_overwrites_corrupt_latest_step(tmp_path):
+    """The PR 2 known finding: a known-corrupt NEWEST checkpoint must
+    not satisfy the latest-step save dedupe. After a fallback-restore
+    past it, the replayed run's final save must WRITE (overwriting the
+    corpse), so a third run restores the true final step instead of
+    falling back again."""
+    import os
+
+    from paddle_tpu.train import restore_with_fallback
+
+    batches = _batches()
+    _, ref = _run(tmp_path / "c", lambda: iter(batches), num_passes=1,
+                  checkpoint_every_n_batches=2)
+    final_step = int(ref.step)
+
+    # corrupt the newest committed step the way a power cut does:
+    # commit marker present, array files truncated
+    step_dir = os.path.join(str(tmp_path / "c"), str(final_step))
+    assert os.path.isdir(step_dir)
+    for root, _dirs, files in os.walk(step_dir):
+        for fn in files:
+            if fn.endswith((".json", "metadata")):
+                continue
+            with open(os.path.join(root, fn), "wb"):
+                pass
+
+    rt2, out = _run(tmp_path / "c", lambda: iter(batches), num_passes=1,
+                    checkpoint_every_n_batches=2)
+    assert rt2.restored_step == final_step - 2      # fell back past it
+    assert int(out.step) == final_step
+
+    # the replayed final step is now DURABLE: a fresh manager restores
+    # it directly (no fallback), with the reference run's params
+    tr = Trainer(_model(), _loss, optim.sgd(0.1))
+    template = tr.init_state(ShapeSpec((4, 5)))
+    from paddle_tpu.train.checkpoint import CheckpointManager
+    mgr = CheckpointManager(str(tmp_path / "c"))
+    bad = []
+    restored, step = restore_with_fallback(mgr, template, bad_steps=bad)
+    assert step == final_step
+    assert bad == []
+    _trees_equal(restored.params, ref.params)
+    mgr.close()
