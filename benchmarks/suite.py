@@ -230,6 +230,8 @@ def bench_seq2seq(batch: int = 64, *, src_len: int = 30, tgt_len: int = 30,
     flops = None
     try:
         cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):   # older jax: one entry
+            cost = cost[0] if cost else {}    # per computation
         if cost and "flops" in cost:
             flops = float(cost["flops"])
     except Exception:
@@ -367,6 +369,8 @@ def bench_transformer_lm(seq_len: int = 8192, *, batch: int = 4,
     flops = None
     try:
         cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):   # older jax: one entry
+            cost = cost[0] if cost else {}    # per computation
         if cost and "flops" in cost:
             flops = float(cost["flops"])
     except Exception:

@@ -587,20 +587,16 @@ def _ring_slot_valid(pos, window: int):
     return jnp.mod(p, window), held >= 0
 
 
-def _kv_quantize(x):
-    """[B, T, Hkv, Dh] fp -> (s8 data, f32 scale [B, T, Hkv]): absmax
-    symmetric per (position, kv-head) — one scale per cached vector, so
-    dequant is an elementwise mul XLA fuses into the attention einsum's
-    operand read (the same fusion the int8 weight streaming relies on,
-    tests/test_compiled_cost.py::TestInt8DecodeLoop)."""
-    xf = at_least_f32(x)
-    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
-    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
-    return q.astype(jnp.int8), scale
-
-
-def _kv_dequantize(q, scale, dtype):
-    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+# THE KV quantization convention — absmax symmetric per (position,
+# kv-head), one scale per cached vector so dequant fuses into the
+# attention einsum's operand read. The single definition lives in
+# ops.paged_attention (the paged arena and the dense caches must
+# quantize identically, and ops cannot import models); these are the
+# models-side names every decode path in this file uses.
+from paddle_tpu.ops.paged_attention import (  # noqa: E402
+    kv_dequantize as _kv_dequantize,
+    kv_quantize as _kv_quantize,
+)
 
 
 def _cached_attention(q, k, v, k_buf, v_buf, t, valid):

@@ -26,6 +26,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu.parallel import compat
+
 PIPE_AXIS = "pipe"
 
 
@@ -76,8 +78,8 @@ def make_pipeline_forward(stage_fn: Callable, mesh: Mesh, *,
         # pvary: the carry is device-VARYING over the pipe axis (each
         # stage holds a different activation), so the initial zeros must
         # carry that type too or scan rejects the carry
-        act0 = lax.pcast(jnp.zeros_like(micro_x[0]), axis,
-                         to='varying')
+        act0 = compat.pcast(jnp.zeros_like(micro_x[0]), axis,
+                            to='varying')
         perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
 
         def tick(act, t):
@@ -97,7 +99,7 @@ def make_pipeline_forward(stage_fn: Callable, mesh: Mesh, *,
         return lax.psum(results, axis_name=axis)
 
     def fwd(stacked_params, micro_x):
-        fn = jax.shard_map(
+        fn = compat.shard_map(
             body, mesh=mesh,
             in_specs=(jax.tree.map(lambda _: P(axis), stacked_params),
                       P()),

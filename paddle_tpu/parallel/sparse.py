@@ -29,6 +29,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from paddle_tpu.parallel import compat
+
 from paddle_tpu.core.mesh import MODEL_AXIS
 from paddle_tpu.ops.embedding import combine_bags
 
@@ -69,7 +71,7 @@ def sharded_lookup(table, ids, mesh: Mesh, *, axis: str = MODEL_AXIS):
         vecs = jnp.where(in_range[..., None], vecs, 0)
         return jax.lax.psum(vecs, axis_name=axis)
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None), P()),
         out_specs=P(),
@@ -163,7 +165,7 @@ def alltoall_lookup(table, ids, mesh: Mesh, *, axis: str = MODEL_AXIS,
         out = jnp.zeros((k_loc, dim), got.dtype).at[order].set(got)
         return out, jax.lax.psum(overflow, axis_name=axis)
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None), P(axis)),
         out_specs=(P(axis, None), P()),
@@ -210,7 +212,7 @@ def alltoall_push_row_grads(table, ids, row_grads, lr,
         return tab_shard.at[safe].add(
             -lr * contrib.astype(tab_shard.dtype))
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None), P(axis), P(axis, None)),
         out_specs=P(axis, None),
@@ -269,7 +271,7 @@ def rowwise_sgd_update(table, ids, row_grads, lr, mesh: Optional[Mesh] = None,
         contrib = jnp.where(in_range[:, None], grads_g, 0)
         return tab_shard.at[safe].add(-lr * contrib.astype(tab_shard.dtype))
 
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         body, mesh=mesh,
         in_specs=(P(axis, None), P(), P()),
         out_specs=P(axis, None),
@@ -381,11 +383,16 @@ class HostOffloadEmbedding:
 
     def _host_sharding(self, table=None):
         """pinned_host sharding on the table's device (falls back to
-        device 0 only when there is no table yet, i.e. at init)."""
+        device 0 only when there is no table yet, i.e. at init).
+        Backends without a pinned_host space (XLA:CPU exposes only
+        unpinned_host) degrade to the device's default space — the
+        offload becomes an emulation there, same spirit as update()'s
+        annotate_device_placement fallback."""
         from jax.sharding import SingleDeviceSharding
 
-        return SingleDeviceSharding(self._table_device(table),
-                                    memory_kind="pinned_host")
+        dev = self._table_device(table)
+        return SingleDeviceSharding(
+            dev, memory_kind=compat.memory_kind(dev, "pinned_host"))
 
     @staticmethod
     def _table_device(table):
@@ -400,8 +407,9 @@ class HostOffloadEmbedding:
     def _dev_sharding(self, table):
         from jax.sharding import SingleDeviceSharding
 
-        return SingleDeviceSharding(self._table_device(table),
-                                    memory_kind="device")
+        dev = self._table_device(table)
+        return SingleDeviceSharding(
+            dev, memory_kind=compat.memory_kind(dev, "device"))
 
     def init(self, rng):
         """Generate the table ON HOST (numpy seeded from the jax key):

@@ -9,7 +9,8 @@ from paddle_tpu.serve.artifact import (
 )
 from paddle_tpu.serve import quant
 from paddle_tpu.serve.engine import (DecodeEngine, EngineState,
-                                     PoolStats)
+                                     PoolStats, PrefillTicket)
+from paddle_tpu.serve.paged import PagePool, PoolExhaustedError
 from paddle_tpu.serve.server import (CircuitBreaker, QueueFullError,
                                      Request, RequestResult,
                                      ServingServer)

@@ -1,4 +1,5 @@
-"""Continuous-batching decode engine: slot-based serving.
+"""Continuous-batching decode engine: slot-based serving over a
+block-paged KV pool.
 
 The reference's serving surface decodes one fixed batch to completion
 (reference: api/PaddleAPI.h:1025 SequenceGenerator;
@@ -11,63 +12,89 @@ jitted step never recompiles — and the host loop admits a queued
 request into a slot the moment one finishes (continuous batching).
 
 TPU-first choices:
-- ONE jitted `decode_step` advances every active slot a token: the
-  per-slot KV caches are [S, max_len, Hkv, Dh] buffers written with
-  per-row scatters at each slot's own position (slots are NOT in
-  lockstep — that is the point), read under a per-row validity mask;
-  sliding-window configs hold [S, window] RING pools instead (per-row
-  slot = pos mod window — O(window) memory and per-step reads, and a
-  bucketed window prompt still decodes exactly like the unpadded
-  generate(), a combination generate() itself cannot serve).
-- Prefill is a separate jitted function per prompt-length bucket
-  (pad prompts host-side to a few bucket lengths to bound compiles);
-  it runs the SAME `_block_parts` body as training/`generate()`, so
-  model changes cannot diverge between paths.
+- ONE jitted `decode_step` advances every active slot a token. The KV
+  state is a BLOCK-PAGED pool ("Ragged Paged Attention", PAPERS.md):
+  per layer one `[num_pages, page_size, Hkv, Dh]` arena plus a static
+  `[S, max_pages_per_slot]` page table; rows scatter-write this step's
+  K/V through the table at their own position (slots are NOT in
+  lockstep — that is the point) and gather their mapped pages for the
+  masked read (ops.paged_attention). Pages are allocated/freed on the
+  HOST (serve.paged.PagePool) at admit / page-boundary / retire, so
+  pool memory follows actual sequence lengths instead of
+  slots x max_len — the capacity win `ServingServer` admits against.
+  Sliding-window configs instead hold [S, window] RING pools (per-row
+  slot = pos mod window — O(window) memory, no paging needed).
+- Copy-free SHARED-PREFIX reuse: a prefix cache keyed by chained
+  prompt-block hash maps common leading blocks (system prompts) to
+  refcounted read-only pages; a hit maps them into the new slot's
+  table and prefill starts at the first divergent block (the
+  copy-on-write split — shared pages are never written, because
+  decode writes land past the prompt).
+- Prefill runs in CHUNKS through one jitted body compiled per
+  (chunk_width, first?, last?): a prefix hit skips straight to its
+  first private position, and `prefill_chunk=N` slices long prompts
+  into fixed N-token chunks the host interleaves with decode steps —
+  no per-prompt-length compile explosion, no head-of-line stall while
+  a long prompt prefills.
 - Inactive slots still compute (static shapes) but their writes are
-  dropped (scatter mode="drop" via an out-of-range position sentinel)
-  and their reads masked.
+  dropped (scatter mode="drop" via sentinel page ids / out-of-range
+  positions) and their reads masked.
 
-Consistency contract, tested in tests/test_serve_engine.py: a GREEDY
-(default select_fn) request served through the engine yields EXACTLY
-the tokens of `transformer.generate()` on the same prompt — regardless
-of which other requests share the pool or when it was admitted.
-SAMPLED serving — per request via `serve(sampling=[...])` (per-slot
-temperature/top_k/top_p arrays through one compiled step) or pool-wide
-via select_fn — runs ONE rng stream PER SLOT, seeded at admission from
-the request's own identity: with an explicit `"seed"` a request's
-draws are fully deterministic and co-tenancy/admission-order INVARIANT
-(tested); the default identity is this engine's admission counter
-(reproducible per engine seed + admission order). Tokens are the
-engine's own stream (not `transformer.sample()`'s); temperature 0 (the
-default) keeps the exact greedy contract beside sampled co-tenants.
+Consistency contract, tested in tests/test_serve_engine.py +
+tests/test_paged_pool.py: a GREEDY (default select_fn) request served
+through the engine yields EXACTLY the tokens of
+`transformer.generate()` on the same prompt — regardless of which
+other requests share the pool, when it was admitted, whether its
+prefix came from the cache, and whether its prefill was chunked.
+(One boundary, inherent to lossy caches: kv_cache_dtype="int8" under
+a prefix hit or chunked prefill reads QUANTIZED prefix K/V where the
+one-shot prefill read exact values — same class of boundary as int8
+decode itself.) SAMPLED serving — per request via
+`serve(sampling=[...])` (per-slot temperature/top_k/top_p arrays
+through one compiled step) or pool-wide via select_fn — runs ONE rng
+stream PER SLOT, seeded at admission from the request's own identity:
+with an explicit `"seed"` a request's draws are fully deterministic
+and co-tenancy/admission-order INVARIANT (tested); the default
+identity is this engine's admission counter (reproducible per engine
+seed + admission order). Tokens are the engine's own stream (not
+`transformer.sample()`'s); temperature 0 (the default) keeps the
+exact greedy contract beside sampled co-tenants.
 """
 
 from __future__ import annotations
 
-import dataclasses
 from typing import NamedTuple, Optional
 
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from paddle_tpu.core.dtypes import default_policy
 from paddle_tpu.models import transformer as T
+from paddle_tpu.ops import paged_attention as pa
+from paddle_tpu.serve.paged import (PagePool, PoolExhaustedError,
+                                    blocks_for)
 
 
 class EngineState(NamedTuple):
-    """Device-resident pool state. caches: per layer (k_buf, v_buf),
-    each [S, max_len, Hkv, Dh] — [S, window, ...] rings under
-    attn_window, (s8 data, scale) pairs under kv_cache_dtype="int8".
-    pos[s] = the next absolute position row s writes; out-of-range
-    sentinels on inactive rows make their scatter writes drop. rng is
-    a PER-SLOT key vector: each request's stream is seeded at its own
-    admission and advances one split per step, so a sampled request's
-    draws depend only on its seed and its own step index — co-tenants
+    """Device-resident pool state. caches: per layer (k_buf, v_buf) —
+    paged ARENAS [num_pages, page_size, Hkv, Dh] addressed through
+    `page_table` for full-attention configs, [S, window, ...] rings
+    under attn_window, (s8 data, scale) pairs under
+    kv_cache_dtype="int8". page_table [S, max_pages_per_slot] int32
+    maps each slot's logical blocks to physical pages (sentinel =
+    num_pages on unmapped entries, so writes there drop). pos[s] = the
+    next absolute position row s writes; out-of-range sentinels on
+    inactive rows make their scatter writes drop. rng is a PER-SLOT
+    key vector: each request's stream is seeded at its own admission
+    and advances one split per step, so a sampled request's draws
+    depend only on its seed and its own step index — co-tenants
     cannot perturb them."""
 
     caches: tuple
+    page_table: jnp.ndarray  # [S, max_pages] int32 (paged mode)
     pos: jnp.ndarray        # [S] int32
     active: jnp.ndarray     # [S] bool
     last_tok: jnp.ndarray   # [S] int32
@@ -97,8 +124,16 @@ class PoolStats:
     completed/expired/shed/failed; `admitted` counts requests that won
     a slot (prefilled at least once) and `retried` counts requeue
     events (not requests). The plain engine.serve() loop — which never
-    sheds, expires, or retries — fills admitted/completed so the
-    ledger reconciles on either path."""
+    sheds or expires, but DOES requeue pool-exhaustion preemption
+    victims — fills admitted/completed/retried so the ledger
+    reconciles on either path.
+
+    The page-pool block (docs/SERVING.md "Paged KV cache"):
+    pages_in_use/pages_free are end-of-run gauges (peak_pages_in_use
+    the high-water mark), prefix_hits/prefix_misses count admissions
+    that did/didn't reuse cached prefix blocks, prefill_chunks counts
+    jitted prefill-chunk invocations (1 per admission unless
+    `prefill_chunk` slices longer prompts)."""
 
     steps: int = 0
     tokens: int = 0
@@ -111,6 +146,13 @@ class PoolStats:
     shed: int = 0
     failed: int = 0
     retried: int = 0
+    # paged KV pool observability
+    pages_in_use: int = 0
+    pages_free: int = 0
+    peak_pages_in_use: int = 0
+    prefix_hits: int = 0
+    prefix_misses: int = 0
+    prefill_chunks: int = 0
 
     def utilization(self, slots: int) -> float:
         return self.tokens / max(self.steps * slots, 1)
@@ -134,15 +176,53 @@ def pad_to_bucket(prompt, buckets):
     return np.pad(np.asarray(prompt), (0, fits[0] - t0)), t0
 
 
+@dataclass
+class PrefillTicket:
+    """Host-side handle for one in-progress (possibly chunked)
+    prefill: `prefill_begin` maps the slot's pages and returns one,
+    each `prefill_advance` runs one jitted chunk. The reliability
+    server keeps tickets per slot so long prompts prefill interleaved
+    with live decodes instead of stalling them."""
+
+    slot: int
+    prompt: np.ndarray          # bucket-padded prompt, int32
+    true_len: int
+    chunk: Optional[int]        # None = the rest in one chunk
+    next_start: int
+    temp: float
+    top_k: int
+    top_p: float
+    req_tag: int
+    req_seed: int
+    windowed: bool = False      # ring pool: one-shot legacy prefill
+
+
 class DecodeEngine:
     """make once per (params, cfg, pool geometry); drive with
-    `init_state` / `prefill` / `decode_step`, or the batteries-included
-    `serve()` host loop."""
+    `init_state` / `prefill` (or `prefill_begin`/`prefill_advance`) /
+    `decode_step`, or the batteries-included `serve()` host loop."""
 
     def __init__(self, params, cfg: T.TransformerConfig, *, slots: int,
                  max_len: int, eos_id: Optional[int] = None,
-                 select_fn=None, seed: int = 0):
-        """Sampling, two ways: per REQUEST via serve(sampling=[...])/
+                 select_fn=None, seed: int = 0,
+                 page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefix_cache: bool = True,
+                 prefix_cache_blocks: int = 512):
+        """Pool geometry: full-attention configs hold a block-paged KV
+        pool of `num_pages` pages of `page_size` positions per layer
+        (default num_pages = slots * ceil(max_len / page_size) — the
+        dense layout's capacity exactly, so the pool can never refuse
+        what the dense pool admitted; pass fewer pages to
+        OVER-SUBSCRIBE slots against actual lengths and let
+        ServingServer admit on headroom). `prefill_chunk` slices
+        prompt prefill into fixed-width chunks the serve loops
+        interleave with decode steps; `prefix_cache` enables
+        copy-free shared-prefix reuse. Sliding-window configs keep
+        their [S, window] ring pools (the paging knobs are inert).
+
+        Sampling, two ways: per REQUEST via serve(sampling=[...])/
         prefill(sampling={...}) — temperature/top_k/top_p ride
         per-slot arrays through ONE compiled step (temp 0 = greedy,
         the default) — or a pool-wide select_fn(logits [B, V], rng)
@@ -153,13 +233,20 @@ class DecodeEngine:
             raise ValueError(
                 f"kv_cache_dtype must be compute|int8, got "
                 f"{cfg.kv_cache_dtype!r}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
         # MoE configs ride the shared _block_parts body like every
         # other decode path. One semantic boundary, inherent to
         # capacity-based routing: expert capacity is a function of the
         # step's token count (= slots here, batch in generate()), so a
         # pathologically imbalanced pool step can drop a token to
         # capacity where a solo decode would not — same boundary the
-        # reference's capacity semantics impose on any batch.
+        # reference's capacity semantics impose on any batch. (A
+        # chunked or prefix-hit prefill changes the per-call token
+        # count the same way.)
         # weight-only int8 params (serve.quant) use the SAME split as
         # generate(): prefill reads the hoisted dequant (one-shot,
         # compute-bound), the per-token step re-traces the dequant
@@ -172,42 +259,82 @@ class DecodeEngine:
         self.eos_id = eos_id
         self.select_fn = select_fn
         self.seed = seed
+        self.paged = cfg.attn_window is None
+        self.page_size = page_size
+        self.max_pages_per_slot = -(-max_len // page_size)
+        self.num_pages = (num_pages if num_pages is not None
+                          else slots * self.max_pages_per_slot)
+        if self.num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got "
+                             f"{self.num_pages}")
+        self.prefill_chunk = prefill_chunk
+        self.prefix_cache = prefix_cache
+        self.prefix_cache_blocks = prefix_cache_blocks
+        self.pool: Optional[PagePool] = None  # built by init_state()
         self._admissions = 0   # default per-request stream identity
         self._prefill_jit = jax.jit(self._prefill_impl,
                                     static_argnames=("t0",))
+        self._chunk_jit = jax.jit(
+            self._chunk_impl,
+            static_argnames=("chunk_w", "from_zero", "final"))
         self._step_jit = jax.jit(self._step_impl)
 
     # -- state ------------------------------------------------------------
 
     def init_state(self) -> EngineState:
         cfg, s = self.cfg, self.slots
-        # sliding-window configs hold a RING pool: window slots per
-        # row (generate()'s rolling cache, per-row), not max_len
-        L = (cfg.attn_window if cfg.attn_window is not None
-             else self.max_len)
         policy = default_policy()
         hkv, dh = cfg.kv_heads, cfg.head_dim
-        def buf():
-            if cfg.kv_cache_dtype == "int8":
-                # (s8 data, per-vector scale) — the SAME quantized-pair
-                # format _cached_attention streams in generate();
-                # constructed directly (zeros quantize to data=0 with
-                # the eps-floor scale) rather than materializing a fp
-                # pool just to quantize known zeros
-                return (jnp.zeros((s, L, hkv, dh), jnp.int8),
-                        jnp.full((s, L, hkv), 1e-8 / 127.0, jnp.float32))
-            return jnp.zeros((s, L, hkv, dh), policy.compute_dtype)
+        if self.paged:
+            # block-paged arenas: one [P, page, Hkv, Dh] pool per
+            # layer, addressed through the per-slot page table
+            L = self.max_len
+            shape = (self.num_pages, self.page_size, hkv, dh)
+
+            def buf():
+                if cfg.kv_cache_dtype == "int8":
+                    return (jnp.zeros(shape, jnp.int8),
+                            jnp.full(shape[:-1], 1e-8 / 127.0,
+                                     jnp.float32))
+                return jnp.zeros(shape, policy.compute_dtype)
+
+            page_table = jnp.full((s, self.max_pages_per_slot),
+                                  self.num_pages, jnp.int32)
+            self.pool = PagePool(
+                num_pages=self.num_pages, page_size=self.page_size,
+                slots=s, max_pages_per_slot=self.max_pages_per_slot,
+                prefix_cache=self.prefix_cache,
+                prefix_cache_blocks=self.prefix_cache_blocks)
+        else:
+            # sliding-window configs hold a RING pool: window slots
+            # per row (generate()'s rolling cache, per-row)
+            L = cfg.attn_window
+
+            def buf():
+                if cfg.kv_cache_dtype == "int8":
+                    # (s8 data, per-vector scale) — the SAME
+                    # quantized-pair format _cached_attention streams
+                    # in generate(); constructed directly (zeros
+                    # quantize to data=0 with the eps-floor scale)
+                    return (jnp.zeros((s, L, hkv, dh), jnp.int8),
+                            jnp.full((s, L, hkv), 1e-8 / 127.0,
+                                     jnp.float32))
+                return jnp.zeros((s, L, hkv, dh), policy.compute_dtype)
+
+            page_table = jnp.zeros((s, 1), jnp.int32)  # inert
+            self.pool = None
 
         caches = tuple((buf(), buf()) for _ in self.params["blocks"])
         # default stream identities restart with the pool: two serve()
         # calls on one engine replay identically (the counter is host
         # state, NOT part of EngineState — a restored state needs its
-        # engine's counter to continue default-identity admissions;
-        # explicit per-request seeds sidestep this entirely)
+        # engine's counter AND page pool to continue; explicit
+        # per-request seeds sidestep the former entirely)
         self._admissions = 0
         return EngineState(
             caches=caches,
-            pos=jnp.full((s,), L, jnp.int32),   # sentinel: writes drop
+            page_table=page_table,
+            pos=jnp.full((s,), self.max_len, jnp.int32),  # writes drop
             active=jnp.zeros((s,), bool),
             last_tok=jnp.zeros((s,), jnp.int32),
             rng=jax.random.split(jax.random.key(self.seed),
@@ -217,18 +344,38 @@ class DecodeEngine:
             top_p=jnp.ones((s,), jnp.float32),
             last_lp=jnp.zeros((s,), jnp.float32))
 
-    # -- prefill (one request into one slot) ------------------------------
+    # -- shared first-token selection --------------------------------------
+
+    def _select_first(self, params, x_last, temp, top_k, top_p,
+                      req_tag, req_seed):
+        """The request's first generated token + its full-softmax
+        logprob, from the last real prompt position's activation —
+        one definition for the ring prefill and every paged chunk."""
+        # this request's OWN stream, seeded at admission: draws depend
+        # only on (engine seed, request seed) and step index
+        req_key = jax.random.fold_in(jax.random.fold_in(
+            jax.random.key(self.seed), req_tag), req_seed)
+        req_key, sub = jax.random.split(req_key)
+        logits = T._head(params, x_last[None])
+        if self.select_fn is not None:
+            first = self.select_fn(logits, sub)[0]
+        else:
+            first = T.per_row_sample(logits, temp[None], top_k[None],
+                                     top_p[None], sub)[0]
+        first_lp = jax.nn.log_softmax(
+            T.at_least_f32(logits), axis=-1)[0, first]
+        return first, first_lp, req_key
+
+    # -- ring (sliding-window) prefill -------------------------------------
 
     def _prefill_impl(self, state: EngineState, slot, prompt, true_len,
                       temp, top_k, top_p, req_tag, req_seed, t0: int):
-        """prompt [t0] int32 (real tokens in [:true_len], rest padding)
-        -> state with slot's cache rows 0..true_len-1 filled, pos=
-        true_len, active, last_tok = the request's first token
-        (its own sampler params / the pool select_fn). true_len is
-        TRACED, so one compile per padded bucket length serves every
-        real length (the padded tail's cache rows hold garbage that the
-        decode mask never reads: reads stop at pos, and a row is
-        overwritten the step before it first becomes readable)."""
+        """One-shot ring-pool prefill (attn_window configs): prompt
+        [t0] int32 (real tokens in [:true_len], rest padding) -> state
+        with the slot's ring holding the last min(true_len, W) real
+        positions, pos=true_len, active, last_tok = the request's
+        first token. true_len is TRACED, so one compile per padded
+        bucket length serves every real length."""
         cfg, params = self.cfg, self.params
         policy = default_policy()
         toks = prompt[None, :]                       # [1, t0]
@@ -245,10 +392,9 @@ class DecodeEngine:
         z = jnp.int32(0)
 
         def write_slot(buf, new):
-            """Write this request's [1, t0, ...] K/V rows into its
+            """Write this request's [1, W, ...] K/V rows into its
             slot — quantizing first when the pool holds (s8, scale)
-            pairs (the padded tail quantizes to garbage the decode
-            mask never reads, same as the fp path)."""
+            pairs."""
             if isinstance(buf, tuple):
                 d, sc = buf
                 nd, nsc = T._kv_quantize(new)
@@ -260,20 +406,17 @@ class DecodeEngine:
             return jax.lax.dynamic_update_slice(
                 buf, new.astype(buf.dtype), (slot, z, z, z))
 
-        if cfg.attn_window is not None:
-            # ring pool: keep only the last min(true_len, W) REAL
-            # positions, each in its slot p mod W — ring slot s holds
-            # p(s) = (true_len-1) - ((true_len-1 - s) mod W); negative
-            # p(s) (short prompts) gathers a clipped row the decode
-            # mask keeps invalid until overwritten. Padded-bucket rows
-            # never enter the ring: p(s) indexes real positions only.
-            w_ = cfg.attn_window
-            p_slot = (true_len - 1) - jnp.mod(
-                (true_len - 1) - jnp.arange(w_), w_)
-            ring_idx = jnp.clip(p_slot, 0, t0 - 1)
-            ring = lambda kv: jnp.take(kv, ring_idx, axis=1)
-        else:
-            ring = lambda kv: kv
+        # ring pool: keep only the last min(true_len, W) REAL
+        # positions, each in its slot p mod W — ring slot s holds
+        # p(s) = (true_len-1) - ((true_len-1 - s) mod W); negative
+        # p(s) (short prompts) gathers a clipped row the decode
+        # mask keeps invalid until overwritten. Padded-bucket rows
+        # never enter the ring: p(s) indexes real positions only.
+        w_ = cfg.attn_window
+        p_slot = (true_len - 1) - jnp.mod(
+            (true_len - 1) - jnp.arange(w_), w_)
+        ring_idx = jnp.clip(p_slot, 0, t0 - 1)
+        ring = lambda kv: jnp.take(kv, ring_idx, axis=1)
 
         caches = []
         for p, (k_buf, v_buf) in zip(params["blocks"], state.caches):
@@ -283,21 +426,11 @@ class DecodeEngine:
         # first token reads the LAST REAL position's logits
         x_last = jax.lax.dynamic_index_in_dim(
             x[0], true_len - 1, axis=0, keepdims=False)
-        # this request's OWN stream, seeded at admission: draws depend
-        # only on (engine seed, request seed) and step index
-        req_key = jax.random.fold_in(jax.random.fold_in(
-            jax.random.key(self.seed), req_tag), req_seed)
-        req_key, sub = jax.random.split(req_key)
-        logits = T._head(params, x_last[None])
-        if self.select_fn is not None:
-            first = self.select_fn(logits, sub)[0]
-        else:
-            first = T.per_row_sample(logits, temp[None], top_k[None],
-                                     top_p[None], sub)[0]
-        first_lp = jax.nn.log_softmax(
-            T.at_least_f32(logits), axis=-1)[0, first]
+        first, first_lp, req_key = self._select_first(
+            params, x_last, temp, top_k, top_p, req_tag, req_seed)
         return EngineState(
             caches=tuple(caches),
+            page_table=state.page_table,
             pos=state.pos.at[slot].set(true_len),
             active=state.active.at[slot].set(True),
             last_tok=state.last_tok.at[slot].set(
@@ -309,31 +442,90 @@ class DecodeEngine:
             last_lp=state.last_lp.at[slot].set(
                 first_lp.astype(jnp.float32)))
 
-    def prefill(self, state: EngineState, slot: int, prompt,
-                true_len: Optional[int] = None,
-                sampling: Optional[dict] = None) -> EngineState:
-        """Admit a request: fill `slot` from `prompt` [t0]. t0 is
-        STATIC per distinct length (one compile each) — pad prompts
-        host-side to a few bucket lengths and pass the real length as
-        `true_len` (traced: no recompile across real lengths within a
-        bucket; decode matches generate() on the unpadded prompt).
-        The slot's first generated token is in .last_tok[slot].
+    # -- paged prefill (chunked, prefix-aware) -----------------------------
 
-        sampling: THIS request's sampler params — a dict with any of
-        temperature/top_k/top_p (missing = greedy/no-filter) and an
-        optional "seed": the request's own rng stream identity, making
-        its draws independent of pool co-tenants and admission order
-        (default: this engine's admission counter). All values are
-        traced (set into per-slot arrays/keys), so requests with
-        different sampling share one compiled step. Incompatible with
-        a pool-wide select_fn override."""
+    def _chunk_impl(self, state: EngineState, slot, toks, start,
+                    true_len, temp, top_k, top_p, req_tag, req_seed,
+                    *, chunk_w: int, from_zero: bool, final: bool):
+        """One prefill CHUNK for one slot: toks [chunk_w] at absolute
+        positions start..start+chunk_w-1. Compiles per (chunk_w,
+        from_zero, final) — a fixed `prefill_chunk` gives O(1)
+        compiles across all prompt lengths. from_zero chunks (start ==
+        0) need no cache reads and run THE SAME within-chunk
+        `_attention` call the one-shot prefill always ran (so the
+        default single-chunk path is numerically identical to it);
+        later chunks attend through the page table over everything
+        cached so far — shared-prefix pages included, which is what
+        makes a prefix hit copy-free. `final` chunks (the one holding
+        position true_len-1) also select the request's first token and
+        activate the slot; padded tail positions (>= true_len) write
+        garbage the decode mask never reads (each cell is overwritten
+        the step before it first becomes readable)."""
+        cfg, params = self.cfg, self.params
+        policy = default_policy()
+        x = jnp.take(params["embed"]["table"], toks[None, :], axis=0)
+        x = x.astype(policy.compute_dtype)
+        ap = start + jnp.arange(chunk_w)            # absolute positions
+        pos = ap[None, :]
+        # pad/garbage positions must not claim MoE expert capacity
+        tok_mask = (ap < true_len)[None, :]
+        pages_row = state.page_table[slot]
+        new_caches = []
+
+        if from_zero:
+            # within-chunk causal attention, masked exactly like
+            # generate(prompt_lens=...) — no cache read needed
+            attn_fn = lambda q, k, v: T._attention(
+                cfg, q, k, v, causal=True, key_lens=true_len[None])
+
+        for p, (k_buf, v_buf) in zip(params["blocks"], state.caches):
+            if from_zero:
+                x, k, v, _ = T._block_parts(cfg, p, x, pos, attn_fn,
+                                            tok_mask)
+                pg, off = pa.page_addresses(pages_row, ap,
+                                            page_size=self.page_size)
+                new_caches.append((pa.write_kv(k_buf, k[0], pg, off),
+                                   pa.write_kv(v_buf, v[0], pg, off)))
+            else:
+                def attn_fn(q, k, v, k_buf=k_buf, v_buf=v_buf):
+                    out, k2, v2 = pa.paged_chunk_attention(
+                        q, k, v, k_buf, v_buf, pages_row, start,
+                        page_size=self.page_size, max_len=self.max_len)
+                    new_caches.append((k2, v2))
+                    return out
+
+                x, _, _, _ = T._block_parts(cfg, p, x, pos, attn_fn,
+                                            tok_mask)
+        state = state._replace(caches=tuple(new_caches))
+        if not final:
+            return state
+        # first token reads the LAST REAL position's logits
+        x_last = jax.lax.dynamic_index_in_dim(
+            x[0], true_len - 1 - start, axis=0, keepdims=False)
+        first, first_lp, req_key = self._select_first(
+            params, x_last, temp, top_k, top_p, req_tag, req_seed)
+        return state._replace(
+            pos=state.pos.at[slot].set(true_len),
+            active=state.active.at[slot].set(True),
+            last_tok=state.last_tok.at[slot].set(
+                first.astype(jnp.int32)),
+            rng=state.rng.at[slot].set(req_key),
+            temp=state.temp.at[slot].set(temp),
+            top_k=state.top_k.at[slot].set(top_k),
+            top_p=state.top_p.at[slot].set(top_p),
+            last_lp=state.last_lp.at[slot].set(
+                first_lp.astype(jnp.float32)))
+
+    # -- admission (begin/advance; prefill() drives both) ------------------
+
+    def _validate_admission(self, prompt, true_len, sampling):
         t0 = int(prompt.shape[-1])
         if true_len is None:
             true_len = t0
         elif not (1 <= true_len <= t0):
             raise ValueError(f"true_len {true_len} not in [1, {t0}]")
         if self.cfg.attn_window is None:
-            # physical bounds of the full-length cache only — the
+            # physical bounds of the full-length pool only — the
             # windowed ring holds any prompt (it keeps the last W).
             # The REAL length is what must leave room for >= 1
             # generated token; padded bucket length merely has to fit
@@ -347,6 +539,14 @@ class DecodeEngine:
                 raise ValueError(
                     f"prompt true_len {true_len} >= max_len "
                     f"{self.max_len}: no room for a generated token")
+            # page-granular capacity: a prompt whose own blocks exceed
+            # the WHOLE pool can never be served — reject up front,
+            # not from a mid-run PoolExhaustedError
+            need = blocks_for(true_len, self.page_size)
+            if need > self.num_pages:
+                raise ValueError(
+                    f"prompt true_len {true_len} needs {need} pages "
+                    f"> page pool num_pages {self.num_pages}")
         sampling = sampling or {}
         if sampling and self.select_fn is not None:
             raise ValueError(
@@ -360,24 +560,122 @@ class DecodeEngine:
         top_k = sampling.get("top_k")        # None-vs-0 must not blur:
         top_p = sampling.get("top_p")        # 0 values are ERRORS below
         T._validate_sampler_args(temp, top_k, top_p)
+        return true_len, temp, top_k, top_p, sampling.get("seed")
+
+    def prefill_begin(self, state: EngineState, slot: int, prompt,
+                      true_len: Optional[int] = None,
+                      sampling: Optional[dict] = None):
+        """Admit a request into `slot`: validate, consult the prefix
+        cache, map the slot's pages (PoolExhaustedError when the
+        private blocks cannot be allocated — the pool is left
+        untouched), and return (state, PrefillTicket). Run the actual
+        forward with `prefill_advance` — once per chunk, interleaved
+        with decode steps however the caller schedules them.
+
+        sampling: THIS request's sampler params — a dict with any of
+        temperature/top_k/top_p (missing = greedy/no-filter) and an
+        optional "seed": the request's own rng stream identity, making
+        its draws independent of pool co-tenants and admission order
+        (default: this engine's admission counter). All values are
+        traced, so requests with different sampling share compiled
+        bodies. Incompatible with a pool-wide select_fn override."""
+        true_len, temp, top_k, top_p, req_seed = \
+            self._validate_admission(prompt, true_len, sampling)
         # the request's OWN stream identity: an explicit seed makes its
         # draws fully request-deterministic (pool/admission invariant);
         # default = this engine's admission counter. The two live in
         # DISJOINT domains (tag bit) so an explicit seed can never
         # collide with a counter value and correlate two streams.
-        req_seed = sampling.get("seed")
         if req_seed is None:
             req_tag, req_seed = 0, self._admissions
         else:
             req_tag = 1
+        prompt_np = np.asarray(prompt, np.int32)
+        if not self.paged:
+            self._admissions += 1
+            return state, PrefillTicket(
+                slot=slot, prompt=prompt_np, true_len=true_len,
+                chunk=None, next_start=0, temp=float(temp),
+                top_k=int(self.cfg.vocab if top_k is None else top_k),
+                top_p=float(1.0 if top_p is None else top_p),
+                req_tag=req_tag, req_seed=int(req_seed),
+                windowed=True)
+        if self.pool is None:
+            raise RuntimeError(
+                "no page pool — call init_state() before prefill")
+        pages, shared_len = self.pool.admit(slot, prompt_np, true_len)
         self._admissions += 1
-        return self._prefill_jit(
-            state, jnp.int32(slot), jnp.asarray(prompt, jnp.int32),
-            jnp.int32(true_len),
-            jnp.float32(temp),
-            jnp.int32(self.cfg.vocab if top_k is None else top_k),
-            jnp.float32(1.0 if top_p is None else top_p),
-            jnp.int32(req_tag), jnp.int32(req_seed), t0=t0)
+        row = np.full((self.max_pages_per_slot,), self.num_pages,
+                      np.int32)
+        row[:len(pages)] = pages
+        state = state._replace(
+            page_table=state.page_table.at[slot].set(
+                jnp.asarray(row)))
+        return state, PrefillTicket(
+            slot=slot, prompt=prompt_np, true_len=true_len,
+            chunk=self.prefill_chunk, next_start=shared_len,
+            temp=float(temp),
+            top_k=int(self.cfg.vocab if top_k is None else top_k),
+            top_p=float(1.0 if top_p is None else top_p),
+            req_tag=req_tag, req_seed=int(req_seed))
+
+    def prefill_advance(self, state: EngineState,
+                        ticket: PrefillTicket):
+        """Run ONE prefill chunk for the ticket; returns (state,
+        done). The final chunk (the one holding position true_len-1)
+        activates the slot and registers the prompt's full blocks in
+        the prefix cache; chunks never run past the last real
+        position, so bucket padding costs no chunk invocations."""
+        if ticket.windowed:
+            state = self._prefill_jit(
+                state, jnp.int32(ticket.slot),
+                jnp.asarray(ticket.prompt, jnp.int32),
+                jnp.int32(ticket.true_len),
+                jnp.float32(ticket.temp), jnp.int32(ticket.top_k),
+                jnp.float32(ticket.top_p), jnp.int32(ticket.req_tag),
+                jnp.int32(ticket.req_seed),
+                t0=int(ticket.prompt.shape[-1]))
+            return state, True
+        start = ticket.next_start
+        t0 = int(ticket.prompt.shape[-1])
+        width = ticket.chunk if ticket.chunk else (t0 - start)
+        final = start + width >= ticket.true_len
+        toks = ticket.prompt[start:start + width]
+        if toks.shape[0] < width:
+            toks = np.pad(toks, (0, width - toks.shape[0]))
+        state = self._chunk_jit(
+            state, jnp.int32(ticket.slot),
+            jnp.asarray(toks, jnp.int32), jnp.int32(start),
+            jnp.int32(ticket.true_len), jnp.float32(ticket.temp),
+            jnp.int32(ticket.top_k), jnp.float32(ticket.top_p),
+            jnp.int32(ticket.req_tag), jnp.int32(ticket.req_seed),
+            chunk_w=width, from_zero=(start == 0), final=final)
+        self.pool.prefill_chunks += 1
+        ticket.next_start = start + width
+        if final:
+            self.pool.register(ticket.slot, ticket.prompt,
+                               ticket.true_len)
+        return state, final
+
+    def prefill(self, state: EngineState, slot: int, prompt,
+                true_len: Optional[int] = None,
+                sampling: Optional[dict] = None) -> EngineState:
+        """Admit a request and run its whole prefill: fill `slot` from
+        `prompt` [t0]. Chunk widths are STATIC (one compile per
+        distinct width) — pad prompts host-side to a few bucket
+        lengths and pass the real length as `true_len` (traced: no
+        recompile across real lengths within a bucket; decode matches
+        generate() on the unpadded prompt). The slot's first generated
+        token is in .last_tok[slot]. Equivalent to `prefill_begin` +
+        `prefill_advance` until done — use those directly to
+        interleave long prefills with decode steps."""
+        state, ticket = self.prefill_begin(state, slot, prompt,
+                                           true_len=true_len,
+                                           sampling=sampling)
+        done = False
+        while not done:
+            state, done = self.prefill_advance(state, ticket)
+        return state
 
     # -- the batched decode step ------------------------------------------
 
@@ -390,7 +688,8 @@ class DecodeEngine:
         x = jnp.take(params["embed"]["table"], tok[:, None], axis=0)
         x = x.astype(policy.compute_dtype)
         pos = state.pos[:, None]                      # [S, 1] per-row rope
-        if cfg.attn_window is not None:
+        new_caches = []
+        if not self.paged:
             # rolling ring pool: generate()'s rolling cache per-row —
             # the slot/validity arithmetic is THE shared convention
             # (T._ring_slot_valid); softmax is permutation-invariant
@@ -400,31 +699,45 @@ class DecodeEngine:
             write_slots = jnp.where(state.active, slots_raw,
                                     jnp.int32(w))   # sentinel: drop
             valid = ring_ok & state.active[:, None]
+            valid4 = valid[:, None, None, :]
+
+            def make_attn(k_buf, v_buf):
+                def attn(q, k, v):
+                    # THE shared decode attention (_cached_attention)
+                    # with a per-row slot VECTOR: each row writes its
+                    # own slot (out-of-range sentinel on inactive rows
+                    # -> drop)
+                    out, k2, v2 = T._cached_attention(
+                        q, k, v, k_buf, v_buf, write_slots, valid4)
+                    new_caches.append((k2, v2))
+                    return out
+
+                return attn
         else:
-            # row r attends cache slots < pos[r]+1 (incl. this write)
-            write_slots = state.pos
-            valid = (jnp.arange(L)[None, :] <= state.pos[:, None]) \
-                & state.active[:, None]
-        valid4 = valid[:, None, None, :]
-        new_caches = []
+
+            def make_attn(k_buf, v_buf):
+                def attn(q, k, v):
+                    # the paged counterpart: scatter this step's K/V
+                    # through the page table, gather the mapped pages
+                    # (position order, sliced to max_len — the exact
+                    # dense key axis) for the masked read
+                    out, k2, v2 = pa.paged_decode_attention(
+                        q, k, v, k_buf, v_buf, state.page_table,
+                        state.pos, state.active,
+                        page_size=self.page_size, max_len=L)
+                    new_caches.append((k2, v2))
+                    return out
+
+                return attn
 
         for p, (k_buf, v_buf) in zip(params["blocks"], state.caches):
-
-            def attn(q, k, v, k_buf=k_buf, v_buf=v_buf):
-                # THE shared decode attention (_cached_attention) with
-                # a per-row slot VECTOR: each row writes its own slot
-                # (out-of-range sentinel on inactive rows -> drop)
-                out, k_buf, v_buf = T._cached_attention(
-                    q, k, v, k_buf, v_buf, write_slots, valid4)
-                new_caches.append((k_buf, v_buf))
-                return out
-
             # inactive slots must not claim MoE expert capacity: their
             # compute is dead (writes drop, reads masked) but without a
             # token_mask the router would still count them against the
             # per-expert budget and could evict REAL tokens under a
             # tight capacity_factor
-            x, _, _, _ = T._block_parts(cfg, p, x, pos, attn,
+            x, _, _, _ = T._block_parts(cfg, p, x, pos,
+                                        make_attn(k_buf, v_buf),
                                         state.active[:, None])
         keys = jax.vmap(jax.random.split)(state.rng)   # [S, 2] keys
         rng, sub = keys[:, 0], keys[:, 1]
@@ -460,13 +773,15 @@ class DecodeEngine:
         if self.eos_id is not None:
             fin = state.active & (emitted == self.eos_id)
         if cfg.attn_window is None:
-            # capacity retirement is a PHYSICAL bound of the full-length
-            # cache only; the ring reuses slots, so windowed requests
-            # are bounded by eos and the caller's max_new alone
+            # capacity retirement is a PHYSICAL bound of the
+            # full-length pool only; the ring reuses slots, so
+            # windowed requests are bounded by eos and the caller's
+            # max_new alone
             fin = fin | (state.active & (state.pos + 1 >= L))
         cont = state.active & ~fin
         new_state = EngineState(
             caches=tuple(new_caches),
+            page_table=state.page_table,
             pos=jnp.where(cont, state.pos + 1, jnp.int32(L)),
             active=cont,
             last_tok=nxt,
@@ -485,16 +800,47 @@ class DecodeEngine:
         the full softmax — transformer.score()'s convention, whatever
         the sampler); finished rows have just emitted their final
         token (eos or cache-full) and their slot is free for the next
-        prefill."""
+        prefill — paged callers must still `release_slot` it so the
+        HOST pool frees its pages."""
         return self._step_jit(state)
 
+    def ensure_decode_page(self, state: EngineState,
+                           slot: int) -> EngineState:
+        """Advance the HOST page bookkeeping for one slot that just
+        consumed a token and continues: when its next write position
+        crosses into an unmapped block, allocate that block's page and
+        push the mapping to the device table. Call exactly once per
+        continuing slot per decode step (both serve loops do). Raises
+        PoolExhaustedError — with the position NOT advanced, so the
+        caller can free a victim and retry — when no page is
+        available."""
+        if not self.paged:
+            return state
+        res = self.pool.extend(slot)
+        if res is not None:
+            blk, page = res
+            state = state._replace(
+                page_table=state.page_table.at[slot, blk].set(
+                    jnp.int32(page)))
+        return state
+
     def release_slot(self, state: EngineState, slot: int) -> EngineState:
-        """Host-side retire of one slot mid-generation: deactivate the
-        row and park its pos on the out-of-range sentinel so the next
-        step's writes drop and its reads stay masked. THE one retire
-        convention — serve()'s token-budget retire and the reliability
-        server's deadline/drain evictions (serve.server) both route
-        here, so the sentinel arithmetic cannot drift between them."""
+        """Host-side retire of one slot: deactivate the row, park its
+        pos on the out-of-range sentinel so the next step's writes
+        drop and its reads stay masked, free its pages back to the
+        pool (refcounted — shared prefix pages survive for their other
+        holders), and reset its page-table row to the drop sentinel.
+        THE one retire convention — serve()'s token-budget retire, its
+        device-finished rows, and the reliability server's deadline/
+        drain/exhaustion evictions (serve.server) all route here, so
+        the sentinel arithmetic and the page accounting cannot drift
+        between them."""
+        if self.paged and self.pool is not None:
+            self.pool.release(slot)
+            state = state._replace(
+                page_table=state.page_table.at[slot].set(
+                    jnp.full((self.max_pages_per_slot,),
+                             self.num_pages, jnp.int32)))
         return state._replace(
             active=state.active.at[slot].set(False),
             pos=state.pos.at[slot].set(jnp.int32(self.max_len)))
@@ -504,11 +850,20 @@ class DecodeEngine:
     def serve(self, prompts, *, max_new: int, buckets=None,
               sampling=None, return_logprobs: bool = False):
         """Serve a list of 1-D int32 prompts through the S-slot pool:
-        admit while slots free, step, collect, refill — the continuous
-        part. Returns per-request generated-token lists (eos included,
-        like generate()); each equals the generate() tokens for that
-        prompt (engine consistency test). max_new bounds every request
-        (cache capacity bounds it too).
+        admit while slots AND pages are free, step, collect, refill —
+        the continuous part. Returns per-request generated-token lists
+        (eos included, like generate()); each equals the generate()
+        tokens for that prompt (engine consistency test). max_new
+        bounds every request (cache capacity bounds it too).
+
+        With `prefill_chunk` set, long prompts prefill one chunk per
+        loop iteration while admitted co-tenants keep decoding — no
+        head-of-line stall. On page-pool exhaustion mid-decode (only
+        possible when num_pages over-subscribes the slots) the loop
+        preempts the cheapest co-tenant back onto the queue
+        (stats.retried — its decode restarts from a fresh prefill,
+        tokens identical) or, with no co-tenant to evict, retires the
+        needy request at pool capacity exactly like the max_len bound.
 
         buckets: optional ascending prompt-length buckets (e.g.
         (32, 128, 512)): each prompt is padded to the smallest bucket
@@ -550,15 +905,26 @@ class DecodeEngine:
                 raise ValueError(
                     f"prompt {i} len {t0} exceeds largest bucket "
                     f"{max(buckets)}")
-            if self.cfg.attn_window is None and t0 >= self.max_len:
-                raise ValueError(
-                    f"prompt {i} true_len {t0} >= max_len "
-                    f"{self.max_len}: no room for a generated token")
+            if self.cfg.attn_window is None:
+                if t0 >= self.max_len:
+                    raise ValueError(
+                        f"prompt {i} true_len {t0} >= max_len "
+                        f"{self.max_len}: no room for a generated "
+                        f"token")
+                # page-granular capacity (same rule as prefill_begin):
+                # a prompt that fits max_len but not the whole page
+                # pool is rejected up front, not mid-run
+                need = blocks_for(t0, self.page_size)
+                if need > self.num_pages:
+                    raise ValueError(
+                        f"prompt {i} needs {need} pages > page pool "
+                        f"num_pages {self.num_pages}")
 
         state = self.init_state()
         stats = PoolStats(requests=len(prompts))
         queue = list(range(len(prompts)))
         slot_req = [-1] * self.slots          # which request owns a slot
+        pending: dict[int, PrefillTicket] = {}  # mid-prefill slots
         emitted: dict[int, list] = {i: [] for i in range(len(prompts))}
         lps: dict[int, list] = {i: [] for i in range(len(prompts))}
         remaining = [max_new] * len(prompts)
@@ -566,19 +932,84 @@ class DecodeEngine:
         def admit():
             nonlocal state
             for slot in range(self.slots):
-                if slot_req[slot] == -1 and queue:
-                    req = queue.pop(0)
-                    padded, true_len = pad_to_bucket(prompts[req],
-                                                     buckets)
-                    state = self.prefill(
+                if slot_req[slot] != -1 or not queue:
+                    continue
+                req = queue[0]
+                padded, true_len = pad_to_bucket(prompts[req],
+                                                 buckets)
+                try:
+                    state, ticket = self.prefill_begin(
                         state, slot, padded, true_len=true_len,
                         sampling=(sampling[req] if sampling else None))
-                    stats.prefills += 1
-                    stats.admitted += 1
-                    slot_req[slot] = req
+                except PoolExhaustedError:
+                    # no pages for the queue head right now: in-flight
+                    # requests will free some — keep it queued, FIFO
+                    break
+                queue.pop(0)
+                slot_req[slot] = req
+                stats.prefills += 1
+                stats.admitted += 1
+                if ticket.chunk is None:
+                    # one-shot prefill (the classic schedule): finish
+                    # it here so this wave's LATER admissions can hit
+                    # the prefix blocks it just registered
+                    done = False
+                    while not done:
+                        state, done = self.prefill_advance(state,
+                                                           ticket)
+                else:
+                    # chunked: defer to the loop, interleaved with
+                    # decode steps (same-wave identical prompts miss
+                    # the cache until the first one's final chunk
+                    # registers — the interleaving trade)
+                    pending[slot] = ticket
+
+        def preempt_or_retire(slot: int) -> bool:
+            """Pool exhausted extending `slot`: evict the
+            LOWEST-PRIORITY in-flight request (latest submission
+            order) back onto the queue — possibly `slot` itself, which
+            then yields to its seniors. Priority is a TOTAL order, so
+            the most senior active request is never preempted and
+            always progresses: no two slots can preempt each other
+            forever (the recompute-preemption livelock). Returns True
+            to retry the page grab, False when `slot` is gone (yielded
+            or — alone in the pool — retired at pool capacity, the
+            paged analog of the max_len bound). Mirrors the server's
+            shed/requeue semantics for the plain loop."""
+            nonlocal state
+            holders = [s_ for s_ in range(self.slots)
+                       if slot_req[s_] != -1]
+            s_v = max(holders, key=lambda s_: slot_req[s_])
+            if s_v == slot and len(holders) == 1:
+                # nobody to yield to: pool capacity IS this request's
+                # bound — retire it with the tokens it has
+                state = self.release_slot(state, slot)
+                slot_req[slot] = -1
+                stats.completed += 1
+                return False
+            req_v = slot_req[s_v]
+            state = self.release_slot(state, s_v)
+            pending.pop(s_v, None)
+            slot_req[s_v] = -1
+            emitted[req_v] = []
+            lps[req_v] = []
+            remaining[req_v] = max_new
+            queue.insert(0, req_v)
+            stats.retried += 1
+            return s_v != slot
 
         admit()
         while any(r != -1 for r in slot_req):
+            # one prefill chunk per mid-prefill slot, interleaved with
+            # the decode steps below (chunked prefill's whole point)
+            for slot in sorted(pending):
+                ticket = pending[slot]
+                state, done = self.prefill_advance(state, ticket)
+                if done:
+                    del pending[slot]
+            if not any(slot_req[s_] != -1 and s_ not in pending
+                       for s_ in range(self.slots)):
+                continue        # only prefills in flight — no step
             state, toks, tok_lps, was_active, fin = \
                 self.decode_step(state)
             stats.steps += 1
@@ -588,24 +1019,40 @@ class DecodeEngine:
             freed = False
             for slot in range(self.slots):
                 req = slot_req[slot]
-                if req == -1 or not was_active_h[slot]:
+                if req == -1 or slot in pending \
+                        or not was_active_h[slot]:
                     continue
                 emitted[req].append(int(toks[slot]))
                 lps[req].append(float(tok_lps[slot]))
                 stats.tokens += 1
                 remaining[req] -= 1
                 if fin_h[slot] or remaining[req] <= 0:
-                    if not fin_h[slot]:
-                        # host-side retire (token budget): deactivate
-                        # the device row too so the slot really frees
-                        # (device-finished rows already are)
-                        state = self.release_slot(state, slot)
+                    # ONE retire path for device-finished and
+                    # budget-finished rows alike: the pool must free
+                    # the pages either way
+                    state = self.release_slot(state, slot)
                     slot_req[slot] = -1
                     stats.completed += 1
                     freed = True
-            if freed:
+                    continue
+                # continuing row: map the next write position's page
+                while True:
+                    try:
+                        state = self.ensure_decode_page(state, slot)
+                        break
+                    except PoolExhaustedError:
+                        if not preempt_or_retire(slot):
+                            freed = True
+                            break   # slot retired at pool capacity
+            if freed or queue:
                 admit()
         toks_out = [emitted[i] for i in range(len(prompts))]
+        if self.pool is not None:
+            pc = self.pool.counters()
+            for k in ("pages_in_use", "pages_free",
+                      "peak_pages_in_use", "prefix_hits",
+                      "prefix_misses", "prefill_chunks"):
+                setattr(stats, k, pc[k])
         self.last_stats = stats
         if return_logprobs:
             return toks_out, [lps[i] for i in range(len(prompts))]

@@ -1,0 +1,402 @@
+"""Host-side page allocator + shared-prefix cache for the paged KV pool.
+
+The device side (ops.paged_attention) reads and writes through a
+static `[S, max_pages_per_slot]` page table; THIS module owns which
+physical pages back which slot, entirely on the host at admit/extend/
+retire time — no device sync in the allocator, the engine pushes table
+rows to the device only when a mapping actually changes (admission,
+one page per `page_size` decoded tokens, retire).
+
+Capacity model: a slot holding a sequence of current length L maps
+`L // page_size + 1` pages (blocks covering positions 0..L — the +1 is
+the block the NEXT decode token writes into). Pool memory therefore
+follows the SUM of actual lengths, not slots x max_len: that is the
+whole throughput case for paging, and `ServingServer` admits against
+`headroom()` instead of free-slot count.
+
+Shared-prefix reuse (copy-free): the prefix cache maps a CHAINED block
+key — (parent_key, the block's page_size token ids) — to the physical
+page holding that block's K/V. Only FULL blocks that a finished
+prefill wrote are registered, and a consumer may share at most the
+blocks strictly before the block containing its own last prompt token
+(so every admission computes >= 1 position — the first-token logits
+must come from a real forward). Shared pages are READ-ONLY by
+construction: decode writes land at positions >= true_len, which is
+past every shared block, so "copy-on-write" resolves at admission time
+— a prompt diverging inside block b simply takes a fresh page for b
+(the CoW split) while blocks [0, b) stay shared. Refcounts track
+holders (each slot + the cache itself); a page frees when its count
+hits zero.
+
+Exhaustion discipline: `alloc` first reclaims LRU cache-only pages
+(refcount 1 — no live slot) and only then raises PoolExhaustedError —
+the signal `ServingServer` turns into shed/requeue and
+`DecodeEngine.serve` into preempt-or-capacity-retire. Entry validation
+rejects a prompt whose own blocks exceed the whole pool up front.
+
+Corruption defense: every cache entry stores its block's token ids and
+`lookup` re-verifies them against the prompt before sharing — a
+corrupted entry (testing.faults `serve_prefix_corrupt_at`) degrades to
+a miss and is evicted instead of silently serving another prompt's
+K/V.
+
+`reconcile()` asserts the page-accounting invariant the chaos harness
+checks after every burst: allocated == in-use + free, every held page
+refcounted >= 1, per-page refcount == its holder count.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+def blocks_for(true_len: int, page_size: int) -> int:
+    """Pages a sequence of prompt length `true_len` maps at admission:
+    blocks covering positions 0..true_len (the +1 is the block the
+    first decode token writes into). THE single definition of the
+    admission-block convention — the allocator and every up-front
+    capacity validation (engine prefill/serve, server submit) route
+    here so the rule cannot drift between them."""
+    return true_len // page_size + 1
+
+
+class PoolExhaustedError(RuntimeError):
+    """No free page and nothing reclaimable — the paged pool's
+    backpressure signal. Transient by nature (pages free as co-tenant
+    requests finish): the server requeues/sheds on it, the plain
+    serve() loop preempts a co-tenant or capacity-retires."""
+
+
+@dataclasses.dataclass
+class _CacheEntry:
+    """One registered prefix block: `tokens` is the ground truth the
+    lookup re-verifies (corruption defense), `key` its chained cache
+    key (kept for eviction bookkeeping)."""
+
+    page: int
+    tokens: Tuple[int, ...]
+    key: tuple
+
+
+class PagePool:
+    """Allocator + prefix cache for one engine pool generation (a new
+    `init_state()` makes a fresh one, like the admission counter)."""
+
+    def __init__(self, *, num_pages: int, page_size: int, slots: int,
+                 max_pages_per_slot: int, prefix_cache: bool = True,
+                 prefix_cache_blocks: int = 512):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.slots = slots
+        self.max_pages_per_slot = max_pages_per_slot
+        self.sentinel = num_pages          # the drop page id
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._refcount = [0] * num_pages
+        self.slot_pages: List[List[int]] = [[] for _ in range(slots)]
+        self.slot_shared = [0] * slots     # leading cache-hit pages
+        self.slot_pos: List[Optional[int]] = [None] * slots
+        self.prefix_cache_enabled = prefix_cache
+        self.prefix_cache_blocks = prefix_cache_blocks
+        self._cache: "collections.OrderedDict[tuple, _CacheEntry]" = \
+            collections.OrderedDict()
+        # counters (PoolStats observability satellite)
+        self.prefix_hits = 0        # admissions reusing >= 1 block
+        self.prefix_misses = 0      # admissions reusing none
+        self.prefix_rejected = 0    # corrupted entries refused+evicted
+        self.prefill_chunks = 0     # jitted chunk invocations
+        self.peak_pages_in_use = 0
+        # testing.faults seam: fault_hook(event, ctx) — "alloc" may
+        # return truthy to force PoolExhaustedError, "lookup" may
+        # mutate the _CacheEntry it is handed
+        self.fault_hook: Optional[Callable] = None
+
+    # -- gauges ------------------------------------------------------------
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def pages_free(self) -> int:
+        return len(self._free)
+
+    def evictable(self) -> int:
+        """Cache-only pages (refcount 1): reclaimable on demand."""
+        return sum(1 for e in self._cache.values()
+                   if self._refcount[e.page] == 1)
+
+    def headroom(self) -> int:
+        """Pages an allocation could obtain right now."""
+        return len(self._free) + self.evictable()
+
+    def blocks_for(self, true_len: int) -> int:
+        """`blocks_for(true_len, self.page_size)` — see the module
+        function (the single admission-block convention)."""
+        return blocks_for(true_len, self.page_size)
+
+    def _hook(self, event: str, ctx=None):
+        if self.fault_hook is not None:
+            return self.fault_hook(event, ctx)
+        return None
+
+    # -- allocation --------------------------------------------------------
+
+    def _reclaim(self, n: int) -> None:
+        """Evict LRU cache-only entries until `n` pages are free (or
+        nothing reclaimable remains)."""
+        if len(self._free) >= n:
+            return
+        for key in list(self._cache):
+            if len(self._free) >= n:
+                break
+            entry = self._cache[key]
+            if self._refcount[entry.page] == 1:
+                del self._cache[key]
+                self._decref(entry.page)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take `n` pages (refcount 1 each), reclaiming cache-only
+        pages as needed; raises PoolExhaustedError leaving the pool
+        untouched when short."""
+        if n == 0:
+            return []
+        if self._hook("alloc", n):
+            raise PoolExhaustedError(
+                "injected page-pool exhaustion (fault plan)")
+        self._reclaim(n)
+        if len(self._free) < n:
+            raise PoolExhaustedError(
+                f"page pool exhausted: need {n} pages, "
+                f"{len(self._free)} free of {self.num_pages} "
+                f"({len(self._cache)} cached blocks, "
+                f"{self.evictable()} evictable)")
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refcount[p] = 1
+        self.peak_pages_in_use = max(self.peak_pages_in_use,
+                                     self.pages_in_use)
+        return pages
+
+    def _decref(self, page: int) -> None:
+        self._refcount[page] -= 1
+        assert self._refcount[page] >= 0, (page, self._refcount[page])
+        if self._refcount[page] == 0:
+            self._free.append(page)
+
+    # -- the prefix cache --------------------------------------------------
+
+    @staticmethod
+    def _block_tokens(tokens, b: int, page: int) -> Tuple[int, ...]:
+        return tuple(int(t) for t in tokens[b * page:(b + 1) * page])
+
+    def shareable_blocks(self, true_len: int) -> int:
+        """How many leading FULL blocks a prompt of `true_len` may
+        CONSUME from the cache: strictly before the block holding its
+        last prompt token, so >= 1 position always prefills (the
+        first-token logits need a real forward)."""
+        return (true_len - 1) // self.page_size
+
+    def lookup(self, tokens, true_len: int) -> List[int]:
+        """Longest chain of cached leading blocks for this prompt
+        (pages in block order, NOT yet refcounted — `admit` takes the
+        references). Re-verifies each entry's stored tokens; a
+        mismatch (corruption) evicts the entry and stops the chain."""
+        pages: List[int] = []
+        if not self.prefix_cache_enabled:
+            return pages
+        key: tuple = ()
+        for b in range(self.shareable_blocks(true_len)):
+            blk = self._block_tokens(tokens, b, self.page_size)
+            key = (key, blk)
+            entry = self._cache.get(key)
+            if entry is None:
+                break
+            self._hook("lookup", entry)
+            if entry.tokens != blk:
+                # corrupted entry: refuse it, evict it, count it
+                del self._cache[key]
+                self._decref(entry.page)
+                self.prefix_rejected += 1
+                break
+            self._cache.move_to_end(key)      # LRU touch
+            pages.append(entry.page)
+        return pages
+
+    def register(self, slot: int, tokens, true_len: int) -> None:
+        """Publish the slot's finished-prefill FULL blocks (end <=
+        true_len) into the cache; the cache takes one reference per
+        newly registered page. Blocks the slot itself shared are
+        already present (touched, not re-referenced)."""
+        if not self.prefix_cache_enabled:
+            return
+        key: tuple = ()
+        n_full = true_len // self.page_size
+        for b in range(min(n_full, len(self.slot_pages[slot]))):
+            blk = self._block_tokens(tokens, b, self.page_size)
+            key = (key, blk)
+            if key in self._cache:
+                self._cache.move_to_end(key)
+                continue
+            page = self.slot_pages[slot][b]
+            self._cache[key] = _CacheEntry(page=page, tokens=blk,
+                                           key=key)
+            self._refcount[page] += 1
+        # bounded cache: shed LRU entries past capacity
+        while len(self._cache) > self.prefix_cache_blocks:
+            _, old = self._cache.popitem(last=False)
+            self._decref(old.page)
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    def _probe_chain(self, tokens, true_len: int) -> List[int]:
+        """The cached leading-block chain for this prompt as a PURE
+        probe: no LRU touch, no eviction, no fault hook — the server
+        re-asks on every loop iteration for a deferred queue head, so
+        probing must not perturb allocator state; `admit()`'s real
+        `lookup` does all of that exactly once."""
+        pages: List[int] = []
+        if self.prefix_cache_enabled:
+            key: tuple = ()
+            for b in range(self.shareable_blocks(true_len)):
+                blk = self._block_tokens(tokens, b, self.page_size)
+                key = (key, blk)
+                entry = self._cache.get(key)
+                if entry is None or entry.tokens != blk:
+                    break
+                pages.append(entry.page)
+        return pages
+
+    def pages_needed(self, tokens, true_len: int) -> int:
+        """Admission cost AFTER prefix reuse (pure probe)."""
+        return self.blocks_for(true_len) - len(
+            self._probe_chain(tokens, true_len))
+
+    def admissible(self, tokens, true_len: int) -> bool:
+        """Can `admit()` succeed RIGHT NOW? The server's admission
+        gate. NOT `pages_needed() <= headroom()`: admit refs the
+        request's own shared prefix pages before allocating (the
+        anti-aliasing order), so cache-only pages in its OWN chain are
+        not reclaimable for this allocation — counting them (as
+        headroom() does) would admit a request whose admit() then
+        raises a spurious PoolExhaustedError and burns retry budget.
+        Pure probe, like pages_needed."""
+        shared = set(self._probe_chain(tokens, true_len))
+        need = self.blocks_for(true_len) - len(shared)
+        avail = len(self._free) + sum(
+            1 for e in self._cache.values()
+            if self._refcount[e.page] == 1 and e.page not in shared)
+        return need <= avail
+
+    def admit(self, slot: int, tokens, true_len: int
+              ) -> Tuple[List[int], int]:
+        """Map a slot for a prompt: share cached leading blocks
+        (refcount++) and allocate the rest. Returns (the slot's full
+        page list, shared_len in tokens). Raises PoolExhaustedError
+        with the pool untouched when the private part cannot be
+        allocated."""
+        assert not self.slot_pages[slot], (
+            f"slot {slot} still holds pages — release before admit")
+        shared = self.lookup(tokens, true_len)
+        total = self.blocks_for(true_len)
+        # take the shared references BEFORE allocating: a cache-only
+        # page (refcount 1) is reclaimable, and alloc's reclaim must
+        # not be able to evict-and-hand-back a page this admission is
+        # about to read — that aliased one page as two blocks of one
+        # slot and let the prefill overwrite published prefix content
+        for p in shared:
+            self._refcount[p] += 1
+        try:
+            fresh = self.alloc(total - len(shared))
+        except PoolExhaustedError:
+            for p in shared:
+                self._decref(p)       # cache ref remains: rc >= 1
+            raise
+        self.slot_pages[slot] = shared + fresh
+        assert len(set(self.slot_pages[slot])) == total, (
+            "page aliased across blocks", slot, self.slot_pages[slot])
+        self.slot_shared[slot] = len(shared)
+        self.slot_pos[slot] = true_len
+        if shared:
+            self.prefix_hits += 1
+        else:
+            self.prefix_misses += 1
+        return list(self.slot_pages[slot]), len(shared) * self.page_size
+
+    def extend(self, slot: int) -> Optional[Tuple[int, int]]:
+        """Advance the slot's write position one token; when it
+        crosses into an unmapped block, allocate that block's page and
+        return (block_index, page) for the device table update (None
+        when no new mapping is needed). On PoolExhaustedError the
+        position does NOT advance — the caller may free a victim and
+        retry."""
+        pos = self.slot_pos[slot]
+        assert pos is not None, f"slot {slot} not admitted"
+        new_pos = pos + 1
+        blk = new_pos // self.page_size
+        out = None
+        if blk >= len(self.slot_pages[slot]):
+            if blk >= self.max_pages_per_slot:
+                # physical max_len bound — the engine retires the row
+                # before ever writing there; nothing to map
+                self.slot_pos[slot] = new_pos
+                return None
+            page = self.alloc(1)[0]               # may raise: pos kept
+            self.slot_pages[slot].append(page)
+            out = (blk, page)
+        self.slot_pos[slot] = new_pos
+        return out
+
+    def release(self, slot: int) -> None:
+        """Drop the slot's references; pages with no other holder
+        (no co-tenant share, not cached) return to the free list.
+        Idempotent — retiring an already-empty slot is a no-op."""
+        for p in self.slot_pages[slot]:
+            self._decref(p)
+        self.slot_pages[slot] = []
+        self.slot_shared[slot] = 0
+        self.slot_pos[slot] = None
+
+    # -- accounting --------------------------------------------------------
+
+    def counters(self) -> Dict[str, int]:
+        return {
+            "pages_in_use": self.pages_in_use,
+            "pages_free": self.pages_free,
+            "peak_pages_in_use": self.peak_pages_in_use,
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_rejected": self.prefix_rejected,
+            "prefill_chunks": self.prefill_chunks,
+        }
+
+    def reconcile(self) -> None:
+        """Assert the page-accounting invariant (chaos-harness
+        contract): allocated = in-use + free, every page referenced by
+        a slot or the cache carries refcount >= 1, and each page's
+        refcount equals its holder count exactly — no leak, no double
+        free, no aliased ownership."""
+        holders = [0] * self.num_pages
+        for pages in self.slot_pages:
+            assert len(set(pages)) == len(pages), (
+                "slot maps one page twice", pages)
+            for p in pages:
+                holders[p] += 1
+        for entry in self._cache.values():
+            holders[entry.page] += 1
+        free = set(self._free)
+        assert len(free) == len(self._free), "free list duplicates"
+        assert self.pages_in_use + self.pages_free == self.num_pages
+        for p in range(self.num_pages):
+            assert self._refcount[p] == holders[p], (
+                f"page {p}: refcount {self._refcount[p]} != "
+                f"{holders[p]} holders")
+            if holders[p] > 0:
+                assert p not in free, f"page {p} held AND free"
+            else:
+                assert p in free, f"page {p} leaked (no holder, not free)"

@@ -12,13 +12,32 @@ serving plan, `tests/test_serve_server.py`):
 
 - **Bounded admission queue with load shedding.** `submit()` is the
   explicit-backpressure boundary: malformed requests (garbage/
-  oversized prompts, bad max_new) are rejected synchronously with
-  `ValueError` and never enter the queue; when the queue is full the
+  oversized prompts, bad max_new, a prompt whose own blocks exceed
+  the whole page pool) are rejected synchronously with `ValueError`
+  and never enter the queue; when the queue is full the
   CHEAPEST-TO-RETRY request (fewest prompt tokens to re-prefill, then
   most deadline slack, then newest) is shed — dropping the incoming
   request raises `QueueFullError`, displacing a queued one records it
   shed and admits the newcomer. Every shed carries the documented
   "load shed" error text.
+- **Page-pool-aware admission.** Over a paged engine the binding
+  resource is PAGES, not slots: `_admit` consults the pool's
+  `headroom()` (free + reclaimable-from-prefix-cache) against the
+  request's post-prefix-reuse page need and defers admission while
+  in-flight work frees pages. Mid-decode exhaustion (an
+  over-subscribed pool where everyone ran long) preempts the
+  cheapest co-tenant back onto the queue (one retry-budget unit, the
+  standard recompute preemption) or — with nobody to evict — retires
+  the needy request at pool capacity; prefill-time
+  `PoolExhaustedError` rides the ordinary requeue path. Pool
+  exhaustion is therefore a first-class shed/requeue reason
+  (docs/RELIABILITY.md "Serving fault model").
+- **Chunked-prefill interleave.** When the engine was built with
+  `prefill_chunk`, admission takes a `PrefillTicket` and the drive
+  loop advances ONE chunk per pending slot per iteration between
+  decode steps — a long prompt cannot head-of-line-stall active
+  decodes. Deadlines, drain, retry, and eviction treat a mid-prefill
+  slot exactly like a decoding one.
 - **Per-request deadlines, enforced mid-generation.** A deadline is
   fixed at submit time; the host loop checks it at every step
   boundary, so an expired request frees its slot for queued work
@@ -65,8 +84,14 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from paddle_tpu.serve.engine import PoolStats, pad_to_bucket
+from paddle_tpu.serve.paged import PoolExhaustedError, blocks_for
 
 log = logging.getLogger(__name__)
+
+#: page-pool counter keys accumulated across pool generations
+#: (backend switches / decode-fault resets build a fresh PagePool)
+_POOL_COUNTER_KEYS = ("prefix_hits", "prefix_misses",
+                      "prefix_rejected", "prefill_chunks")
 
 #: terminal request outcomes — exactly one per submitted request
 COMPLETED = "completed"
@@ -240,6 +265,14 @@ class ServingServer:
         self._slot_req: List[Optional[Request]] = []
         self._emitted: Dict[int, List[int]] = {}
         self._lps: Dict[int, List[float]] = {}
+        # chunked-prefill tickets per slot (engines built with
+        # prefill_chunk): advanced one chunk per drive-loop iteration
+        self._prefilling: Dict[int, object] = {}
+        # page-pool counters survive pool generations (reset/switch)
+        self._active_pool = None
+        self._pool_base: Dict[str, int] = {
+            k: 0 for k in _POOL_COUNTER_KEYS}
+        self._pool_base["peak_pages_in_use"] = 0
 
     @property
     def draining(self) -> bool:
@@ -282,6 +315,16 @@ class ServingServer:
             raise ValueError(
                 f"prompt len {t0} >= max_len {self.engine.max_len}: "
                 f"no room for a generated token")
+        if cfg.attn_window is None and getattr(self.engine, "paged",
+                                               False):
+            # page-granular capacity (engine.prefill_begin's rule): a
+            # prompt that fits max_len but not the WHOLE page pool can
+            # never be served — reject at submit, not mid-prefill
+            need = blocks_for(t0, self.engine.page_size)
+            if need > self.engine.num_pages:
+                raise ValueError(
+                    f"prompt len {t0} needs {need} pages > page pool "
+                    f"num_pages {self.engine.num_pages}")
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         return arr.astype(np.int32)
@@ -410,9 +453,26 @@ class ServingServer:
 
     # -- pool plumbing -----------------------------------------------------
 
+    def _fold_pool_counters(self) -> None:
+        """Bank the retiring PagePool's counters before a fresh one
+        replaces it (decode-fault reset / backend switch) so
+        counters() never goes backwards."""
+        if self._active_pool is None:
+            return
+        pc = self._active_pool.counters()
+        for k in _POOL_COUNTER_KEYS:
+            self._pool_base[k] += pc[k]
+        self._pool_base["peak_pages_in_use"] = max(
+            self._pool_base["peak_pages_in_use"],
+            pc["peak_pages_in_use"])
+        self._active_pool = None
+
     def _reset_pool(self) -> None:
+        self._fold_pool_counters()
         self._state = self._backend.init_state()
         self._slot_req = [None] * self._backend.slots
+        self._prefilling.clear()
+        self._active_pool = getattr(self._backend, "pool", None)
 
     def _bucketed(self, req: Request) -> np.ndarray:
         # the engine's own padding convention; _validate already
@@ -474,12 +534,86 @@ class ServingServer:
 
     def _retire_slot(self, slot: int) -> None:
         """Host-side slot free via the engine's own retire convention
-        (release_slot) — the deadline/drain eviction and serve()'s
-        token-budget retire share one sentinel arithmetic."""
+        (release_slot) — the deadline/drain/exhaustion evictions and
+        serve()'s token-budget retire share one sentinel arithmetic,
+        and on a paged engine release_slot is ALSO what frees the
+        slot's pages, so every retirement (device-finished rows
+        included) must route here."""
         self._state = self._backend.release_slot(self._state, slot)
         self._slot_req[slot] = None
+        self._prefilling.pop(slot, None)
 
     # -- the drive loop ----------------------------------------------------
+
+    def _advance_prefills(self) -> None:
+        """One prefill chunk per mid-prefill slot per loop iteration —
+        the interleave that keeps a long prompt from head-of-line
+        stalling active decodes. Faults during a chunk use the same
+        requeue/fail discipline as one-shot prefill (the wrapped
+        engine raises BEFORE touching the state; the slot's pages are
+        freed by the retire)."""
+        for slot in sorted(self._prefilling):
+            ticket = self._prefilling.get(slot)
+            req = self._slot_req[slot]
+            if ticket is None or req is None:
+                continue
+            try:
+                self._state, done = self._backend.prefill_advance(
+                    self._state, ticket)
+            except ValueError as e:
+                self._retire_slot(slot)
+                self._finish(req, FAILED,
+                             error=f"prefill rejected: {e}")
+                continue
+            except Exception as e:
+                if self._backend is self.native_backend:
+                    self._native_fault(e)
+                if self._slot_req[slot] is req:
+                    self._retire_slot(slot)
+                    self._requeue_or_fail(req,
+                                          f"prefill chunk fault: {e}")
+                continue
+            if self._backend is self.native_backend:
+                self.breaker.record_success()
+            if done:
+                self._prefilling.pop(slot, None)
+
+    def _ensure_pages(self, slot: int, req: Request) -> None:
+        """Map the next write position's page for a continuing slot.
+        On PoolExhaustedError — only possible when num_pages
+        over-subscribes the slots — evict the LOWEST-PRIORITY
+        (highest req_id = latest submitted) in-flight request back
+        onto the queue (recompute preemption: one retry-budget unit,
+        tokens identical on replay) and retry; the needy request
+        itself yields when it IS the junior one. Priority is total,
+        so the most senior request always progresses — no mutual-
+        preemption livelock — and retry budgets bound the recompute
+        thrash. With nobody else holding pages, retire THIS request
+        at pool capacity, the paged analog of the max_len
+        retirement."""
+        ensure = getattr(self._backend, "ensure_decode_page", None)
+        if ensure is None:
+            return
+        while True:
+            try:
+                self._state = ensure(self._state, slot)
+                return
+            except PoolExhaustedError as e:
+                holders = [
+                    (s2, r2) for s2, r2 in enumerate(self._slot_req)
+                    if r2 is not None]
+                s2, r2 = max(holders, key=lambda sr: sr[1].req_id)
+                if s2 == slot and len(holders) == 1:
+                    self._retire_slot(slot)
+                    self._finish(
+                        req, COMPLETED,
+                        retries=self.max_retries - req.retries_left)
+                    return
+                self._retire_slot(s2)
+                self._requeue_or_fail(
+                    r2, f"preempted on page-pool exhaustion: {e}")
+                if s2 == slot:
+                    return          # the needy request yielded
 
     def _expire_queued(self) -> None:
         now = self.clock()
@@ -500,17 +634,47 @@ class ServingServer:
                 self._finish(req, EXPIRED, error=(
                     "deadline expired at admission (prefill skipped)"))
                 continue
+            pool = getattr(self._backend, "pool", None)
+            if pool is not None:
+                # the binding resource on a paged engine is PAGES, not
+                # slots: defer admission while the pool could not map
+                # the request's post-prefix-reuse need right now —
+                # in-flight work frees pages, and with nothing in
+                # flight the whole pool is available (submit() already
+                # rejected what can never fit). admissible() mirrors
+                # admit()'s own reclaim arithmetic, so a passed gate
+                # cannot raise a spurious PoolExhaustedError
+                if not pool.admissible(req.prompt, req.true_len):
+                    self.queue.insert(0, req)
+                    break
+            chunked = (getattr(self._backend, "prefill_chunk", None)
+                       is not None
+                       and hasattr(self._backend, "prefill_begin"))
             try:
-                self._state = self._backend.prefill(
-                    self._state, slot, self._bucketed(req),
-                    true_len=req.true_len, sampling=req.sampling)
+                if chunked:
+                    self._state, ticket = self._backend.prefill_begin(
+                        self._state, slot, self._bucketed(req),
+                        true_len=req.true_len, sampling=req.sampling)
+                    self._prefilling[slot] = ticket
+                else:
+                    self._state = self._backend.prefill(
+                        self._state, slot, self._bucketed(req),
+                        true_len=req.true_len, sampling=req.sampling)
             except ValueError as e:
                 # deterministic rejection — retrying cannot help
                 self._finish(req, FAILED, error=f"prefill rejected: {e}")
                 continue
+            except PoolExhaustedError as e:
+                # capacity pressure, NOT backend ill-health: never
+                # feeds the circuit breaker (admit/begin leave the
+                # pool untouched on failure); ordinary requeue path
+                self._requeue_or_fail(req, f"prefill fault: {e}")
+                continue
             except Exception as e:
-                # transient fault: the held state is untouched
-                # (prefill is pure), so only THIS request is suspect —
+                # transient fault (an injected engine fault or a
+                # native bridge error): the held state is untouched
+                # (prefill is pure / begin leaves the pool untouched
+                # on failure), so only THIS request is suspect —
                 # unless the breaker opens, which evicts the pool and
                 # switches backends first
                 if self._backend is self.native_backend:
@@ -562,18 +726,30 @@ class ServingServer:
                 self._expire_queued()
                 self._maybe_probe_native()
                 self._admit()
+                self._advance_prefills()
                 inflight = [r for r in self._slot_req if r is not None]
                 if not inflight:
                     if not self.queue or self._draining:
                         break
                     continue
                 if self._drain_expired():
+                    # before the mid-prefill early-out: the drain
+                    # grace must bind even when every occupied slot
+                    # is still prefilling (a long chunked prompt must
+                    # not overstay the grace by its remaining chunks)
                     for slot, req in enumerate(self._slot_req):
                         if req is not None:
                             self._finish(req, EXPIRED, error=(
                                 f"drain grace expired "
                                 f"({self._drain_reason})"))
                             self._retire_slot(slot)
+                    continue
+                if not any(r is not None and s not in self._prefilling
+                           for s, r in enumerate(self._slot_req)):
+                    # only mid-prefill slots: no decode yet — but
+                    # per-request deadlines bind a mid-prefill slot
+                    # exactly like a decoding one
+                    self._expire_in_flight()
                     continue
                 try:
                     (self._state, toks, tok_lps, was_active,
@@ -594,7 +770,8 @@ class ServingServer:
                 toks, tok_lps, was_active_h, fin_h = jax.device_get(
                     (toks, tok_lps, was_active, fin))
                 for slot, req in enumerate(self._slot_req):
-                    if req is None or not was_active_h[slot]:
+                    if req is None or slot in self._prefilling \
+                            or not was_active_h[slot]:
                         continue
                     self._emitted[req.req_id].append(int(toks[slot]))
                     self._lps[req.req_id].append(float(tok_lps[slot]))
@@ -603,13 +780,15 @@ class ServingServer:
                             len(self._emitted[req.req_id])
                             >= req.max_new)
                     if done:
-                        if not fin_h[slot]:
-                            self._retire_slot(slot)
-                        else:
-                            self._slot_req[slot] = None
+                        # device-finished and budget-finished rows
+                        # retire the same way: the paged pool frees
+                        # this slot's pages in release_slot
+                        self._retire_slot(slot)
                         self._finish(
                             req, COMPLETED,
                             retries=self.max_retries - req.retries_left)
+                    else:
+                        self._ensure_pages(slot, req)
                 self._expire_in_flight()
                 for hook in list(self.on_step):
                     hook(self, self.stats.steps)
@@ -625,8 +804,12 @@ class ServingServer:
 
     def counters(self) -> Dict[str, int]:
         """The structured outcome counters (PoolStats fields):
-        admitted/shed/expired/retried/completed/failed + requests."""
-        return {
+        admitted/shed/expired/retried/completed/failed + requests,
+        plus the page-pool block (pages_in_use/pages_free are live
+        gauges of the current pool generation; prefix_hits/
+        prefix_misses/prefix_rejected/prefill_chunks and
+        peak_pages_in_use accumulate across generations)."""
+        out = {
             "requests": self.stats.requests,
             "admitted": self.stats.admitted,
             "completed": self.stats.completed,
@@ -635,12 +818,27 @@ class ServingServer:
             "failed": self.stats.failed,
             "retried": self.stats.retried,
         }
+        out.update(self._pool_base)
+        out.setdefault("pages_in_use", 0)
+        out.setdefault("pages_free", 0)
+        if self._active_pool is not None:
+            pc = self._active_pool.counters()
+            for k in _POOL_COUNTER_KEYS:
+                out[k] = self._pool_base[k] + pc[k]
+            out["pages_in_use"] = pc["pages_in_use"]
+            out["pages_free"] = pc["pages_free"]
+            out["peak_pages_in_use"] = max(
+                self._pool_base["peak_pages_in_use"],
+                pc["peak_pages_in_use"])
+        return out
 
     def reconcile(self) -> None:
         """Assert the accounting contract: every submitted request has
-        exactly one terminal outcome and the counters match the
-        request log. Raises AssertionError on any silent drop — the
-        chaos harness calls this after every burst."""
+        exactly one terminal outcome, the counters match the request
+        log, and the page pool's books balance (allocated = in-use +
+        free, every held page refcounted, refcounts == holder counts
+        — PagePool.reconcile). Raises AssertionError on any silent
+        drop — the chaos harness calls this after every burst."""
         assert len(self.results) == self.stats.requests, (
             len(self.results), self.stats.requests)
         assert not self.queue and not any(
@@ -652,3 +850,8 @@ class ServingServer:
         for o in OUTCOMES:
             assert tally[o] == getattr(self.stats, o), (
                 o, tally[o], getattr(self.stats, o))
+        if self._active_pool is not None:
+            self._active_pool.reconcile()
+            # an idle server holds no pages outside the prefix cache
+            pool = self._active_pool
+            assert all(not p for p in pool.slot_pages), pool.slot_pages

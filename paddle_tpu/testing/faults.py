@@ -38,7 +38,16 @@ Serving faults (the serve.server chaos harness, docs/RELIABILITY.md
   deadline storms without wall-clock sleeps;
 - oversized/garbage prompts (`garbage_prompts`) — canonical malformed
   traffic the admission validators must reject without crashing the
-  pool.
+  pool;
+- page-pool EXHAUSTION on the nth allocation (`wrap_page_pool`,
+  `serve_page_alloc_error_at`) — the paged-KV backpressure shape: the
+  server must shed/requeue and every request still end in exactly one
+  outcome;
+- prefix-cache CORRUPTION on the nth cache lookup
+  (`serve_prefix_corrupt_at`): the hit entry's stored tokens are
+  flipped before the pool's re-verification — the defense must treat
+  it as a miss, evict the entry (`prefix_rejected`), and preserve
+  greedy parity rather than serve another prompt's K/V.
 
 Parameter-server faults (native.pserver + parallel.pserver_client,
 docs/RELIABILITY.md "Parameter-server fault model") use the shard's
@@ -91,6 +100,8 @@ class FaultPlan:
     serve_error_first_n: Optional[int] = None     # first N engine calls
     serve_stall_at: Optional[int] = None          # nth decode_step
     serve_stall_s: float = 0.0                    # clock burned per stall
+    serve_page_alloc_error_at: Optional[int] = None  # nth page alloc
+    serve_prefix_corrupt_at: Optional[int] = None    # nth cache lookup
     # -- parameter-server faults (native.pserver, via wrap_pserver_shard) --
     pserver_kill_push_at: Optional[int] = None    # nth push received
     pserver_lost_ack_at: Optional[int] = None     # nth push ACK dropped
@@ -108,6 +119,8 @@ class FaultPlan:
         self._serve_prefill_counter = 0
         self._serve_decode_counter = 0
         self._serve_call_counter = 0
+        self._page_alloc_counter = 0
+        self._prefix_lookup_counter = 0
         self._pserver_push_counter = 0
         self._pserver_ack_counter = 0
         self._pserver_repl_counter = 0
@@ -202,6 +215,44 @@ class FaultPlan:
         Everything else delegates, so a wrapped engine is otherwise
         bit-identical to the real one."""
         return _FaultyEngine(engine, self, clock)
+
+    def wrap_page_pool(self, pool):
+        """Install this plan on a `serve.paged.PagePool` via its
+        `fault_hook` seam. Fault points:
+
+        - "alloc": the `serve_page_alloc_error_at`-th allocation call
+          (admissions AND mid-decode extends, plan-global) reports
+          exhaustion — the pool raises PoolExhaustedError exactly as
+          if the arena were full, so the server's shed/requeue and the
+          engine's preempt paths run against a provably healthy pool;
+        - "lookup": the `serve_prefix_corrupt_at`-th prefix-cache hit
+          has its stored block tokens FLIPPED before the pool
+          re-verifies them — the corruption-defense path (treat as
+          miss, evict, count prefix_rejected) must fire and greedy
+          parity must survive."""
+        plan = self
+
+        def hook(event: str, ctx=None):
+            if event == "alloc":
+                idx = plan._page_alloc_counter
+                plan._page_alloc_counter += 1
+                if (idx == plan.serve_page_alloc_error_at
+                        and not plan._spent("pagealloc")):
+                    plan._note("pagealloc", idx)
+                    return True        # pool raises PoolExhaustedError
+            elif event == "lookup":
+                idx = plan._prefix_lookup_counter
+                plan._prefix_lookup_counter += 1
+                if (idx == plan.serve_prefix_corrupt_at
+                        and not plan._spent("prefixcorrupt")):
+                    plan._note("prefixcorrupt", idx)
+                    # flip the stored tokens in place: verification
+                    # against the real prompt block must now fail
+                    ctx.tokens = tuple(t ^ 1 for t in ctx.tokens)
+            return None
+
+        pool.fault_hook = hook
+        return pool
 
     # -- parameter-server faults ------------------------------------------
 
@@ -347,7 +398,7 @@ class _FaultyEngine:
             return True
         return False
 
-    def prefill(self, *args, **kwargs):
+    def _prefill_fault(self):
         plan = self._plan
         burst = self._burst()
         idx = plan._serve_prefill_counter
@@ -359,7 +410,32 @@ class _FaultyEngine:
                 and not plan._spent("sprefill")):
             plan._note("sprefill", idx)
             raise FaultError(f"injected prefill fault #{idx}")
+
+    def init_state(self, *args, **kwargs):
+        """Delegate, then install the plan on the freshly built page
+        pool — wrap_engine alone is enough for paged faults even
+        though the server rebuilds pools on reset/backend switch."""
+        state = self._engine.init_state(*args, **kwargs)
+        pool = getattr(self._engine, "pool", None)
+        if pool is not None:
+            self._plan.wrap_page_pool(pool)
+        return state
+
+    def prefill(self, *args, **kwargs):
+        self._prefill_fault()
         return self._engine.prefill(*args, **kwargs)
+
+    def prefill_begin(self, *args, **kwargs):
+        # host-side bookkeeping only — faults strike the chunks (the
+        # forward work), mirroring "raises BEFORE touching the engine"
+        return self._engine.prefill_begin(*args, **kwargs)
+
+    def prefill_advance(self, state, ticket):
+        """Each chunk counts as one prefill call for the fault
+        schedule: serve_prefill_error_at can strike any chunk of a
+        chunked prefill, and the burst counter keeps ticking."""
+        self._prefill_fault()
+        return self._engine.prefill_advance(state, ticket)
 
     def decode_step(self, state):
         plan = self._plan

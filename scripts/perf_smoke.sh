@@ -1,0 +1,25 @@
+#!/usr/bin/env sh
+# Perf smoke lane: run ONLY the CPU-runnable performance tests
+# (marker `perf` — e.g. the paged-KV 2x-admission acceptance bound in
+# tests/test_paged_pool.py), then the serving bench stage, so the
+# perf trajectory is measurable without a live chip:
+#
+#     scripts/perf_smoke.sh             # the whole perf lane + bench
+#     scripts/perf_smoke.sh --no-bench  # tests only
+#     scripts/perf_smoke.sh -k paged    # filter, passes through
+#
+# The bench stage prints one JSON line per metric (tokens/s, pool
+# occupancy, prefix-cache hit rate) — same format as bench.py, which
+# also runs this stage first, before the chip-liveness gate.
+set -e
+cd "$(dirname "$0")/.."
+bench=1
+if [ "$1" = "--no-bench" ]; then
+    bench=0
+    shift
+fi
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m perf \
+    -p no:cacheprovider "$@"
+if [ "$bench" = "1" ]; then
+    env JAX_PLATFORMS=cpu python bench.py --serving-only
+fi

@@ -44,6 +44,11 @@ def pytest_configure(config):
         "(native.pserver leases/replication/failover) — a subset of "
         "the faults lane, runs IN tier-1; `-m pserver` (or "
         "`scripts/fault_smoke.sh pserver`) runs it alone")
+    config.addinivalue_line(
+        "markers", "perf: CPU-runnable performance smoke lane "
+        "(capacity/throughput assertions, e.g. the paged-pool 2x "
+        "admission bound) — fast, runs IN tier-1; `-m perf` (or "
+        "`scripts/perf_smoke.sh`) runs it alone")
 
 
 @pytest.fixture
