@@ -1,0 +1,36 @@
+"""Static analysis + runtime guards for the compiled-execution contract.
+
+The whole framework bet (PAPER.md: declarative config -> compiled
+execution) is that every hot path stays inside one compiled XLA
+program. Nothing in Python enforces that by construction — a stray
+`.item()`, a host branch on a traced value, or a `jax.jit` rebuilt per
+call silently turns "as fast as the hardware allows" into per-step
+recompiles and host round-trips. This package is the enforcement:
+
+- `graftlint`   — AST linter for trace-safety and recompile discipline
+                  (rules GL001-GL006, per-line disable comments,
+                  committed baseline allowlist).
+- `locklint`    — lock-discipline checker for the threaded native
+                  runtimes (rule LK001: an attribute mutated both
+                  under a held lock and outside one).
+- `guards`      — runtime enforcement: `RecompileGuard` (a region
+                  must not compile) and `no_implicit_transfers`
+                  (a region must not implicitly cross host<->device).
+
+CLI: `python -m paddle_tpu.analysis --check` lints the package against
+`analysis/baseline.json` and exits non-zero on any unbaselined
+finding (docs/ANALYSIS.md).
+"""
+
+from paddle_tpu.analysis.graftlint import (Finding, RULES, lint_file,
+                                           lint_source)
+from paddle_tpu.analysis.locklint import lint_locks
+from paddle_tpu.analysis.guards import (RecompileError, RecompileGuard,
+                                        TransferError,
+                                        no_implicit_transfers)
+
+__all__ = [
+    "Finding", "RULES", "lint_file", "lint_source", "lint_locks",
+    "RecompileError", "RecompileGuard", "TransferError",
+    "no_implicit_transfers",
+]

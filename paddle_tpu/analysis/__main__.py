@@ -1,0 +1,5 @@
+import sys
+
+from paddle_tpu.analysis.run import run_cli
+
+sys.exit(run_cli())

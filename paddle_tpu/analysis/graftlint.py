@@ -1,0 +1,939 @@
+"""graftlint: AST linter for JAX trace-safety & recompile discipline.
+
+Whole-program compilation frameworks get their guarantee by
+construction (arXiv:1810.09868 compiles entire Julia programs to one
+XLA computation); a Python/JAX codebase has to EARN it — any host-side
+escape inside a traced function (host sync, Python control flow on a
+tracer, per-call `jit` construction) silently downgrades a compiled
+hot loop to per-step recompiles and host round-trips. graftlint finds
+those escapes statically.
+
+Rules (docs/ANALYSIS.md has one bad/good example per rule):
+
+  GL001  host sync inside a traced function: `.item()`/`.tolist()`,
+         `float()`/`int()`/`bool()` on a traced value, `np.*` host
+         ops on traced values, `jax.device_get`, `print` of a traced
+         value (use `jax.debug.print`).
+  GL002  Python `if`/`while`/`assert`/ternary on a traced value —
+         needs `lax.cond`/`lax.while_loop`/`jnp.where`.
+  GL003  weak-dtype constructor: `jnp.array`/`jnp.asarray`/`jnp.full`
+         with a bare Python numeric literal and no `dtype=` — under
+         `jax_enable_x64` this materializes float64/int64 and
+         poisons downstream dtypes (and compile keys).
+  GL004  recompile hazard: `jax.jit` constructed inside a loop,
+         list-valued (unhashable) `static_argnums`/`static_argnames`,
+         iteration over a `set` inside a traced function (pytree
+         order is nondeterministic across processes).
+  GL005  tracer leak: a traced value stored on `self`, a global, or
+         mutated into a container that outlives the trace.
+  GL006  module-import-time `jnp`/`jax.random`/`jax.lax` computation
+         (device work + compile before anyone asked for it).
+
+How "traced" is decided (heuristic, intra-module): a function is
+traced when it is decorated with / passed to `jax.jit`, `pjit`,
+`jax.vmap`, `jax.grad`, `jax.value_and_grad`, `jax.checkpoint`,
+`jax.lax.{scan,cond,while_loop,fori_loop,switch,map}`, or defined
+inside a traced function. Within one, taint starts at the function's
+non-static parameters (static args are read off visible
+`static_argnames=`/`static_argnums=` at the jit site or decorator)
+and propagates through expressions; `.shape`/`.dtype`/`.ndim`/`.size`
+reads are host metadata and un-taint.
+
+Escape hatch: `# graftlint: disable=GL001(reason)` on the flagged
+line (or any line of the flagged statement) suppresses that rule
+there — the reason is REQUIRED; a bare disable does not count.
+Repo-wide accepted findings live in `analysis/baseline.json`
+(see `python -m paddle_tpu.analysis --help`).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "GL001": "host sync inside a traced function",
+    "GL002": "Python control flow on a traced value",
+    "GL003": "weak-dtype constructor (implicit 64-bit under x64)",
+    "GL004": "recompile hazard",
+    "GL005": "tracer leak out of the traced scope",
+    "GL006": "module-import-time jnp computation",
+    "LK001": "attribute mutated both under a held lock and outside one",
+}
+
+#: transforms whose function argument is traced
+_TRACING_CALLS = {
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad",
+    "checkpoint", "remat", "scan", "cond", "while_loop", "fori_loop",
+    "switch", "custom_vjp", "custom_jvp",
+}
+#: like _TRACING_CALLS, but the bare leaf is ambiguous (jax.tree.map,
+#: builtin map) — only a lax-qualified call counts
+_LAX_ONLY_CALLS = {"map"}
+#: jit-like constructors (GL004 cares where these are BUILT)
+_JIT_NAMES = {"jit", "pjit"}
+#: attribute reads that return host metadata, never a tracer
+_UNTAINT_ATTRS = {"shape", "dtype", "ndim", "size", "sharding",
+                  "aval", "weak_type"}
+#: container mutators (GL005 leak sinks / LK shared)
+_MUTATORS = {"append", "extend", "insert", "add", "update",
+             "setdefault", "appendleft"}
+#: call roots that produce/propagate device values
+_ARRAY_ROOTS = {"jnp", "lax", "jax"}
+#: jnp constructors checked by GL003 (value arg position)
+_WEAK_CTORS = {"array": 0, "asarray": 0, "full": 1}
+
+# the reason must START on the disable line (non-empty — a bare
+# disable does not suppress); it may run onto the next comment line
+# before its closing paren
+_DISABLE_RE = re.compile(
+    r"graftlint:\s*disable=([A-Z]{2}\d{3})\s*"
+    r"(?:\((\s*[^)\s][^)]*)\)?)?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint hit. `func` is the dotted lexical scope (`<module>`
+    for top level) — the baseline keys on (rule, path, func), never
+    on line numbers, so unrelated edits don't churn it."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    func: str
+    message: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.func)
+
+    def __str__(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.func}] {self.message}")
+
+
+def _suppressions(source: str) -> Dict[int, List[Tuple[str, str]]]:
+    """line -> [(rule, reason)] from `# graftlint: disable=ID(reason)`
+    comments. Tokenize (not a line regex) so a '#' inside a string
+    can't fake a directive."""
+    out: Dict[int, List[Tuple[str, str]]] = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            for m in _DISABLE_RE.finditer(tok.string):
+                out.setdefault(tok.start[0], []).append(
+                    (m.group(1), (m.group(2) or "").strip()))
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _is_suppressed(f: Finding, node: ast.AST,
+                   supp: Dict[int, List[Tuple[str, str]]],
+                   src_lines: Optional[List[str]] = None) -> bool:
+    """A disable comment counts on any line of the flagged node, or
+    in the contiguous comment block directly above it."""
+    def match(ln: int) -> bool:
+        return any(rule == f.rule and reason
+                   for rule, reason in supp.get(ln, ()))
+
+    lo = getattr(node, "lineno", f.line)
+    hi = getattr(node, "end_lineno", None) or lo
+    if any(match(ln) for ln in range(lo, hi + 1)):
+        return True
+    if src_lines:
+        ln = lo - 1
+        while (ln >= 1
+               and src_lines[ln - 1].lstrip().startswith("#")):
+            if match(ln):
+                return True
+            ln -= 1
+    return False
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jnp.zeros' for Attribute/Name chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _root(dotted: Optional[str]) -> Optional[str]:
+    return dotted.split(".", 1)[0] if dotted else None
+
+
+def _static_names_from_call(call: ast.Call) -> Set[str]:
+    """Names listed in a visible static_argnames=(...) kwarg."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(
+                        el.value, str):
+                    out.add(el.value)
+    return out
+
+
+def _static_nums_from_call(call: ast.Call) -> Set[int]:
+    out: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            for el in ast.walk(kw.value):
+                if isinstance(el, ast.Constant) and isinstance(
+                        el.value, int):
+                    out.add(el.value)
+    return out
+
+
+class _TraceIndex:
+    """Pass 1: which function NAMES are handed to tracing transforms
+    anywhere in the module, and the static-arg info visible at those
+    sites. Name-based and module-local — deliberately conservative."""
+
+    def __init__(self, tree: ast.Module):
+        self.traced_names: Set[str] = set()
+        self.static_names: Dict[str, Set[str]] = {}
+        self.static_nums: Dict[str, Set[int]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = _dotted(node.func)
+            if fn is None:
+                continue
+            leaf = fn.split(".")[-1]
+            if leaf not in _TRACING_CALLS and not (
+                    leaf in _LAX_ONLY_CALLS
+                    and (fn.startswith("lax.")
+                         or fn.startswith("jax.lax."))):
+                continue
+            for arg in node.args:
+                name = None
+                if isinstance(arg, ast.Name):
+                    name = arg.id
+                elif isinstance(arg, ast.Attribute):
+                    name = arg.attr          # jax.jit(self._step_impl)
+                if name is None:
+                    continue
+                self.traced_names.add(name)
+                sn = _static_names_from_call(node)
+                if sn:
+                    self.static_names.setdefault(name, set()).update(sn)
+                nums = _static_nums_from_call(node)
+                if nums:
+                    self.static_nums.setdefault(name, set()).update(nums)
+
+
+def _decorator_trace_info(
+        fn: ast.FunctionDef) -> Tuple[bool, Set[str], Set[int]]:
+    """(is_traced, static_argnames, static_argnums) from decorators:
+    @jax.jit, @jit, @partial(jax.jit, static_argnames=...), etc."""
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    traced = False
+    for dec in fn.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        dn = _dotted(d)
+        leaf = dn.split(".")[-1] if dn else None
+        if leaf in _TRACING_CALLS:
+            traced = True
+            if isinstance(dec, ast.Call):
+                names |= _static_names_from_call(dec)
+                nums |= _static_nums_from_call(dec)
+        elif leaf == "partial" and isinstance(dec, ast.Call):
+            inner = dec.args[0] if dec.args else None
+            idn = _dotted(inner) if inner is not None else None
+            if idn and idn.split(".")[-1] in _TRACING_CALLS:
+                traced = True
+                names |= _static_names_from_call(dec)
+                nums |= _static_nums_from_call(dec)
+    return traced, names, nums
+
+
+class Linter:
+    """One file's worth of graftlint. `lint_source` is the entry."""
+
+    def __init__(self, source: str, path: str,
+                 rules: Optional[Sequence[str]] = None):
+        self.source = source
+        self.src_lines = source.splitlines()
+        self.path = path
+        self.rules = set(rules) if rules else None
+        self.findings: List[Finding] = []
+        self.supp = _suppressions(source)
+        self.suppressed: List[Finding] = []
+
+    # -- reporting --------------------------------------------------------
+
+    def _emit(self, rule: str, node: ast.AST, func: str,
+              message: str) -> None:
+        if self.rules is not None and rule not in self.rules:
+            return
+        f = Finding(rule, self.path, getattr(node, "lineno", 0),
+                    getattr(node, "col_offset", 0), func, message)
+        if _is_suppressed(f, node, self.supp, self.src_lines):
+            self.suppressed.append(f)
+            return
+        self.findings.append(f)
+
+    # -- drive ------------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        try:
+            tree = ast.parse(self.source, filename=self.path)
+        except SyntaxError as e:
+            self._emit("GL006", ast.Module(body=[], type_ignores=[]),
+                       "<module>", f"file does not parse: {e}")
+            return self.findings
+        self.index = _TraceIndex(tree)
+        self._module_level(tree)
+        self._walk_scope(tree.body, func="<module>", traced=False,
+                         taint=set(), bound_stack=[], in_loop=False)
+        return self.findings
+
+    # -- GL006: import-time compute ---------------------------------------
+
+    def _module_level(self, tree: ast.Module) -> None:
+        def walk_pruned(node):
+            """ast.walk that does NOT descend into function/lambda
+            bodies — those don't execute at import time."""
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                yield from walk_pruned(child)
+
+        def check_expr(expr: ast.AST, where: str) -> None:
+            for node in walk_pruned(expr):
+                if isinstance(node, ast.Call):
+                    dn = _dotted(node.func)
+                    root = _root(dn)
+                    if root in ("jnp", "lax") or (
+                            dn and (dn.startswith("jax.random.")
+                                    or dn.startswith("jax.numpy.")
+                                    or dn.startswith("jax.lax.")
+                                    or dn.startswith("jax.nn."))):
+                        self._emit(
+                            "GL006", node, where,
+                            f"`{dn}(...)` runs at import time — "
+                            f"device compute + compile before any "
+                            f"caller asked; build it lazily")
+
+        def scan_body(body, where):
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    # default values DO evaluate at import
+                    for d in (stmt.args.defaults
+                              + [d for d in stmt.args.kw_defaults
+                                 if d is not None]):
+                        check_expr(d, where)
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    scan_body(stmt.body, f"{where}.{stmt.name}"
+                              if where != "<module>" else stmt.name)
+                    continue
+                if isinstance(stmt, ast.If):
+                    # `if __name__ == "__main__":` is run-as-script,
+                    # not import time
+                    t = stmt.test
+                    if (isinstance(t, ast.Compare)
+                            and isinstance(t.left, ast.Name)
+                            and t.left.id == "__name__"):
+                        continue
+                    scan_body(stmt.body, where)
+                    scan_body(stmt.orelse, where)
+                    continue
+                if isinstance(stmt, (ast.Try,)):
+                    scan_body(stmt.body, where)
+                    for h in stmt.handlers:
+                        scan_body(h.body, where)
+                    scan_body(stmt.finalbody, where)
+                    continue
+                check_expr(stmt, where)
+
+        scan_body(tree.body, "<module>")
+
+    # -- scope walker (everything else) ------------------------------------
+
+    def _walk_scope(self, body: Sequence[ast.stmt], *, func: str,
+                    traced: bool, taint: Set[str],
+                    bound_stack: List[Set[str]],
+                    in_loop: bool) -> None:
+        """Walk one function body (or the module body for defs).
+        `taint` is shared mutable state for this traced stack;
+        `bound_stack` tracks names bound at each traced-function
+        level (GL005 closure discrimination)."""
+        checker = _BodyChecker(self, func=func, traced=traced,
+                               taint=taint, bound_stack=bound_stack,
+                               in_loop=in_loop)
+        for stmt in body:
+            checker.visit(stmt)
+
+    def child_scope(self, fn, *, parent_func: str, parent_traced: bool,
+                    parent_taint: Set[str],
+                    bound_stack: List[Set[str]],
+                    in_loop: bool) -> None:
+        """Enter a FunctionDef found while walking."""
+        name = fn.name
+        qual = name if parent_func == "<module>" else (
+            f"{parent_func}.{name}")
+        dec_traced, dec_static, dec_nums = _decorator_trace_info(fn)
+        traced = (parent_traced or dec_traced
+                  or name in self.index.traced_names)
+        statics = set(dec_static) | self.index.static_names.get(
+            name, set())
+        static_nums = set(dec_nums) | self.index.static_nums.get(
+            name, set())
+        args = fn.args
+        pos = [a.arg for a in args.posonlyargs + args.args]
+        taint: Set[str] = set(parent_taint) if parent_traced else set()
+        bound: Set[str] = set()
+        if traced:
+            for i, a in enumerate(pos):
+                if a in ("self", "cls"):
+                    continue
+                if a in statics or i in static_nums:
+                    continue
+                taint.add(a)
+            for a in args.kwonlyargs:
+                if a.arg not in statics:
+                    taint.add(a.arg)
+            if args.vararg:
+                taint.add(args.vararg.arg)
+            if args.kwarg:
+                taint.add(args.kwarg.arg)
+            bound.update(pos)
+            bound.update(a.arg for a in args.kwonlyargs)
+        stack = bound_stack + [bound] if traced else []
+        self._walk_scope(fn.body, func=qual, traced=traced,
+                         taint=taint, bound_stack=stack,
+                         in_loop=in_loop if not traced else False)
+
+
+class _BodyChecker(ast.NodeVisitor):
+    """Statement/expression checks for one lexical function body."""
+
+    def __init__(self, linter: Linter, *, func: str, traced: bool,
+                 taint: Set[str], bound_stack: List[Set[str]],
+                 in_loop: bool):
+        self.l = linter
+        self.func = func
+        self.traced = traced
+        self.taint = taint
+        self.bound_stack = bound_stack
+        self.in_loop = in_loop
+        self.globals: Set[str] = set()
+
+    # -- taint ------------------------------------------------------------
+
+    def _bind(self, name: str) -> None:
+        if self.bound_stack:
+            self.bound_stack[-1].add(name)
+
+    def _is_bound_in_stack(self, name: str) -> bool:
+        return any(name in s for s in self.bound_stack)
+
+    def tainted(self, node: ast.AST) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.taint
+        if isinstance(node, ast.Attribute):
+            if node.attr in _UNTAINT_ATTRS:
+                return False
+            return self.tainted(node.value)
+        if isinstance(node, ast.Call):
+            dn = _dotted(node.func)
+            root = _root(dn)
+            leaf = dn.split(".")[-1] if dn else None
+            if leaf in ("len", "isinstance", "hasattr", "getattr",
+                        "range", "type", "id",
+                        # host-side metadata predicates, not arrays
+                        "issubdtype", "result_type", "eval_shape",
+                        "tree_structure"):
+                return False
+            if root in _ARRAY_ROOTS and self.traced:
+                # jnp.*/lax.*/jax.* calls produce device values in a
+                # traced scope (jnp.arange over static bounds too —
+                # it becomes a constant, but combining it is fine;
+                # taint only matters for the sinks)
+                if dn.startswith(("jax.tree_util.", "jax.tree.")):
+                    return any(self.tainted(a) for a in node.args)
+                return True
+            if self.tainted(node.func):
+                return True
+            return (any(self.tainted(a) for a in node.args)
+                    or any(self.tainted(kw.value)
+                           for kw in node.keywords))
+        if isinstance(node, ast.Subscript):
+            return self.tainted(node.value) or self.tainted(node.slice)
+        if isinstance(node, (ast.BinOp,)):
+            return self.tainted(node.left) or self.tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # `x is None` / `x is not None` / isinstance-style checks
+            # are host-decidable regardless of x
+            if all(isinstance(op, (ast.Is, ast.IsNot))
+                   for op in node.ops):
+                return False
+            return (self.tainted(node.left)
+                    or any(self.tainted(c) for c in node.comparators))
+        if isinstance(node, ast.IfExp):
+            return (self.tainted(node.body)
+                    or self.tainted(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return (any(self.tainted(v) for v in node.values)
+                    or any(k is not None and self.tainted(k)
+                           for k in node.keys))
+        if isinstance(node, ast.Starred):
+            return self.tainted(node.value)
+        if isinstance(node, ast.Slice):
+            return (self.tainted(node.lower)
+                    or self.tainted(node.upper)
+                    or self.tainted(node.step))
+        if isinstance(node, ast.JoinedStr):
+            return any(self.tainted(v) for v in node.values)
+        if isinstance(node, ast.FormattedValue):
+            return self.tainted(node.value)
+        return False
+
+    def _assign_target(self, target: ast.AST, value_tainted: bool,
+                       value: Optional[ast.AST] = None) -> None:
+        if isinstance(target, ast.Name):
+            if value_tainted:
+                self.taint.add(target.id)
+            else:
+                self.taint.discard(target.id)
+            self._bind(target.id)
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, value_tainted)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            # precise per-element taint for the patterns that matter:
+            # `a, b = f(x), g(y)` and *_with_path / enumerate pairs
+            elts = list(target.elts)
+            if (value is not None and isinstance(
+                    value, (ast.Tuple, ast.List))
+                    and len(value.elts) == len(elts)):
+                for t, v in zip(elts, value.elts):
+                    self._assign_target(t, self.tainted(v), v)
+                return
+            for t in elts:
+                self._assign_target(t, value_tainted)
+
+    # -- statements --------------------------------------------------------
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self.globals.update(node.names)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._bind(node.name)
+        self.l.child_scope(node, parent_func=self.func,
+                           parent_traced=self.traced,
+                           parent_taint=self.taint,
+                           bound_stack=self.bound_stack,
+                           in_loop=self.in_loop)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        base = (node.name if self.func == "<module>"
+                else f"{self.func}.{node.name}")
+        self.l._walk_scope(node.body, func=base, traced=False,
+                           taint=set(), bound_stack=[],
+                           in_loop=False)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # lambda bodies inherit the traced context (they're almost
+        # always step bodies / attn closures here) — but their
+        # parameter taint is SCOPED to the body: a host variable that
+        # happens to share a lambda param's name must not be flagged
+        # after the lambda
+        if self.traced:
+            saved_taint = set(self.taint)
+            saved_bound = (set(self.bound_stack[-1])
+                           if self.bound_stack else None)
+            for a in node.args.args:
+                self.taint.add(a.arg)
+                self._bind(a.arg)
+            self.visit_expr(node.body)
+            self.taint.clear()
+            self.taint.update(saved_taint)
+            if saved_bound is not None:
+                self.bound_stack[-1].clear()
+                self.bound_stack[-1].update(saved_bound)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self.visit_expr(node.value)
+        vt = self.traced and self.tainted(node.value)
+        for t in node.targets:
+            if isinstance(t, ast.Attribute):
+                self._check_attr_leak(node, t, vt)
+            elif isinstance(t, ast.Subscript):
+                self._check_subscript_leak(node, t, vt)
+            else:
+                self._assign_target(t, vt, node.value)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self.visit_expr(node.value)
+            vt = self.traced and self.tainted(node.value)
+            if isinstance(node.target, ast.Name):
+                self._assign_target(node.target, vt, node.value)
+            elif isinstance(node.target, ast.Attribute):
+                self._check_attr_leak(node, node.target, vt)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self.visit_expr(node.value)
+        vt = self.traced and (self.tainted(node.value)
+                              or self.tainted(node.target))
+        if isinstance(node.target, ast.Name):
+            if vt:
+                self.taint.add(node.target.id)
+            if (self.traced and node.target.id in self.globals
+                    and self.tainted(node.value)):
+                self.l._emit(
+                    "GL005", node, self.func,
+                    f"traced value written to global "
+                    f"`{node.target.id}` — it outlives the trace")
+        elif isinstance(node.target, ast.Attribute):
+            self._check_attr_leak(node, node.target,
+                                  self.tainted(node.value))
+
+    def _check_attr_leak(self, node: ast.AST, target: ast.Attribute,
+                         value_tainted: bool) -> None:
+        if not self.traced or not value_tainted:
+            return
+        base = _dotted(target.value)
+        if base in ("self", "cls"):
+            self.l._emit(
+                "GL005", node, self.func,
+                f"traced value stored on `{base}.{target.attr}` — "
+                f"the tracer outlives the trace (return it instead)")
+
+    def _check_subscript_leak(self, node: ast.AST,
+                              target: ast.Subscript,
+                              value_tainted: bool) -> None:
+        if not self.traced or not value_tainted:
+            return
+        base = target.value
+        if isinstance(base, ast.Name):
+            if (not self._is_bound_in_stack(base.id)
+                    or base.id in self.globals):
+                self.l._emit(
+                    "GL005", node, self.func,
+                    f"traced value stored into `{base.id}[...]`, "
+                    f"which is bound outside the traced scope")
+        elif isinstance(base, ast.Attribute):
+            if _dotted(base.value) in ("self", "cls"):
+                self.l._emit(
+                    "GL005", node, self.func,
+                    f"traced value stored into "
+                    f"`self.{base.attr}[...]` — it outlives the "
+                    f"trace")
+
+    def visit_If(self, node: ast.If) -> None:
+        self.visit_expr(node.test)
+        if self.traced and self.tainted(node.test):
+            self.l._emit(
+                "GL002", node, self.func,
+                "Python `if` on a traced value forces a host sync "
+                "per call — use `jax.lax.cond`/`jnp.where`")
+        for s in node.body:
+            self.visit(s)
+        for s in node.orelse:
+            self.visit(s)
+
+    def visit_While(self, node: ast.While) -> None:
+        self.visit_expr(node.test)
+        if self.traced and self.tainted(node.test):
+            self.l._emit(
+                "GL002", node, self.func,
+                "Python `while` on a traced value — use "
+                "`jax.lax.while_loop`")
+        old = self.in_loop
+        self.in_loop = True
+        for s in node.body:
+            self.visit(s)
+        self.in_loop = old
+        for s in node.orelse:
+            self.visit(s)
+
+    def visit_Assert(self, node: ast.Assert) -> None:
+        self.visit_expr(node.test)
+        if self.traced and self.tainted(node.test):
+            self.l._emit(
+                "GL002", node, self.func,
+                "`assert` on a traced value — use "
+                "`jax.debug.check`/`checkify` or hoist to the host")
+
+    def visit_For(self, node: ast.For) -> None:
+        self.visit_expr(node.iter)
+        tainted_iter = self.traced and self.tainted(node.iter)
+        if self.traced:
+            self._check_set_iteration(node)
+        # enumerate/_with_path: index/path element is host data
+        it = node.iter
+        handled = False
+        if (isinstance(it, ast.Call)
+                and isinstance(node.target, ast.Tuple)
+                and len(node.target.elts) == 2):
+            dn = _dotted(it.func) or ""
+            leaf = dn.split(".")[-1]
+            if leaf == "enumerate" or leaf.endswith("_with_path"):
+                inner_t = (self.traced
+                           and any(self.tainted(a) for a in it.args))
+                self._assign_target(node.target.elts[0], False)
+                self._assign_target(node.target.elts[1], inner_t)
+                handled = True
+        if not handled:
+            self._assign_target(node.target, tainted_iter)
+        old = self.in_loop
+        self.in_loop = True
+        for s in node.body:
+            self.visit(s)
+        self.in_loop = old
+        for s in node.orelse:
+            self.visit(s)
+
+    def _check_set_iteration(self, node: ast.For) -> None:
+        it = node.iter
+        dn = _dotted(it.func) if isinstance(it, ast.Call) else None
+        if isinstance(it, ast.Set) or (
+                dn in ("set", "frozenset")):
+            self.l._emit(
+                "GL004", node, self.func,
+                "iterating a set inside a traced function: pytree "
+                "construction order is nondeterministic across "
+                "processes (sort it first)")
+
+    def visit_With(self, node: ast.With) -> None:
+        for item in node.items:
+            self.visit_expr(item.context_expr)
+            if item.optional_vars is not None:
+                self._assign_target(item.optional_vars, False)
+        for s in node.body:
+            self.visit(s)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if node.value is not None:
+            self.visit_expr(node.value)
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        # a container mutation that LEAKS is a bare statement call
+        # (`acc.append(x)` returns None); a used result means a
+        # functional API that merely shares the name (e.g.
+        # `optimizer.update(...)`)
+        self._stmt_call = (node.value
+                           if isinstance(node.value, ast.Call)
+                           else None)
+        self.visit_expr(node.value)
+        self._stmt_call = None
+
+    def visit_Raise(self, node: ast.Raise) -> None:
+        if node.exc is not None:
+            self.visit_expr(node.exc)
+
+    def visit_Try(self, node: ast.Try) -> None:
+        for s in node.body:
+            self.visit(s)
+        for h in node.handlers:
+            for s in h.body:
+                self.visit(s)
+        for s in node.orelse:
+            self.visit(s)
+        for s in node.finalbody:
+            self.visit(s)
+
+    # -- expressions -------------------------------------------------------
+
+    def visit_expr(self, node: ast.AST) -> None:
+        """Recursive expression scan for call-shaped findings."""
+        if node is None:
+            return
+        for child in ast.walk(node):
+            if isinstance(child, ast.Call):
+                self._check_call(child)
+            elif isinstance(child, ast.Lambda):
+                self.visit_Lambda(child)
+            elif isinstance(child, ast.IfExp):
+                if self.traced and self.tainted(child.test):
+                    self.l._emit(
+                        "GL002", child, self.func,
+                        "ternary on a traced value — use "
+                        "`jnp.where`/`lax.cond`")
+            elif isinstance(child, (ast.ListComp, ast.SetComp,
+                                    ast.DictComp,
+                                    ast.GeneratorExp)):
+                for gen in child.generators:
+                    self._assign_target(
+                        gen.target,
+                        self.traced and self.tainted(gen.iter))
+
+    def _check_call(self, node: ast.Call) -> None:
+        dn = _dotted(node.func)
+        leaf = dn.split(".")[-1] if dn else None
+        root = _root(dn)
+
+        # GL004: jit constructed inside a loop — a fresh jit wrapper
+        # has a fresh cache, so every iteration recompiles
+        if leaf in _JIT_NAMES and root in ("jax", "jit", "pjit"):
+            if self.in_loop:
+                self.l._emit(
+                    "GL004", node, self.func,
+                    "`jax.jit` constructed inside a loop: each "
+                    "wrapper has its own compile cache — hoist it")
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames") \
+                        and isinstance(kw.value, ast.List):
+                    self.l._emit(
+                        "GL004", node, self.func,
+                        f"list-valued `{kw.arg}` — lists are "
+                        f"unhashable; use a tuple")
+
+        if not self.traced:
+            # GL003 applies everywhere (host constants feed compiled
+            # fns as weak-typed operands)
+            self._check_weak_ctor(node, dn, leaf, root)
+            return
+
+        # -- inside a traced function ----------------------------------
+        self._check_weak_ctor(node, dn, leaf, root)
+
+        # GL001: .item()/.tolist() on anything in a traced scope
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ("item", "tolist") \
+                and self.tainted(node.func.value):
+            self.l._emit(
+                "GL001", node, self.func,
+                f"`.{node.func.attr}()` inside a traced function "
+                f"forces a device->host sync per call")
+
+        # GL001: float()/int()/bool()/complex() on a traced value
+        if leaf in ("float", "int", "bool", "complex") \
+                and dn == leaf and node.args \
+                and self.tainted(node.args[0]):
+            self.l._emit(
+                "GL001", node, self.func,
+                f"`{leaf}()` on a traced value — host sync; keep it "
+                f"an array (jnp.float32(...) / astype)")
+
+        # GL001: numpy host ops on traced values
+        if root in ("np", "numpy") and any(
+                self.tainted(a) for a in node.args):
+            self.l._emit(
+                "GL001", node, self.func,
+                f"`{dn}` is a HOST numpy op on a traced value — "
+                f"use the jnp equivalent")
+
+        # GL001: explicit device_get in traced code
+        if dn in ("jax.device_get",):
+            self.l._emit(
+                "GL001", node, self.func,
+                "`jax.device_get` inside a traced function")
+
+        # GL001: print of a traced value
+        if dn == "print" and any(self.tainted(a) for a in node.args):
+            self.l._emit(
+                "GL001", node, self.func,
+                "`print` of a traced value prints a tracer (or "
+                "syncs) — use `jax.debug.print`")
+
+        # GL005: container mutators on names bound OUTSIDE the traced
+        # scope stack (closure/global lists collecting tracers); only
+        # bare statement calls count — a used return value means a
+        # functional API that shares the method name
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS \
+                and node is getattr(self, "_stmt_call", None) \
+                and any(self.tainted(a) for a in node.args):
+            base = node.func.value
+            if isinstance(base, ast.Name) \
+                    and not self._is_bound_in_stack(base.id):
+                self.l._emit(
+                    "GL005", node, self.func,
+                    f"traced value `.{node.func.attr}`-ed into "
+                    f"`{base.id}`, bound outside the traced scope — "
+                    f"it outlives the trace")
+            elif isinstance(base, ast.Attribute) \
+                    and _dotted(base.value) in ("self", "cls"):
+                self.l._emit(
+                    "GL005", node, self.func,
+                    f"traced value `.{node.func.attr}`-ed into "
+                    f"`self.{base.attr}` — it outlives the trace")
+
+    def _check_weak_ctor(self, node: ast.Call, dn: Optional[str],
+                         leaf: Optional[str],
+                         root: Optional[str]) -> None:
+        if root not in ("jnp",) and not (
+                dn and dn.startswith("jax.numpy.")):
+            return
+        if leaf == "arange":
+            # jnp.arange is a device iota wherever it runs; without a
+            # dtype it follows the x64 default — int64/float64 iotas
+            # in op code under jax_enable_x64 (the test env), 2x the
+            # index bandwidth for nothing
+            if not any(kw.arg == "dtype" for kw in node.keywords):
+                self.l._emit(
+                    "GL003", node, self.func,
+                    "`jnp.arange` without `dtype=` follows the x64 "
+                    "default — an int64/float64 iota under "
+                    "jax_enable_x64; pass dtype=jnp.int32 (indices) "
+                    "or the compute dtype")
+            return
+        if leaf not in _WEAK_CTORS:
+            return
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            return
+        argpos = _WEAK_CTORS[leaf]
+        # a positional dtype (jnp.full(shape, v, jnp.f32)) also counts
+        if len(node.args) > argpos + 1:
+            return
+        if len(node.args) <= argpos:
+            return
+        val = node.args[argpos]
+        if isinstance(val, ast.UnaryOp):
+            val = val.operand
+        if isinstance(val, ast.Constant) and isinstance(
+                val.value, (int, float)):
+            self.l._emit(
+                "GL003", node, self.func,
+                f"`{dn}` with a bare Python literal and no `dtype=` "
+                f"is weak-typed — under x64 it lands float64/int64 "
+                f"and poisons downstream dtypes")
+
+    # default: recurse statements, scan expressions
+    def generic_visit(self, node: ast.AST) -> None:
+        if isinstance(node, ast.expr):
+            self.visit_expr(node)
+            return
+        super().generic_visit(node)
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Lint one source string; returns unsuppressed findings."""
+    return Linter(source, path, rules=rules).run()
+
+
+def lint_file(path: str,
+              rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path, rules=rules)

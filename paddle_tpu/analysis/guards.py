@@ -1,0 +1,232 @@
+"""Runtime enforcement of the compiled-execution contract.
+
+graftlint (static) catches the code SHAPES that cause recompiles and
+host round-trips; these guards catch the EVENTS at runtime — in tests
+("the decode loop compiles exactly once and never again"), and
+opted-in around production hot loops (`paddle_tpu serve/train
+--transfer-guard`).
+
+- `RecompileGuard`: counts XLA backend compilations inside a `with`
+  region via `jax.monitoring` duration events
+  (`/jax/core/compile/backend_compile_duration` fires once per real
+  backend compile), falling back to counting the
+  `jax_log_compiles` log stream when the monitoring API is absent.
+  With `jax_log_compiles` available it also records WHAT compiled,
+  so a violation names the offender. `max_compiles=0` (default)
+  makes any compile in the region a `RecompileError` — the
+  steady-state assertion.
+
+- `no_implicit_transfers`: thin wrapper over
+  `jax.transfer_guard("disallow")` — implicit host->device transfers
+  (e.g. feeding a step numpy arrays per call) raise instead of
+  silently re-staging every step. Explicit transfers
+  (`jax.device_put`, `jnp.asarray`, `jax.device_get`) stay allowed:
+  the guard forces the hot loop to NAME its sanctioned transfers.
+  NOTE: on the CPU backend device->host reads are zero-copy and not
+  guarded, so CPU tests exercise the host->device direction only.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+from typing import List, Optional
+
+import jax
+
+
+class RecompileError(RuntimeError):
+    """A guarded steady-state region compiled more than allowed."""
+
+
+class TransferError(RuntimeError):
+    """Reserved for future explicit-transfer accounting; implicit
+    transfer violations surface as jax's own XlaRuntimeError from
+    `jax.transfer_guard` (re-raised unchanged so the device/runtime
+    context is not lost)."""
+
+
+#: process-wide registry of active guards; the monitoring listener is
+#: registered once (jax.monitoring has no per-listener removal) and
+#: fans events out to whoever is currently active
+_active_guards: List["RecompileGuard"] = []
+_registry_lock = threading.Lock()
+_listener_installed = False
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _on_event_duration(name: str, duration: float, **kw) -> None:
+    if name != _COMPILE_EVENT:
+        return
+    with _registry_lock:
+        guards = list(_active_guards)
+    for g in guards:
+        g._count += 1
+
+
+def _install_listener() -> bool:
+    """Register the shared monitoring listener once; False when the
+    monitoring API is unavailable (old jax) — callers fall back to
+    log counting."""
+    global _listener_installed
+    with _registry_lock:
+        if _listener_installed:
+            return True
+        reg = getattr(getattr(jax, "monitoring", None),
+                      "register_event_duration_secs_listener", None)
+        if reg is None:
+            return False
+        reg(_on_event_duration)
+        _listener_installed = True
+        return True
+
+
+class _CompileLogHandler(logging.Handler):
+    """Collects `jax_log_compiles` 'Compiling <name> ...' records:
+    the names make RecompileError actionable, and the count is the
+    fallback when jax.monitoring is missing."""
+
+    def __init__(self) -> None:
+        super().__init__(level=logging.DEBUG)
+        self.names: List[str] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        msg = record.getMessage()
+        if msg.startswith("Compiling "):
+            self.names.append(msg.split(" ", 2)[1])
+
+
+class RecompileGuard:
+    """Assert a region of host code does not trigger XLA compiles.
+
+    >>> step = jax.jit(f)
+    >>> step(x)                          # warmup: the ONE compile
+    >>> with RecompileGuard(name="train step") as g:
+    ...     for _ in range(3):
+    ...         x = step(x)              # steady state: no compiles
+    >>> g.compiles
+    0
+
+    `max_compiles` > 0 allows a known number (e.g. a region expected
+    to compile exactly once: max_compiles=1 plus asserting
+    `g.compiles == 1` afterwards). On violation `__exit__` raises
+    `RecompileError` naming what compiled when jax_log_compiles
+    could see it. Re-entrant use of distinct instances nests fine;
+    one instance is single-use."""
+
+    def __init__(self, max_compiles: int = 0, *,
+                 name: str = "steady-state region"):
+        if max_compiles < 0:
+            raise ValueError(
+                f"max_compiles must be >= 0, got {max_compiles}")
+        self.max_compiles = max_compiles
+        self.name = name
+        self._count = 0
+        self._entered = False
+        self._log_handler: Optional[_CompileLogHandler] = None
+        self._monitored = False
+        self._prev_log_compiles: Optional[bool] = None
+
+    # -- results -----------------------------------------------------------
+
+    @property
+    def compiles(self) -> int:
+        """Backend compiles observed in the region (monitoring count
+        when available, else the compile-log count)."""
+        if self._monitored:
+            return self._count
+        return len(self.compiled_names)
+
+    @property
+    def compiled_names(self) -> List[str]:
+        """Names of computations compiled in the region (needs
+        jax_log_compiles; best-effort)."""
+        return list(self._log_handler.names) if self._log_handler \
+            else []
+
+    # -- context -----------------------------------------------------------
+
+    def __enter__(self) -> "RecompileGuard":
+        if self._entered:
+            raise RuntimeError("RecompileGuard is single-use — make "
+                               "a new one per region")
+        self._entered = True
+        self._monitored = _install_listener()
+        # name collection (and the fallback count) via the compile
+        # log; propagation is parked so jax_log_compiles doesn't spam
+        # the caller's console for the duration
+        self._log_handler = _CompileLogHandler()
+        self._logger = logging.getLogger("jax._src.interpreters.pxla")
+        self._quiet = logging.getLogger("jax._src.dispatch")
+        self._prev_level = self._logger.level
+        self._prev_prop = (self._logger.propagate,
+                           self._quiet.propagate)
+        self._logger.addHandler(self._log_handler)
+        self._logger.propagate = False
+        # a cut-off logger with NO handler falls back to lastResort
+        # (stderr) — park a NullHandler so it truly goes quiet
+        self._null = logging.NullHandler()
+        self._quiet.addHandler(self._null)
+        self._quiet.propagate = False
+        if self._logger.level > logging.WARNING or \
+                self._logger.level == logging.NOTSET:
+            self._logger.setLevel(logging.WARNING)
+        self._prev_log_compiles = bool(
+            jax.config.jax_log_compiles)
+        if not self._prev_log_compiles:
+            jax.config.update("jax_log_compiles", True)
+        with _registry_lock:
+            _active_guards.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        with _registry_lock:
+            if self in _active_guards:
+                _active_guards.remove(self)
+        self._logger.removeHandler(self._log_handler)
+        self._logger.setLevel(self._prev_level)
+        self._logger.propagate = self._prev_prop[0]
+        self._quiet.removeHandler(self._null)
+        self._quiet.propagate = self._prev_prop[1]
+        if not self._prev_log_compiles:
+            jax.config.update("jax_log_compiles", False)
+        if exc_type is not None:
+            return
+        if self.compiles > self.max_compiles:
+            names = self.compiled_names
+            detail = (f": compiled {', '.join(names)}" if names
+                      else " (enable jax_log_compiles for names)")
+            raise RecompileError(
+                f"{self.name} triggered {self.compiles} XLA "
+                f"compile(s), allowed {self.max_compiles}{detail} — "
+                f"a steady-state loop is recompiling (changing "
+                f"shapes/dtypes/static args, or a jit built per "
+                f"call)")
+
+
+@contextlib.contextmanager
+def no_implicit_transfers(level: str = "disallow"):
+    """`with no_implicit_transfers():` — implicit host<->device
+    transfers in the region raise (jax.transfer_guard). `level` may
+    be any jax transfer-guard level ("allow", "log", "disallow",
+    "log_explicit", "disallow_explicit")."""
+    with jax.transfer_guard(level):
+        yield
+
+
+@contextlib.contextmanager
+def steady_state(name: str = "steady-state region", *,
+                 max_compiles: int = 0,
+                 transfers: Optional[str] = "disallow"):
+    """The combined contract for a hot loop: no (re)compiles AND no
+    implicit transfers. The shape the ISSUE's regression tests
+    assert on the decode loop and the train step."""
+    guard = RecompileGuard(max_compiles, name=name)
+    if transfers is None:
+        with guard as g:
+            yield g
+        return
+    with guard as g, jax.transfer_guard(transfers):
+        yield g

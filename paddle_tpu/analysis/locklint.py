@@ -1,0 +1,248 @@
+"""locklint: lock-discipline checker for the threaded native runtimes.
+
+The race-detector shape for our socket servers (`native/pserver.py`,
+`native/taskqueue.py`, `serve/server.py`): a class that guards state
+with `with self._lock:` must guard it EVERYWHERE — an attribute
+mutated both under a held lock and outside one is either a data race
+or an undocumented invariant. locklint flags exactly that (rule
+LK001, reported through the same Finding/baseline machinery as
+graftlint).
+
+Mechanics, per class:
+
+- lock attributes = `self.X = threading.Lock()/RLock()/Condition()`
+  (or `Event` is NOT a lock) assignments anywhere in the class;
+- a mutation is `self.attr = ...` / `self.attr += ...` /
+  `self.attr[k] = ...` / `self.attr.append/add/update/...(...)`;
+- a mutation is LOCKED when it sits lexically inside
+  `with self.<lock>:`, or inside a method annotated
+  `# locklint: holds-lock(reason)` on its `def` line — the
+  annotation is for helpers the class only ever calls with the lock
+  already held (e.g. the pserver request handlers dispatched under
+  `_dispatch`'s lock);
+- `__init__` never counts (construction happens-before publication);
+- LK001 fires on each UNLOCKED mutation site of an attribute that
+  also has LOCKED mutation sites. Suppress per line with
+  `# graftlint: disable=LK001(reason)`.
+
+A class with no lock attribute is never flagged — locklint checks
+discipline against the lock the author chose, it does not demand one.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from paddle_tpu.analysis.graftlint import (Finding, _dotted,
+                                           _is_suppressed,
+                                           _suppressions)
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore"}
+_MUTATORS = {"append", "extend", "insert", "add", "discard", "remove",
+             "pop", "popleft", "appendleft", "clear", "update",
+             "setdefault", "__setitem__"}
+# the reason must START on the annotation line (non-empty); it may
+# run onto the next comment line before its closing paren
+_HOLDS_RE = re.compile(
+    r"locklint:\s*holds-lock\s*(?:\((\s*[^)\s][^)]*)\)?)?")
+
+
+@dataclasses.dataclass
+class _Site:
+    attr: str
+    line: int
+    col: int
+    method: str
+    locked: bool
+    node: ast.AST
+
+
+def _holds_lock_lines(source: str) -> Set[int]:
+    """Lines carrying a `# locklint: holds-lock(reason)` comment (the
+    reason is required, same contract as disable comments)."""
+    out: Set[int] = set()
+    try:
+        for tok in tokenize.generate_tokens(
+                io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _HOLDS_RE.search(tok.string)
+            if m and (m.group(1) or "").strip():
+                out.add(tok.start[0])
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+class _MethodScanner(ast.NodeVisitor):
+    """Collect mutation sites of self-attributes inside one method,
+    tracking lexical `with self.<lock>` nesting."""
+
+    def __init__(self, lock_names: Set[str], method: str,
+                 holds_lock: bool):
+        self.lock_names = lock_names
+        self.method = method
+        self.lock_depth = 1 if holds_lock else 0
+        self.sites: List[_Site] = []
+
+    def _self_attr(self, node: ast.AST) -> Optional[str]:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def _record(self, attr: Optional[str], node: ast.AST) -> None:
+        if attr is None or attr in self.lock_names:
+            return
+        self.sites.append(_Site(
+            attr=attr, line=node.lineno, col=node.col_offset,
+            method=self.method, locked=self.lock_depth > 0,
+            node=node))
+
+    def visit_With(self, node: ast.With) -> None:
+        holds = False
+        for item in node.items:
+            ctx = item.context_expr
+            attr = self._self_attr(ctx)
+            if attr is None and isinstance(ctx, ast.Call):
+                attr = self._self_attr(ctx.func)  # self._cv.acquire()?
+            if attr in self.lock_names:
+                holds = True
+        if holds:
+            self.lock_depth += 1
+        self.generic_visit(node)
+        if holds:
+            self.lock_depth -= 1
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            self._record(self._self_attr(t), node)
+            if isinstance(t, ast.Subscript):
+                self._record(self._self_attr(t.value), node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record(self._self_attr(node.target), node)
+        if isinstance(node.target, ast.Subscript):
+            self._record(self._self_attr(node.target.value), node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record(self._self_attr(node.target), node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._record(self._self_attr(t), node)
+            if isinstance(t, ast.Subscript):
+                self._record(self._self_attr(t.value), node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            self._record(self._self_attr(node.func.value), node)
+        self.generic_visit(node)
+
+    # nested defs run on other stacks/contexts; scanned separately
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _class_lock_names(cls: ast.ClassDef) -> Set[str]:
+    names: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        dn = _dotted(node.value.func) or ""
+        if dn.split(".")[-1] not in _LOCK_CTORS:
+            continue
+        for t in node.targets:
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                names.add(t.attr)
+    return names
+
+
+def lint_locks_source(source: str, path: str = "<string>"
+                      ) -> List[Finding]:
+    """LK001 findings for one file (unsuppressed only)."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []
+    supp = _suppressions(source)
+    holds_lines = _holds_lock_lines(source)
+    src_lines = source.splitlines()
+
+    def _annotated(meth: ast.FunctionDef) -> bool:
+        """holds-lock applies on the def line, between the def line
+        and the first body statement, or in the contiguous
+        comment-block directly above the def (decorator position)."""
+        for ln in range(meth.lineno, meth.body[0].lineno + 1):
+            if ln in holds_lines:
+                return True
+        ln = meth.lineno - 1
+        while ln >= 1 and src_lines[ln - 1].lstrip().startswith("#"):
+            if ln in holds_lines:
+                return True
+            ln -= 1
+        return False
+
+    findings: List[Finding] = []
+    for cls in [n for n in ast.walk(tree)
+                if isinstance(n, ast.ClassDef)]:
+        lock_names = _class_lock_names(cls)
+        if not lock_names:
+            continue
+        sites: List[_Site] = []
+        for meth in [n for n in cls.body
+                     if isinstance(n, ast.FunctionDef)]:
+            if meth.name == "__init__":
+                continue
+            sc = _MethodScanner(lock_names, meth.name,
+                                _annotated(meth))
+            for stmt in meth.body:
+                sc.visit(stmt)
+            sites.extend(sc.sites)
+        by_attr: Dict[str, List[_Site]] = {}
+        for s in sites:
+            by_attr.setdefault(s.attr, []).append(s)
+        for attr, ss in sorted(by_attr.items()):
+            locked = [s for s in ss if s.locked]
+            unlocked = [s for s in ss if not s.locked]
+            if not locked or not unlocked:
+                continue
+            lock_desc = "/".join(sorted(lock_names))
+            for s in unlocked:
+                f = Finding(
+                    "LK001", path, s.line, s.col,
+                    f"{cls.name}.{s.method}",
+                    f"`self.{attr}` mutated WITHOUT `self."
+                    f"{lock_desc}` held, but also mutated under it "
+                    f"(e.g. {cls.name}.{locked[0].method}:"
+                    f"{locked[0].line}) — lock it, or annotate the "
+                    f"method `# locklint: holds-lock(reason)`")
+                if _is_suppressed(f, s.node, supp, src_lines):
+                    continue
+                findings.append(f)
+    return findings
+
+
+def lint_locks(path: str) -> List[Finding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_locks_source(f.read(), path)

@@ -21,11 +21,24 @@ A config script is a Python file defining `get_config()` returning a dict:
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import runpy
 import sys
 import time
 from typing import Optional
+
+
+def _transfer_guard(enabled: bool):
+    """Opt-in runtime enforcement for the hot loop (`--transfer-guard`,
+    docs/ANALYSIS.md): implicit host<->device transfers raise instead
+    of silently re-staging every step. Explicit staging
+    (jax.device_put / jnp.asarray of numpy arrays) stays allowed."""
+    if not enabled:
+        return contextlib.nullcontext()
+    from paddle_tpu.analysis.guards import no_implicit_transfers
+
+    return no_implicit_transfers()
 
 
 def _load_config(path: str) -> dict:
@@ -128,6 +141,13 @@ def cmd_train(args) -> int:
         raise SystemExit("config provides no 'reader' for training")
     feeder = data_mod.DataFeeder()
     batches = lambda: feeder(data_mod.batch_reader(reader, args.batch_size))
+    if args.transfer_guard:
+        # the input feed is the hot loop's ONE sanctioned transfer —
+        # stage it explicitly so `disallow` holds for everything else
+        import jax
+
+        raw_batches = batches
+        batches = lambda: (jax.device_put(b) for b in raw_batches())
 
     t0 = time.time()
 
@@ -155,15 +175,18 @@ def cmd_train(args) -> int:
             lr_backoff=args.lr_backoff,
             watchdog_timeout_s=args.watchdog_timeout)
         try:
-            state = rt.run(state, batches, num_passes=num_passes,
-                           event_handler=handler)
+            with _transfer_guard(args.transfer_guard):
+                state = rt.run(state, batches, num_passes=num_passes,
+                               event_handler=handler)
         except Preempted as p:
             print(f"preempted: checkpoint saved at step {p.step}; "
                   f"re-run to resume")
             return 143   # 128 + SIGTERM: the scheduler restarts us
     else:
-        state = trainer.train(
-            state, batches, num_passes=num_passes, event_handler=handler)
+        with _transfer_guard(args.transfer_guard):
+            state = trainer.train(
+                state, batches, num_passes=num_passes,
+                event_handler=handler)
     if args.save_dir:
         import os
 
@@ -284,11 +307,13 @@ def cmd_serve(args) -> int:
                 or args.default_deadline_ms is not None)
     try:
         if reliable:
-            return _serve_reliable(args, eng, prompts, sampling,
-                                   buckets, sink)
-        out = eng.serve(prompts, max_new=args.max_new, buckets=buckets,
-                        sampling=sampling,
-                        return_logprobs=args.logprobs)
+            with _transfer_guard(args.transfer_guard):
+                return _serve_reliable(args, eng, prompts, sampling,
+                                       buckets, sink)
+        with _transfer_guard(args.transfer_guard):
+            out = eng.serve(prompts, max_new=args.max_new,
+                            buckets=buckets, sampling=sampling,
+                            return_logprobs=args.logprobs)
         toks, lps = out if args.logprobs else (out, None)
         for i, g in enumerate(toks):
             print(" ".join(str(t) for t in g), file=sink)
@@ -482,6 +507,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="abort (exit 75) if no step completes for this "
                         "many seconds — bounds wedged-collective hangs")
     t.add_argument("--seed", type=int, default=0)
+    t.add_argument("--transfer-guard", action="store_true",
+                   help="enforce jax.transfer_guard('disallow') "
+                        "around the train loop: implicit host<->device"
+                        " transfers raise; batches are device_put "
+                        "explicitly (docs/ANALYSIS.md)")
     t.add_argument("--coordinator", default=None,
                    help="host:port of process 0 for multi-host jobs")
     t.add_argument("--num-processes", type=int, default=None)
@@ -551,6 +581,11 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--drain-report", default=None,
                     help="write the drain report JSON here on "
                          "graceful shutdown")
+    sv.add_argument("--transfer-guard", action="store_true",
+                    help="enforce jax.transfer_guard('disallow') "
+                         "around the decode loop: implicit "
+                         "host<->device transfers raise "
+                         "(docs/ANALYSIS.md)")
     sv.set_defaults(fn=cmd_serve)
 
     ms = sub.add_parser("master")

@@ -50,7 +50,8 @@ def bow_lr_from_tokens(params, tokens, lengths):
     bow input)."""
     b, t = tokens.shape
     rows = jnp.take(params["fc"]["kernel"], tokens, axis=0)  # [B, T, C]
-    mask = (jnp.arange(t)[None, :] < lengths[:, None])[..., None]
+    mask = (jnp.arange(
+        t, dtype=jnp.int32)[None, :] < lengths[:, None])[..., None]
     return jnp.sum(jnp.where(mask, rows, 0.0), axis=1) + params["fc"]["bias"]
 
 

@@ -152,7 +152,7 @@ def teacher_forced_hidden(params, src_tokens, src_lengths, tgt_in):
     b, s = src_tokens.shape
     enc_out, h0 = encode(params, src_tokens, src_lengths)
     enc_proj = project_encoder(params, enc_out)  # hoisted
-    enc_mask = jnp.arange(s)[None, :] < src_lengths[:, None]
+    enc_mask = jnp.arange(s, dtype=jnp.int32)[None, :] < src_lengths[:, None]
     emb = jnp.take(params["tgt_embed"], tgt_in, axis=0)  # [B, T, E]
     hs, _ = decoder_group(h0.shape[-1], emit="hidden").run(
         params, emb, boots={"h": h0},
@@ -193,7 +193,8 @@ def loss(params, src_tokens, src_lengths, tgt_tokens, tgt_lengths, *,
         logits = teacher_forced_logits(params, src_tokens, src_lengths,
                                        tgt_in)
         ce = losses.softmax_cross_entropy(logits, tgt_tokens)  # [B, T]
-    mask = (jnp.arange(t)[None, :] < tgt_lengths[:, None]).astype(ce.dtype)
+    mask = (jnp.arange(
+        t, dtype=jnp.int32)[None, :] < tgt_lengths[:, None]).astype(ce.dtype)
     return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
@@ -204,7 +205,7 @@ def generate(params, src_tokens, src_lengths, *, beam_size: int = 4,
     b, s = src_tokens.shape
     enc_out, h0 = encode(params, src_tokens, src_lengths)
     enc_proj = project_encoder(params, enc_out)
-    enc_mask = jnp.arange(s)[None, :] < src_lengths[:, None]
+    enc_mask = jnp.arange(s, dtype=jnp.int32)[None, :] < src_lengths[:, None]
     vocab = params["out"]["kernel"].shape[1]
     return decoder_group(h0.shape[-1]).generate(
         params,
@@ -228,7 +229,7 @@ def greedy_generate(params, src_tokens, src_lengths, *, max_len: int = 20,
     b, s = src_tokens.shape
     enc_out, h0 = encode(params, src_tokens, src_lengths)
     enc_proj = project_encoder(params, enc_out)
-    enc_mask = jnp.arange(s)[None, :] < src_lengths[:, None]
+    enc_mask = jnp.arange(s, dtype=jnp.int32)[None, :] < src_lengths[:, None]
     return decoder_group(h0.shape[-1]).generate(
         params,
         embed_fn=lambda toks: jnp.take(params["tgt_embed"], toks, axis=0),

@@ -217,8 +217,9 @@ def _dense_attention(q, k, v, causal: bool, key_mask=None,
         tq, tk = scores.shape[-2], scores.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), bool), tk - tq)
         if window is not None:
-            qpos = jnp.arange(tq)[:, None] + (tk - tq)
-            mask = mask & (qpos - jnp.arange(tk)[None, :] < window)
+            qpos = jnp.arange(tq, dtype=jnp.int32)[:, None] + (tk - tq)
+            mask = mask & (qpos - jnp.arange(
+                tk, dtype=jnp.int32)[None, :] < window)
         scores = jnp.where(mask, scores, -1e30)
     if key_mask is not None:
         scores = jnp.where(key_mask[:, None, None, :], scores, -1e30)
@@ -273,7 +274,8 @@ def _attention(cfg: TransformerConfig, q, k, v, causal: bool,
     # implementation decides both masked and unmasked prefills;
     # lens-only callers get the equivalent right-padding mask here
     if key_mask is None and key_lens is not None:
-        key_mask = jnp.arange(k.shape[1])[None, :] < key_lens[:, None]
+        key_mask = jnp.arange(
+            k.shape[1], dtype=jnp.int32)[None, :] < key_lens[:, None]
     return _dense_attention(q, k, v, causal, key_mask, window)
 
 
@@ -363,7 +365,7 @@ def _forward(params, cfg: TransformerConfig, tokens, positions=None,
     x = x.astype(policy.compute_dtype)
     if positions is None:
         positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1]), tokens.shape)
+            jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape)
     blk = _block
     if cfg.remat:
         # cfg and attn_fn are static (non-pytree) arguments
@@ -391,7 +393,8 @@ def loss(params, cfg: TransformerConfig, tokens, lengths=None,
     CE term AND of MoE expert capacity/aux accounting."""
     tmask = None
     if lengths is not None:
-        tmask = jnp.arange(tokens.shape[1] - 1)[None, :] < lengths[:, None]
+        tmask = jnp.arange(
+            tokens.shape[1] - 1, dtype=jnp.int32)[None, :] < lengths[:, None]
     targets = tokens[:, 1:]
     if cfg.fused_ce_chunk:
         hid, aux = _forward(params, cfg, tokens[:, :-1], token_mask=tmask,
@@ -409,7 +412,8 @@ def loss(params, cfg: TransformerConfig, tokens, lengths=None,
     if lengths is None:
         ce = jnp.mean(nll)
     else:
-        mask = jnp.arange(1, tokens.shape[1])[None, :] < lengths[:, None]
+        mask = jnp.arange(
+            1, tokens.shape[1], dtype=jnp.int32)[None, :] < lengths[:, None]
         ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
     if cfg.moe_experts > 0:
         ce = ce + cfg.moe_aux_weight * aux
@@ -424,7 +428,8 @@ def score(params, cfg: TransformerConfig, tokens, lengths=None):
     tmask = None
     if lengths is not None:
         # pads must not claim MoE expert capacity (same as loss())
-        tmask = jnp.arange(tokens.shape[1] - 1)[None, :] < lengths[:, None]
+        tmask = jnp.arange(
+            tokens.shape[1] - 1, dtype=jnp.int32)[None, :] < lengths[:, None]
     targets = tokens[:, 1:]
     if cfg.fused_ce_chunk:
         # gold log-prob is exactly -(nll): the chunked scan gives it
@@ -445,7 +450,8 @@ def score(params, cfg: TransformerConfig, tokens, lengths=None):
     if lengths is None:
         mask = jnp.ones_like(gold, bool)
     else:
-        mask = jnp.arange(1, tokens.shape[1])[None, :] < lengths[:, None]
+        mask = jnp.arange(
+            1, tokens.shape[1], dtype=jnp.int32)[None, :] < lengths[:, None]
     gold = jnp.where(mask, gold, 0.0)
     n = jnp.maximum(jnp.sum(mask, axis=1), 1)
     return gold, -jnp.sum(gold, axis=1) / n
@@ -522,7 +528,7 @@ def _prefill_kv(params, cfg: TransformerConfig, toks, total: int):
     b, w = toks.shape
     x = jnp.take(params["embed"]["table"], toks, axis=0)
     x = x.astype(policy.compute_dtype)
-    pos = jnp.broadcast_to(jnp.arange(w), (b, w))
+    pos = jnp.broadcast_to(jnp.arange(w, dtype=jnp.int32), (b, w))
     caches = []
     for blk in params["blocks"]:
         x, k, v, _ = _block_parts(
@@ -544,11 +550,11 @@ def _window_forward(p, c: TransformerConfig, caches, toks, start, total):
     w = toks.shape[1]
     x = jnp.take(p["embed"]["table"], toks, axis=0)
     x = x.astype(policy.compute_dtype)
-    pos = start + jnp.arange(w)[None, :]
-    ar = jnp.arange(total)[None, :]
+    pos = start + jnp.arange(w, dtype=jnp.int32)[None, :]
+    ar = jnp.arange(total, dtype=jnp.int32)[None, :]
     # window position j sees cache slots <= start + j (and within the
     # sliding-attention band when configured)
-    qpos = (start + jnp.arange(w))[None, :, None]
+    qpos = (start + jnp.arange(w, dtype=jnp.int32))[None, :, None]
     if c.attn_window is not None:
         valid = _band_valid(ar[None, :, :], qpos, c.attn_window)
     else:
@@ -582,7 +588,7 @@ def _ring_slot_valid(pos, window: int):
     be a scalar (lockstep scan) or [S] (per-row pool). Returns
     (write_slot like pos, valid [..., window])."""
     p = jnp.asarray(pos)
-    arw = jnp.arange(window)
+    arw = jnp.arange(window, dtype=jnp.int32)
     held = p[..., None] - jnp.mod(p[..., None] - arw, window)
     return jnp.mod(p, window), held >= 0
 
@@ -625,7 +631,7 @@ def _cached_attention(q, k, v, k_buf, v_buf, t, valid):
     b, tq, h, dh = q.shape
     if getattr(t, "ndim", 0) == 1:
         assert tq == 1, "per-row slot writes require single-position q"
-        rows = jnp.arange(b)
+        rows = jnp.arange(b, dtype=jnp.int32)
 
         def write(buf, new):
             return buf.at[rows, t].set(
@@ -735,12 +741,13 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
     # each layer's rotated K/V captured into fixed-size cache buffers
     x = jnp.take(params["embed"]["table"], prompt, axis=0)
     x = x.astype(policy.compute_dtype)
-    pos = jnp.broadcast_to(jnp.arange(t0), (b, t0))
+    pos = jnp.broadcast_to(jnp.arange(t0, dtype=jnp.int32), (b, t0))
     if prompt_lens is None:
         key_ok = None
         prefill_attn = lambda q, k, v: _attention(cfg, q, k, v, causal=True)
     else:
-        key_ok = jnp.arange(t0)[None, :] < prompt_lens[:, None]  # [B, Tk]
+        key_ok = jnp.arange(
+            t0, dtype=jnp.int32)[None, :] < prompt_lens[:, None]  # [B, Tk]
         # key_ok itself only feeds the MoE token mask below; attention
         # takes the lens encoding (flash per-row bound, dense builds
         # the equivalent right-padding mask internally)
@@ -756,7 +763,7 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
             # keep only the last `window` prompt positions, each in its
             # ring slot p mod window (a permutation for consecutive p)
             lo = max(0, t0 - cache_len)
-            slots_init = jnp.arange(lo, t0) % cache_len
+            slots_init = jnp.arange(lo, t0, dtype=jnp.int32) % cache_len
             k_buf = jnp.zeros((b, cache_len) + k.shape[2:], k.dtype) \
                 .at[:, slots_init].set(k[:, lo:t0])
             v_buf = jnp.zeros((b, cache_len) + v.shape[2:], v.dtype) \
@@ -795,7 +802,7 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
             pos = jnp.broadcast_to(t[None, None], (b, 1))
         else:
             pos = (prompt_lens.astype(jnp.int32) + s)[:, None]
-        ar = jnp.arange(total)
+        ar = jnp.arange(total, dtype=jnp.int32)
         slot = t
         if prompt_lens is None:
             if rolling:
@@ -835,7 +842,7 @@ def generate(params, cfg: TransformerConfig, prompt, steps: int, *,
 
     _, toks = jax.lax.scan(
         step, (first, jnp.asarray(t0, jnp.int32), caches, rng, done0),
-        jnp.arange(steps), length=steps)
+        jnp.arange(steps, dtype=jnp.int32), length=steps)
     # emitted = [first, t1, ..., t_{steps-1}]: exactly the new tokens
     return jnp.concatenate([prompt, toks.transpose(1, 0)], axis=1)
 
@@ -909,7 +916,7 @@ def speculative_generate(params, cfg: TransformerConfig,
                              total)
     out_buf = jnp.zeros((b, total), prompt.dtype).at[:, :t0].set(prompt)
     t_end = t0 + steps
-    karange = jnp.arange(draft_k + 1)
+    karange = jnp.arange(draft_k + 1, dtype=jnp.int32)
 
     def row_round(t, done, rounds, out_row, tgt_c, dft_c):
         """One speculative round for ONE row. Runs under vmap: every
@@ -942,7 +949,7 @@ def speculative_generate(params, cfg: TransformerConfig,
             return (dft, nxt), nxt
 
         (dft1, _), more = jax.lax.scan(
-            draft_step, (dft1, d0), jnp.arange(draft_k - 1))
+            draft_step, (dft1, d0), jnp.arange(draft_k - 1, dtype=jnp.int32))
         drafts = jnp.concatenate(
             [d0[None, :], more], axis=0).transpose(1, 0)   # [1, K]
 
@@ -997,7 +1004,7 @@ def speculative_generate(params, cfg: TransformerConfig,
         # finished rows: everything from their stop point on is fill —
         # generate()'s post-eos semantics, so the hard-equality test
         # covers the padding too
-        col = jnp.arange(total)[None, :]
+        col = jnp.arange(total, dtype=jnp.int32)[None, :]
         out_buf = jnp.where(done[:, None] & (col >= t[:, None]),
                             jnp.asarray(fill, out_buf.dtype), out_buf)
     if return_stats:
@@ -1058,7 +1065,7 @@ def speculative_sample(params, cfg: TransformerConfig,
                              total)
     out_buf = jnp.zeros((b, total), prompt.dtype).at[:, :t0].set(prompt)
     t_end = t0 + steps
-    karange = jnp.arange(draft_k + 1)
+    karange = jnp.arange(draft_k + 1, dtype=jnp.int32)
 
     def filt_logp(logits):
         """Filtered log-distribution [N, V] — the ONE distribution both
@@ -1097,7 +1104,7 @@ def speculative_sample(params, cfg: TransformerConfig,
             return (dft, nxt), (nxt, q[0])
 
         (dft1, _), (more, qmore) = jax.lax.scan(
-            draft_step, (dft1, d0), jnp.arange(draft_k - 1))
+            draft_step, (dft1, d0), jnp.arange(draft_k - 1, dtype=jnp.int32))
         drafts = jnp.concatenate([d0[None, :], more],
                                  axis=0).transpose(1, 0)   # [1, K]
         qdist = jnp.concatenate([q0, qmore], axis=0)       # [K, V]
@@ -1167,7 +1174,7 @@ def speculative_sample(params, cfg: TransformerConfig,
          jnp.zeros((b,), jnp.int32), jax.random.split(rng, b),
          out_buf, tgt_caches, dft_caches))
     if eos_id is not None:
-        col = jnp.arange(total)[None, :]
+        col = jnp.arange(total, dtype=jnp.int32)[None, :]
         out_buf = jnp.where(done[:, None] & (col >= t[:, None]),
                             jnp.asarray(fill, out_buf.dtype), out_buf)
     if return_stats:
@@ -1242,10 +1249,11 @@ def beam_decode(params, cfg: TransformerConfig, prompt, steps: int,
         pos = jnp.broadcast_to(t[None, None], (toks.shape[0], 1))
         new_dec = {"t": dec["t"] + 1}
         if cfg.attn_window is not None:
-            valid = _band_valid(jnp.arange(total), t,
+            valid = _band_valid(jnp.arange(total, dtype=jnp.int32), t,
                                 cfg.attn_window)[None, None, None, :]
         else:
-            valid = (jnp.arange(total) <= t)[None, None, None, :]
+            valid = (jnp.arange(
+                total, dtype=jnp.int32) <= t)[None, None, None, :]
         for i in range(len(p_full["blocks"])):
             k_buf, v_buf = dec[f"k{i}"], dec[f"v{i}"]
 
@@ -1299,7 +1307,8 @@ def _filter_logits(logits, temperature, top_k, top_p):
             k_eff = min(top_k, logits.shape[-1])
             kth = desc[:, k_eff - 1][:, None]
             logits = jnp.where(logits >= kth, logits, -jnp.inf)
-            desc = jnp.where(jnp.arange(desc.shape[-1])[None, :] <
+            desc = jnp.where(jnp.arange(
+                desc.shape[-1], dtype=jnp.int32)[None, :] <
                              k_eff, desc, -jnp.inf)
         if top_p is not None:
             probs = jax.nn.softmax(desc, axis=-1)
@@ -1327,7 +1336,8 @@ def per_row_filter_logits(logits, temperature, top_k, top_p):
     k_eff = jnp.clip(top_k, 1, v)
     kth = jnp.take_along_axis(desc, (k_eff - 1)[:, None], axis=-1)
     x = jnp.where(x >= kth, x, -jnp.inf)
-    desc = jnp.where(jnp.arange(v)[None, :] < k_eff[:, None], desc,
+    desc = jnp.where(jnp.arange(
+        v, dtype=jnp.int32)[None, :] < k_eff[:, None], desc,
                      -jnp.inf)
     probs = jax.nn.softmax(desc, axis=-1)
     cum = jnp.cumsum(probs, axis=-1) - probs

@@ -69,7 +69,8 @@ def loss_nce(params, context_ids, next_ids, rng, *, num_noise: int = 16):
         rng, num_noise, vocab, shape=(context_ids.shape[0],))
     per_ex = sampling.nce_loss(
         params["out"]["kernel"], params["out"]["bias"], h, next_ids, noise,
-        noise_probs=sampling.log_uniform_prob(jnp.arange(vocab), vocab))
+        noise_probs=sampling.log_uniform_prob(jnp.arange(
+            vocab, dtype=jnp.int32), vocab))
     return jnp.mean(per_ex)
 
 

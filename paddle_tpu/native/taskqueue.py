@@ -561,13 +561,20 @@ class HAMaster:
                 shutil.copyfile(local_tmp, shared_tmp)  # slow: off-lock
                 final = os.path.join(self.dir, name)
                 os.replace(shared_tmp, final)
-            except BaseException:
+            except BaseException as e:
                 # don't leak a partial in the shared dir (a quota-full
                 # dir of dead .tmp files would keep snapshots failing)
                 try:
                     os.unlink(shared_tmp)
                 except OSError:
                     pass
+                # record the durability gap HERE, under the snapshot
+                # lock — previously the cadence thread wrote
+                # last_snapshot_error unlocked (a stale failure could
+                # overwrite a newer success), and a failed MANUAL
+                # checkpoint() never recorded it at all
+                if isinstance(e, OSError):
+                    self.last_snapshot_error = str(e)
                 raise
             finally:
                 try:
@@ -601,10 +608,10 @@ class HAMaster:
             try:
                 self.checkpoint()
             except OSError as e:
-                # keep retrying, but make the durability gap VISIBLE:
-                # persistent failure means recovery would restore stale
-                # state (see last_snapshot_time/error)
-                self.last_snapshot_error = str(e)
+                # keep retrying; checkpoint() already recorded the
+                # durability gap (last_snapshot_error, under its
+                # lock) — persistent failure means recovery would
+                # restore stale state
                 logging.getLogger(__name__).warning(
                     "HAMaster snapshot to %s failed: %s", self.dir, e)
 

@@ -170,7 +170,7 @@ class RecurrentGroup:
         if lengths is None:
             mask = jnp.ones((b, t), bool)
         else:
-            mask = jnp.arange(t)[None, :] < lengths[:, None]
+            mask = jnp.arange(t, dtype=jnp.int32)[None, :] < lengths[:, None]
 
         xs_tm = tuple(jnp.swapaxes(x, 0, 1) for x in xs)  # [T, B, ...]
         mask_tm = jnp.swapaxes(mask, 0, 1)

@@ -266,7 +266,7 @@ class NCE(Layer):
             shape=(hidden.shape[0],))
         noise_probs = None
         if self.use_correction:
-            ids = jnp.arange(self.num_classes)
+            ids = jnp.arange(self.num_classes, dtype=jnp.int32)
             noise_probs = sampling_ops.log_uniform_prob(
                 ids, self.num_classes)
         loss = sampling_ops.nce_loss(
@@ -310,7 +310,8 @@ class AdditiveAttention(Layer):
             + linalg.matmul(keys, params["w_keys"]))
         scores = linalg.matmul(proj, params["v"])[..., 0]  # [B, S]
         if lengths is not None:
-            mask = jnp.arange(keys.shape[1])[None, :] < lengths[:, None]
+            mask = jnp.arange(
+                keys.shape[1], dtype=jnp.int32)[None, :] < lengths[:, None]
             scores = jnp.where(mask, scores, -1e30)
         weights = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
         ctx = jnp.einsum("bs,bsf->bf", weights, keys.astype(weights.dtype))
@@ -559,8 +560,9 @@ def _gather_window(x, starts, sizes, k: int):
     a dense ragged batch, zero-masked beyond size and the batch's T.
     Shared by SubSequence and SequenceSlice."""
     b, t, f = x.shape
-    pos = jnp.arange(k)[None, :] + starts[:, None]
-    valid = (jnp.arange(k)[None, :] < sizes[:, None]) & (pos < t)
+    pos = jnp.arange(k, dtype=jnp.int32)[None, :] + starts[:, None]
+    valid = (jnp.arange(
+        k, dtype=jnp.int32)[None, :] < sizes[:, None]) & (pos < t)
     safe = jnp.clip(pos, 0, t - 1)
     out = jnp.take_along_axis(x, safe[..., None], axis=1)
     return out * valid[..., None].astype(out.dtype)
@@ -762,7 +764,8 @@ class SequenceReshape(Layer):
             new_lengths = lengths * f // self.new_dim
         # zero everything past each sequence's new length so no stale
         # token data leaks to consumers that ignore lengths
-        valid = jnp.arange(t_new)[None, :] < new_lengths[:, None]
+        valid = jnp.arange(
+            t_new, dtype=jnp.int32)[None, :] < new_lengths[:, None]
         return (out * valid[..., None].astype(out.dtype), new_lengths), {}
 
 
@@ -787,7 +790,8 @@ class SequenceConcat(Layer):
         bsz, ta, f = a.shape
         tb = b.shape[1]
         t_out = ta + tb
-        pos = jnp.arange(t_out)[None, :]                    # [1, T]
+        pos = jnp.arange(
+            t_out, dtype=jnp.int32)[None, :]                    # [1, T]
         from_a = pos < la[:, None]
         b_idx = jnp.clip(pos - la[:, None], 0, tb - 1)
         a_idx = jnp.clip(pos, 0, ta - 1)

@@ -73,7 +73,8 @@ def beam_search(
         # only beam 0 is live at step 0 so identical first expansions
         # don't fill the beam with duplicates
         scores=jnp.tile(
-            jnp.where(jnp.arange(k) == 0, 0.0, NEG_INF)[None, :], (b, 1)
+            jnp.where(jnp.arange(
+                k, dtype=jnp.int32) == 0, 0.0, NEG_INF)[None, :], (b, 1)
         ),
         finished=jnp.zeros((b, k), bool),
         decoder_state=jax.tree.map(tile_to_beams, init_decoder_state),
@@ -215,7 +216,7 @@ def cross_entropy_over_beam(step_scores, parents, gold_pos):
     logsumexp(paths + gold-extra) - gold_path_score.
     """
     e, b, k = step_scores.shape
-    barange = jnp.arange(b)
+    barange = jnp.arange(b, dtype=jnp.int32)
 
     # final-step paths: accumulate ancestry scores (E is static/small)
     acc = step_scores[-1]
@@ -227,7 +228,8 @@ def cross_entropy_over_beam(step_scores, parents, gold_pos):
     # gold path score: sum of its per-step scores while it survives
     in_beam = gold_pos >= 0                                  # [E, B]
     safe_pos = jnp.maximum(gold_pos, 0)
-    gold_step = step_scores[jnp.arange(e)[:, None], barange[None, :],
+    gold_step = step_scores[jnp.arange(
+        e, dtype=jnp.int32)[:, None], barange[None, :],
                             safe_pos]                        # [E, B]
     gold_score = jnp.sum(jnp.where(in_beam, gold_step, 0.0), axis=0)
 

@@ -617,10 +617,12 @@ def max_pool2d_with_index(x, window: IntOr2 = 2, *,
     vals = patches.reshape(n, oh, ow, c, wh * ww)
     (ph0, _), (pw0, _) = explicit_pad(h, w, (wh, ww), (sh, sw), padding)
     # absolute source coordinates of every window cell: [OH/OW, wh*ww]
-    r = jnp.arange(wh * ww) // ww
-    s = jnp.arange(wh * ww) % ww
-    abs_h = jnp.arange(oh)[:, None] * sh - ph0 + r[None, :]   # [OH, K]
-    abs_w = jnp.arange(ow)[:, None] * sw - pw0 + s[None, :]   # [OW, K]
+    r = jnp.arange(wh * ww, dtype=jnp.int32) // ww
+    s = jnp.arange(wh * ww, dtype=jnp.int32) % ww
+    abs_h = jnp.arange(
+        oh, dtype=jnp.int32)[:, None] * sh - ph0 + r[None, :]   # [OH, K]
+    abs_w = jnp.arange(
+        ow, dtype=jnp.int32)[:, None] * sw - pw0 + s[None, :]   # [OW, K]
     valid = ((abs_h >= 0) & (abs_h < h))[None, :, None, None, :] & \
         ((abs_w >= 0) & (abs_w < w))[None, None, :, None, :]
     fill = (jnp.array(-jnp.inf, x.dtype)

@@ -37,7 +37,7 @@ def init_crf_params(rng, num_tags: int, scale: float = 0.1) -> CRFParams:
 
 
 def _mask(lengths, t):
-    return jnp.arange(t)[None, :] < lengths[:, None]
+    return jnp.arange(t, dtype=jnp.int32)[None, :] < lengths[:, None]
 
 
 def crf_log_norm(params: CRFParams, emissions, lengths):
@@ -104,7 +104,8 @@ def crf_decode(params: CRFParams, emissions, lengths) -> Tuple[jnp.ndarray, jnp.
         new_delta = jnp.max(scores, axis=1) + emit_t
         delta_out = jnp.where(m_t[:, None], new_delta, delta)
         # where masked, backpointer = identity (carry tag through)
-        ident = jnp.broadcast_to(jnp.arange(n)[None, :], (b, n))
+        ident = jnp.broadcast_to(jnp.arange(
+            n, dtype=jnp.int32)[None, :], (b, n))
         bp = jnp.where(m_t[:, None], best_prev, ident)
         return delta_out, bp
 

@@ -64,7 +64,7 @@ def ctc_loss(log_probs, input_lengths, labels, label_lengths, *, blank: int = 0)
         shift1 = jnp.concatenate([jnp.full((b, 1), LOG_EPS), alpha[:, :-1]], axis=1)
         shift2 = jnp.concatenate([jnp.full((b, 2), LOG_EPS), alpha[:, :-2]], axis=1)
         # skip (shift2) not allowed into blanks or repeated labels
-        is_blank_pos = (jnp.arange(s)[None, :] % 2) == 0
+        is_blank_pos = (jnp.arange(s, dtype=jnp.int32)[None, :] % 2) == 0
         allow_skip = (~is_blank_pos) & (~same_as_prev2)
         shift2 = jnp.where(allow_skip, shift2, LOG_EPS)
         new_alpha = logaddexp3(alpha, shift1, shift2) + emit(log_p_t)
@@ -72,7 +72,8 @@ def ctc_loss(log_probs, input_lengths, labels, label_lengths, *, blank: int = 0)
         active = (t_idx < input_lengths)[:, None]
         return jnp.where(active, new_alpha, alpha), None
 
-    xs = (jnp.swapaxes(log_probs[:, 1:], 0, 1), jnp.arange(1, t))
+    xs = (jnp.swapaxes(log_probs[:, 1:], 0, 1), jnp.arange(
+        1, t, dtype=jnp.int32))
     alpha, _ = jax.lax.scan(body, alpha0, xs)
 
     # final prob: last blank or last label position of the extended seq
@@ -93,7 +94,8 @@ def ctc_greedy_decode(log_probs, input_lengths, *, blank: int = 0):
     """
     b, t, c = log_probs.shape
     best = jnp.argmax(log_probs, axis=-1)  # [B, T]
-    frame_valid = jnp.arange(t)[None, :] < input_lengths[:, None]
+    frame_valid = jnp.arange(
+        t, dtype=jnp.int32)[None, :] < input_lengths[:, None]
     prev = jnp.concatenate([jnp.full((b, 1), -1, best.dtype), best[:, :-1]], axis=1)
     keep = (best != blank) & (best != prev) & frame_valid
 

@@ -129,7 +129,8 @@ def match_priors(priors, gt_boxes, gt_valid, threshold: float = 0.5):
     best_prior = jnp.argmax(ious, axis=0)             # [M]
     m = gt_boxes.shape[0]
     hit = gt_valid[None, :] & (
-        best_prior[None, :] == jnp.arange(n)[:, None])        # [N, M]
+        best_prior[None, :] == jnp.arange(
+            n, dtype=jnp.int32)[:, None])        # [N, M]
     forced = jnp.max(
         jnp.where(hit, jnp.arange(m, dtype=jnp.int32)[None, :], -1), axis=1)
     return jnp.where(forced >= 0, forced, match).astype(jnp.int32)
@@ -167,7 +168,8 @@ def multibox_loss(loc_preds, conf_logits, priors, gt_boxes, gt_labels,
     k = jnp.minimum((neg_pos_ratio * num_pos).astype(jnp.int32),
                     pos.shape[0])
     order = jnp.argsort(-neg_score)
-    rank = jnp.zeros_like(order).at[order].set(jnp.arange(order.shape[0]))
+    rank = jnp.zeros_like(order).at[order].set(jnp.arange(
+        order.shape[0], dtype=jnp.int32))
     neg = (~pos) & (rank < k) & jnp.isfinite(neg_score)
     conf_loss = jnp.where(pos | neg, ce, 0.0).sum()
 
@@ -186,8 +188,9 @@ def nms_mask(boxes, scores, *, iou_threshold: float = 0.45):
     # suppressor[i, j]: box i outranks box j (higher score, index as
     # tie-break) and overlaps it
     higher = scores[:, None] > scores[None, :]
+    rank = jnp.arange(k, dtype=jnp.int32)
     tie = (scores[:, None] == scores[None, :]) & \
-        (jnp.arange(k)[:, None] < jnp.arange(k)[None, :])
+        (rank[:, None] < rank[None, :])
     suppressor = (higher | tie) & (ious > iou_threshold)
 
     def step(_, keep):

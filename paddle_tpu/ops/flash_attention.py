@@ -224,8 +224,8 @@ def _windowed_backward(q, k, v, lens, o, lse, g, *, block_k: int,
     gp = _pad_to(gf, tk_pad + span, 1)
     deltap = _pad_to(delta, tk_pad + span, 1)
     lsep = _pad_to(lse, tk_pad + span, 1)
-    kpos_base = jnp.arange(block_k)
-    qwin_base = jnp.arange(span)
+    kpos_base = jnp.arange(block_k, dtype=jnp.int32)
+    qwin_base = jnp.arange(span, dtype=jnp.int32)
 
     def step(dq_pad, blk):
         j, kj, vj = blk                                   # kj/vj [BH,BK,D]
@@ -255,7 +255,7 @@ def _windowed_backward(q, k, v, lens, o, lse, g, *, block_k: int,
     nblk = tk_pad // block_k
     dq_pad, (dks, dvs) = jax.lax.scan(
         step, jnp.zeros((bh, tk_pad + span, d), jnp.float32),
-        (jnp.arange(nblk), kb, vb))
+        (jnp.arange(nblk, dtype=jnp.int32), kb, vb))
     dk = dks.transpose(1, 0, 2, 3).reshape(bh, tk_pad, d)[:, :t_kv]
     dv = dvs.transpose(1, 0, 2, 3).reshape(bh, tk_pad, d)[:, :t_kv]
     return ((dq_pad[:, :t] * scale).astype(q.dtype), dk.astype(k.dtype),
@@ -281,8 +281,8 @@ def _blockwise_backward(q, k, v, lens, o, lse, g, *, causal: bool,
     vp = _pad_to(v.astype(jnp.float32), tk_pad, 1)
     kb = kp.reshape(bh, tk_pad // block_k, block_k, d).transpose(1, 0, 2, 3)
     vb = vp.reshape(bh, tk_pad // block_k, block_k, d).transpose(1, 0, 2, 3)
-    kpos_base = jnp.arange(block_k)
-    qpos = jnp.arange(t)
+    kpos_base = jnp.arange(block_k, dtype=jnp.int32)
+    qpos = jnp.arange(t, dtype=jnp.int32)
 
     def step(dq_acc, blk):
         j, kj, vj = blk                                    # kj/vj [BH,BK,D]
@@ -302,7 +302,7 @@ def _blockwise_backward(q, k, v, lens, o, lse, g, *, causal: bool,
     nblk = tk_pad // block_k
     dq, (dks, dvs) = jax.lax.scan(
         step, jnp.zeros((bh, t, d), jnp.float32),
-        (jnp.arange(nblk), kb, vb))
+        (jnp.arange(nblk, dtype=jnp.int32), kb, vb))
     dk = dks.transpose(1, 0, 2, 3).reshape(bh, tk_pad, d)[:, :t_kv]
     dv = dvs.transpose(1, 0, 2, 3).reshape(bh, tk_pad, d)[:, :t_kv]
     return ((dq * scale).astype(q.dtype), dk.astype(k.dtype),

@@ -39,7 +39,7 @@ def multiplex(index, *inputs):
     operators/multiplex_op.cc): out[i] = inputs[index[i]][i]."""
     stacked = jnp.stack(inputs)  # [K, B, ...]
     idx = index.reshape(-1).astype(jnp.int32)
-    batch = jnp.arange(stacked.shape[1])
+    batch = jnp.arange(stacked.shape[1], dtype=jnp.int32)
     return stacked[idx, batch]
 
 
@@ -72,7 +72,8 @@ def conv_shift(x, y):
     enforce(n <= m, f"conv_shift kernel width {n} exceeds row width {m}")
     half = n // 2
     # idx[i, j] = (i + j - half) mod m — static [M, N] table
-    idx = (jnp.arange(m)[:, None] + jnp.arange(n)[None, :] - half) % m
+    idx = (jnp.arange(m, dtype=jnp.int32)[:, None]
+           + jnp.arange(n, dtype=jnp.int32)[None, :] - half) % m
     gathered = x[:, idx]                      # [B, M, N]
     return jnp.einsum("bmn,bn->bm", gathered, y)
 

@@ -136,13 +136,16 @@ def lambda_rank_segment(scores, relevance, *, ndcg_num: int = 5):
     gains = jnp.power(2.0, rel) - 1.0
     # ideal DCG over top ndcg_num
     sorted_gains = jnp.sort(gains)[::-1]
-    discounts = 1.0 / jnp.log2(jnp.arange(sorted_gains.shape[0]) + 2.0)
-    topk_mask = (jnp.arange(sorted_gains.shape[0]) < ndcg_num).astype(jnp.float32)
+    discounts = 1.0 / jnp.log2(jnp.arange(
+        sorted_gains.shape[0], dtype=jnp.int32) + 2.0)
+    topk_mask = (jnp.arange(
+        sorted_gains.shape[0], dtype=jnp.int32) < ndcg_num).astype(jnp.float32)
     ideal_dcg = jnp.sum(sorted_gains * discounts * topk_mask)
     inv_idcg = jnp.where(ideal_dcg > 0, 1.0 / jnp.maximum(ideal_dcg, 1e-12), 0.0)
     # current ranks by score (descending)
     order = jnp.argsort(-scores)
-    ranks = jnp.empty_like(order).at[order].set(jnp.arange(scores.shape[0]))
+    ranks = jnp.empty_like(order).at[order].set(jnp.arange(
+        scores.shape[0], dtype=jnp.int32))
     disc = 1.0 / jnp.log2(at_least_f32(ranks) + 2.0)
     sij = scores[:, None] - scores[None, :]
     delta_ndcg = jnp.abs((gains[:, None] - gains[None, :]) * (disc[:, None] - disc[None, :])) * inv_idcg

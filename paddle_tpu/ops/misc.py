@@ -107,7 +107,7 @@ def scale_sub_region(x, boxes, value: float):
     ws, we = b[:, 4] - 1, b[:, 5] - 1
 
     def rng_mask(lo, hi, size):
-        r = jnp.arange(size)
+        r = jnp.arange(size, dtype=jnp.int32)
         return (r[None, :] >= lo[:, None]) & (r[None, :] <= hi[:, None])
 
     mask = (rng_mask(hs, he, h)[:, :, None, None]
@@ -148,7 +148,7 @@ def row_conv(x, weight, lengths=None):
     bsz, t, d = x.shape
     ctx = weight.shape[0]
     if lengths is not None:
-        tmask = jnp.arange(t)[None, :] < lengths[:, None]
+        tmask = jnp.arange(t, dtype=jnp.int32)[None, :] < lengths[:, None]
         x = x * tmask[..., None]
     out = jnp.zeros_like(x)
     for i in range(ctx):

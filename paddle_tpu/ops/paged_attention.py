@@ -167,7 +167,7 @@ def paged_decode_attention(q, k, v, k_arena, v_arena, page_table, pos,
                  else k_arena).shape[0]
     max_pages = page_table.shape[1]
     blk = jnp.clip(pos // page_size, 0, max_pages - 1)
-    pg = page_table[jnp.arange(s), blk]
+    pg = page_table[jnp.arange(s, dtype=jnp.int32), blk]
     # belt + braces: unmapped entries already hold the sentinel, but an
     # inactive row's clipped block index must never resurrect a write
     pg = jnp.where(active, pg, jnp.int32(num_pages))
@@ -176,7 +176,7 @@ def paged_decode_attention(q, k, v, k_arena, v_arena, page_table, pos,
     v_arena = write_kv(v_arena, v[:, 0], pg, off)
     k_read = gather_kv(k_arena, page_table, max_len, q.dtype)
     v_read = gather_kv(v_arena, page_table, max_len, q.dtype)
-    valid = (jnp.arange(max_len)[None, :] <= pos[:, None]) \
+    valid = (jnp.arange(max_len, dtype=jnp.int32)[None, :] <= pos[:, None]) \
         & active[:, None]
     out = grouped_masked_attention(q, k_read, v_read,
                                    valid[:, None, None, :])
@@ -198,13 +198,15 @@ def paged_chunk_attention(q, k, v, k_arena, v_arena, pages_row, start,
     row); start: absolute position of chunk element 0 (traced).
     Returns (out [1, C, H, Dh], k_arena, v_arena)."""
     c = q.shape[1]
-    ap = start + jnp.arange(c)                    # absolute positions
+    ap = start + jnp.arange(
+        c, dtype=jnp.int32)                    # absolute positions
     pg, off = page_addresses(pages_row, ap, page_size=page_size)
     k_arena = write_kv(k_arena, k[0], pg, off)
     v_arena = write_kv(v_arena, v[0], pg, off)
     k_read = gather_kv(k_arena, pages_row[None], max_len, q.dtype)
     v_read = gather_kv(v_arena, pages_row[None], max_len, q.dtype)
-    valid = jnp.arange(max_len)[None, :] <= ap[:, None]   # [C, max_len]
+    valid = jnp.arange(
+        max_len, dtype=jnp.int32)[None, :] <= ap[:, None]   # [C, max_len]
     out = grouped_masked_attention(q, k_read, v_read,
                                    valid[None, None])
     return out, k_arena, v_arena

@@ -186,7 +186,7 @@ def lstm(params, x, lengths=None, *, initial_state: Optional[LSTMState] = None,
     if lengths is None:
         mask = jnp.ones((b, t), bool)
     else:
-        mask = jnp.arange(t)[None, :] < lengths[:, None]
+        mask = jnp.arange(t, dtype=jnp.int32)[None, :] < lengths[:, None]
 
     # hoist the input projection: ONE [B*T, F]x[F, 4H] matmul feeding the
     # MXU at full tilt; the scan then only carries the h@W_hh recurrence
@@ -232,7 +232,7 @@ def gru(params, x, lengths=None, *, initial_state=None, reverse: bool = False,
     if lengths is None:
         mask = jnp.ones((b, t), bool)
     else:
-        mask = jnp.arange(t)[None, :] < lengths[:, None]
+        mask = jnp.arange(t, dtype=jnp.int32)[None, :] < lengths[:, None]
     x_proj = linalg.matmul(x, params["w_ih"]) + params["b"]  # hoisted
     xs = jnp.swapaxes(x_proj, 0, 1)
 
@@ -276,7 +276,7 @@ def simple_rnn(params, x, lengths=None, *, activation=jnp.tanh,
     if lengths is None:
         mask = jnp.ones((b, t), bool)
     else:
-        mask = jnp.arange(t)[None, :] < lengths[:, None]
+        mask = jnp.arange(t, dtype=jnp.int32)[None, :] < lengths[:, None]
     x_proj = linalg.matmul(x, params["w_ih"]) + params["b"]  # hoisted
     xs = jnp.swapaxes(x_proj, 0, 1)
 
@@ -423,8 +423,9 @@ def md_lstm(params, x, *, reverse_rows: bool = False,
     xp = (linalg.matmul(x, params["w_ih"]) + params["b"]).astype(dt)
     nd = h + w - 1
 
-    rows = jnp.arange(h)[:, None]
-    cols = jnp.arange(nd)[None, :] - rows              # [H, ND] j = d - i
+    rows = jnp.arange(h, dtype=jnp.int32)[:, None]
+    cols = jnp.arange(
+        nd, dtype=jnp.int32)[None, :] - rows              # [H, ND] j = d - i
     on_grid = (cols >= 0) & (cols < w)
     # skewed[:, i, d, :] = xp[:, i, d - i, :] (zero off-grid)
     skewed = jnp.take_along_axis(
@@ -453,7 +454,8 @@ def md_lstm(params, x, *, reverse_rows: bool = False,
         (skewed.transpose(2, 0, 1, 3), on_grid.T))    # [ND, B, H, 5H]
 
     # unskew: out[:, i, j] = ys[i + j, :, i]
-    diag_of = rows + jnp.arange(w)[None, :]            # [H, W]
+    diag_of = rows + jnp.arange(
+        w, dtype=jnp.int32)[None, :]            # [H, W]
     out = jnp.take_along_axis(
         ys.transpose(1, 2, 0, 3), diag_of[None, :, :, None], axis=2)
     if reverse_cols:

@@ -141,7 +141,7 @@ def masked_positions(tokens, mask, fill=0.0):
 
 def length_mask(lengths, max_len: int):
     """[B, T] boolean mask from lengths."""
-    return jnp.arange(max_len)[None, :] < lengths[:, None]
+    return jnp.arange(max_len, dtype=jnp.int32)[None, :] < lengths[:, None]
 
 
 def dense_sequence_pool(x, lengths, mode: str = "mean"):
@@ -270,7 +270,7 @@ def first_subseq_of_outer(inner_values, outer_of_inner, num_outer: int):
     SubNestedSequenceLayer / seqlastins over nested): [num_inner, ...] ->
     [num_outer, ...]."""
     num_inner = inner_values.shape[0]
-    idx = jnp.arange(num_inner)
+    idx = jnp.arange(num_inner, dtype=jnp.int32)
     first_idx = jax.ops.segment_min(idx, outer_of_inner,
                                     num_segments=num_outer)
     safe = jnp.clip(first_idx, 0, num_inner - 1)
@@ -301,7 +301,7 @@ def context_projection(x, lengths, *, context_len: int,
     start_pad = max(0, -context_start)
     end_pad = max(0, context_len + context_start - 1)
     pieces = []
-    pos = jnp.arange(t)
+    pos = jnp.arange(t, dtype=jnp.int32)
     for j in range(context_len):
         off = context_start + j
         src = pos + off  # source position for each output position
@@ -360,10 +360,10 @@ def kmax_seq_score(scores, lengths, k: int):
     with the best valid position (reference pads with 0).
     """
     t = scores.shape[1]
-    valid = jnp.arange(t)[None, :] < lengths[:, None]
+    valid = jnp.arange(t, dtype=jnp.int32)[None, :] < lengths[:, None]
     masked = jnp.where(valid, scores, -jnp.inf)
     _, ids = jax.lax.top_k(masked, k)
     # where a sequence has < k valid entries, repeat its argmax
     have = jnp.minimum(lengths, k)[:, None]
     best = ids[:, :1]
-    return jnp.where(jnp.arange(k)[None, :] < have, ids, best)
+    return jnp.where(jnp.arange(k, dtype=jnp.int32)[None, :] < have, ids, best)

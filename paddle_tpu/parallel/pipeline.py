@@ -90,7 +90,8 @@ def make_pipeline_forward(stage_fn: Callable, mesh: Mesh, *,
             out = stage_fn(local_params, x_in)
             return out, out
 
-        _, outs = lax.scan(tick, act0, jnp.arange(ticks))  # [T, Bm, ...]
+        _, outs = lax.scan(tick, act0, jnp.arange(
+            ticks, dtype=jnp.int32))  # [T, Bm, ...]
         # the last stage's outputs, ticks S-1 .. S-2+M, are the results;
         # zero elsewhere + psum replicates them to every pipe device
         results = lax.dynamic_slice_in_dim(outs, n_stage - 1, m, axis=0)

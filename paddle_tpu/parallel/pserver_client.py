@@ -230,6 +230,8 @@ class PServerClient:
             for s in range(len(self.specs)):
                 self._register_shard(s)
 
+    # locklint: holds-lock(callers enter via public methods holding
+    # the reentrant self._lock)
     def _register_shard(self, s: int) -> None:
         resp = self._conns[s].call(
             bytes([OP_REGISTER])
@@ -336,6 +338,8 @@ class PServerClient:
                                  np.ascontiguousarray(ids[sel]),
                                  np.ascontiguousarray(grads[sel]), lr)
 
+    # locklint: holds-lock(called from push_row_grads/load_table
+    # under the reentrant self._lock)
     def _push_shard(self, s: int, epoch: int, ids: np.ndarray,
                     grads: np.ndarray, lr: float) -> None:
         payload = (bytes([OP_PUSH])
@@ -425,6 +429,7 @@ class PServerClient:
         self._check(resp, "pass_state")
         return struct.unpack_from("<q", resp, 1)[0]
 
+    # locklint: holds-lock(called from finish_pass's locked poll loop)
     def _finish_shard(self, s: int) -> Tuple[int, bool]:
         while True:
             if self._tokens[s] is None:

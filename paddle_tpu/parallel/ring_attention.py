@@ -49,7 +49,8 @@ def dense_attention(q, k, v, *, causal: bool = False, mask=None):
         d).astype(acc_dtype)
     if causal:
         tq, tk = q.shape[1], k.shape[1]
-        cm = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        cm = (jnp.arange(tq, dtype=jnp.int32)[:, None]
+              >= jnp.arange(tk, dtype=jnp.int32)[None, :])
         scores = jnp.where(cm[None, None], scores, NEG_INF)
     if mask is not None:
         scores = jnp.where(mask[:, None], scores, NEG_INF)
@@ -73,8 +74,8 @@ def _block_attend(q, k, v, q_offset, k_offset, *, causal, scale):
         * scale.astype(acc_dtype)
     if causal:
         tq, tk = q.shape[1], k.shape[1]
-        qpos = q_offset + jnp.arange(tq)
-        kpos = k_offset + jnp.arange(tk)
+        qpos = q_offset + jnp.arange(tq, dtype=jnp.int32)
+        kpos = k_offset + jnp.arange(tk, dtype=jnp.int32)
         cm = qpos[:, None] >= kpos[None, :]
         scores = jnp.where(cm[None, None], scores, NEG_INF)
     m = jnp.max(scores, axis=-1)  # [B,H,Tq]

@@ -91,8 +91,9 @@ def _route_to_owners(ids_local, n: int, rows_per_shard: int, capacity: int):
     order = jnp.argsort(owner, stable=True)
     sorted_ids = ids_local[order]
     sorted_owner = owner[order]
-    first_idx = jnp.searchsorted(sorted_owner, jnp.arange(n + 1))
-    pos_in_run = jnp.arange(k) - first_idx[sorted_owner]
+    first_idx = jnp.searchsorted(sorted_owner, jnp.arange(
+        n + 1, dtype=jnp.int32))
+    pos_in_run = jnp.arange(k, dtype=jnp.int32) - first_idx[sorted_owner]
     kept = (pos_in_run < capacity) & (sorted_owner < n)
     send = jnp.full((n, capacity), -1, ids_local.dtype)
     send = send.at[sorted_owner, pos_in_run].set(

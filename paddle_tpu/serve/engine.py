@@ -63,6 +63,7 @@ exact greedy contract beside sampled co-tenants.
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import NamedTuple, Optional
 
 from dataclasses import dataclass
@@ -76,6 +77,28 @@ from paddle_tpu.models import transformer as T
 from paddle_tpu.ops import paged_attention as pa
 from paddle_tpu.serve.paged import (PagePool, PoolExhaustedError,
                                     blocks_for)
+
+
+@lru_cache(maxsize=8192)
+def _staged(val, dtype):
+    """Committed device scalar for a host value, cached by value.
+
+    The host-side bookkeeping around the jitted bodies (page-map
+    updates, slot retires, per-chunk prefill scalars) used to hand
+    eager ops bare Python scalars — each one an IMPLICIT host->device
+    transfer, re-staged every step (`analysis.guards`' transfer guard
+    flags exactly this). Explicit `device_put` staging cached by value
+    makes the steady-state loop transfer-free and reuses the committed
+    buffer across steps: slots, block indices, page ids, bucket
+    lengths and sampler params all draw from small repeating sets."""
+    return jax.device_put(np.asarray(val, dtype))
+
+
+def _staged_once(val, dtype):
+    """Explicit staging WITHOUT the cache — for per-request-unique
+    values (request seeds, admission-counter tags) that would only
+    pollute the `_staged` LRU and evict its genuinely hot entries."""
+    return jax.device_put(np.asarray(val, dtype))
 
 
 class EngineState(NamedTuple):
@@ -270,6 +293,10 @@ class DecodeEngine:
         self.prefill_chunk = prefill_chunk
         self.prefix_cache = prefix_cache
         self.prefix_cache_blocks = prefix_cache_blocks
+        # the retired-slot page-table row, staged ONCE (an eager
+        # jnp.full per retire would re-transfer the sentinel row)
+        self._empty_row = jax.device_put(np.full(
+            (self.max_pages_per_slot,), self.num_pages, np.int32))
         self.pool: Optional[PagePool] = None  # built by init_state()
         self._admissions = 0   # default per-request stream identity
         self._prefill_jit = jax.jit(self._prefill_impl,
@@ -278,13 +305,30 @@ class DecodeEngine:
             self._chunk_impl,
             static_argnames=("chunk_w", "from_zero", "final"))
         self._step_jit = jax.jit(self._step_impl)
+        # jitted micro-updates for the HOST-side bookkeeping (page
+        # map, slot retire): eager .at[] ops hand XLA implicit scalar
+        # transfers per call (their negative-index fixup runs with
+        # python constants); a jitted body compiles once (warmed in
+        # init_state) and takes only staged device scalars
+        self._pagemap_jit = jax.jit(
+            lambda tbl, slot, blk, page: tbl.at[slot, blk].set(page))
+        self._rowset_jit = jax.jit(
+            lambda tbl, slot, row: tbl.at[slot].set(row))
+        self._retire_jit = jax.jit(
+            lambda active, pos, slot, fill: (
+                active.at[slot].set(False), pos.at[slot].set(fill)))
 
     # -- state ------------------------------------------------------------
 
     def init_state(self) -> EngineState:
+        # every buffer is built host-side and staged EXPLICITLY
+        # (device_put): pool construction is the one sanctioned bulk
+        # transfer, so `serve --transfer-guard` holds end-to-end, and
+        # initialization compiles no throwaway fill programs
         cfg, s = self.cfg, self.slots
         policy = default_policy()
         hkv, dh = cfg.kv_heads, cfg.head_dim
+        dput = jax.device_put
         if self.paged:
             # block-paged arenas: one [P, page, Hkv, Dh] pool per
             # layer, addressed through the per-slot page table
@@ -293,13 +337,13 @@ class DecodeEngine:
 
             def buf():
                 if cfg.kv_cache_dtype == "int8":
-                    return (jnp.zeros(shape, jnp.int8),
-                            jnp.full(shape[:-1], 1e-8 / 127.0,
-                                     jnp.float32))
-                return jnp.zeros(shape, policy.compute_dtype)
+                    return (dput(np.zeros(shape, np.int8)),
+                            dput(np.full(shape[:-1], 1e-8 / 127.0,
+                                         np.float32)))
+                return dput(np.zeros(shape, policy.compute_dtype))
 
-            page_table = jnp.full((s, self.max_pages_per_slot),
-                                  self.num_pages, jnp.int32)
+            page_table = dput(np.full((s, self.max_pages_per_slot),
+                                      self.num_pages, np.int32))
             self.pool = PagePool(
                 num_pages=self.num_pages, page_size=self.page_size,
                 slots=s, max_pages_per_slot=self.max_pages_per_slot,
@@ -316,12 +360,13 @@ class DecodeEngine:
                     # quantized-pair format _cached_attention streams
                     # in generate(); constructed directly (zeros
                     # quantize to data=0 with the eps-floor scale)
-                    return (jnp.zeros((s, L, hkv, dh), jnp.int8),
-                            jnp.full((s, L, hkv), 1e-8 / 127.0,
-                                     jnp.float32))
-                return jnp.zeros((s, L, hkv, dh), policy.compute_dtype)
+                    return (dput(np.zeros((s, L, hkv, dh), np.int8)),
+                            dput(np.full((s, L, hkv), 1e-8 / 127.0,
+                                         np.float32)))
+                return dput(np.zeros((s, L, hkv, dh),
+                                     policy.compute_dtype))
 
-            page_table = jnp.zeros((s, 1), jnp.int32)  # inert
+            page_table = dput(np.zeros((s, 1), np.int32))  # inert
             self.pool = None
 
         caches = tuple((buf(), buf()) for _ in self.params["blocks"])
@@ -331,18 +376,31 @@ class DecodeEngine:
         # engine's counter AND page pool to continue; explicit
         # per-request seeds sidestep the former entirely)
         self._admissions = 0
+        active = dput(np.zeros((s,), bool))
+        pos = dput(np.full((s,), self.max_len, np.int32))
+        # pre-warm the host-bookkeeping micro-jits with value-no-op
+        # calls on the fresh state, so a first page-boundary crossing
+        # or retire mid-serve never compiles inside the steady loop
+        z = _staged(0, np.int32)
+        self._retire_jit(active, pos, z,
+                         _staged(self.max_len, np.int32))
+        if self.paged:
+            self._pagemap_jit(page_table, z, z,
+                              _staged(self.num_pages, np.int32))
+            self._rowset_jit(page_table, z, self._empty_row)
         return EngineState(
             caches=caches,
             page_table=page_table,
-            pos=jnp.full((s,), self.max_len, jnp.int32),  # writes drop
-            active=jnp.zeros((s,), bool),
-            last_tok=jnp.zeros((s,), jnp.int32),
-            rng=jax.random.split(jax.random.key(self.seed),
-                                 self.slots),
-            temp=jnp.zeros((s,), jnp.float32),
-            top_k=jnp.full((s,), cfg.vocab, jnp.int32),
-            top_p=jnp.ones((s,), jnp.float32),
-            last_lp=jnp.zeros((s,), jnp.float32))
+            pos=pos,                        # sentinel: writes drop
+            active=active,
+            last_tok=dput(np.zeros((s,), np.int32)),
+            rng=jax.random.split(
+                jax.random.key(dput(np.int64(self.seed))),
+                self.slots),
+            temp=dput(np.zeros((s,), np.float32)),
+            top_k=dput(np.full((s,), cfg.vocab, np.int32)),
+            top_p=dput(np.ones((s,), np.float32)),
+            last_lp=dput(np.zeros((s,), np.float32)))
 
     # -- shared first-token selection --------------------------------------
 
@@ -381,14 +439,14 @@ class DecodeEngine:
         toks = prompt[None, :]                       # [1, t0]
         x = jnp.take(params["embed"]["table"], toks, axis=0)
         x = x.astype(policy.compute_dtype)
-        pos = jnp.arange(t0)[None, :]
+        pos = jnp.arange(t0, dtype=jnp.int32)[None, :]
         # pad keys masked out exactly like generate(prompt_lens=...)
         attn = lambda q, k, v: T._attention(
             cfg, q, k, v, causal=True, key_lens=true_len[None])
         # bucket-pad tokens must not claim MoE expert capacity either —
         # the same key_ok mask generate()/loss()/score() pass through
         # to the router (transformer.py _forward token_mask)
-        tok_mask = (jnp.arange(t0) < true_len)[None, :]
+        tok_mask = (jnp.arange(t0, dtype=jnp.int32) < true_len)[None, :]
         z = jnp.int32(0)
 
         def write_slot(buf, new):
@@ -414,7 +472,7 @@ class DecodeEngine:
         # never enter the ring: p(s) indexes real positions only.
         w_ = cfg.attn_window
         p_slot = (true_len - 1) - jnp.mod(
-            (true_len - 1) - jnp.arange(w_), w_)
+            (true_len - 1) - jnp.arange(w_, dtype=jnp.int32), w_)
         ring_idx = jnp.clip(p_slot, 0, t0 - 1)
         ring = lambda kv: jnp.take(kv, ring_idx, axis=1)
 
@@ -465,7 +523,8 @@ class DecodeEngine:
         policy = default_policy()
         x = jnp.take(params["embed"]["table"], toks[None, :], axis=0)
         x = x.astype(policy.compute_dtype)
-        ap = start + jnp.arange(chunk_w)            # absolute positions
+        ap = start + jnp.arange(
+            chunk_w, dtype=jnp.int32)            # absolute positions
         pos = ap[None, :]
         # pad/garbage positions must not claim MoE expert capacity
         tok_mask = (ap < true_len)[None, :]
@@ -609,7 +668,8 @@ class DecodeEngine:
                       np.int32)
         row[:len(pages)] = pages
         state = state._replace(
-            page_table=state.page_table.at[slot].set(
+            page_table=self._rowset_jit(
+                state.page_table, _staged(slot, np.int32),
                 jnp.asarray(row)))
         return state, PrefillTicket(
             slot=slot, prompt=prompt_np, true_len=true_len,
@@ -626,14 +686,20 @@ class DecodeEngine:
         activates the slot and registers the prompt's full blocks in
         the prefix cache; chunks never run past the last real
         position, so bucket padding costs no chunk invocations."""
+        # every scalar argument is staged explicitly (cached by
+        # value): bucket lengths, sampler params and slot ids repeat
+        # across requests, so admission costs no implicit transfers
+        # and no per-call re-staging
         if ticket.windowed:
             state = self._prefill_jit(
-                state, jnp.int32(ticket.slot),
+                state, _staged(ticket.slot, np.int32),
                 jnp.asarray(ticket.prompt, jnp.int32),
-                jnp.int32(ticket.true_len),
-                jnp.float32(ticket.temp), jnp.int32(ticket.top_k),
-                jnp.float32(ticket.top_p), jnp.int32(ticket.req_tag),
-                jnp.int32(ticket.req_seed),
+                _staged(ticket.true_len, np.int32),
+                _staged(ticket.temp, np.float32),
+                _staged(ticket.top_k, np.int32),
+                _staged(ticket.top_p, np.float32),
+                _staged_once(ticket.req_tag, np.int32),
+                _staged_once(ticket.req_seed, np.int32),
                 t0=int(ticket.prompt.shape[-1]))
             return state, True
         start = ticket.next_start
@@ -644,11 +710,14 @@ class DecodeEngine:
         if toks.shape[0] < width:
             toks = np.pad(toks, (0, width - toks.shape[0]))
         state = self._chunk_jit(
-            state, jnp.int32(ticket.slot),
-            jnp.asarray(toks, jnp.int32), jnp.int32(start),
-            jnp.int32(ticket.true_len), jnp.float32(ticket.temp),
-            jnp.int32(ticket.top_k), jnp.float32(ticket.top_p),
-            jnp.int32(ticket.req_tag), jnp.int32(ticket.req_seed),
+            state, _staged(ticket.slot, np.int32),
+            jnp.asarray(toks, jnp.int32), _staged(start, np.int32),
+            _staged(ticket.true_len, np.int32),
+            _staged(ticket.temp, np.float32),
+            _staged(ticket.top_k, np.int32),
+            _staged(ticket.top_p, np.float32),
+            _staged_once(ticket.req_tag, np.int32),
+            _staged_once(ticket.req_seed, np.int32),
             chunk_w=width, from_zero=(start == 0), final=final)
         self.pool.prefill_chunks += 1
         ticket.next_start = start + width
@@ -819,9 +888,13 @@ class DecodeEngine:
         res = self.pool.extend(slot)
         if res is not None:
             blk, page = res
+            # staged scalars through the jitted setter: the per-step
+            # page-map update costs no implicit transfer and no
+            # compile (transfer-guard regression, tests/test_analysis)
             state = state._replace(
-                page_table=state.page_table.at[slot, blk].set(
-                    jnp.int32(page)))
+                page_table=self._pagemap_jit(
+                    state.page_table, _staged(slot, np.int32),
+                    _staged(blk, np.int32), _staged(page, np.int32)))
         return state
 
     def release_slot(self, state: EngineState, slot: int) -> EngineState:
@@ -838,12 +911,13 @@ class DecodeEngine:
         if self.paged and self.pool is not None:
             self.pool.release(slot)
             state = state._replace(
-                page_table=state.page_table.at[slot].set(
-                    jnp.full((self.max_pages_per_slot,),
-                             self.num_pages, jnp.int32)))
-        return state._replace(
-            active=state.active.at[slot].set(False),
-            pos=state.pos.at[slot].set(jnp.int32(self.max_len)))
+                page_table=self._rowset_jit(
+                    state.page_table, _staged(slot, np.int32),
+                    self._empty_row))
+        active, pos = self._retire_jit(
+            state.active, state.pos, _staged(slot, np.int32),
+            _staged(self.max_len, np.int32))
+        return state._replace(active=active, pos=pos)
 
     # -- batteries-included host scheduler --------------------------------
 

@@ -566,7 +566,11 @@ class ResilientTrainer:
                 self._maybe_drain(state)
                 handler(E.BeginIteration(pass_id, batch_id))
                 inputs, labels = self.trainer._split_batch(batch)
-                step_rng = jax.random.fold_in(base_rng, gidx)
+                # device_put the fold data EXPLICITLY: a bare python
+                # int here is an implicit h2d transfer every step
+                # (jax.transfer_guard flags it; analysis.guards)
+                step_rng = jax.random.fold_in(
+                    base_rng, jax.device_put(np.uint32(gidx)))
                 prev_state = state
                 state, loss, metrics = self._step(
                     state, step_rng, inputs, labels)

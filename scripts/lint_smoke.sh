@@ -1,0 +1,27 @@
+#!/usr/bin/env sh
+# Lint smoke lane: the static-analysis gate plus its test suite, one
+# command (docs/ANALYSIS.md):
+#
+#   1. `python -m paddle_tpu.analysis --check` — graftlint (GL001-
+#      GL006 trace-safety/recompile discipline) + locklint (LK001
+#      lock discipline) over the whole repo against the committed
+#      baseline (paddle_tpu/analysis/baseline.json); any unbaselined
+#      finding fails the lane.
+#   2. `pytest -m analysis` — per-rule must-flag/near-miss fixtures
+#      and the RecompileGuard steady-state regressions (decode loop
+#      and train step compile once, then zero recompiles / implicit
+#      transfers).
+#
+#     scripts/lint_smoke.sh              # gate + tests
+#     scripts/lint_smoke.sh --check-only # just the lint gate (fast)
+#     scripts/lint_smoke.sh -k guard     # filter, passes through
+#
+# CPU-only and deterministic; extra args pass through to pytest.
+set -e
+cd "$(dirname "$0")/.."
+env JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --check
+if [ "$1" = "--check-only" ]; then
+    exit 0
+fi
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m analysis \
+    -p no:cacheprovider "$@"

@@ -49,6 +49,12 @@ def pytest_configure(config):
         "(capacity/throughput assertions, e.g. the paged-pool 2x "
         "admission bound) — fast, runs IN tier-1; `-m perf` (or "
         "`scripts/perf_smoke.sh`) runs it alone")
+    config.addinivalue_line(
+        "markers", "analysis: static-analysis + compile-discipline "
+        "suite (graftlint/locklint rule fixtures, the repo --check "
+        "gate, RecompileGuard steady-state regressions) — fast and "
+        "CPU-only, runs IN tier-1; `-m analysis` (or "
+        "`scripts/lint_smoke.sh`) runs it alone")
 
 
 @pytest.fixture

@@ -1,0 +1,634 @@
+"""Static-analysis pass (graftlint/locklint) + runtime guard tests.
+
+Three layers, mirroring docs/ANALYSIS.md:
+
+1. Per-rule fixture snippets: every rule has a must-flag case AND a
+   near-miss it must NOT flag (the false-positive contract is as much
+   of the tool's value as the detection).
+2. The repo gate itself: `--check` against the committed baseline
+   exits 0 — zero unbaselined findings at HEAD — and the two
+   locklint-hardened modules stay clean.
+3. RecompileGuard/transfer-guard regression tests: the DecodeEngine
+   decode loop and the jitted train step compile EXACTLY ONCE and
+   hit zero recompiles / zero implicit transfers over 3+ steady-state
+   iterations — the "every hot path stays inside one compiled XLA
+   program" contract, enforced at runtime.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis.graftlint import Finding, lint_source
+from paddle_tpu.analysis.guards import (RecompileError, RecompileGuard,
+                                        no_implicit_transfers,
+                                        steady_state)
+from paddle_tpu.analysis.locklint import lint_locks_source
+from paddle_tpu.analysis.run import (apply_baseline, collect_findings,
+                                     run_cli)
+
+pytestmark = pytest.mark.analysis
+
+
+def rules_of(src):
+    return {f.rule for f in lint_source(textwrap.dedent(src), "t.py")}
+
+
+# -- rule fixtures: one must-flag + one near-miss per rule ---------------
+
+
+class TestGL001HostSync:
+    def test_item_flagged(self):
+        assert "GL001" in rules_of("""
+            import jax
+            @jax.jit
+            def f(x):
+                return x * x.item()
+        """)
+
+    def test_float_of_traced_flagged(self):
+        assert "GL001" in rules_of("""
+            import jax
+            @jax.jit
+            def f(x):
+                return float(x)
+        """)
+
+    def test_numpy_on_traced_flagged(self):
+        assert "GL001" in rules_of("""
+            import jax, numpy as np
+            @jax.jit
+            def f(x):
+                return np.asarray(x)
+        """)
+
+    def test_print_of_traced_flagged(self):
+        assert "GL001" in rules_of("""
+            import jax
+            @jax.jit
+            def f(x):
+                print(x)
+                return x
+        """)
+
+    def test_device_get_flagged(self):
+        assert "GL001" in rules_of("""
+            import jax
+            @jax.jit
+            def f(x):
+                return jax.device_get(x)
+        """)
+
+    def test_near_miss_static_print_and_host_float(self):
+        # printing shapes (host metadata) in traced code is fine, and
+        # float() in plain host code is fine
+        assert not rules_of("""
+            import jax
+            @jax.jit
+            def f(x):
+                print("shape:", x.shape)
+                return x
+            def host(loss):
+                return float(loss)
+        """)
+
+
+class TestGL002TracedControlFlow:
+    def test_if_on_traced_flagged(self):
+        assert "GL002" in rules_of("""
+            import jax
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+
+    def test_while_and_assert_flagged(self):
+        src_rules = rules_of("""
+            import jax
+            @jax.jit
+            def f(x):
+                assert x > 0
+                while x.sum() > 0:
+                    x = x - 1
+                return x
+        """)
+        assert "GL002" in src_rules
+
+    def test_scan_body_is_traced(self):
+        assert "GL002" in rules_of("""
+            from jax import lax
+            def outer(xs):
+                def body(c, x):
+                    if x > 0:
+                        c = c + x
+                    return c, x
+                return lax.scan(body, 0.0, xs)
+        """)
+
+    def test_near_miss_shape_branch_and_is_none(self):
+        # shape/dtype reads are host metadata; `is None` is
+        # host-decidable; host functions branch freely
+        assert not rules_of("""
+            import jax
+            @jax.jit
+            def f(x, y=None):
+                if x.shape[0] > 4:
+                    x = x[:4]
+                if y is not None:
+                    x = x + y
+                return x
+        """)
+
+    def test_near_miss_lambda_param_taint_is_scoped(self):
+        # a host variable sharing a lambda param's name must not be
+        # flagged after the lambda (the param taint dies with it)
+        assert not rules_of("""
+            import jax
+            @jax.jit
+            def f(x):
+                n = 3
+                g = lambda n: n + 1
+                if n > 2:
+                    return g(x)
+                return x
+        """)
+
+    def test_jit_site_static_argnames_not_tainted(self):
+        # the engine idiom: jax.jit(self._impl, static_argnames=...)
+        # makes `flag` a compile-time python value — branching on it
+        # is the DESIGN, not a bug
+        assert not rules_of("""
+            import jax
+            class E:
+                def __init__(self):
+                    self._j = jax.jit(self._impl,
+                                      static_argnames=("flag",))
+                def _impl(self, x, flag):
+                    if flag:
+                        return x * 2
+                    return x
+        """)
+
+
+class TestGL003WeakDtype:
+    def test_bare_literal_ctor_flagged(self):
+        assert "GL003" in rules_of("""
+            import jax.numpy as jnp
+            def f():
+                return jnp.array(2.0)
+        """)
+
+    def test_full_literal_flagged(self):
+        assert "GL003" in rules_of("""
+            import jax.numpy as jnp
+            def f(s):
+                return jnp.full(s, 1e-8)
+        """)
+
+    def test_undtyped_arange_flagged(self):
+        assert "GL003" in rules_of("""
+            import jax.numpy as jnp
+            def f(t):
+                return jnp.arange(t)
+        """)
+
+    def test_near_miss_explicit_dtype(self):
+        assert not rules_of("""
+            import jax.numpy as jnp
+            def f(s, t):
+                a = jnp.array(2.0, dtype=jnp.float32)
+                b = jnp.full(s, 1e-8, jnp.float32)
+                c = jnp.arange(t, dtype=jnp.int32)
+                d = jnp.asarray(s)       # non-literal payload
+                return a, b, c, d
+        """)
+
+
+class TestGL004RecompileHazards:
+    def test_jit_in_loop_flagged(self):
+        assert "GL004" in rules_of("""
+            import jax
+            def f(fs, x):
+                outs = []
+                for g in fs:
+                    outs.append(jax.jit(g)(x))
+                return outs
+        """)
+
+    def test_list_static_argnums_flagged(self):
+        assert "GL004" in rules_of("""
+            import jax
+            def f(g):
+                return jax.jit(g, static_argnums=[0, 1])
+        """)
+
+    def test_set_iteration_in_traced_flagged(self):
+        assert "GL004" in rules_of("""
+            import jax
+            @jax.jit
+            def f(x):
+                t = x
+                for k in set((1, 2, 3)):
+                    t = t + k
+                return t
+        """)
+
+    def test_near_miss_hoisted_jit_and_sorted_set(self):
+        assert not rules_of("""
+            import jax
+            def build(g):
+                return jax.jit(g, static_argnums=(0, 1))
+            @jax.jit
+            def f(x):
+                t = x
+                for k in sorted(set((1, 2, 3))):
+                    t = t + k
+                return t
+        """)
+
+
+class TestGL005TracerLeak:
+    def test_store_on_self_flagged(self):
+        assert "GL005" in rules_of("""
+            import jax
+            class A:
+                def run(self, x):
+                    return jax.jit(self._step)(x)
+                def _step(self, x):
+                    self.last = x * 2
+                    return x
+        """)
+
+    def test_append_to_closure_flagged(self):
+        assert "GL005" in rules_of("""
+            import jax
+            acc = []
+            @jax.jit
+            def f(x):
+                acc.append(x * 2)
+                return x
+        """)
+
+    def test_near_miss_local_accumulator(self):
+        # the engine's own idiom: new_caches is bound INSIDE the
+        # traced scope, collecting across a nested closure — legal
+        assert not rules_of("""
+            import jax
+            @jax.jit
+            def f(pairs, x):
+                new_caches = []
+                def attn(k, v):
+                    new_caches.append((k, v))
+                    return x
+                for k, v in pairs:
+                    x = attn(k, v)
+                return x, tuple(new_caches)
+        """)
+
+    def test_near_miss_functional_update_api(self):
+        # `.update(...)` whose RESULT is used is an optimizer-style
+        # functional API, not a dict mutation
+        assert not rules_of("""
+            import jax
+            def make(optimizer):
+                @jax.jit
+                def step(state, grads):
+                    params, opt = optimizer.update(grads, state)
+                    return params, opt
+                return step
+        """)
+
+
+class TestGL006ImportTimeCompute:
+    def test_module_level_flagged(self):
+        assert "GL006" in rules_of("""
+            import jax.numpy as jnp
+            TABLE = jnp.zeros((10,))
+        """)
+
+    def test_default_arg_flagged(self):
+        assert "GL006" in rules_of("""
+            import jax.numpy as jnp
+            def f(x, w=jnp.ones((3,))):
+                return x * w
+        """)
+
+    def test_near_miss_inside_function_and_main_block(self):
+        assert not rules_of("""
+            import jax.numpy as jnp
+            def f():
+                return jnp.zeros((10,))
+            if __name__ == "__main__":
+                print(jnp.zeros((2,)))
+        """)
+
+    def test_near_miss_module_level_lambda_body(self):
+        # a lambda BODY doesn't run at import — only its construction
+        assert not rules_of("""
+            import jax.numpy as jnp
+            _pad = lambda x: jnp.maximum(x, 0)
+            TABLE = {"relu": lambda x: jnp.maximum(x, 0)}
+        """)
+
+
+class TestSuppression:
+    SRC = """
+        import jax
+        @jax.jit
+        def f(x):
+            y = float(x)  # graftlint: disable=GL001({})
+            return y
+    """
+
+    def test_disable_with_reason_suppresses(self):
+        assert not rules_of(self.SRC.format("test exercises the sync"))
+
+    def test_bare_disable_does_not_count(self):
+        # the reason is REQUIRED — a naked disable still reports
+        assert "GL001" in rules_of(self.SRC.format(""))
+
+    def test_comment_block_above_statement(self):
+        assert not rules_of("""
+            import jax
+            @jax.jit
+            def f(x):
+                # graftlint: disable=GL001(reason spans the block
+                # above the statement)
+                y = float(x)
+                return y
+        """)
+
+
+# -- locklint -------------------------------------------------------------
+
+
+LOCKED_SRC = """
+import threading
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+        self.err = None
+
+    def locked_inc(self):
+        with self._lock:
+            self.n += 1
+
+    def racy_inc(self):{}
+        self.n += 1
+"""
+
+
+class TestLocklint:
+    def test_mixed_discipline_flagged(self):
+        fs = lint_locks_source(LOCKED_SRC.format(""), "t.py")
+        assert [f.rule for f in fs] == ["LK001"]
+        assert "self.n" in fs[0].message
+
+    def test_holds_lock_annotation_clears(self):
+        src = LOCKED_SRC.format(
+            "\n        # locklint: holds-lock(caller locks)")
+        assert lint_locks_source(src, "t.py") == []
+
+    def test_near_miss_consistently_unlocked(self):
+        # no locked mutation site -> no discipline to enforce
+        # (single-threaded classes don't get nagged)
+        src = LOCKED_SRC.replace(
+            "        with self._lock:\n            self.n += 1",
+            "        self.n += 1")
+        assert lint_locks_source(src, "t.py") == []
+
+    def test_init_is_exempt(self):
+        src = """
+import threading
+
+class S:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def inc(self):
+        with self._lock:
+            self.n += 1
+"""
+        assert lint_locks_source(src, "t.py") == []
+
+    def test_hardened_modules_stay_clean(self):
+        # the PR's lock-discipline sweep: the native runtimes and the
+        # pserver client must have zero unannotated findings
+        fs = collect_findings([
+            "paddle_tpu/native/taskqueue.py",
+            "paddle_tpu/native/pserver.py",
+            "paddle_tpu/serve/server.py",
+            "paddle_tpu/parallel/pserver_client.py",
+        ], rules=["LK001"])
+        assert fs == [], [str(f) for f in fs]
+
+
+class TestHAMasterSnapshotErrorRegression:
+    """The genuine race locklint surfaced: HAMaster._loop wrote
+    last_snapshot_error OUTSIDE _snap_lock (a stale failure could
+    overwrite a newer success), and a failed MANUAL checkpoint()
+    recorded nothing. Now checkpoint() itself records under the
+    lock."""
+
+    def test_manual_checkpoint_failure_records_error(self, tmp_path):
+        from paddle_tpu.native.taskqueue import HAMaster
+
+        ha = HAMaster(str(tmp_path), interval_s=0)  # no cadence thread
+        try:
+            orig = ha.queue.snapshot
+            ha.queue.snapshot = lambda path: (_ for _ in ()).throw(
+                OSError("disk full"))
+            with pytest.raises(OSError):
+                ha.checkpoint()
+            assert "disk full" in ha.last_snapshot_error
+            ha.queue.snapshot = orig
+            ha.checkpoint()
+            assert ha.last_snapshot_error is None
+            assert ha.last_snapshot_time is not None
+        finally:
+            ha.stop(final_snapshot=False)
+
+
+# -- baseline mechanics ---------------------------------------------------
+
+
+class TestBaseline:
+    def F(self, rule="GL001", path="a.py", func="f", line=1):
+        return Finding(rule, path, line, 0, func, "m")
+
+    def test_counts_cover_and_excess_reports(self):
+        base = {("GL001", "a.py", "f"):
+                {"rule": "GL001", "path": "a.py", "func": "f",
+                 "count": 1, "reason": "r"}}
+        un, stale = apply_baseline([self.F(line=1)], base)
+        assert un == [] and stale == []
+        un, _ = apply_baseline([self.F(line=1), self.F(line=9)], base)
+        assert len(un) == 1 and un[0].line == 9
+
+    def test_stale_entries_surface(self):
+        base = {("GL001", "gone.py", "f"):
+                {"rule": "GL001", "path": "gone.py", "func": "f",
+                 "count": 1, "reason": "r"}}
+        un, stale = apply_baseline([], base)
+        assert un == [] and stale == [("GL001", "gone.py", "f")]
+
+    def test_repo_gate_is_green(self, capsys):
+        # THE acceptance criterion: zero unbaselined findings at HEAD
+        rc = run_cli(["--check"])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+
+
+# -- runtime guards: the two hottest loops --------------------------------
+
+
+def _small_cfg():
+    from paddle_tpu.models import transformer as T
+
+    return T.TransformerConfig(vocab=31, dim=16, n_layers=1,
+                               n_heads=2, attn_impl="dense")
+
+
+class TestRecompileGuardUnit:
+    def test_catches_recompile_and_names_it(self):
+        f = jax.jit(lambda x: x * 2)
+        f(jnp.ones((3,), jnp.float32))
+        with pytest.raises(RecompileError):
+            with RecompileGuard(name="unit"):
+                f(jnp.ones((5,), jnp.float32))   # new shape: compile
+
+    def test_steady_state_passes(self):
+        f = jax.jit(lambda x: x * 2)
+        x = jnp.ones((4,), jnp.float32)
+        f(x)
+        with RecompileGuard(name="unit") as g:
+            for _ in range(3):
+                f(x)
+        assert g.compiles == 0
+
+    def test_transfer_guard_bites_on_implicit_h2d(self):
+        f = jax.jit(lambda x: x + 1)
+        f(jnp.ones((4,), jnp.float32))
+        with pytest.raises(Exception):
+            with no_implicit_transfers():
+                f(np.ones((4,), np.float32))     # implicit transfer
+        # explicit staging passes
+        with no_implicit_transfers():
+            f(jax.device_put(np.ones((4,), np.float32)))
+
+
+class TestDecodeLoopSteadyState:
+    """ISSUE acceptance: the decode loop compiles exactly once, then
+    zero recompiles and zero implicit transfers over 3+ steady
+    iterations — including a page-boundary crossing (the host-side
+    page map update must not re-stage anything)."""
+
+    def test_decode_step_compiles_once_then_never(self):
+        from paddle_tpu.serve.engine import DecodeEngine
+
+        from paddle_tpu.models import transformer as T
+
+        cfg = _small_cfg()
+        params = T.init_params(jax.random.key(0), cfg)
+        # page_size 4 + a 3-token prompt => the guarded steady window
+        # below crosses a page boundary
+        eng = DecodeEngine(params, cfg, slots=2, max_len=16,
+                           page_size=4)
+        state = eng.init_state()
+        r = np.random.RandomState(0)
+        state = eng.prefill(
+            state, 0, r.randint(0, 31, (3,)).astype(np.int32))
+        with RecompileGuard(max_compiles=64, name="warmup") as warm:
+            state, *_ = eng.decode_step(state)
+            state = eng.ensure_decode_page(state, 0)
+        assert warm.compiles >= 1        # the ONE compile happened...
+        with steady_state("decode loop", transfers="disallow") as g:
+            for _ in range(4):           # ...and never again
+                state, toks, lps, was, fin = eng.decode_step(state)
+                state = eng.ensure_decode_page(state, 0)
+                jax.device_get((toks, lps, was, fin))  # explicit: ok
+        assert g.compiles == 0
+
+    def test_full_serve_is_transfer_clean(self):
+        """`serve --transfer-guard`'s contract: the WHOLE serve path —
+        pool init (explicit device_put staging), admission, decode,
+        retire — runs under disallow with greedy parity intact."""
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.serve.engine import DecodeEngine
+
+        cfg = _small_cfg()
+        params = T.init_params(jax.random.key(0), cfg)
+        eng = DecodeEngine(params, cfg, slots=2, max_len=16)
+        r = np.random.RandomState(0)
+        p = r.randint(0, 31, (5,)).astype(np.int32)
+        with no_implicit_transfers():
+            got = eng.serve([p], max_new=4, buckets=(8,))
+        ref = T.generate(params, cfg, jnp.asarray(p)[None, :],
+                         steps=4)
+        assert got[0] == [int(t)
+                          for t in np.asarray(ref[0, len(p):])]
+
+    def test_served_second_wave_is_compile_free(self):
+        """After one serve() wave warmed every body (prefill bucket,
+        step, retire), a second wave over the same bucket must not
+        compile anything — the continuous-batching promise."""
+        from paddle_tpu.serve.engine import DecodeEngine
+
+        from paddle_tpu.models import transformer as T
+
+        cfg = _small_cfg()
+        params = T.init_params(jax.random.key(0), cfg)
+        eng = DecodeEngine(params, cfg, slots=2, max_len=16)
+        r = np.random.RandomState(1)
+        mk = lambda n: [r.randint(0, 31, (5,)).astype(np.int32)
+                        for _ in range(n)]
+        eng.serve(mk(2), max_new=4, buckets=(8,))         # warm wave
+        with RecompileGuard(name="second serve wave") as g:
+            got = eng.serve(mk(3), max_new=4, buckets=(8,))
+        assert g.compiles == 0
+        assert len(got) == 3 and all(len(t) for t in got)
+
+
+class TestTrainStepSteadyState:
+    def test_train_step_compiles_once_then_never(self):
+        from paddle_tpu import models, optim
+        from paddle_tpu.nn.module import ShapeSpec
+        from paddle_tpu.ops import losses
+        from paddle_tpu.train import Trainer
+
+        trainer = Trainer(
+            models.lenet.mlp(10, hidden=(16,)),
+            loss_fn=lambda lo, la: jnp.mean(
+                losses.softmax_cross_entropy(lo, la)),
+            optimizer=optim.sgd(0.1), seed=0)
+        state = trainer.init_state(ShapeSpec((8, 28, 28, 1)))
+        r = np.random.RandomState(0)
+        # the ONE sanctioned per-step transfer is the input batch —
+        # staged EXPLICITLY, which is what lets transfers="disallow"
+        # hold for everything else
+        batch = jax.device_put((
+            r.randn(8, 28, 28, 1).astype(np.float32),
+            r.randint(0, 10, (8,)).astype(np.int32)))
+        rng = jax.random.key(0)
+        with RecompileGuard(max_compiles=64, name="warmup") as warm:
+            rng, step_rng = jax.random.split(rng)
+            state, loss, _ = trainer._train_step(
+                state, step_rng, (batch[0],), (batch[1],))
+        assert warm.compiles >= 1
+        with steady_state("train step", transfers="disallow") as g:
+            for _ in range(3):
+                # Trainer.train's own per-step idiom: split stays on
+                # device, so the ONLY transfer is the explicit batch
+                rng, step_rng = jax.random.split(rng)
+                state, loss, _ = trainer._train_step(
+                    state, step_rng, (batch[0],), (batch[1],))
+        assert g.compiles == 0
+        assert np.isfinite(float(loss))

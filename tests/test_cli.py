@@ -242,3 +242,12 @@ def get_serve_config():
                          jnp.asarray(p, jnp.int32)[None, :], steps=6)
         assert got == [int(t) for t in np.asarray(ref[0, len(p):])]
     assert lines[1].startswith("# logprobs ")
+
+    # --transfer-guard: the same run under jax.transfer_guard
+    # ("disallow") — the decode loop must not implicitly re-stage
+    # anything (docs/ANALYSIS.md), and the output must be identical
+    out2 = tmp_path / "out_guarded.txt"
+    assert main(["serve", "--config", str(cfg_file),
+                 "--prompts", str(prompts), "--max-new", "6",
+                 "--transfer-guard", "--output", str(out2)]) == 0
+    assert out2.read_text().strip().splitlines() == lines[::2]

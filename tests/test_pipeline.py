@@ -59,6 +59,8 @@ def test_pipeline_single_microbatch_and_m_less_than_stages():
     for m in (1, 2):
         micro_x = jnp.asarray(np.random.RandomState(m).randn(m, 2, 5),
                               jnp.float32)
+        # graftlint: disable=GL004(each m is a distinct static shape —
+        # one deliberate compile per loop iteration)
         got = jax.jit(PP.make_pipeline_forward(_stage_fn, mesh))(
             stacked, micro_x)
         want = _sequential_ref(per_stage, micro_x)

@@ -141,7 +141,7 @@ class TestInt8Quant:
         tree = {"proj": {"kernel": jnp.ones((4, 4)),
                          "bias": jnp.ones((4,))},
                 "embed": {"table": jnp.ones((8, 4))},
-                "ids": jnp.arange(6)}
+                "ids": jnp.arange(6, dtype=jnp.int32)}
         qt = quant.quantize_params(tree)  # DEFAULT_MATCH
         assert isinstance(qt["proj"]["kernel"], quant.QuantizedTensor)
         assert qt["proj"]["bias"].shape == (4,)       # vector: untouched

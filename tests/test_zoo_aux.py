@@ -210,7 +210,7 @@ def test_named_scope_compiles():
         with named_scope("layer1"):
             return x * 2
 
-    assert float(f(jnp.asarray(3.0))) == 6.0
+    assert float(f(jnp.asarray(3.0, jnp.float32))) == 6.0
 
 
 def test_trainer_checkgrad():
