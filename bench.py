@@ -277,6 +277,120 @@ def bench_serving() -> None:
          "x dense slots", None, dense_slots=s_dense,
          peak_concurrent=peak_active[0],
          meets_2x=bool(admit_ratio >= 2.0))
+    bench_router(cfg, params)
+
+
+def bench_router(cfg, params) -> None:
+    """Router stage of the CPU serving bench (ISSUE 6): a 3-replica
+    fleet under shared-prefix traffic. Three numbers, all
+    CPU-runnable and emitted before the chip gate can starve them:
+
+    - aggregate fleet tokens/s through the router's round-robin
+      drive;
+    - prefix-hit rate with AFFINITY routing vs RANDOM routing over
+      identical traffic (the router's whole reason to exist: affinity
+      concentrates each hot prefix on one replica's cache);
+    - requests-recovered-after-kill: a replica is killed mid-burst
+      (testing.faults) and the wall-clock from kill to the last
+      redistributed request completing is the recovery latency."""
+    from paddle_tpu.serve.engine import DecodeEngine
+    from paddle_tpu.serve.policy import RandomRoutingPolicy
+    from paddle_tpu.serve.router import ServingRouter
+    from paddle_tpu.serve.server import ServingServer
+    from paddle_tpu.testing.faults import FaultPlan
+
+    n_rep, slots, page = 3, 4, 16
+    r = np.random.RandomState(1)
+    families = [r.randint(0, 256, (32,)).astype(np.int32)
+                for _ in range(n_rep)]
+    prompts = []
+    for i in range(30):
+        tail = r.randint(0, 256, (8 + 4 * (i % 3),)).astype(np.int32)
+        prompts.append(np.concatenate([families[i % n_rep], tail]))
+
+    def mk_fleet(policy=None, wrap=None):
+        engines = [DecodeEngine(params, cfg, slots=slots, max_len=128,
+                                page_size=page)
+                   for _ in range(n_rep)]
+        if wrap:
+            engines = [wrap.get(i, lambda e: e)(engines[i])
+                       for i in range(n_rep)]
+        # one shared prompt bucket: every replica compiles ONE
+        # prefill shape, so warmup actually covers the traffic
+        servers = [ServingServer(e, max_queue=64, max_retries=3,
+                                 buckets=(48,))
+                   for e in engines]
+        return ServingRouter(servers, policy=policy)
+
+    def drive(router, max_new=16):
+        # warm every replica's compiles OUTSIDE the timed window (3
+        # unique throwaway prompts spill one to each replica); rates
+        # are timed-window deltas, like the single-box stage
+        wr = np.random.RandomState(99)
+        for _ in range(n_rep):
+            router.submit(wr.randint(0, 256, (40,)).astype(np.int32),
+                          max_new=2)
+        router.run()
+        base = router.counters()
+        rids = [router.submit(p, max_new=max_new) for p in prompts]
+        t0 = time.perf_counter()
+        res = router.run()
+        dt = time.perf_counter() - t0
+        router.reconcile()
+        toks = sum(len(res[i].tokens) for i in rids)
+        c = router.counters()
+        hits = (c.get("fleet_prefix_hits", 0)
+                - base.get("fleet_prefix_hits", 0))
+        misses = (c.get("fleet_prefix_misses", 0)
+                  - base.get("fleet_prefix_misses", 0))
+        return toks, dt, hits / max(hits + misses, 1), c
+
+    log(f"router: affinity fleet ({n_rep} replicas)")
+    aff_router = mk_fleet()
+    toks, dt, aff_rate, _ = drive(aff_router)
+    emit("serve_router_tokens_per_sec", round(toks / dt, 1),
+         "tokens/sec", None, replicas=n_rep,
+         prefix_hit_rate_affinity=round(aff_rate, 3))
+    log("router: random-routing control fleet")
+    # separate fleet (fresh caches) over IDENTICAL traffic: the only
+    # variable is the routing policy
+    _, _, rand_rate, _ = drive(mk_fleet(
+        policy=RandomRoutingPolicy(seed=0)))
+    emit("serve_router_prefix_hit_rate", round(aff_rate, 3),
+         "fraction", None, random_routing=round(rand_rate, 3),
+         affinity_advantage=round(aff_rate - rand_rate, 3))
+
+    log("router: kill-recovery fleet")
+    plan = FaultPlan(router_kill_decode_at=8)
+    router = mk_fleet(wrap={0: lambda e: plan.wrap_replica_engine(e)})
+    # recovery latency = kill observed -> last redistributed request
+    # done, on the replicas' own clock (time.monotonic)
+    kill_t = [None]
+    orig_death = router._on_replica_death
+
+    def timed_death(rep, exc):
+        kill_t[0] = time.monotonic()
+        orig_death(rep, exc)
+
+    router._on_replica_death = timed_death
+    rids = [router.submit(p, max_new=16) for p in prompts]
+    res = router.run()
+    router.reconcile()
+    c = router.counters()
+    recovered = [res[i] for i in rids
+                 if res[i].redistributions > 0
+                 and res[i].outcome == "completed"]
+    latency = (round(max(r.done_at for r in recovered) - kill_t[0], 3)
+               if recovered and kill_t[0] is not None else None)
+    emit("serve_router_kill_recovery_latency_s", latency,
+         "seconds kill->last recovered", None,
+         requests_recovered=len(recovered),
+         replicas_lost=c["replicas_lost"],
+         redistributed=c["redistributed"],
+         completed=c["completed"],
+         all_exactly_once=bool(
+             c["completed"] + c["expired"] + c["shed"] + c["failed"]
+             == c["requests"]))
 
 
 def run_resnet_child(batch, timeout_s: int):

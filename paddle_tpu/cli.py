@@ -282,12 +282,17 @@ def cmd_serve(args) -> int:
     if missing:
         raise SystemExit(
             f"get_serve_config() is missing {sorted(missing)}")
-    eng = DecodeEngine(
-        sc["params"], sc["cfg"],
-        slots=sc.get("slots", 8) if args.slots is None else args.slots,
-        max_len=(sc.get("max_len", 2048) if args.max_len is None
-                 else args.max_len),
-        eos_id=sc.get("eos_id"), seed=args.seed)
+
+    def make_engine():
+        return DecodeEngine(
+            sc["params"], sc["cfg"],
+            slots=(sc.get("slots", 8) if args.slots is None
+                   else args.slots),
+            max_len=(sc.get("max_len", 2048) if args.max_len is None
+                     else args.max_len),
+            eos_id=sc.get("eos_id"), seed=args.seed)
+
+    eng = make_engine()
 
     with open(args.prompts) as f:
         prompts = [np.asarray([int(t) for t in line.split()], np.int32)
@@ -306,6 +311,16 @@ def cmd_serve(args) -> int:
     reliable = (args.max_queue is not None
                 or args.default_deadline_ms is not None)
     try:
+        if args.replicas is not None and args.replicas > 1:
+            # N single-box replicas behind the prefix-affinity router
+            # (docs/SERVING.md "Multi-replica routing"): one engine
+            # (and so one paged pool + prefix cache) per replica,
+            # weights shared host-side
+            engines = [eng] + [make_engine()
+                               for _ in range(args.replicas - 1)]
+            with _transfer_guard(args.transfer_guard):
+                return _serve_fleet(args, engines, prompts, sampling,
+                                    buckets, sink)
         if reliable:
             with _transfer_guard(args.transfer_guard):
                 return _serve_reliable(args, eng, prompts, sampling,
@@ -375,6 +390,18 @@ def _serve_reliable(args, eng, prompts, sampling, buckets, sink):
         # queued request expired at admission) — feed the rest
         feed()
         results = server.run()
+    _render_serve_results(args, sink, prompts, ids, results,
+                          server.counters())
+    return 0
+
+
+def _render_serve_results(args, sink, prompts, ids, results, counters):
+    """THE ordered per-request output convention, shared by the
+    single-server reliable path and the fleet path so the transcript
+    format cannot drift between them: completed requests print their
+    token ids (plus optional logprobs), everything else a
+    `# req <i> <outcome>: <reason>` comment, then one `# outcomes`
+    counters trailer a caller can reconcile the whole run from."""
     for i in range(len(prompts)):
         if i not in ids:
             print(f"# req {i} shed: not submitted (draining)",
@@ -388,9 +415,95 @@ def _serve_reliable(args, eng, prompts, sampling, buckets, sink):
                     f"{x:.4f}" for x in res.logprobs), file=sink)
         else:
             print(f"# req {i} {res.outcome}: {res.error}", file=sink)
-    c = server.counters()
-    print("# outcomes " + " ".join(f"{k}={v}" for k, v in c.items()),
+    print("# outcomes " + " ".join(f"{k}={v}"
+                                   for k, v in counters.items()),
           file=sink)
+    return 0
+
+
+def _serve_fleet(args, engines, prompts, sampling, buckets, sink):
+    """`serve --replicas N`: the multi-replica fleet (serve.router).
+    Each replica is a full reliability server; the router fronts them
+    with prefix-affinity routing, health-checked failover, and
+    replica-loss redistribution. Like _serve_reliable, the batch FEEDS
+    the fleet as queues drain (submitting everything up-front would
+    shed any batch larger than the fleet's queue capacity while the
+    pools sit idle), SIGTERM/SIGINT drains the whole fleet gracefully,
+    and the output is one line per request IN ORDER plus the fleet
+    `# outcomes` trailer."""
+    import json
+    import signal
+
+    from paddle_tpu.serve.router import QueueFullError, ServingRouter
+    from paddle_tpu.serve.server import ServingServer
+
+    servers = [
+        ServingServer(
+            e,
+            max_queue=(args.max_queue if args.max_queue is not None
+                       else 64),
+            default_deadline_ms=args.default_deadline_ms,
+            max_retries=args.max_retries,
+            buckets=buckets,
+            drain_grace_s=args.drain_grace)
+        for e in engines]
+    router = ServingRouter(servers)
+
+    def handler(signum, frame):
+        router.drain(reason=f"signal {signum}")
+
+    prev = {s: signal.signal(s, handler)
+            for s in (signal.SIGTERM, signal.SIGINT)}
+    ids = {}
+    cursor = [0]
+
+    def feed():
+        while cursor[0] < len(prompts) and not router.draining:
+            if (router.queue_space() <= 0
+                    and any(r.routable() for r in router.replicas)):
+                # queues full but the fleet is healthy: run() drains
+                # them and the next feed() continues
+                break
+            # NO routable replica: submit anyway — it raises the
+            # ledgered no-routable QueueFullError per prompt, so the
+            # batch terminates with explicit sheds instead of
+            # busy-spinning on a dead fleet
+            i = cursor[0]
+            cursor[0] += 1
+            try:
+                ids[i] = router.submit(
+                    prompts[i], max_new=args.max_new,
+                    sampling=(sampling[i] if sampling else None))
+            except (ValueError, QueueFullError) as e:
+                ids[i] = e.rr_id   # ledgered under its assigned id
+
+    # feed AS QUEUES DRAIN, like the single-server reliable path:
+    # every replica's step refills the fleet, so a batch larger than
+    # the fleet's queue capacity streams through instead of being
+    # served in drain-refill waves
+    for srv in servers:
+        srv.on_step.append(lambda _s, _step: feed())
+    try:
+        feed()
+        results = router.run()
+        while cursor[0] < len(prompts) and not router.draining:
+            feed()
+            results = router.run()
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+    router.reconcile()
+    counters = router.counters()
+    _render_serve_results(args, sink, prompts, ids, results, counters)
+    if args.drain_report and router.draining:
+        tmp = f"{args.drain_report}.tmp"
+        with open(tmp, "w") as f:
+            json.dump({"reason": "fleet drain", "counters": counters,
+                       "per_replica": router.per_replica()}, f,
+                      indent=1)
+        import os
+
+        os.replace(tmp, args.drain_report)
     return 0
 
 
@@ -552,6 +665,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="file: one whitespace-separated id sequence "
                     "per line")
     sv.add_argument("--max-new", type=int, default=128)
+    sv.add_argument("--replicas", type=int, default=None,
+                    help="serve through an N-replica fleet behind the "
+                         "prefix-affinity router (serve.router): one "
+                         "engine pool per replica, health-checked "
+                         "failover, replica-loss redistribution")
     sv.add_argument("--slots", type=int, default=None)
     sv.add_argument("--max-len", type=int, default=None)
     sv.add_argument("--buckets", default=None,

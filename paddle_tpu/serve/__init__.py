@@ -10,7 +10,11 @@ from paddle_tpu.serve.artifact import (
 from paddle_tpu.serve import quant
 from paddle_tpu.serve.engine import (DecodeEngine, EngineState,
                                      PoolStats, PrefillTicket)
-from paddle_tpu.serve.paged import PagePool, PoolExhaustedError
+from paddle_tpu.serve.paged import (PagePool, PoolExhaustedError,
+                                    chain_keys)
+from paddle_tpu.serve.policy import RandomRoutingPolicy, SchedulerPolicy
+from paddle_tpu.serve.router import (Replica, ReplicaDeadError,
+                                     RouterResult, ServingRouter)
 from paddle_tpu.serve.server import (CircuitBreaker, QueueFullError,
                                      Request, RequestResult,
                                      ServingServer)

@@ -18,6 +18,14 @@ import tarfile
 from typing import Any, Callable, Optional, Sequence
 
 import jax
+# `jax.export` is a lazily-registered submodule: bare `jax.export.…`
+# raises AttributeError unless SOMETHING imported the module first.
+# Orbax happens to, so any test run that touched a checkpoint passed —
+# and standalone runs of the artifact tests failed (test_transformer
+# serving_artifact / test_cli train_save_merge_infer, the known
+# ordering-dependent failures). Register it up front, HERE, so every
+# artifact consumer works regardless of import order.
+import jax.export  # noqa: F401  (registration side effect)
 import jax.numpy as jnp
 import numpy as np
 

@@ -77,6 +77,7 @@ from paddle_tpu.models import transformer as T
 from paddle_tpu.ops import paged_attention as pa
 from paddle_tpu.serve.paged import (PagePool, PoolExhaustedError,
                                     blocks_for)
+from paddle_tpu.serve.policy import SchedulerPolicy
 
 
 @lru_cache(maxsize=8192)
@@ -221,9 +222,17 @@ class PrefillTicket:
 
 
 class DecodeEngine:
-    """make once per (params, cfg, pool geometry); drive with
+    """The EXECUTOR half of the serving stack (the policy half is
+    `serve.policy.SchedulerPolicy` — see its docstring for the split):
+    make once per (params, cfg, pool geometry); drive with
     `init_state` / `prefill` (or `prefill_begin`/`prefill_advance`) /
-    `decode_step`, or the batteries-included `serve()` host loop."""
+    `decode_step` / `ensure_decode_page` / `release_slot` — THE
+    executor surface every scheduler (the batteries-included `serve()`
+    host loop here, `ServingServer`, the fleet router's replicas)
+    consumes — or just call `serve()`. Scheduling decisions inside
+    `serve()` (admission order, preemption victim, prefill interleave)
+    route through the `policy`; the jitted bodies and pool writes do
+    not."""
 
     def __init__(self, params, cfg: T.TransformerConfig, *, slots: int,
                  max_len: int, eos_id: Optional[int] = None,
@@ -232,7 +241,8 @@ class DecodeEngine:
                  num_pages: Optional[int] = None,
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = True,
-                 prefix_cache_blocks: int = 512):
+                 prefix_cache_blocks: int = 512,
+                 policy: Optional[SchedulerPolicy] = None):
         """Pool geometry: full-attention configs hold a block-paged KV
         pool of `num_pages` pages of `page_size` positions per layer
         (default num_pages = slots * ceil(max_len / page_size) — the
@@ -277,6 +287,7 @@ class DecodeEngine:
         # streams s8 weights. Identity (zero cost) for fp params.
         self.params, self._step_params = T._int8_step_params(params)
         self.cfg = cfg
+        self.policy = policy if policy is not None else SchedulerPolicy()
         self.slots = slots
         self.max_len = max_len
         self.eos_id = eos_id
@@ -317,6 +328,14 @@ class DecodeEngine:
         self._retire_jit = jax.jit(
             lambda active, pos, slot, fill: (
                 active.at[slot].set(False), pos.at[slot].set(fill)))
+
+    def ping(self) -> None:
+        """The health-probe surface: a cheap host-side liveness touch
+        (no device work, no state). The real engine always answers;
+        a dead-replica proxy (testing.faults) raises here exactly
+        like a lost device would on its first RPC — which is what
+        makes the fleet router's health checks honest."""
+        return None
 
     # -- state ------------------------------------------------------------
 
@@ -1008,18 +1027,25 @@ class DecodeEngine:
             for slot in range(self.slots):
                 if slot_req[slot] != -1 or not queue:
                     continue
-                req = queue[0]
+                idx = self.policy.next_index(queue)
+                req = queue[idx]
                 padded, true_len = pad_to_bucket(prompts[req],
                                                  buckets)
+                if not self.policy.can_admit(self.pool, padded,
+                                             true_len):
+                    # no pages for the policy's pick right now:
+                    # in-flight requests will free some — keep it
+                    # queued in place
+                    break
                 try:
                     state, ticket = self.prefill_begin(
                         state, slot, padded, true_len=true_len,
                         sampling=(sampling[req] if sampling else None))
                 except PoolExhaustedError:
-                    # no pages for the queue head right now: in-flight
-                    # requests will free some — keep it queued, FIFO
+                    # the gate passed but admit still raised (an
+                    # injected alloc fault) — same answer: wait
                     break
-                queue.pop(0)
+                queue.pop(idx)
                 slot_req[slot] = req
                 stats.prefills += 1
                 stats.admitted += 1
@@ -1039,21 +1065,22 @@ class DecodeEngine:
                     pending[slot] = ticket
 
         def preempt_or_retire(slot: int) -> bool:
-            """Pool exhausted extending `slot`: evict the
-            LOWEST-PRIORITY in-flight request (latest submission
+            """Pool exhausted extending `slot`: evict the victim the
+            policy picks (default: LOWEST priority = latest submission
             order) back onto the queue — possibly `slot` itself, which
-            then yields to its seniors. Priority is a TOTAL order, so
-            the most senior active request is never preempted and
-            always progresses: no two slots can preempt each other
-            forever (the recompute-preemption livelock). Returns True
-            to retry the page grab, False when `slot` is gone (yielded
-            or — alone in the pool — retired at pool capacity, the
-            paged analog of the max_len bound). Mirrors the server's
-            shed/requeue semantics for the plain loop."""
+            then yields to its seniors. The default priority is a
+            TOTAL order, so the most senior active request is never
+            preempted and always progresses: no two slots can preempt
+            each other forever (the recompute-preemption livelock).
+            Returns True to retry the page grab, False when `slot` is
+            gone (yielded or — alone in the pool — retired at pool
+            capacity, the paged analog of the max_len bound). Mirrors
+            the server's shed/requeue semantics for the plain loop."""
             nonlocal state
             holders = [s_ for s_ in range(self.slots)
                        if slot_req[s_] != -1]
-            s_v = max(holders, key=lambda s_: slot_req[s_])
+            s_v = self.policy.preemption_victim(
+                [(s_, slot_req[s_]) for s_ in holders])
             if s_v == slot and len(holders) == 1:
                 # nobody to yield to: pool capacity IS this request's
                 # bound — retire it with the tokens it has
@@ -1075,14 +1102,18 @@ class DecodeEngine:
         admit()
         while any(r != -1 for r in slot_req):
             # one prefill chunk per mid-prefill slot, interleaved with
-            # the decode steps below (chunked prefill's whole point)
-            for slot in sorted(pending):
-                ticket = pending[slot]
+            # the decode steps below (chunked prefill's whole point);
+            # which slots advance (and in what order) is the policy's
+            for slot in self.policy.prefill_slots(list(pending)):
+                ticket = pending.get(slot)
+                if ticket is None:
+                    continue
                 state, done = self.prefill_advance(state, ticket)
                 if done:
                     del pending[slot]
-            if not any(slot_req[s_] != -1 and s_ not in pending
-                       for s_ in range(self.slots)):
+            decoding = sum(slot_req[s_] != -1 and s_ not in pending
+                           for s_ in range(self.slots))
+            if not self.policy.should_decode(decoding, len(pending)):
                 continue        # only prefills in flight — no step
             state, toks, tok_lps, was_active, fin = \
                 self.decode_step(state)

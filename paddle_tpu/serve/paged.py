@@ -62,6 +62,37 @@ def blocks_for(true_len: int, page_size: int) -> int:
     return true_len // page_size + 1
 
 
+def shareable_blocks(true_len: int, page_size: int) -> int:
+    """Leading FULL blocks a prompt of `true_len` may consume from a
+    prefix cache: strictly before the block holding its last prompt
+    token, so >= 1 position always prefills (the first-token logits
+    need a real forward). Module-level twin of the pool method, shared
+    with the router's affinity-key derivation."""
+    return (true_len - 1) // page_size
+
+
+def chain_keys(tokens, true_len: int, page_size: int,
+               n_blocks: Optional[int] = None) -> List[tuple]:
+    """The prompt's CHAINED block keys, shallowest first: key[b] =
+    (key[b-1], block b's page_size token ids), key[-1] = (). THE one
+    derivation of the prefix-cache key — `PagePool`'s lookup/register
+    and the fleet router's affinity map (serve.router) both call it,
+    so "a request whose prefix is hot on replica k" is decided by
+    exactly the hash the replica's own cache would hit. Default depth
+    is the CONSUMER bound (`shareable_blocks`); register passes the
+    publisher bound (every full block) explicitly."""
+    if n_blocks is None:
+        n_blocks = shareable_blocks(true_len, page_size)
+    keys: List[tuple] = []
+    key: tuple = ()
+    for b in range(n_blocks):
+        key = (key, tuple(int(t)
+                          for t in tokens[b * page_size:
+                                          (b + 1) * page_size]))
+        keys.append(key)
+    return keys
+
+
 class PoolExhaustedError(RuntimeError):
     """No free page and nothing reclaimable — the paged pool's
     backpressure signal. Transient by nature (pages free as co-tenant
@@ -191,16 +222,10 @@ class PagePool:
 
     # -- the prefix cache --------------------------------------------------
 
-    @staticmethod
-    def _block_tokens(tokens, b: int, page: int) -> Tuple[int, ...]:
-        return tuple(int(t) for t in tokens[b * page:(b + 1) * page])
-
     def shareable_blocks(self, true_len: int) -> int:
-        """How many leading FULL blocks a prompt of `true_len` may
-        CONSUME from the cache: strictly before the block holding its
-        last prompt token, so >= 1 position always prefills (the
-        first-token logits need a real forward)."""
-        return (true_len - 1) // self.page_size
+        """`shareable_blocks(true_len, self.page_size)` — see the
+        module function (the single consumer-bound convention)."""
+        return shareable_blocks(true_len, self.page_size)
 
     def lookup(self, tokens, true_len: int) -> List[int]:
         """Longest chain of cached leading blocks for this prompt
@@ -210,10 +235,8 @@ class PagePool:
         pages: List[int] = []
         if not self.prefix_cache_enabled:
             return pages
-        key: tuple = ()
-        for b in range(self.shareable_blocks(true_len)):
-            blk = self._block_tokens(tokens, b, self.page_size)
-            key = (key, blk)
+        for key in chain_keys(tokens, true_len, self.page_size):
+            blk = key[1]
             entry = self._cache.get(key)
             if entry is None:
                 break
@@ -235,11 +258,12 @@ class PagePool:
         already present (touched, not re-referenced)."""
         if not self.prefix_cache_enabled:
             return
-        key: tuple = ()
         n_full = true_len // self.page_size
-        for b in range(min(n_full, len(self.slot_pages[slot]))):
-            blk = self._block_tokens(tokens, b, self.page_size)
-            key = (key, blk)
+        keys = chain_keys(tokens, true_len, self.page_size,
+                          n_blocks=min(n_full,
+                                       len(self.slot_pages[slot])))
+        for b, key in enumerate(keys):
+            blk = key[1]
             if key in self._cache:
                 self._cache.move_to_end(key)
                 continue
@@ -262,12 +286,9 @@ class PagePool:
         `lookup` does all of that exactly once."""
         pages: List[int] = []
         if self.prefix_cache_enabled:
-            key: tuple = ()
-            for b in range(self.shareable_blocks(true_len)):
-                blk = self._block_tokens(tokens, b, self.page_size)
-                key = (key, blk)
+            for key in chain_keys(tokens, true_len, self.page_size):
                 entry = self._cache.get(key)
-                if entry is None or entry.tokens != blk:
+                if entry is None or entry.tokens != key[1]:
                     break
                 pages.append(entry.page)
         return pages

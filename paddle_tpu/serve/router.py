@@ -1,0 +1,539 @@
+"""Multi-replica serving fleet: the prefix-affinity router.
+
+One `ServingServer` is a single box: a replica loss takes every
+in-flight request and the whole prefix cache with it, and full-program
+compilation (PAPERS.md: arxiv 1810.09868) makes a replica restart
+expensive enough that ROUTING AROUND failure — not waiting out a
+recompile — is the only production answer. `ServingRouter` fronts N
+replicas (each a `ServingServer` over its own `DecodeEngine` pool) and
+owns three jobs:
+
+- **Prefix-affinity routing.** The paged pool's chained block hashes
+  (serve.paged, "Ragged Paged Attention", arxiv 2604.15464) are
+  exactly the routing key: the router derives a prompt's chain with
+  THE SAME `paged.chain_keys` the replica-local prefix caches hash
+  with, keeps a bounded LRU affinity map (chain key -> replica), and
+  lands a request on the replica holding its DEEPEST cached prefix —
+  so the fleet-wide hit rate approaches the single-box rate instead of
+  dividing by N. A miss (or an unroutable affinity target) spills to
+  the least-loaded replica; which replica wins is a
+  `SchedulerPolicy.route`/`spill` decision, so routing policy is
+  pluggable like every other scheduling choice.
+
+- **Health-checked failover.** Each replica carries the same
+  `CircuitBreaker` idiom the server uses for its native backend:
+  periodic probes (injectable clock, `probe_interval_s`) feed the
+  breaker, an open breaker takes the replica out of the candidate set,
+  and after `cooldown_s` the half-open probe decides — closed on
+  success, re-opened on failure. A probe BLACKHOLE (probes fail while
+  the replica might be fine) therefore degrades to "stop routing
+  there", never to a hang.
+
+- **Redistribution on replica loss.** A dead replica's engine raises a
+  replica-fatal error out of `ServingServer.step()` with the host-side
+  scheduler LEDGER intact (`pending_requests()`); the router harvests
+  it and resubmits every request that had NO terminal outcome to a
+  survivor — remaining `retries_left` carried over (budgets intact),
+  remaining deadline recomputed on the shared clock, original sampling
+  preserved. Requests whose outcome already landed keep it. The
+  invariant the chaos suite (`tests/test_router.py`) proves: every
+  router-submitted request ends in EXACTLY ONE outcome — never lost
+  with the device, never served twice — and the fleet's counters
+  reconcile. Redistributed decodes restart from a fresh prefill on the
+  survivor (recompute failover): greedy and explicitly-seeded
+  requests yield the exact tokens they would have without the kill.
+
+Planned maintenance uses `retire_replica()` instead: stop routing to
+the replica, redistribute its QUEUE immediately, let its in-flight
+work finish in place, then drop it from the sweep — zero recompute.
+
+The router is pure host-side scheduling — no jax import, nothing
+staged — so the fleet's hot path stays clean under
+`transfer_guard("disallow")` exactly as each replica's decode loop
+already is.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from paddle_tpu.serve.paged import chain_keys
+from paddle_tpu.serve.policy import SchedulerPolicy
+from paddle_tpu.serve.server import (COMPLETED, EXPIRED, FAILED, OUTCOMES,
+                                     SHED, CircuitBreaker, QueueFullError,
+                                     Request, ServingServer)
+
+
+class ReplicaDeadError(RuntimeError):
+    """A replica's engine is GONE (device lost, process killed). The
+    `replica_fatal` marker tells `ServingServer.step()` to propagate
+    instead of burning retry budgets against a corpse; the router
+    catches it, marks the replica dead, and redistributes."""
+
+    replica_fatal = True
+
+
+@dataclasses.dataclass
+class RouterResult:
+    """Terminal record for one router-submitted request. `replica` is
+    the replica that produced the outcome; `redistributions` counts
+    replica-loss handoffs (0 for a request that never moved);
+    `retries` mirrors the serving-level transient-retry count."""
+
+    rr_id: int
+    outcome: str
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    logprobs: List[float] = dataclasses.field(default_factory=list)
+    error: Optional[str] = None
+    replica: Optional[int] = None
+    redistributions: int = 0
+    retries: int = 0
+    done_at: float = 0.0        # the serving replica's clock
+
+
+class Replica:
+    """One fleet member: a `ServingServer` plus its health state.
+    `probe_hook` is the fault seam (`FaultPlan.wrap_probe`): a raising
+    hook is a blackholed health check."""
+
+    def __init__(self, rid: int, server: ServingServer,
+                 breaker: CircuitBreaker):
+        self.rid = rid
+        self.server = server
+        self.breaker = breaker
+        self.alive = True
+        self.retired = False
+        # rep-local req_id -> router rr_id, for every request routed
+        # here whose outcome has not been mirrored yet
+        self.pending: Dict[int, int] = {}
+        self.probe_hook: Optional[Callable] = None
+
+    def load(self) -> int:
+        return self.server.load()
+
+    def routable(self) -> bool:
+        """May NEW traffic land here? Alive, not retiring, and the
+        health breaker closed — half-open replicas are probed back to
+        health, not fed live requests."""
+        return (self.alive and not self.retired
+                and self.breaker.state == "closed")
+
+    def probe(self) -> None:
+        """One health check: the hook seam first (a raising hook is a
+        blackholed probe), then the server's `ping()` — which touches
+        the active backend, so a dead engine raises here exactly like
+        a lost device answering its first RPC."""
+        if self.probe_hook is not None:
+            self.probe_hook(self)
+        if not self.alive:
+            raise ReplicaDeadError(f"replica {self.rid} is dead")
+        self.server.ping()
+
+
+class ServingRouter:
+    """Front N `ServingServer` replicas with prefix-affinity routing,
+    health-checked failover, and exactly-once redistribution. Drive it
+    like a server: `submit()` traffic, `run()` until the fleet drains,
+    `counters()`/`reconcile()` for the ledger. The drive loop
+    round-robins one `step()` per live replica per sweep, so a slow
+    replica skews its own latency, not the fleet's."""
+
+    def __init__(self, servers: List[ServingServer], *,
+                 clock: Callable[[], float] = time.monotonic,
+                 failure_threshold: int = 3,
+                 cooldown_s: float = 30.0,
+                 probe_interval_s: float = 5.0,
+                 affinity_blocks: int = 4096,
+                 policy: Optional[SchedulerPolicy] = None):
+        if not servers:
+            raise ValueError("a fleet needs >= 1 replica")
+        self.clock = clock
+        self.policy = (policy if policy is not None
+                       else SchedulerPolicy())
+        self.probe_interval_s = probe_interval_s
+        self.affinity_blocks = affinity_blocks
+        self.replicas = [
+            Replica(i, srv, CircuitBreaker(
+                failure_threshold=failure_threshold,
+                cooldown_s=cooldown_s, clock=clock))
+            for i, srv in enumerate(servers)]
+        # affinity key geometry comes from the replica engines; a
+        # non-paged fleet (ring pools have no prefix cache) routes by
+        # load alone
+        eng = servers[0].engine
+        self._paged = bool(getattr(eng, "paged", False)
+                           and getattr(eng, "prefix_cache", False))
+        self._page_size = int(getattr(eng, "page_size", 0) or 0)
+        # chain key -> Replica, LRU-bounded like the replica caches
+        self._affinity: "collections.OrderedDict[tuple, Replica]" = \
+            collections.OrderedDict()
+        self.results: Dict[int, RouterResult] = {}
+        self._next_id = 0
+        self._last_probe = float("-inf")
+        # rr_id -> redistribution hops so far, for requests currently
+        # living on their second-or-later replica
+        self._moved: Dict[int, int] = {}
+        # fleet ledger counters (requests is submissions; the outcome
+        # keys tally self.results exactly — reconcile() asserts it)
+        self.stats: Dict[str, int] = {
+            "requests": 0, "completed": 0, "expired": 0, "shed": 0,
+            "failed": 0, "redistributed": 0, "replicas_lost": 0,
+            "affinity_hits": 0, "affinity_spills": 0}
+        # dead replicas' pool counters, banked at death so aggregate
+        # prefix-hit observability never goes backwards
+        self._dead_base: Dict[str, int] = {}
+
+    # -- routing -----------------------------------------------------------
+
+    def _chain(self, prompt) -> List[tuple]:
+        if not self._paged:
+            return []
+        arr = np.asarray(prompt)
+        if arr.ndim != 1 or not np.issubdtype(arr.dtype, np.integer):
+            # malformed traffic gets NO affinity key — it still
+            # routes (spill), and the replica's validator rejects it
+            # with the documented ValueError
+            return []
+        return chain_keys(arr, int(arr.size), self._page_size)
+
+    def _note_affinity(self, chain: List[tuple], rep: Replica) -> None:
+        """The chosen replica is about to prefill (and so register)
+        every block on this chain: point the affinity map there.
+        Bounded LRU, mirroring the replica-local cache bound."""
+        for key in chain:
+            if key in self._affinity:
+                self._affinity.move_to_end(key)
+            self._affinity[key] = rep
+        while len(self._affinity) > self.affinity_blocks:
+            self._affinity.popitem(last=False)
+
+    def _pick(self, chain: List[tuple]) -> Optional[Replica]:
+        cands = [r for r in self.replicas if r.routable()]
+        # prefer replicas with admission-queue space: an affinity
+        # target that is FULL is a miss (spill costs one prefill; a
+        # shed loses the request) — only when EVERY queue is full do
+        # all candidates stay in, so the replica-level displacement
+        # shed still decides genuine fleet-wide overload
+        roomy = [r for r in cands if r.server.queue_space > 0]
+        rep = self.policy.route(chain, self._affinity, roomy or cands)
+        if rep is not None:
+            hit = any(self._affinity.get(k) is rep
+                      for k in reversed(chain))
+            self.stats["affinity_hits" if hit
+                       else "affinity_spills"] += 1
+        return rep
+
+    def submit(self, prompt, *, max_new: int,
+               deadline_ms: Optional[float] = -1,
+               sampling: Optional[dict] = None) -> int:
+        """Route one request into the fleet; returns its router-level
+        rr_id. Mirrors the single-server contract: malformed input
+        raises ValueError (ledgered FAILED), an overload shed raises
+        QueueFullError (ledgered SHED) — both carry `.rr_id` so burst
+        callers reconcile without catching. Either way the request has
+        exactly one outcome in `results` eventually."""
+        rr_id = self._next_id
+        self._next_id += 1
+        self.stats["requests"] += 1
+        chain = self._chain(prompt)
+        rep = self._pick(chain)
+        if rep is None:
+            res = RouterResult(
+                rr_id=rr_id, outcome=SHED,
+                error="load shed: no routable replica (fleet "
+                      "unhealthy or draining)")
+            self._record(res)
+            err = QueueFullError(res.error)
+            err.rr_id = rr_id
+            raise err
+        try:
+            rep_id = rep.server.submit(
+                prompt, max_new=max_new, deadline_ms=deadline_ms,
+                sampling=sampling)
+        except ValueError as e:
+            # deterministic rejection by the replica's validator —
+            # mirror its (already ledgered) FAILED result
+            self._record(RouterResult(
+                rr_id=rr_id, outcome=FAILED, error=str(e),
+                replica=rep.rid))
+            e.rr_id = rr_id
+            raise
+        except QueueFullError as e:
+            # the replica shed the INCOMING request as cheapest to
+            # retry (a displaced QUEUED victim is mirrored on the
+            # next sweep instead)
+            self._record(RouterResult(
+                rr_id=rr_id, outcome=SHED, error=str(e),
+                replica=rep.rid))
+            e.rr_id = rr_id
+            raise
+        rep.pending[rep_id] = rr_id
+        self._note_affinity(chain, rep)
+        return rr_id
+
+    # -- the ledger --------------------------------------------------------
+
+    def _record(self, res: RouterResult) -> None:
+        assert res.rr_id not in self.results, (
+            f"request {res.rr_id} already has outcome "
+            f"{self.results[res.rr_id].outcome}, refusing a second")
+        self.results[res.rr_id] = res
+        self.stats[res.outcome] += 1
+
+    def _mirror(self, rep: Replica) -> None:
+        """Pull newly-terminal outcomes from the replica's ledger into
+        the fleet ledger. Carries the redistribution count forward so
+        a handed-off request's final record names every hop."""
+        for rep_id in [i for i in rep.pending
+                       if i in rep.server.results]:
+            rr_id = rep.pending.pop(rep_id)
+            r = rep.server.results[rep_id]
+            prior = self._moved.get(rr_id, 0)
+            self._record(RouterResult(
+                rr_id=rr_id, outcome=r.outcome,
+                tokens=list(r.tokens), logprobs=list(r.logprobs),
+                error=r.error, replica=rep.rid,
+                redistributions=prior, retries=r.retries,
+                done_at=r.done_at))
+            self._moved.pop(rr_id, None)
+
+    # -- failover ----------------------------------------------------------
+
+    def _bank_pool_counters(self, rep: Replica) -> None:
+        for k, v in rep.server.counters().items():
+            if k in ("prefix_hits", "prefix_misses", "prefix_rejected",
+                     "prefill_chunks", "requests", "completed",
+                     "expired", "shed", "failed", "retried",
+                     "admitted"):
+                self._dead_base[k] = self._dead_base.get(k, 0) + v
+
+    def _on_replica_death(self, rep: Replica, exc: Exception) -> None:
+        """The crash path: mark dead, drop its affinity entries (its
+        cache died with it), mirror what already finished, and
+        redistribute everything still pending — remaining retry
+        budgets and deadlines intact, exactly one outcome each."""
+        rep.alive = False
+        rep.breaker.record_failure()
+        self.stats["replicas_lost"] += 1
+        for key in [k for k, r in self._affinity.items() if r is rep]:
+            del self._affinity[key]
+        self._mirror(rep)           # outcomes that beat the crash
+        self._bank_pool_counters(rep)
+        ledger = {r.req_id: r for r in rep.server.pending_requests()}
+        for rep_id, rr_id in sorted(rep.pending.items()):
+            req = ledger.get(rep_id)
+            self._redistribute(
+                rr_id, req,
+                why=f"replica {rep.rid} lost ({exc})")
+        rep.pending.clear()
+
+    def _redistribute(self, rr_id: int, req: Optional[Request],
+                      why: str) -> None:
+        if req is None:
+            # cannot happen through the harvest contract (pending =
+            # not-terminal = in the ledger); terminal defense so a
+            # request is never silently dropped
+            self._record(RouterResult(
+                rr_id=rr_id, outcome=FAILED,
+                error=f"request lost in failover: {why}"))
+            return
+        moves = self._moved.get(rr_id, 0) + 1
+        self._moved[rr_id] = moves
+        self.stats["redistributed"] += 1
+        chain = self._chain(req.prompt)
+        rep = self._pick(chain)
+        if rep is None:
+            self._moved.pop(rr_id, None)
+            self._record(RouterResult(
+                rr_id=rr_id, outcome=FAILED,
+                error=f"no live replica to redistribute to: {why}",
+                redistributions=moves))
+            return
+        remaining_ms = (None if req.deadline is None else
+                        (req.deadline - self.clock()) * 1000.0)
+        try:
+            rep_id = rep.server.submit(
+                req.prompt, max_new=req.max_new,
+                deadline_ms=remaining_ms, sampling=req.sampling,
+                retries_left=req.retries_left)
+        except (ValueError, QueueFullError) as e:
+            # the survivor's validator/shed verdict IS the outcome
+            # (an already-expired deadline lands here as shed/failed
+            # only via overload; expiry itself is handled in-queue)
+            self._moved.pop(rr_id, None)
+            self._record(RouterResult(
+                rr_id=rr_id, outcome=(
+                    FAILED if isinstance(e, ValueError) else SHED),
+                error=f"redistribution refused: {e}",
+                replica=rep.rid, redistributions=moves))
+            return
+        rep.pending[rep_id] = rr_id
+        self._note_affinity(chain, rep)
+
+    def drain(self, reason: str = "drain requested") -> None:
+        """Fleet-wide graceful drain (the SIGTERM path): every live
+        replica stops admitting, sheds its queue, and finishes
+        in-flight work within its own drain grace; `run()` then
+        mirrors the outcomes as usual. New submits shed with the
+        replica's draining error."""
+        for rep in self.replicas:
+            if rep.alive:
+                rep.server.drain(reason=reason)
+
+    @property
+    def draining(self) -> bool:
+        return any(rep.alive and rep.server.draining
+                   for rep in self.replicas)
+
+    def queue_space(self) -> int:
+        """Free admission capacity across routable replicas — batch
+        feeders submit at most this many before the next run()."""
+        return sum(r.server.queue_space for r in self.replicas
+                   if r.routable())
+
+    def retire_replica(self, rid: int,
+                       reason: str = "retired") -> None:
+        """The PLANNED-maintenance path: stop routing to the replica,
+        redistribute its queue NOW (those requests never started, so
+        the handoff is free), and let its in-flight slots finish in
+        place — zero recompute, then the sweep drops it once idle."""
+        rep = self.replicas[rid]
+        rep.retired = True
+        for req in list(rep.server.queue):
+            # the replica never produced an outcome for these: the
+            # server withdraws them from its own ledger (queue +
+            # submission counter, one operation — ServingServer owns
+            # its books) and they route as a fresh redistribution,
+            # budgets intact
+            if rep.server.withdraw_queued(req.req_id) is None:
+                continue
+            rr_id = rep.pending.pop(req.req_id, None)
+            if rr_id is None:
+                continue
+            self._redistribute(rr_id, req, why=reason)
+
+    # -- health ------------------------------------------------------------
+
+    def _probe_due(self) -> bool:
+        return (self.clock() - self._last_probe
+                >= self.probe_interval_s)
+
+    def probe_all(self) -> None:
+        """One health sweep: every non-dead replica gets a probe; the
+        breaker ingests the verdict (open after failure_threshold
+        consecutive failures; half-open probes close on success).
+        `allow()` gates the probe so the breaker's half-open contract
+        holds: ONE post-cooldown probe decides — success closes,
+        failure RE-OPENS for another full cooldown (without allow()'s
+        sticky half-open mark, a failing half-open probe would leave
+        the breaker half-open and re-probe every interval)."""
+        self._last_probe = self.clock()
+        for rep in self.replicas:
+            if not rep.alive or rep.retired:
+                continue
+            if not rep.breaker.allow():
+                continue            # open: cooling down — no probe yet
+            try:
+                rep.probe()
+            except Exception as e:
+                # duck-typed like every other failover site: ANY
+                # replica-fatal error (not just our class) is a death,
+                # everything else a transient probe failure for the
+                # breaker
+                if getattr(e, "replica_fatal", False):
+                    self._on_replica_death(rep, e)
+                else:
+                    rep.breaker.record_failure()
+            else:
+                rep.breaker.record_success()
+
+    # -- the drive loop ----------------------------------------------------
+
+    def run(self) -> Dict[int, RouterResult]:
+        """Serve until every replica is idle: round-robin one
+        `step()` per live replica per sweep, probing on the
+        `probe_interval_s` cadence, harvesting outcomes, and
+        redistributing on any replica-fatal error. Safe to call
+        repeatedly — later `submit()`s extend the same ledger."""
+        while True:
+            if self._probe_due():
+                self.probe_all()
+            busy = False
+            for rep in self.replicas:
+                if not rep.alive:
+                    continue
+                try:
+                    busy = rep.server.step() or busy
+                except Exception as e:
+                    if getattr(e, "replica_fatal", False):
+                        self._on_replica_death(rep, e)
+                        busy = True     # survivors just got work
+                        continue
+                    raise
+                self._mirror(rep)
+            if not busy:
+                break
+        return self.results
+
+    # -- observability -----------------------------------------------------
+
+    def counters(self) -> Dict[str, int]:
+        """The fleet ledger (router-level outcome tallies + routing
+        and failover counters) plus the AGGREGATE pool/serving
+        counters summed across replicas — dead replicas' contributions
+        banked at death, so prefix-hit observability survives a
+        crash. Per-replica detail: `per_replica()`."""
+        out = dict(self.stats)
+        out["replicas_alive"] = sum(
+            r.alive and not r.retired for r in self.replicas)
+        agg: Dict[str, int] = dict(self._dead_base)
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            for k, v in rep.server.counters().items():
+                agg[k] = agg.get(k, 0) + v
+        for k, v in agg.items():
+            out[f"fleet_{k}"] = v
+        return out
+
+    def per_replica(self) -> Dict[int, Dict[str, int]]:
+        return {rep.rid: rep.server.counters()
+                for rep in self.replicas if rep.alive}
+
+    def prefix_hit_rate(self) -> float:
+        """Aggregate replica-local prefix-cache hit rate — the number
+        the chaos suite watches recover after a kill redistributes a
+        dead cache's traffic onto cold survivors."""
+        c = self.counters()
+        h = c.get("fleet_prefix_hits", 0)
+        m = c.get("fleet_prefix_misses", 0)
+        return h / max(h + m, 1)
+
+    def reconcile(self) -> None:
+        """The fleet accounting contract, chaos-tested: every
+        router-submitted request has EXACTLY ONE terminal outcome
+        (`_record` refuses seconds; this asserts none is missing),
+        the outcome tallies equal the ledger, nothing is still
+        pending anywhere, and every live replica's own books balance
+        (`ServingServer.reconcile`, page invariants included)."""
+        assert len(self.results) == self.stats["requests"], (
+            len(self.results), self.stats["requests"])
+        tally = {o: 0 for o in OUTCOMES}
+        for res in self.results.values():
+            assert res.outcome in OUTCOMES, res
+            tally[res.outcome] += 1
+        for o in OUTCOMES:
+            assert tally[o] == self.stats[o], (
+                o, tally[o], self.stats[o])
+        assert not self._moved, self._moved
+        for rep in self.replicas:
+            assert not rep.pending, (
+                f"replica {rep.rid} still holds unmirrored requests "
+                f"{rep.pending}")
+            if rep.alive and not rep.retired:
+                rep.server.reconcile()

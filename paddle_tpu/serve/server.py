@@ -85,6 +85,7 @@ import numpy as np
 
 from paddle_tpu.serve.engine import PoolStats, pad_to_bucket
 from paddle_tpu.serve.paged import PoolExhaustedError, blocks_for
+from paddle_tpu.serve.policy import SchedulerPolicy
 
 log = logging.getLogger(__name__)
 
@@ -105,6 +106,17 @@ class QueueFullError(RuntimeError):
     """The admission queue is full and the INCOMING request was the
     cheapest to retry — the explicit-backpressure signal. The request
     is recorded shed; the caller should back off and resubmit."""
+
+
+def _replica_fatal(exc: Exception) -> bool:
+    """True for errors that mean the BACKEND IS GONE (a dead replica's
+    engine raising `serve.router.ReplicaDeadError`), not a transient
+    fault: the server must NOT burn the in-flight requests' retry
+    budgets against a corpse — it re-raises so the fleet router can
+    mark the replica dead and redistribute with budgets intact. Duck-
+    typed on a `replica_fatal` attribute so this module needs no
+    import of the router (which imports it)."""
+    return bool(getattr(exc, "replica_fatal", False))
 
 
 class CircuitBreaker:
@@ -221,7 +233,8 @@ class ServingServer:
                  breaker: Optional[CircuitBreaker] = None,
                  clock: Callable[[], float] = time.monotonic,
                  drain_report_path: Optional[str] = None,
-                 install_signal_handlers: bool = False):
+                 install_signal_handlers: bool = False,
+                 policy: Optional[SchedulerPolicy] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         if max_retries < 0:
@@ -236,6 +249,12 @@ class ServingServer:
                     f"the cache")
         self.engine = engine              # the pure-JAX fallback
         self.native_backend = native_backend
+        # scheduling DECISIONS route through the policy surface
+        # (serve.policy): default to the engine's own policy so one
+        # object governs both schedulers, else the stock FIFO policy
+        self.policy = (policy if policy is not None
+                       else getattr(engine, "policy", None)
+                       or SchedulerPolicy())
         self.max_queue = max_queue
         self.default_deadline_ms = default_deadline_ms
         self.max_retries = max_retries
@@ -349,9 +368,14 @@ class ServingServer:
 
     def submit(self, prompt, *, max_new: int,
                deadline_ms: Optional[float] = -1,
-               sampling: Optional[dict] = None) -> int:
+               sampling: Optional[dict] = None,
+               retries_left: Optional[int] = None) -> int:
         """Enqueue one request; returns its req_id. `deadline_ms` is
         relative to now (-1 = the server default, None = no deadline).
+        `retries_left` overrides the transient-fault budget for THIS
+        request (default: the server's `max_retries`) — the fleet
+        router uses it to redistribute a dead replica's requests onto
+        survivors with their remaining budgets intact.
 
         Raises ValueError for malformed input (recorded FAILED — it
         never enters the queue) and QueueFullError when the queue is
@@ -378,7 +402,9 @@ class ServingServer:
         req = Request(req_id=req_id, prompt=arr, true_len=int(arr.size),
                       max_new=max_new, sampling=sampling,
                       deadline=deadline, submitted_at=now,
-                      retries_left=self.max_retries)
+                      retries_left=(self.max_retries
+                                    if retries_left is None
+                                    else retries_left))
         if self._draining:
             self._finish(req, SHED,
                          error="load shed: server is draining")
@@ -387,7 +413,7 @@ class ServingServer:
             err.req_id = req_id
             raise err
         if len(self.queue) >= self.max_queue:
-            victim = min(self.queue + [req], key=lambda r: r.retry_cost)
+            victim = self.policy.shed_victim(self.queue, req)
             if victim is req:
                 self._finish(req, SHED, error=(
                     f"load shed: queue full (max_queue="
@@ -403,6 +429,21 @@ class ServingServer:
                 f"displaced as cheapest to retry"))
         self.queue.append(req)
         return req_id
+
+    def withdraw_queued(self, req_id: int) -> Optional[Request]:
+        """Remove a QUEUED request as if it had never been submitted:
+        it leaves the queue and the submission counter backs it out,
+        so this server's ledger stays balanced (len(results) ==
+        stats.requests) with no terminal outcome recorded here. The
+        fleet router's retire path uses this to hand a retiring
+        replica's queue to survivors; returns None when `req_id` is
+        not queued (already admitted, finished, or unknown)."""
+        for req in self.queue:
+            if req.req_id == req_id:
+                self.queue.remove(req)
+                self.stats.requests -= 1
+                return req
+        return None
 
     # -- drain -------------------------------------------------------------
 
@@ -552,7 +593,7 @@ class ServingServer:
         requeue/fail discipline as one-shot prefill (the wrapped
         engine raises BEFORE touching the state; the slot's pages are
         freed by the retire)."""
-        for slot in sorted(self._prefilling):
+        for slot in self.policy.prefill_slots(list(self._prefilling)):
             ticket = self._prefilling.get(slot)
             req = self._slot_req[slot]
             if ticket is None or req is None:
@@ -566,6 +607,8 @@ class ServingServer:
                              error=f"prefill rejected: {e}")
                 continue
             except Exception as e:
+                if _replica_fatal(e):
+                    raise       # dead backend: the router's problem
                 if self._backend is self.native_backend:
                     self._native_fault(e)
                 if self._slot_req[slot] is req:
@@ -602,7 +645,9 @@ class ServingServer:
                 holders = [
                     (s2, r2) for s2, r2 in enumerate(self._slot_req)
                     if r2 is not None]
-                s2, r2 = max(holders, key=lambda sr: sr[1].req_id)
+                s2 = self.policy.preemption_victim(
+                    [(s_, r_.req_id) for s_, r_ in holders])
+                r2 = self._slot_req[s2]
                 if s2 == slot and len(holders) == 1:
                     self._retire_slot(slot)
                     self._finish(
@@ -628,25 +673,26 @@ class ServingServer:
         while not self._draining and self.queue and any(
                 r is None for r in self._slot_req):
             slot = self._slot_req.index(None)
-            req = self.queue.pop(0)
+            idx = self.policy.next_index(self.queue)
+            req = self.queue.pop(idx)
             now = self.clock()
             if req.deadline is not None and now >= req.deadline:
                 self._finish(req, EXPIRED, error=(
                     "deadline expired at admission (prefill skipped)"))
                 continue
             pool = getattr(self._backend, "pool", None)
-            if pool is not None:
-                # the binding resource on a paged engine is PAGES, not
-                # slots: defer admission while the pool could not map
-                # the request's post-prefix-reuse need right now —
-                # in-flight work frees pages, and with nothing in
-                # flight the whole pool is available (submit() already
-                # rejected what can never fit). admissible() mirrors
-                # admit()'s own reclaim arithmetic, so a passed gate
-                # cannot raise a spurious PoolExhaustedError
-                if not pool.admissible(req.prompt, req.true_len):
-                    self.queue.insert(0, req)
-                    break
+            # the binding resource on a paged engine is PAGES, not
+            # slots: the policy defers admission while the pool could
+            # not map the request's post-prefix-reuse need right now —
+            # in-flight work frees pages, and with nothing in flight
+            # the whole pool is available (submit() already rejected
+            # what can never fit). can_admit mirrors admit()'s own
+            # reclaim arithmetic, so a passed gate cannot raise a
+            # spurious PoolExhaustedError
+            if not self.policy.can_admit(pool, req.prompt,
+                                         req.true_len):
+                self.queue.insert(idx, req)
+                break
             chunked = (getattr(self._backend, "prefill_chunk", None)
                        is not None
                        and hasattr(self._backend, "prefill_begin"))
@@ -671,6 +717,12 @@ class ServingServer:
                 self._requeue_or_fail(req, f"prefill fault: {e}")
                 continue
             except Exception as e:
+                if _replica_fatal(e):
+                    # dead backend: requeue the request UNCHARGED (the
+                    # fault is the replica's, not the request's) and
+                    # let the router take over
+                    self.queue.insert(0, req)
+                    raise
                 # transient fault (an injected engine fault or a
                 # native bridge error): the held state is untouched
                 # (prefill is pure / begin leaves the pool untouched
@@ -704,94 +756,113 @@ class ServingServer:
         return (self._draining and self._drain_deadline is not None
                 and self.clock() >= self._drain_deadline)
 
+    def step(self) -> bool:
+        """ONE drive-loop iteration: shed/expire/admit, advance one
+        prefill chunk per pending slot, run at most one decode step,
+        mirror its tokens, map pages, expire deadlines, fire `on_step`
+        hooks. Returns True while work remains (queued or in-flight),
+        False once idle — `run()` loops this, and the fleet router
+        (`serve.router.ServingRouter`) round-robins it across replicas
+        so one slow replica cannot stall the others.
+
+        A replica-fatal backend error (`_replica_fatal`) propagates
+        out of here with the host-side ledger (queue + slot
+        assignments) INTACT — the router harvests it to redistribute
+        with retry budgets preserved."""
+        import jax
+
+        if self._state is None:
+            self._reset_pool()
+        if self._draining:
+            for req in list(self.queue):
+                self.queue.remove(req)
+                self._finish(req, SHED, error=(
+                    f"load shed: draining "
+                    f"({self._drain_reason})"))
+        self._expire_queued()
+        self._maybe_probe_native()
+        self._admit()
+        self._advance_prefills()
+        inflight = [r for r in self._slot_req if r is not None]
+        if not inflight:
+            return bool(self.queue) and not self._draining
+        if self._drain_expired():
+            # before the mid-prefill early-out: the drain grace must
+            # bind even when every occupied slot is still prefilling
+            # (a long chunked prompt must not overstay the grace by
+            # its remaining chunks)
+            for slot, req in enumerate(self._slot_req):
+                if req is not None:
+                    self._finish(req, EXPIRED, error=(
+                        f"drain grace expired "
+                        f"({self._drain_reason})"))
+                    self._retire_slot(slot)
+            return True
+        decoding = sum(r is not None and s not in self._prefilling
+                       for s, r in enumerate(self._slot_req))
+        if not self.policy.should_decode(decoding,
+                                         len(self._prefilling)):
+            # only mid-prefill slots: no decode yet — but per-request
+            # deadlines bind a mid-prefill slot exactly like a
+            # decoding one
+            self._expire_in_flight()
+            return True
+        try:
+            (self._state, toks, tok_lps, was_active,
+             fin) = self._backend.decode_step(self._state)
+        except Exception as e:
+            if _replica_fatal(e):
+                raise           # dead backend: the router's problem
+            if self._backend is self.native_backend:
+                self._native_fault(e)
+                if self._backend is self.native_backend:
+                    # breaker still closed: retry on native
+                    self._evict_in_flight(f"decode fault: {e}")
+            else:
+                self._evict_in_flight(f"decode fault: {e}")
+            return True
+        if self._backend is self.native_backend:
+            self.breaker.record_success()
+        self.stats.steps += 1
+        toks, tok_lps, was_active_h, fin_h = jax.device_get(
+            (toks, tok_lps, was_active, fin))
+        for slot, req in enumerate(self._slot_req):
+            if req is None or slot in self._prefilling \
+                    or not was_active_h[slot]:
+                continue
+            self._emitted[req.req_id].append(int(toks[slot]))
+            self._lps[req.req_id].append(float(tok_lps[slot]))
+            self.stats.tokens += 1
+            done = (bool(fin_h[slot]) or
+                    len(self._emitted[req.req_id])
+                    >= req.max_new)
+            if done:
+                # device-finished and budget-finished rows retire the
+                # same way: the paged pool frees this slot's pages in
+                # release_slot
+                self._retire_slot(slot)
+                self._finish(
+                    req, COMPLETED,
+                    retries=self.max_retries - req.retries_left)
+            else:
+                self._ensure_pages(slot, req)
+        self._expire_in_flight()
+        for hook in list(self.on_step):
+            hook(self, self.stats.steps)
+        return True
+
     def run(self) -> Dict[int, RequestResult]:
         """Serve until the queue and pool are empty (or the drain
         grace ends). Safe to call repeatedly — new `submit()`s between
         runs (or from `on_step` hooks during one) extend the same
         accounting. Returns `self.results`."""
-        import jax
-
         prev_handlers = (self._install_signals()
                          if self.install_signal_handlers else None)
         if self._state is None:
             self._reset_pool()
         try:
-            while True:
-                if self._draining:
-                    for req in list(self.queue):
-                        self.queue.remove(req)
-                        self._finish(req, SHED, error=(
-                            f"load shed: draining "
-                            f"({self._drain_reason})"))
-                self._expire_queued()
-                self._maybe_probe_native()
-                self._admit()
-                self._advance_prefills()
-                inflight = [r for r in self._slot_req if r is not None]
-                if not inflight:
-                    if not self.queue or self._draining:
-                        break
-                    continue
-                if self._drain_expired():
-                    # before the mid-prefill early-out: the drain
-                    # grace must bind even when every occupied slot
-                    # is still prefilling (a long chunked prompt must
-                    # not overstay the grace by its remaining chunks)
-                    for slot, req in enumerate(self._slot_req):
-                        if req is not None:
-                            self._finish(req, EXPIRED, error=(
-                                f"drain grace expired "
-                                f"({self._drain_reason})"))
-                            self._retire_slot(slot)
-                    continue
-                if not any(r is not None and s not in self._prefilling
-                           for s, r in enumerate(self._slot_req)):
-                    # only mid-prefill slots: no decode yet — but
-                    # per-request deadlines bind a mid-prefill slot
-                    # exactly like a decoding one
-                    self._expire_in_flight()
-                    continue
-                try:
-                    (self._state, toks, tok_lps, was_active,
-                     fin) = self._backend.decode_step(self._state)
-                except Exception as e:
-                    if self._backend is self.native_backend:
-                        self._native_fault(e)
-                        if self._backend is self.native_backend:
-                            # breaker still closed: retry on native
-                            self._evict_in_flight(
-                                f"decode fault: {e}")
-                    else:
-                        self._evict_in_flight(f"decode fault: {e}")
-                    continue
-                if self._backend is self.native_backend:
-                    self.breaker.record_success()
-                self.stats.steps += 1
-                toks, tok_lps, was_active_h, fin_h = jax.device_get(
-                    (toks, tok_lps, was_active, fin))
-                for slot, req in enumerate(self._slot_req):
-                    if req is None or slot in self._prefilling \
-                            or not was_active_h[slot]:
-                        continue
-                    self._emitted[req.req_id].append(int(toks[slot]))
-                    self._lps[req.req_id].append(float(tok_lps[slot]))
-                    self.stats.tokens += 1
-                    done = (bool(fin_h[slot]) or
-                            len(self._emitted[req.req_id])
-                            >= req.max_new)
-                    if done:
-                        # device-finished and budget-finished rows
-                        # retire the same way: the paged pool frees
-                        # this slot's pages in release_slot
-                        self._retire_slot(slot)
-                        self._finish(
-                            req, COMPLETED,
-                            retries=self.max_retries - req.retries_left)
-                    else:
-                        self._ensure_pages(slot, req)
-                self._expire_in_flight()
-                for hook in list(self.on_step):
-                    hook(self, self.stats.steps)
+            while self.step():
+                pass
         finally:
             if prev_handlers:
                 for s, h in prev_handlers.items():
@@ -801,6 +872,34 @@ class ServingServer:
         return self.results
 
     # -- observability -----------------------------------------------------
+
+    def ping(self) -> None:
+        """Health check: touch the ACTIVE backend's probe surface so
+        a dead engine raises its replica-fatal error here instead of
+        mid-burst. Pure host-side — no device work."""
+        fn = getattr(self._backend, "ping", None)
+        if fn is not None:
+            fn()
+
+    def load(self) -> int:
+        """Host-side load gauge: queued + in-flight requests. The
+        fleet router's least-loaded spill reads this — pure host
+        state, no device sync."""
+        return len(self.queue) + sum(
+            r is not None for r in self._slot_req)
+
+    def pending_requests(self) -> List[Request]:
+        """Every request with NO terminal outcome yet — in-flight
+        first (slot order, the admission order preserved), then the
+        queue. This is the host-side scheduler LEDGER: when a
+        replica's device dies mid-burst (its engine raises a
+        replica-fatal error), the ledger is exactly what survives,
+        and the router harvests it to resubmit each request to a
+        survivor with its remaining `retries_left` intact — never
+        zero outcomes (nothing silently lost with the device), never
+        two (anything already in `results` is NOT pending)."""
+        return ([r for r in self._slot_req if r is not None]
+                + list(self.queue))
 
     def counters(self) -> Dict[str, int]:
         """The structured outcome counters (PoolStats fields):

@@ -49,6 +49,23 @@ Serving faults (the serve.server chaos harness, docs/RELIABILITY.md
   it as a miss, evict the entry (`prefix_rejected`), and preserve
   greedy parity rather than serve another prompt's K/V.
 
+Router/fleet faults (serve.router, docs/RELIABILITY.md "Router fault
+model") prove the multi-replica story:
+- a REPLICA KILL at the nth decode step (`wrap_replica_engine`,
+  `router_kill_decode_at`): the wrapped engine raises the
+  replica-fatal ReplicaDeadError and stays dead — every later call
+  raises too, exactly like a lost device; the router must harvest the
+  host ledger and redistribute with exactly-once outcomes;
+- a HEALTH-PROBE BLACKHOLE (`wrap_probe`,
+  `router_probe_drop_first_n`): the first N probes of the wrapped
+  replica raise while the replica itself stays healthy — the breaker
+  must open (routing stops) and the first clean probe must close it
+  (routing resumes), never a hang, never a false kill;
+- a SLOW replica (`router_slow_decode_s` on `wrap_replica_engine`
+  with a ManualClock): every decode step on that replica burns clock
+  — deadline skew concentrates on its own requests, and the fleet's
+  round-robin drive keeps the other replicas at full rate.
+
 Parameter-server faults (native.pserver + parallel.pserver_client,
 docs/RELIABILITY.md "Parameter-server fault model") use the shard's
 `fault_hook` seam (`wrap_pserver_shard`):
@@ -102,6 +119,10 @@ class FaultPlan:
     serve_stall_s: float = 0.0                    # clock burned per stall
     serve_page_alloc_error_at: Optional[int] = None  # nth page alloc
     serve_prefix_corrupt_at: Optional[int] = None    # nth cache lookup
+    # -- router/fleet faults (serve.router, via wrap_replica_engine) --
+    router_kill_decode_at: Optional[int] = None   # nth decode on wrapped
+    router_probe_drop_first_n: Optional[int] = None  # blackholed probes
+    router_slow_decode_s: float = 0.0             # clock skew per decode
     # -- parameter-server faults (native.pserver, via wrap_pserver_shard) --
     pserver_kill_push_at: Optional[int] = None    # nth push received
     pserver_lost_ack_at: Optional[int] = None     # nth push ACK dropped
@@ -121,6 +142,8 @@ class FaultPlan:
         self._serve_call_counter = 0
         self._page_alloc_counter = 0
         self._prefix_lookup_counter = 0
+        self._router_decode_counter = 0
+        self._router_probe_counter = 0
         self._pserver_push_counter = 0
         self._pserver_ack_counter = 0
         self._pserver_repl_counter = 0
@@ -253,6 +276,51 @@ class FaultPlan:
 
         pool.fault_hook = hook
         return pool
+
+    # -- router / fleet faults --------------------------------------------
+
+    def wrap_replica_engine(self, engine,
+                            clock: Optional["ManualClock"] = None):
+        """Wrap one replica's DecodeEngine with fleet-level faults:
+
+        - `router_kill_decode_at`: the nth decode_step across ALL
+          engines wrapped by this plan (wrap one engine for an exact
+          per-replica index) raises `serve.router.ReplicaDeadError` —
+          and the wrapper is DEAD from then on: every later prefill/
+          decode/init raises too, exactly like a lost device. The
+          fault is the replica-fatal shape `ServingServer.step()`
+          re-raises with its host ledger intact, so the router's
+          harvest-and-redistribute path runs against the real
+          contract;
+        - `router_slow_decode_s` (+ ManualClock): EVERY decode step on
+          this replica advances `clock` first — a persistently slow
+          replica skews deadlines for its own requests without one
+          wall-clock sleep.
+
+        Everything else delegates, so an unkilled wrapped replica is
+        bit-identical to the real engine."""
+        return _DoomedReplicaEngine(engine, self, clock)
+
+    def wrap_probe(self, replica):
+        """Blackhole the replica's health checks: the first
+        `router_probe_drop_first_n` probe calls (plan-global counter)
+        raise FaultError while the replica itself keeps serving —
+        the router's breaker must open on consecutive probe failures
+        (routing stops) and the first clean probe must close it
+        (routing resumes)."""
+        plan = self
+
+        def hook(rep):
+            idx = plan._router_probe_counter
+            plan._router_probe_counter += 1
+            if (plan.router_probe_drop_first_n is not None
+                    and idx < plan.router_probe_drop_first_n):
+                plan._note("probedrop", idx)
+                raise FaultError(
+                    f"injected health-probe blackhole #{idx}")
+
+        replica.probe_hook = hook
+        return replica
 
     # -- parameter-server faults ------------------------------------------
 
@@ -453,6 +521,72 @@ class _FaultyEngine:
                 and not plan._spent("sdecode")):
             plan._note("sdecode", idx)
             raise FaultError(f"injected decode fault #{idx}")
+        return self._engine.decode_step(state)
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+
+class _DoomedReplicaEngine:
+    """DecodeEngine proxy for fleet chaos: healthy (bit-identical
+    delegation) until `router_kill_decode_at` fires, then PERMANENTLY
+    dead — every engine call raises the replica-fatal
+    ReplicaDeadError, like a device that fell off the bus. Optional
+    persistent slow-decode clock skew rides the same wrapper."""
+
+    def __init__(self, engine, plan: "FaultPlan",
+                 clock: Optional["ManualClock"]):
+        self._engine = engine
+        self._plan = plan
+        self._clock = clock
+        self.dead = False
+
+    def _dead_error(self):
+        from paddle_tpu.serve.router import ReplicaDeadError
+
+        return ReplicaDeadError(
+            "injected replica death (fault plan)")
+
+    def _check_dead(self):
+        if self.dead:
+            raise self._dead_error()
+
+    def ping(self):
+        self._check_dead()
+        return self._engine.ping()
+
+    def init_state(self, *args, **kwargs):
+        self._check_dead()
+        return self._engine.init_state(*args, **kwargs)
+
+    def prefill(self, *args, **kwargs):
+        self._check_dead()
+        return self._engine.prefill(*args, **kwargs)
+
+    def prefill_begin(self, *args, **kwargs):
+        self._check_dead()
+        return self._engine.prefill_begin(*args, **kwargs)
+
+    def prefill_advance(self, *args, **kwargs):
+        self._check_dead()
+        return self._engine.prefill_advance(*args, **kwargs)
+
+    def ensure_decode_page(self, *args, **kwargs):
+        self._check_dead()
+        return self._engine.ensure_decode_page(*args, **kwargs)
+
+    def decode_step(self, state):
+        self._check_dead()
+        plan = self._plan
+        idx = plan._router_decode_counter
+        plan._router_decode_counter += 1
+        if plan.router_slow_decode_s > 0 and self._clock is not None:
+            self._clock.advance(plan.router_slow_decode_s)
+        if (idx == plan.router_kill_decode_at
+                and not plan._spent("replicakill")):
+            plan._note("replicakill", idx)
+            self.dead = True
+            raise self._dead_error()
         return self._engine.decode_step(state)
 
     def __getattr__(self, name):

@@ -1,23 +1,26 @@
 #!/usr/bin/env sh
 # Chaos smoke lane: run ONLY the fault-injection tests (marker
 # `faults` — training resilience in tests/test_resilience.py, the
-# serving chaos harness in tests/test_serve_server.py, and the
+# serving chaos harness in tests/test_serve_server.py, the
+# multi-replica router fleet in tests/test_router.py, and the
 # parameter-server fault suite in tests/test_pserver.py), so
 # degradation coverage is cheap to invoke standalone:
 #
 #     scripts/fault_smoke.sh            # the whole faults lane
 #     scripts/fault_smoke.sh pserver    # just the pserver lane
 #                                       #   (leases/replication/failover)
+#     scripts/fault_smoke.sh router     # just the serving-fleet lane
+#                                       #   (affinity/failover/redistribute)
 #     scripts/fault_smoke.sh -k serve   # just the serving chaos suite
 #
 # CPU-only and deterministic (testing.faults FaultPlan + ManualClock;
-# pserver faults via the shard fault_hook seam); extra args pass
-# through to pytest.
+# pserver faults via the shard fault_hook seam; replica kills via the
+# replica-engine proxy); extra args pass through to pytest.
 set -e
 cd "$(dirname "$0")/.."
 marker=faults
-if [ "$1" = "pserver" ]; then
-    marker=pserver
+if [ "$1" = "pserver" ] || [ "$1" = "router" ]; then
+    marker=$1
     shift
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "$marker" \

@@ -16,6 +16,14 @@
 #     scripts/lint_smoke.sh --check-only # just the lint gate (fast)
 #     scripts/lint_smoke.sh -k guard     # filter, passes through
 #
+# Related gate (tier-1 duration budget, tests/conftest.py): the suite
+# runs near its 870s cap, so the conftest ALWAYS reports any non-slow
+# test whose call phase exceeds 10s in a "tier-1 budget guard"
+# terminal section; run pytest with `--budget-guard 15` to make
+# offenders FAIL the session (15, not 10: the router chaos
+# acceptance test is a deliberate ~12s heavyweight kept in tier-1,
+# and durations are load-sensitive — use an otherwise-idle machine).
+#
 # CPU-only and deterministic; extra args pass through to pytest.
 set -e
 cd "$(dirname "$0")/.."

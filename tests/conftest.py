@@ -31,6 +31,21 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_addoption(parser):
+    parser.addoption(
+        "--budget-guard", type=float, default=None, metavar="SECONDS",
+        help="tier-1 duration budget guard: FAIL the session when any "
+             "non-slow test's call phase exceeds this many seconds "
+             "(the suite runs near its 870s cap — a single creeping "
+             "test eats everyone's headroom). Without the flag the "
+             "guard still REPORTS offenders over the default "
+             "threshold (10s) in the terminal summary.")
+
+
+#: report-only threshold when --budget-guard is not passed
+_BUDGET_DEFAULT_S = 10.0
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 fast gate "
@@ -55,6 +70,59 @@ def pytest_configure(config):
         "gate, RecompileGuard steady-state regressions) — fast and "
         "CPU-only, runs IN tier-1; `-m analysis` (or "
         "`scripts/lint_smoke.sh`) runs it alone")
+    config.addinivalue_line(
+        "markers", "router: multi-replica serving-fleet suite "
+        "(serve.router affinity/failover/redistribution chaos) — a "
+        "subset of the faults lane, runs IN tier-1; `-m router` (or "
+        "`scripts/fault_smoke.sh router`) runs it alone")
+
+
+def pytest_runtest_logreport(report):
+    """Collect call-phase durations of tests that are NOT marked slow
+    for the tier-1 budget guard (the slow lane is excluded from the
+    870s gate, so only fast-lane creep matters)."""
+    if report.when != "call":
+        return
+    if "slow" in getattr(report, "keywords", {}):
+        return
+    # stash on the report's session via terminal summary access below
+    _budget_records.append((report.nodeid, report.duration))
+
+
+_budget_records = []
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """The tier-1 budget guard (docs: ROADMAP 'near the 870s cap'):
+    list every non-slow test whose call phase ran past the threshold.
+    Report-only by default; `--budget-guard S` makes offenders FAIL
+    the session so the cap regression is caught at review time, and
+    `scripts/lint_smoke.sh` documents the invocation."""
+    limit = config.getoption("--budget-guard")
+    threshold = _BUDGET_DEFAULT_S if limit is None else limit
+    offenders = sorted((d, nid) for nid, d in _budget_records
+                       if d > threshold)
+    if not offenders:
+        return
+    terminalreporter.section("tier-1 budget guard")
+    for d, nid in offenders:
+        terminalreporter.write_line(
+            f"  {d:7.1f}s  {nid}   (non-slow test over "
+            f"{threshold:.0f}s — mark it `slow` or shrink it)")
+    if limit is not None:
+        terminalreporter.write_line(
+            f"budget guard FAILING the session: {len(offenders)} "
+            f"non-slow test(s) over {limit:.0f}s")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # computed from the raw records, not the summary stash: hook
+    # ordering between this and the terminal reporter's own
+    # sessionfinish is not guaranteed
+    limit = session.config.getoption("--budget-guard")
+    if limit is not None and any(d > limit
+                                 for _, d in _budget_records):
+        session.exitstatus = 1
 
 
 @pytest.fixture

@@ -202,6 +202,7 @@ def test_export_native_and_serve(config_file, tmp_path, capsys):
                                atol=2e-4)
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_serve_verb(tmp_path, capsys):
     """`paddle_tpu serve`: config script -> engine pool -> id-in/id-out
     completions matching generate() (greedy default)."""

@@ -55,6 +55,7 @@ def _residual_bytes(model, mstate, params, rng, x_shape):
 
 
 class TestRematResiduals:
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_remat_shrinks_residual_set(self):
         """Measured AT the headline bench config (bs 256, 224px —
         eval_shape makes the big shape free): 42.16 GiB of residuals

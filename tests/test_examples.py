@@ -28,7 +28,15 @@ CASES = {
 }
 
 
-@pytest.mark.parametrize("case", sorted(CASES))
+#: cases whose smoke run exceeds the tier-1 duration budget (10s —
+#: conftest budget guard): they run in the slow lane instead
+_SLOW_CASES = {"serving.py", "serving.py --no-quant", "mnist_train.py",
+               "transformer_lm.py", "transformer_lm.py --moe"}
+
+
+@pytest.mark.parametrize(
+    "case", [pytest.param(c, marks=[pytest.mark.slow])
+             if c in _SLOW_CASES else c for c in sorted(CASES)])
 def test_example_runs(case):
     script = case.split()[0]
     env = dict(os.environ, JAX_PLATFORMS="cpu")

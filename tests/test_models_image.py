@@ -31,6 +31,7 @@ def test_resnet50_shapes(rng):
     assert spec.shape == (2, 10)
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_resnet18_forward_and_grad(rng):
     model = models.resnet.resnet(18, num_classes=5, width=8)
     params, state, x, _ = _forward_check(model, (2, 32, 32, 3), 5, rng)
@@ -129,6 +130,7 @@ def test_alexnet(rng):
     _forward_check(model, (1, 127, 127, 3), 4, rng)
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_googlenet(rng):
     model = models.googlenet.googlenet(num_classes=6)
     _forward_check(model, (1, 64, 64, 3), 6, rng)

@@ -169,6 +169,7 @@ class TestMoETransformer:
         l_no_aux = T.loss(params, dc.replace(cfg, moe_aux_weight=0.0), toks)
         assert float(l_moe) != float(l_no_aux)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_moe_transformer_trains_and_generates(self):
         from paddle_tpu import optim
         from paddle_tpu.models import transformer as T
@@ -246,6 +247,7 @@ class TestPaddingMask:
         _, _, aux4, _ = moe.top_k_gating(logits[4:], 1, cap)
         np.testing.assert_allclose(float(aux), float(aux4), rtol=1e-6)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_transformer_loss_with_lengths(self):
         from paddle_tpu.models import transformer as T
         cfg = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
@@ -364,6 +366,7 @@ class TestDispatchImpls:
 
 
 class TestRoutingProperties:
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_invariants_random_shapes(self):
         """Property sweep: for random (T, E, cap, k, mask) the routing
         must never collide slots, never let pads claim capacity, and

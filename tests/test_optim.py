@@ -132,6 +132,7 @@ def test_lbfgs_quadratic_near_newton():
     assert float(loss(params)) < 1e-6, float(loss(params))
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_owlqn_produces_exact_zeros():
     """OWL-QN on a lasso-style objective: the orthant projection must
     drive truly-irrelevant coordinates to EXACT zero (the reference's

@@ -166,6 +166,7 @@ class TestPagePool:
 
 
 class TestPrefixReuseParity:
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_shared_system_prefix_hits_and_matches(self, params):
         """Co-tenants sharing a 16-token system prefix (2 pages of 8):
         later admissions hit the cache, skip that prefill work, and
@@ -183,6 +184,7 @@ class TestPrefixReuseParity:
         assert st.prefix_misses == 1, st
         eng.pool.reconcile()
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_divergence_exactly_at_page_boundary(self, params):
         """Two prompts identical through block 0 and divergent at
         position page_size exactly: block 0 is shared, block 1 is the
@@ -212,6 +214,7 @@ class TestPrefixReuseParity:
 
 
 class TestChunkedPrefill:
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_long_prompt_chunks_and_matches(self, params):
         """A prompt longer than one chunk prefills in fixed chunks
         with decodes interleaved; tokens match solo generate() for
@@ -226,6 +229,7 @@ class TestChunkedPrefill:
         # 23 -> 3 chunks, 4 -> 1, 17 -> 3
         assert eng.last_stats.prefill_chunks == 7, eng.last_stats
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_decode_interleaves_with_chunks(self, params):
         """The head-of-line property itself: while the long prompt is
         mid-prefill, the already-active short request keeps emitting —
@@ -280,6 +284,7 @@ class TestPoolExhaustion:
             srv.submit(rng_tokens(20, seed=40), max_new=2)
         assert srv.results[0].outcome == "failed"
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_serve_preempts_and_still_matches(self, params):
         """Over-subscribed plain serve(): slots outnumber pages, so
         mid-decode exhaustion preempts co-tenants back onto the queue
@@ -297,6 +302,7 @@ class TestPoolExhaustion:
         assert sum(len(g) == 12 for g in got) >= 2, got
         eng.pool.reconcile()
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_server_sheds_requeues_on_exhaustion_chaos(self, params):
         """ACCEPTANCE CHAOS: a mixed-length burst through an
         over-subscribed server pool — page exhaustion mid-burst drives
@@ -323,6 +329,7 @@ class TestPoolExhaustion:
                 assert r.tokens == ref_tokens(params, p, 10), rid
         assert c["pages_in_use"] - eng.pool.evictable() == 0
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_page_alloc_fault_injection(self, params):
         """FaultPlan pool exhaustion: the nth allocation reports
         exhaustion against a HEALTHY pool — the requeue path must
@@ -412,6 +419,7 @@ def test_engine_stats_pool_fields(params):
 
 
 @pytest.mark.perf
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_paged_admits_2x_dense_slots_at_equal_budget(params):
     """ISSUE 4 acceptance: at EQUAL HBM budget the paged pool admits
     >= 2x the dense layout's concurrent requests on a mixed-length

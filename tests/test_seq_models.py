@@ -6,6 +6,7 @@ v1_api_demo/sequence_tagging convergence)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from paddle_tpu import optim
 from paddle_tpu.data import batch as B, datasets
@@ -376,6 +377,7 @@ def test_generation_matches_golden_file():
 
 
 class TestSeq2SeqFusedCE:
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_fused_ce_matches_plain(self):
         """fused_ce_chunk folds the 30k-vocab decoder head into a
         checkpointed chunked scan; values and grads must match the

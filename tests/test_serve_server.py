@@ -64,6 +64,7 @@ def prompts_rng(n, lens, seed=0):
 
 
 class TestAdmission:
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_completed_requests_match_generate(self, params, eng2):
         """The reliability layer must not perturb the math: a greedy
         request served through the scheduler equals its solo

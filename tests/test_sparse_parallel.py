@@ -117,6 +117,7 @@ def test_unique_rows_grad():
     np.testing.assert_allclose(got[9], [1, 1, 1])
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_sharded_embedding_module_end_to_end(mesh):
     """Tiny sparse-embedding training loop: loss decreases and only
     touched rows move (the test_CompareSparse equivalence idea)."""
@@ -198,6 +199,7 @@ def test_broadcast_from(mesh):
 
 # ---- all-to-all exchange path (round-2: VERDICT item 4) ----------------
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_alltoall_lookup_matches_dense(mesh):
     from paddle_tpu.parallel import alltoall_lookup
 
@@ -210,6 +212,7 @@ def test_alltoall_lookup_matches_dense(mesh):
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_alltoall_lookup_out_of_range_zero(mesh):
     from paddle_tpu.parallel import alltoall_lookup
 
@@ -222,6 +225,7 @@ def test_alltoall_lookup_out_of_range_zero(mesh):
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_alltoall_lookup_skewed_ids(mesh):
     """Worst-case routing: every id owned by one shard — the default
     capacity (K/n) must still be lossless."""
@@ -236,6 +240,7 @@ def test_alltoall_lookup_skewed_ids(mesh):
     assert int(overflow) == 0
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_alltoall_capacity_overflow_detected(mesh):
     from paddle_tpu.parallel import alltoall_lookup
 
@@ -252,6 +257,7 @@ def test_alltoall_capacity_overflow_detected(mesh):
                                rtol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_alltoall_lookup_grad_flows_to_table(mesh):
     """Autodiff through the owner-routed exchange: table gradient equals
     the dense lookup's scatter-add gradient."""
@@ -274,6 +280,7 @@ def test_alltoall_lookup_grad_flows_to_table(mesh):
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_alltoall_push_row_grads_matches_dense(mesh):
     from paddle_tpu.parallel import alltoall_push_row_grads
 

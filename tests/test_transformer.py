@@ -75,6 +75,7 @@ def test_overfits_tiny_batch(params):
     assert float(l) < first * 0.7, (first, float(l))
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_generate_matches_teacher_forcing(params):
     """KV-cache greedy decode == argmax over apply() at every step (the
     cache path and the full forward are different codepaths)."""
@@ -91,6 +92,7 @@ def test_generate_matches_teacher_forcing(params):
     np.testing.assert_array_equal(out, cur)
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_remat_matches(params):
     toks = jnp.asarray(np.random.RandomState(5).randint(0, 61, (2, 8)))
     cfg_r = T.TransformerConfig(vocab=61, dim=32, n_layers=2, n_heads=4,
@@ -104,6 +106,7 @@ def test_remat_matches(params):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_tp_sharded_loss_matches(params):
     """dp x tp over the 8-CPU mesh computes the same loss/grads as one
     device (GSPMD inserts the collectives; TP_RULES shard qkv/fc1 by
@@ -157,6 +160,7 @@ def test_transformer_serving_artifact(tmp_path, params):
 
 
 class TestContextParallel:
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_cp_loss_matches_dense(self):
         """Sequence-sharded (ring attention) transformer loss must equal
         the single-device dense loss — values and gradients."""
@@ -189,6 +193,7 @@ class TestContextParallel:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=2e-4)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_cp_with_remat_and_lengths(self):
         from paddle_tpu.core import mesh as mesh_lib
 
@@ -208,6 +213,7 @@ class TestContextParallel:
         assert abs(dense - cp) < 1e-4, (dense, cp)
 
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_cp_matches_dense_under_bf16_policy(self):
         """The f32-scores invariant must hold inside ring attention too:
         under the bf16 compute policy CP and dense stay within bf16
@@ -233,6 +239,7 @@ class TestContextParallel:
             dtypes.set_default_policy(old)
         assert abs(dense - cp) < 3e-2 * max(1.0, abs(dense)), (dense, cp)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_cp_composes_with_moe(self):
         """Context parallelism and MoE blocks in one model: the seq-
         sharded loss must still equal the single-device loss (routing is
@@ -268,6 +275,7 @@ class TestSampling:
         np.testing.assert_array_equal(np.asarray(greedy),
                                       np.asarray(sampled))
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_sampling_deterministic_per_key_and_varies(self):
         params = T.init_params(jax.random.key(0), self.CFG)
         prompt = jnp.zeros((2, 4), jnp.int32)
@@ -348,6 +356,7 @@ class TestVariableLengthPrompts:
     CFG = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
                               mlp_ratio=2, attn_impl="dense")
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_padded_row_matches_solo_run(self):
         """A short prompt decoded inside a padded batch must produce
         exactly the continuation it gets when decoded alone."""
@@ -371,6 +380,7 @@ class TestVariableLengthPrompts:
                                      jnp.asarray(long_p), steps=6))
         np.testing.assert_array_equal(out[0, 8:], full[0, 8:])
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_variable_length_sampling_matches_solo(self):
         """sample() forwards prompt_lens: with temperature 0 (greedy)
         the padded short row must equal its solo sampled run."""
@@ -407,6 +417,7 @@ class TestVariableLengthPrompts:
                                       steps=3, prompt_lens=lens))
         np.testing.assert_array_equal(flash, dense)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_padded_row_matches_solo_with_moe(self):
         """Pad positions must not claim MoE expert capacity: at a
         no-drop capacity the padded short row still equals its solo
@@ -432,6 +443,7 @@ class TestBeamDecode:
     CFG = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
                               mlp_ratio=2, attn_impl="dense")
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_beam1_equals_greedy(self):
         params = T.init_params(jax.random.key(0), self.CFG)
         prompt = jnp.asarray(
@@ -456,6 +468,7 @@ class TestBeamDecode:
                                 beam_size=1)
         np.testing.assert_array_equal(np.asarray(seqs[:, 0]), greedy)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_wider_beam_never_scores_worse(self):
         """The best beam's total log-prob must be >= the greedy
         sequence's (verified with score())."""
@@ -479,6 +492,7 @@ class TestBeamDecode:
         np.testing.assert_allclose(np.asarray(scores[:, 0]), best_lp,
                                    atol=1e-3)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_single_token_prompt(self):
         """t0 == 1 has nothing to prefill: the caches must start empty
         instead of tracing a T=0 sequence through the blocks, and beam-1
@@ -599,6 +613,7 @@ class TestSpeculativeDecode:
         return target, draft, draft_cfg
 
     @pytest.mark.parametrize("k", [1, 3, 5])
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_matches_greedy_with_unrelated_draft(self, k):
         target, draft, draft_cfg = self._models()
         prompt = jnp.asarray(
@@ -624,6 +639,7 @@ class TestSpeculativeDecode:
         np.testing.assert_array_equal(np.asarray(got), want)
         assert int(rounds[0]) == 2, rounds  # ceil(10/5); rounds is [B]
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_gqa_target(self):
         cfg = T.TransformerConfig(vocab=32, dim=16, n_layers=2,
                                   n_heads=4, n_kv_heads=1, mlp_ratio=2,
@@ -659,6 +675,7 @@ class TestSpeculativeDecode:
             qp, self.CFG, draft, draft_cfg, prompt, steps=7, draft_k=3))
         np.testing.assert_array_equal(got, want)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_batched_matches_per_row_greedy(self):
         """Rows accept different prefix lengths (different prompts vs
         the same draft) yet each row's output must equal ITS OWN greedy
@@ -675,6 +692,7 @@ class TestSpeculativeDecode:
             np.testing.assert_array_equal(got[i:i + 1], want,
                                           err_msg=f"row {i}")
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_batched_mixed_draft_quality(self):
         """One row decodes with a perfect-draft dynamic (target==draft
         would accept everything) while the other disagrees constantly —
@@ -731,6 +749,7 @@ class TestSpeculativeSampling:
         draft = T.init_params(jax.random.key(9), draft_cfg)
         return target, draft, draft_cfg
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_first_token_distribution_matches_target(self):
         """2000 identical rows, 1 step: the empirical histogram of the
         first sampled token must match the target's filtered softmax at
@@ -764,6 +783,7 @@ class TestSpeculativeSampling:
             rng=jax.random.key(3), draft_k=3, top_k=1))
         np.testing.assert_array_equal(got, want)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_perfect_draft_accepts_everything(self):
         """draft == target => p == q => acceptance probability 1 per
         token: steps tokens must take exactly ceil(steps/(k+1)) rounds
@@ -827,6 +847,7 @@ class TestDecodeFeatureMatrix:
         (1, 0, "none"), (2, 4, "none"), (1, 4, "ntk"),
         (2, 0, "linear"), (1, 4, "linear"), (4, 4, "ntk"),
     ])
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_decode_matches_teacher_forcing(self, kv, moe, scaling):
         cfg = T.TransformerConfig(
             vocab=32, dim=16, n_layers=2, n_heads=4, n_kv_heads=kv,
@@ -842,6 +863,7 @@ class TestDecodeFeatureMatrix:
         (2, 0, 3, False), (1, 4, 4, False), (2, 0, None, True),
         (1, 0, 3, True), (4, 4, 4, True),
     ])
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_decode_matrix_window_int8(self, kv, moe, window, int8):
         """GQA x MoE x sliding-window x int8: window < t0+steps forces
         the r5 ROLLING ring cache, and int8 forces the in-loop dequant
@@ -1002,6 +1024,7 @@ class TestRopeScaling:
 
 
 class TestScore:
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_logprobs_and_masking(self):
         cfg = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
                                   mlp_ratio=2, attn_impl="dense")
@@ -1033,6 +1056,7 @@ class TestFusedCE:
         return dataclasses.replace(CFG, **kw)
 
     @pytest.mark.parametrize("chunk", [4, 7, 64])
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_loss_and_grads_match_plain(self, params, chunk):
         toks = jnp.asarray(
             np.random.RandomState(1).randint(0, 61, (3, 13)), jnp.int32)
@@ -1139,6 +1163,7 @@ class TestInt8KVCache:
         b = T.generate(params, q, prompt, steps=steps, **kw)
         return a, b
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_tokens_agree_with_fp_cache(self, params):
         prompt = jnp.asarray(
             np.random.RandomState(0).randint(0, 61, (3, 9)), jnp.int32)
@@ -1159,6 +1184,7 @@ class TestInt8KVCache:
         agree = float(jnp.mean((a == b).astype(jnp.float32)))
         assert agree >= 0.9, agree
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_composes_with_varlen_prompts_and_int8_weights(self, params):
         from paddle_tpu.serve import quant
         qp = quant.quantize_params(params)
