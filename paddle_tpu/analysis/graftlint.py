@@ -62,8 +62,14 @@ RULES: Dict[str, str] = {
     "GL004": "recompile hazard",
     "GL005": "tracer leak out of the traced scope",
     "GL006": "module-import-time jnp computation",
+    "GL007": "bare time.time()/print() in an instrumented module",
     "LK001": "attribute mutated both under a held lock and outside one",
 }
+
+#: path fragments marking modules under the obs instrumentation
+#: contract (GL007): timing goes through the injectable clock,
+#: output through span events / the flight recorder
+_OBS_SCOPED = ("paddle_tpu/serve/", "paddle_tpu/train/")
 
 #: transforms whose function argument is traced
 _TRACING_CALLS = {
@@ -268,6 +274,8 @@ class Linter:
         self.source = source
         self.src_lines = source.splitlines()
         self.path = path
+        self.obs_scoped = any(
+            frag in path.replace("\\", "/") for frag in _OBS_SCOPED)
         self.rules = set(rules) if rules else None
         self.findings: List[Finding] = []
         self.supp = _suppressions(source)
@@ -807,6 +815,29 @@ class _BodyChecker(ast.NodeVisitor):
                         "GL004", node, self.func,
                         f"list-valued `{kw.arg}` — lists are "
                         f"unhashable; use a tuple")
+
+        # GL007: serve/ and train/ are instrumented modules — timing
+        # belongs on the component's injectable clock and output in
+        # the obs ring, traced or not. A stray time.time() drifts
+        # from the recorded timeline (and jumps on NTP steps); a bare
+        # print() bypasses the flight recorder. Skip prints of traced
+        # values — GL001 below owns those with the sharper message.
+        if self.l.obs_scoped:
+            if dn == "time.time":
+                self.l._emit(
+                    "GL007", node, self.func,
+                    "`time.time()` in an instrumented module — use "
+                    "the component's injectable clock (`clock=`, "
+                    "default `time.monotonic`) so metrics and spans "
+                    "share one timeline")
+            elif dn == "print" and not (
+                    self.traced
+                    and any(self.tainted(a) for a in node.args)):
+                self.l._emit(
+                    "GL007", node, self.func,
+                    "bare `print()` in an instrumented module — emit "
+                    "a span event / flight-recorder record (or use "
+                    "`logging`) so the output lands in the obs ring")
 
         if not self.traced:
             # GL003 applies everywhere (host constants feed compiled

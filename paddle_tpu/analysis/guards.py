@@ -196,6 +196,22 @@ class RecompileGuard:
             return
         if self.compiles > self.max_compiles:
             names = self.compiled_names
+            # lazy, peek-only: importing guards must never allocate obs
+            # state, and a process without a flight recorder pays zero.
+            # A recorder that exists gets the offending names in its
+            # ring BEFORE the raise — the steady-state recompile lands
+            # in the next fault dump with the computation named.
+            try:
+                from paddle_tpu.obs.flight import peek_default
+                rec = peek_default()
+                if rec is not None:
+                    rec.record("guard", "recompile-violation",
+                               region=self.name,
+                               compiles=self.compiles,
+                               max_compiles=self.max_compiles,
+                               compiled_names=names)
+            except Exception:
+                pass
             detail = (f": compiled {', '.join(names)}" if names
                       else " (enable jax_log_compiles for names)")
             raise RecompileError(
@@ -212,8 +228,22 @@ def no_implicit_transfers(level: str = "disallow"):
     transfers in the region raise (jax.transfer_guard). `level` may
     be any jax transfer-guard level ("allow", "log", "disallow",
     "log_explicit", "disallow_explicit")."""
-    with jax.transfer_guard(level):
-        yield
+    try:
+        with jax.transfer_guard(level):
+            yield
+    except Exception as e:
+        # same peek-only flight hook as RecompileGuard: an implicit
+        # transfer caught by the guard lands in the ring before it
+        # propagates, so the next dump names the violation
+        try:
+            from paddle_tpu.obs.flight import peek_default
+            rec = peek_default()
+            if rec is not None:
+                rec.record("guard", "transfer-violation",
+                           level=level, error=str(e))
+        except Exception:
+            pass
+        raise
 
 
 @contextlib.contextmanager

@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import contextlib
 import json
+import os
 import runpy
 import sys
 import time
@@ -39,6 +40,41 @@ def _transfer_guard(enabled: bool):
     from paddle_tpu.analysis.guards import no_implicit_transfers
 
     return no_implicit_transfers()
+
+
+def _obs_stack(metrics_out=None, flight_dir=None):
+    """Build the (registry, tracer, flight) triple for an instrumented
+    run — or (None, None, None) when neither flag asked for it, so the
+    uninstrumented path allocates nothing (the <2% overhead gate)."""
+    if metrics_out is None and flight_dir is None:
+        return None, None, None
+    from paddle_tpu.obs import (FlightRecorder, MetricsRegistry, Tracer,
+                                set_default)
+
+    if flight_dir:
+        # pre-create it: FlightRecorder.dump treats a nonexistent
+        # directory as an exact FILE path, which would collapse every
+        # fault dump onto one overwritten file
+        os.makedirs(flight_dir, exist_ok=True)
+
+    registry = MetricsRegistry() if metrics_out else None
+    flight = FlightRecorder()
+    # finished spans feed the ring; the module default makes
+    # RecompileGuard / transfer-guard violations land there too
+    set_default(flight)
+    return registry, Tracer(sink=flight.note_span), flight
+
+
+def _write_metrics(registry, path: str) -> None:
+    """Export a registry snapshot: .json/.jsonl gets the JSON-lines
+    form, anything else Prometheus text exposition."""
+    if registry is None or not path:
+        return
+    text = (registry.to_jsonl()
+            if path.endswith((".json", ".jsonl"))
+            else registry.to_prometheus())
+    with open(path, "w") as f:
+        f.write(text)
 
 
 def _load_config(path: str) -> dict:
@@ -149,12 +185,14 @@ def cmd_train(args) -> int:
         raw_batches = batches
         batches = lambda: (jax.device_put(b) for b in raw_batches())
 
-    t0 = time.time()
+    # monotonic is the obs-layer clock convention (registry/tracer
+    # default) — elapsed display must not jump with wall-clock slews
+    t0 = time.monotonic()
 
     def handler(ev):
         if isinstance(ev, E.EndIteration) and ev.batch_id % args.log_period == 0:
             print(f"pass {ev.pass_id} batch {ev.batch_id} "
-                  f"cost {ev.cost:.6f} ({time.time() - t0:.1f}s)")
+                  f"cost {ev.cost:.6f} ({time.monotonic() - t0:.1f}s)")
         if isinstance(ev, E.EndPass):
             print(f"=== pass {ev.pass_id} done ===")
 
@@ -167,13 +205,19 @@ def cmd_train(args) -> int:
         from paddle_tpu.train.resilience import (Preempted,
                                                  ResilientTrainer)
 
+        # obs stack only when asked: flight dumps land beside the
+        # checkpoints (ResilientTrainer's flight_dir default)
+        registry, tracer, flight = _obs_stack(args.metrics_out)
         rt = ResilientTrainer(
             trainer, args.checkpoint_dir,
             checkpoint_every_n_batches=args.checkpoint_every,
             bad_step_policy=args.bad_step_policy,
             max_bad_steps=args.max_bad_steps,
             lr_backoff=args.lr_backoff,
-            watchdog_timeout_s=args.watchdog_timeout)
+            watchdog_timeout_s=args.watchdog_timeout,
+            tracer=tracer, flight=flight)
+        if registry is not None:
+            rt.bind_metrics(registry)
         try:
             with _transfer_guard(args.transfer_guard):
                 state = rt.run(state, batches, num_passes=num_passes,
@@ -181,7 +225,9 @@ def cmd_train(args) -> int:
         except Preempted as p:
             print(f"preempted: checkpoint saved at step {p.step}; "
                   f"re-run to resume")
+            _write_metrics(registry, args.metrics_out)
             return 143   # 128 + SIGTERM: the scheduler restarts us
+        _write_metrics(registry, args.metrics_out)
     else:
         with _transfer_guard(args.transfer_guard):
             state = trainer.train(
@@ -351,6 +397,8 @@ def _serve_reliable(args, eng, prompts, sampling, buckets, sink):
     can reconcile the whole run from the transcript alone."""
     from paddle_tpu.serve.server import QueueFullError, ServingServer
 
+    registry, tracer, flight = _obs_stack(args.metrics_out,
+                                          args.flight_dir)
     server = ServingServer(
         eng,
         max_queue=(args.max_queue if args.max_queue is not None
@@ -360,7 +408,10 @@ def _serve_reliable(args, eng, prompts, sampling, buckets, sink):
         buckets=buckets,
         drain_grace_s=args.drain_grace,
         drain_report_path=args.drain_report,
-        install_signal_handlers=True)
+        install_signal_handlers=True,
+        tracer=tracer, flight=flight)
+    if registry is not None:
+        server.bind_metrics(registry)
     # feed the batch AS THE QUEUE DRAINS, like a well-behaved client:
     # submitting everything up-front would force the shed path on any
     # batch larger than max_queue even though the pool is idle and the
@@ -392,6 +443,7 @@ def _serve_reliable(args, eng, prompts, sampling, buckets, sink):
         results = server.run()
     _render_serve_results(args, sink, prompts, ids, results,
                           server.counters())
+    _write_metrics(registry, args.metrics_out)
     return 0
 
 
@@ -437,6 +489,8 @@ def _serve_fleet(args, engines, prompts, sampling, buckets, sink):
     from paddle_tpu.serve.router import QueueFullError, ServingRouter
     from paddle_tpu.serve.server import ServingServer
 
+    registry, tracer, flight = _obs_stack(args.metrics_out,
+                                          args.flight_dir)
     servers = [
         ServingServer(
             e,
@@ -445,9 +499,15 @@ def _serve_fleet(args, engines, prompts, sampling, buckets, sink):
             default_deadline_ms=args.default_deadline_ms,
             max_retries=args.max_retries,
             buckets=buckets,
-            drain_grace_s=args.drain_grace)
+            drain_grace_s=args.drain_grace,
+            # replicas SHARE the fleet tracer: the router mints the
+            # rr<N> span, the replica's _finish ends it
+            tracer=tracer, flight=flight)
         for e in engines]
-    router = ServingRouter(servers)
+    router = ServingRouter(servers, tracer=tracer, flight=flight,
+                           flight_dir=args.flight_dir)
+    if registry is not None:
+        router.bind_metrics(registry)
 
     def handler(signum, frame):
         router.drain(reason=f"signal {signum}")
@@ -495,6 +555,7 @@ def _serve_fleet(args, engines, prompts, sampling, buckets, sink):
     router.reconcile()
     counters = router.counters()
     _render_serve_results(args, sink, prompts, ids, results, counters)
+    _write_metrics(registry, args.metrics_out)
     if args.drain_report and router.draining:
         tmp = f"{args.drain_report}.tmp"
         with open(tmp, "w") as f:
@@ -505,6 +566,80 @@ def _serve_fleet(args, engines, prompts, sampling, buckets, sink):
 
         os.replace(tmp, args.drain_report)
     return 0
+
+
+def cmd_obs(args) -> int:
+    """Observability utilities (docs/OBSERVABILITY.md):
+
+      obs dump FILE   — pretty-print a flight-recorder dump
+      obs schema      — self-check the metrics-export schema (build a
+                        registry with one of each metric kind, snapshot
+                        + export it, validate the invariants the
+                        scrape/ingest side relies on); exit 1 on drift
+    """
+    if args.obs_cmd == "dump":
+        with open(args.file) as f:
+            payload = json.load(f)
+        if payload.get("kind") != "flight_dump":
+            print(f"{args.file}: not a flight dump "
+                  f"(kind={payload.get('kind')!r})", file=sys.stderr)
+            return 1
+        print(f"flight dump: reason={payload['reason']} "
+              f"pid={payload.get('pid')} "
+              f"events={payload.get('n_events')}")
+        for k, v in (payload.get("extra") or {}).items():
+            print(f"  extra.{k} = {json.dumps(v, default=str)}")
+        tail = payload.get("events", [])[-args.last:]
+        for e in tail:
+            t = e.get("t")
+            head = (f"  [{t:.3f}] {e.get('kind')}/{e.get('name')}"
+                    if isinstance(t, float)
+                    else f"  {e.get('kind')}/{e.get('name')}")
+            rest = {k: v for k, v in e.items()
+                    if k not in ("t", "kind", "name")}
+            print(head + (f" {json.dumps(rest, default=str)}"
+                          if rest else ""))
+        return 0
+    if args.obs_cmd == "schema":
+        from paddle_tpu.obs import MetricsRegistry
+
+        reg = MetricsRegistry(clock=lambda: 0.0)
+        reg.counter("demo_total", "demo counter").inc(
+            2, labels={"outcome": "completed"})
+        reg.gauge("demo_gauge", "demo gauge").set(1.5)
+        reg.histogram("demo_seconds", "demo histogram").observe(0.01)
+        snap = reg.snapshot()
+        errs = []
+        for key in ("ts", "series", "dropped_series", "source_errors"):
+            if key not in snap:
+                errs.append(f"snapshot missing key {key!r}")
+        kinds = {s["name"]: s["kind"] for s in snap["series"]}
+        for name, kind in (("demo_total", "counter"),
+                           ("demo_gauge", "gauge")):
+            if kinds.get(name) != kind:
+                errs.append(f"{name}: kind {kinds.get(name)!r} != "
+                            f"{kind!r}")
+        for s in snap["series"]:
+            if not isinstance(s.get("value"), (int, float)):
+                errs.append(f"{s['name']}: non-numeric value")
+        prom = reg.to_prometheus()
+        for needle in ("# TYPE demo_total counter",
+                       'demo_total{outcome="completed"} 2',
+                       "# TYPE demo_seconds histogram",
+                       'le="+Inf"', "demo_seconds_count",
+                       "demo_seconds_sum"):
+            if needle not in prom:
+                errs.append(f"prometheus text missing {needle!r}")
+        for line in reg.to_jsonl().splitlines():
+            json.loads(line)   # every line must parse standalone
+        if errs:
+            for e in errs:
+                print(f"schema drift: {e}", file=sys.stderr)
+            return 1
+        print(f"obs schema ok: {len(snap['series'])} series, "
+              f"{len(prom.splitlines())} prometheus lines")
+        return 0
+    raise SystemExit(f"unknown obs subcommand {args.obs_cmd!r}")
 
 
 def cmd_master(args) -> int:
@@ -625,6 +760,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "around the train loop: implicit host<->device"
                         " transfers raise; batches are device_put "
                         "explicitly (docs/ANALYSIS.md)")
+    t.add_argument("--metrics-out", default=None,
+                   help="write an obs metrics snapshot here at exit "
+                        "(.json/.jsonl -> JSON lines, else Prometheus "
+                        "text); with --checkpoint-dir also enables "
+                        "step tracing + the flight recorder "
+                        "(docs/OBSERVABILITY.md)")
     t.add_argument("--coordinator", default=None,
                    help="host:port of process 0 for multi-host jobs")
     t.add_argument("--num-processes", type=int, default=None)
@@ -704,6 +845,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "around the decode loop: implicit "
                          "host<->device transfers raise "
                          "(docs/ANALYSIS.md)")
+    sv.add_argument("--metrics-out", default=None,
+                    help="write an obs metrics snapshot here at exit "
+                         "(.json/.jsonl -> JSON lines, else "
+                         "Prometheus text); enables request tracing "
+                         "(docs/OBSERVABILITY.md)")
+    sv.add_argument("--flight-dir", default=None,
+                    help="flight-recorder dump directory: replica "
+                         "death / breaker-open / SIGTERM dump the "
+                         "recent-event ring here")
     sv.set_defaults(fn=cmd_serve)
 
     ms = sub.add_parser("master")
@@ -716,6 +866,20 @@ def build_parser() -> argparse.ArgumentParser:
     ms.add_argument("--snapshot", default=None)
     ms.add_argument("--snapshot-period", type=float, default=30.0)
     ms.set_defaults(fn=cmd_master)
+
+    ob = sub.add_parser(
+        "obs", help="observability utilities: pretty-print flight "
+        "dumps, self-check the metrics schema (docs/OBSERVABILITY.md)")
+    obs_sub = ob.add_subparsers(dest="obs_cmd", required=True)
+    od = obs_sub.add_parser("dump",
+                            help="pretty-print a flight-recorder dump")
+    od.add_argument("file")
+    od.add_argument("--last", type=int, default=20,
+                    help="show only the last N ring events")
+    obs_sub.add_parser(
+        "schema",
+        help="validate the metrics-export schema (exit 1 on drift)")
+    ob.set_defaults(fn=cmd_obs)
 
     sub.add_parser("bench").set_defaults(fn=cmd_bench)
 

@@ -764,6 +764,16 @@ class PServerShard:
                     replica_lost=bool(self._repl and self._repl.lost),
                     last_snapshot_error=self.last_snapshot_error)
 
+    def bind_metrics(self, registry, *, prefix: str = "pserver",
+                     labels: Optional[dict] = None) -> None:
+        """Register `stats()` as a read-through source on an
+        `obs.MetricsRegistry` — the registry's sanitizer maps
+        replica_lost (bool) to 0/1 and drops last_snapshot_error (str);
+        everything else exports as the very ledger OP_STATS serves."""
+        registry.register_source(
+            prefix, self.stats,
+            labels={"shard": str(self.shard_id), **(labels or {})})
+
 
 def _encode_state(st: ShardState) -> bytes:
     ek = np.asarray(sorted(st.epochs), np.int64)
@@ -858,6 +868,17 @@ class PServerGroup:
     def stop(self) -> None:
         for sh in self.primaries + self.backups:
             sh.stop()
+
+    def bind_metrics(self, registry, *, prefix: str = "pserver",
+                     labels: Optional[dict] = None) -> None:
+        """Register every shard (primaries AND backups) on the
+        registry; the role label separates the replication tiers."""
+        for sh in self.primaries:
+            sh.bind_metrics(registry, prefix=prefix,
+                            labels={"role": "primary", **(labels or {})})
+        for sh in self.backups:
+            sh.bind_metrics(registry, prefix=prefix,
+                            labels={"role": "backup", **(labels or {})})
 
     def __enter__(self) -> "PServerGroup":
         return self

@@ -36,7 +36,7 @@ import socket
 import struct
 import threading
 import time
-from typing import List, Optional, Sequence, Tuple
+from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -189,7 +189,8 @@ class PServerClient:
                  trainer_id: int = 0,
                  lease_ttl_s: float = 30.0, timeout: float = 30.0,
                  retries: int = 8, backoff_base: float = 0.02,
-                 backoff_max: float = 1.0, seed: Optional[int] = None):
+                 backoff_max: float = 1.0, seed: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic):
         self.dim = int(dim)
         specs = sorted(specs, key=lambda s: s.row_lo)
         for a, b in zip(specs, specs[1:]):
@@ -217,11 +218,31 @@ class PServerClient:
         # — an unlocked send/recv pair would desync the framing), and
         # public methods compose (fetch_table -> get_rows)
         self._lock = threading.RLock()
-        self._last_hb = time.monotonic()
+        self.clock = clock
+        self._last_hb = clock()
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self.stats = {"pushes": 0, "duplicate_acks": 0,
-                      "reregistrations": 0}
+                      "reregistrations": 0, "pulls": 0}
+        # observability seam (the PagePool.obs_hook idiom): fires AFTER
+        # an RPC settles, exceptions swallowed — ResilientTrainer points
+        # this at the live step span so push/pull land on its trail.
+        self.obs_hook: Optional[Callable] = None
+
+    def _obs(self, event: str, **ctx) -> None:
+        if self.obs_hook is None:
+            return
+        try:
+            self.obs_hook(event, ctx)
+        except Exception:
+            pass
+
+    def bind_metrics(self, registry, *, prefix: str = "pserver_client",
+                     labels=None) -> None:
+        """Register this client's exactly-once ledger as a read-through
+        metrics source — exported numbers ARE the ledger."""
+        registry.register_source(prefix, lambda: dict(self.stats),
+                                 labels=labels)
 
     # -- leases ----------------------------------------------------------
 
@@ -263,7 +284,7 @@ class PServerClient:
                     self._register_shard(s)
                 else:
                     self._check(resp, "heartbeat")
-            self._last_hb = time.monotonic()
+            self._last_hb = self.clock()
 
     def start_heartbeats(self, interval_s: float) -> None:
         if self._hb_thread is not None:
@@ -314,6 +335,8 @@ class PServerClient:
                 rows = np.frombuffer(resp, np.float32, n * dim,
                                      offset=5).reshape(n, dim)
                 out[sel] = rows
+            self.stats["pulls"] += 1
+        self._obs("pserver_pull", rows=int(ids.shape[0]))
         return out
 
     def push_row_grads(self, ids, row_grads, lr: float) -> None:
@@ -352,11 +375,15 @@ class PServerClient:
             resp = self._conns[s].call(payload)
             if resp[0] == ST_OK:
                 self.stats["pushes"] += 1
+                self._obs("pserver_push", shard=s, epoch=epoch,
+                          rows=int(ids.size), outcome="ok")
                 return
             if resp[0] == ST_DUP:
                 # applied on an earlier attempt whose ACK was lost —
                 # exactly-once held, count it for observability
                 self.stats["duplicate_acks"] += 1
+                self._obs("pserver_push", shard=s, epoch=epoch,
+                          rows=int(ids.size), outcome="dup")
                 return
             if resp[0] == ST_LEASE_EXPIRED:
                 # the answering server (failover target, or one that
@@ -393,18 +420,18 @@ class PServerClient:
             vote_tokens = list(self._tokens)
         if not wait:
             return start[0][0] + (1 if start[0][1] else 0)
-        deadline = time.monotonic() + timeout_s
+        deadline = self.clock() + timeout_s
         pass_nums = []
         for s, (before, done) in enumerate(start):
             target = before + 1
             current = before + 1 if done else before
             while current < target:
-                if time.monotonic() > deadline:
+                if self.clock() > deadline:
                     raise TimeoutError(
                         f"pass barrier on shard {s} not reached in "
                         f"{timeout_s}s (pass {current} < {target})")
                 time.sleep(poll_s)
-                if (time.monotonic() - self._last_hb
+                if (self.clock() - self._last_hb
                         > self.lease_ttl_s / 3):
                     self.heartbeat()
                 with self._lock:

@@ -146,6 +146,13 @@ class PagePool:
         # return truthy to force PoolExhaustedError, "lookup" may
         # mutate the _CacheEntry it is handed
         self.fault_hook: Optional[Callable] = None
+        # paddle_tpu.obs seam: obs_hook(event, ctx) fires AFTER an
+        # admit/release mutates the books (never before — observers
+        # must see settled state, and a raising hook must not be able
+        # to half-apply an admission). ServingServer attaches page
+        # events to the owning request's span through it. Host-side
+        # only; exceptions are swallowed.
+        self.obs_hook: Optional[Callable] = None
 
     # -- gauges ------------------------------------------------------------
 
@@ -175,6 +182,14 @@ class PagePool:
         if self.fault_hook is not None:
             return self.fault_hook(event, ctx)
         return None
+
+    def _obs(self, event: str, **ctx) -> None:
+        if self.obs_hook is None:
+            return
+        try:
+            self.obs_hook(event, ctx)
+        except Exception:
+            pass        # telemetry never takes the pool down
 
     # -- allocation --------------------------------------------------------
 
@@ -347,6 +362,8 @@ class PagePool:
             self.prefix_hits += 1
         else:
             self.prefix_misses += 1
+        self._obs("page_admit", slot=slot, pages=total,
+                  shared=len(shared), free=self.pages_free)
         return list(self.slot_pages[slot]), len(shared) * self.page_size
 
     def extend(self, slot: int) -> Optional[Tuple[int, int]]:
@@ -377,11 +394,15 @@ class PagePool:
         """Drop the slot's references; pages with no other holder
         (no co-tenant share, not cached) return to the free list.
         Idempotent — retiring an already-empty slot is a no-op."""
+        released = len(self.slot_pages[slot])
         for p in self.slot_pages[slot]:
             self._decref(p)
         self.slot_pages[slot] = []
         self.slot_shared[slot] = 0
         self.slot_pos[slot] = None
+        if released:
+            self._obs("page_release", slot=slot, pages=released,
+                      free=self.pages_free)
 
     # -- accounting --------------------------------------------------------
 

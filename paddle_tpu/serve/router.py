@@ -62,6 +62,8 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from paddle_tpu.obs.flight import FlightRecorder
+from paddle_tpu.obs.trace import Tracer
 from paddle_tpu.serve.paged import chain_keys
 from paddle_tpu.serve.policy import SchedulerPolicy
 from paddle_tpu.serve.server import (COMPLETED, EXPIRED, FAILED, OUTCOMES,
@@ -149,7 +151,10 @@ class ServingRouter:
                  cooldown_s: float = 30.0,
                  probe_interval_s: float = 5.0,
                  affinity_blocks: int = 4096,
-                 policy: Optional[SchedulerPolicy] = None):
+                 policy: Optional[SchedulerPolicy] = None,
+                 tracer: Optional[Tracer] = None,
+                 flight: Optional[FlightRecorder] = None,
+                 flight_dir: Optional[str] = None):
         if not servers:
             raise ValueError("a fleet needs >= 1 replica")
         self.clock = clock
@@ -187,6 +192,16 @@ class ServingRouter:
         # dead replicas' pool counters, banked at death so aggregate
         # prefix-hit observability never goes backwards
         self._dead_base: Dict[str, int] = {}
+        # observability (paddle_tpu.obs). The router mints the fleet
+        # request-id (`rr<N>`) and starts the span; the SERVING
+        # REPLICA ends it at the terminal outcome (the same tracer is
+        # normally shared), and `_record` closes any span a
+        # tracer-less replica left open — exactly one terminal span
+        # per rr id either way. `flight_dir` is where the ring dumps
+        # on replica death.
+        self.tracer = tracer
+        self.flight = flight
+        self.flight_dir = flight_dir
 
     # -- routing -----------------------------------------------------------
 
@@ -240,6 +255,9 @@ class ServingRouter:
         rr_id = self._next_id
         self._next_id += 1
         self.stats["requests"] += 1
+        tid = self.trace_id(rr_id)
+        if self.tracer is not None:
+            self.tracer.start(tid, "fleet.request", rr_id=rr_id)
         chain = self._chain(prompt)
         rep = self._pick(chain)
         if rep is None:
@@ -254,7 +272,7 @@ class ServingRouter:
         try:
             rep_id = rep.server.submit(
                 prompt, max_new=max_new, deadline_ms=deadline_ms,
-                sampling=sampling)
+                sampling=sampling, trace_id=tid)
         except ValueError as e:
             # deterministic rejection by the replica's validator —
             # mirror its (already ledgered) FAILED result
@@ -278,12 +296,31 @@ class ServingRouter:
 
     # -- the ledger --------------------------------------------------------
 
+    @staticmethod
+    def trace_id(rr_id: int) -> str:
+        """The fleet-wide trace id for one router submission — minted
+        here, propagated down through the replica's scheduler, the
+        engine and the page pool (obs.trace)."""
+        return f"rr{rr_id}"
+
     def _record(self, res: RouterResult) -> None:
         assert res.rr_id not in self.results, (
             f"request {res.rr_id} already has outcome "
             f"{self.results[res.rr_id].outcome}, refusing a second")
         self.results[res.rr_id] = res
         self.stats[res.outcome] += 1
+        if self.tracer is not None:
+            # the serving replica normally ended the span at its
+            # terminal outcome; a tracer-less replica (or a router-
+            # level shed with no replica at all) leaves it open —
+            # close it here so every rr id gets exactly one terminal
+            # span. get() only returns LIVE spans, so this never
+            # double-ends.
+            tid = self.trace_id(res.rr_id)
+            if self.tracer.get(tid) is not None:
+                self.tracer.end(tid, res.outcome, error=res.error,
+                                replica=res.replica,
+                                redistributions=res.redistributions)
 
     def _mirror(self, rep: Replica) -> None:
         """Pull newly-terminal outcomes from the replica's ledger into
@@ -320,6 +357,10 @@ class ServingRouter:
         rep.alive = False
         rep.breaker.record_failure()
         self.stats["replicas_lost"] += 1
+        if self.flight is not None:
+            self.flight.record(
+                "fault", "replica-death", replica=rep.rid,
+                error=str(exc), pending=len(rep.pending))
         for key in [k for k, r in self._affinity.items() if r is rep]:
             del self._affinity[key]
         self._mirror(rep)           # outcomes that beat the crash
@@ -331,6 +372,11 @@ class ServingRouter:
                 rr_id, req,
                 why=f"replica {rep.rid} lost ({exc})")
         rep.pending.clear()
+        if self.flight is not None and self.flight_dir:
+            self.flight.dump(
+                self.flight_dir, f"replica-death-r{rep.rid}",
+                extra={"error": str(exc),
+                       "counters": self.counters()})
 
     def _redistribute(self, rr_id: int, req: Optional[Request],
                       why: str) -> None:
@@ -345,6 +391,9 @@ class ServingRouter:
         moves = self._moved.get(rr_id, 0) + 1
         self._moved[rr_id] = moves
         self.stats["redistributed"] += 1
+        if self.tracer is not None:
+            self.tracer.event(self.trace_id(rr_id), "redistributed",
+                              why=why, moves=moves)
         chain = self._chain(req.prompt)
         rep = self._pick(chain)
         if rep is None:
@@ -360,7 +409,8 @@ class ServingRouter:
             rep_id = rep.server.submit(
                 req.prompt, max_new=req.max_new,
                 deadline_ms=remaining_ms, sampling=req.sampling,
-                retries_left=req.retries_left)
+                retries_left=req.retries_left,
+                trace_id=self.trace_id(rr_id))
         except (ValueError, QueueFullError) as e:
             # the survivor's validator/shed verdict IS the outcome
             # (an already-expired deadline lands here as shed/failed
@@ -481,6 +531,22 @@ class ServingRouter:
         return self.results
 
     # -- observability -----------------------------------------------------
+
+    def bind_metrics(self, registry, *, prefix: str = "fleet",
+                     labels: Optional[Dict[str, str]] = None) -> None:
+        """Register the fleet ledger (`counters()`, `fleet_*`
+        aggregates included) as a read-through source on an
+        `obs.MetricsRegistry` — exported numbers and `reconcile()`
+        read the same books."""
+        registry.register_source(prefix, self.counters, labels=labels)
+        if self.tracer is not None:
+            registry.register_source(f"{prefix}_trace",
+                                     self.tracer.counters,
+                                     labels=labels)
+        if self.flight is not None:
+            registry.register_source(f"{prefix}_flight",
+                                     self.flight.counters,
+                                     labels=labels)
 
     def counters(self) -> Dict[str, int]:
         """The fleet ledger (router-level outcome tallies + routing

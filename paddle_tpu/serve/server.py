@@ -77,12 +77,15 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import os
 import signal
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from paddle_tpu.obs.flight import FlightRecorder
+from paddle_tpu.obs.trace import Tracer
 from paddle_tpu.serve.engine import PoolStats, pad_to_bucket
 from paddle_tpu.serve.paged import PoolExhaustedError, blocks_for
 from paddle_tpu.serve.policy import SchedulerPolicy
@@ -234,7 +237,9 @@ class ServingServer:
                  clock: Callable[[], float] = time.monotonic,
                  drain_report_path: Optional[str] = None,
                  install_signal_handlers: bool = False,
-                 policy: Optional[SchedulerPolicy] = None):
+                 policy: Optional[SchedulerPolicy] = None,
+                 tracer: Optional[Tracer] = None,
+                 flight: Optional[FlightRecorder] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         if max_retries < 0:
@@ -267,6 +272,18 @@ class ServingServer:
                                    else None)
         self.install_signal_handlers = install_signal_handlers
         self.on_step: List[Callable] = []
+        # observability (paddle_tpu.obs): pure host-side — spans and
+        # flight events never touch a device value, so instrumentation
+        # runs clean under transfer_guard("disallow") and adds no
+        # compile keys. Both default OFF (None).
+        self.tracer = tracer
+        self.flight = flight
+        # req_id -> live Span (cached so per-event instrumentation
+        # skips the tracer's lock; the id lives on span.trace_id)
+        self._trace_ids: Dict[int, Any] = {}
+        self._admitting_req: Optional[Request] = None
+        self._latency_hist = None
+        self._latency_labels: Dict[str, str] = {}
 
         self.stats = PoolStats()
         self.results: Dict[int, RequestResult] = {}
@@ -360,16 +377,94 @@ class ServingServer:
             submitted_at=req.submitted_at, done_at=self.clock())
         self.results[req.req_id] = res
         setattr(self.stats, outcome, getattr(self.stats, outcome) + 1)
+        self._trace_end(req.req_id, outcome, error=error,
+                        retries=retries, backend=res.backend,
+                        tokens=len(res.tokens))
+        if self._latency_hist is not None:
+            self._latency_hist.observe(
+                res.done_at - res.submitted_at,
+                labels={**self._latency_labels, "outcome": outcome})
         return res
 
     def _backend_name(self) -> str:
         return ("native" if self._backend is not None
                 and self._backend is self.native_backend else "jax")
 
+    # -- observability plumbing (host-side only) ---------------------------
+
+    def _trace_event(self, req_id: int, name: str, **data) -> None:
+        if self.tracer is None:
+            return
+        span = self._trace_ids.get(req_id)
+        if span is not None:
+            # the cached span skips the tracer's lock + live-table
+            # lookup — this runs per admit/retry on the serve loop
+            span.event(name, **data)
+
+    def _trace_end(self, req_id: int, outcome: str, **tags) -> None:
+        if self.tracer is None:
+            return
+        span = self._trace_ids.pop(req_id, None)
+        if span is not None:
+            self.tracer.end(span, outcome, **tags)
+
+    def _flight_dump(self, reason: str, **extra) -> None:
+        """Dump the flight ring next to the drain report (the
+        postmortem directory). Without a drain_report_path the ring
+        stays in memory — the event is still recorded."""
+        if self.flight is None or not self.drain_report_path:
+            return
+        d = os.path.dirname(self.drain_report_path) or "."
+        self.flight.dump(d, reason,
+                         extra={**extra, "counters": self.counters()})
+
+    def _pool_obs(self, event: str, ctx: dict) -> None:
+        """PagePool admit/release seam (`pool.obs_hook`): attach page
+        events to the owning request's span via the host ledger and
+        mirror them into the flight ring. During prefill the slot is
+        not yet in `_slot_req` — `_admitting_req` bridges the gap."""
+        slot = ctx.get("slot")
+        req = (self._slot_req[slot]
+               if slot is not None
+               and 0 <= slot < len(self._slot_req) else None)
+        if req is None:
+            req = self._admitting_req
+        if req is not None:
+            self._trace_event(req.req_id, event, **ctx)
+            if self.tracer is not None:
+                return  # the span carries the event into the ring via
+                        # the sink — a separate flight record would
+                        # double the per-admission cost for no signal
+        if self.flight is not None:
+            self.flight.record("pool", event, **ctx)
+
+    def bind_metrics(self, registry, *, prefix: str = "serve",
+                     labels: Optional[Dict[str, str]] = None) -> None:
+        """Attach this server to a `obs.MetricsRegistry`: the ledger
+        (`counters()`) becomes a read-through source — exported
+        metrics and `reconcile()` read the SAME numbers — and a
+        request-latency histogram is observed at every terminal
+        outcome. `labels` (e.g. {"replica": "r0"}) keeps multiple
+        servers on one registry apart."""
+        self._latency_labels = dict(labels or {})
+        registry.register_source(prefix, self.counters, labels=labels)
+        self._latency_hist = registry.histogram(
+            f"{prefix}_request_latency_seconds",
+            "submit -> terminal-outcome latency, by outcome")
+        if self.tracer is not None:
+            registry.register_source(f"{prefix}_trace",
+                                     self.tracer.counters,
+                                     labels=labels)
+        if self.flight is not None:
+            registry.register_source(f"{prefix}_flight",
+                                     self.flight.counters,
+                                     labels=labels)
+
     def submit(self, prompt, *, max_new: int,
                deadline_ms: Optional[float] = -1,
                sampling: Optional[dict] = None,
-               retries_left: Optional[int] = None) -> int:
+               retries_left: Optional[int] = None,
+               trace_id: Optional[str] = None) -> int:
         """Enqueue one request; returns its req_id. `deadline_ms` is
         relative to now (-1 = the server default, None = no deadline).
         `retries_left` overrides the transient-fault budget for THIS
@@ -386,6 +481,15 @@ class ServingServer:
         self._next_id += 1
         self.stats.requests += 1
         now = self.clock()
+        if self.tracer is not None:
+            # mint once: the fleet router passes its rr id down so a
+            # redistributed request keeps ONE span; a standalone
+            # server mints req<N>. Tracer.start dedupes a live id
+            # (resubmission after replica death) instead of forking
+            # the audit trail.
+            tid = trace_id if trace_id is not None else f"req{req_id}"
+            self._trace_ids[req_id] = self.tracer.start(
+                tid, "serve.request", req_id=req_id)
         try:
             arr = self._validate(prompt, max_new)
         except ValueError as e:
@@ -393,6 +497,7 @@ class ServingServer:
                 req_id=req_id, outcome=FAILED, error=str(e),
                 submitted_at=now, done_at=now)
             self.stats.failed += 1
+            self._trace_end(req_id, FAILED, error=str(e))
             e.req_id = req_id       # burst callers reconcile by id
             raise
         if deadline_ms == -1:
@@ -461,6 +566,9 @@ class ServingServer:
 
     def _install_signals(self):
         def handler(signum, frame):
+            if self.flight is not None:
+                self.flight.record("signal", f"signal-{signum}")
+                self._flight_dump(f"signal-{signum}")
             self.drain(reason=f"signal {signum}")
 
         try:
@@ -487,8 +595,6 @@ class ServingServer:
             tmp = f"{self.drain_report_path}.tmp"
             with open(tmp, "w") as f:
                 json.dump(report, f, indent=1)
-            import os
-
             os.replace(tmp, self.drain_report_path)
         return report
 
@@ -514,6 +620,9 @@ class ServingServer:
         self._slot_req = [None] * self._backend.slots
         self._prefilling.clear()
         self._active_pool = getattr(self._backend, "pool", None)
+        if self._active_pool is not None and (
+                self.tracer is not None or self.flight is not None):
+            self._active_pool.obs_hook = self._pool_obs
 
     def _bucketed(self, req: Request) -> np.ndarray:
         # the engine's own padding convention; _validate already
@@ -531,6 +640,8 @@ class ServingServer:
             self._emitted.pop(req.req_id, None)
             self._lps.pop(req.req_id, None)
             self.queue.insert(0, req)
+            self._trace_event(req.req_id, "retried", why=why,
+                              retries_left=req.retries_left)
             log.warning("request %d requeued after %s (%d retries "
                         "left)", req.req_id, why, req.retries_left)
         else:
@@ -557,6 +668,13 @@ class ServingServer:
             log.warning("circuit breaker %s after native fault (%s); "
                         "falling back to the pure-JAX engine",
                         self.breaker.state, exc)
+            if self.flight is not None:
+                self.flight.record("breaker", "breaker-open",
+                                   state=self.breaker.state,
+                                   failures=self.breaker.failures,
+                                   trips=self.breaker.trips,
+                                   error=str(exc))
+                self._flight_dump("breaker-open", error=str(exc))
             self._backend = self.engine
             self._evict_in_flight(f"native backend fault: {exc}")
 
@@ -672,6 +790,7 @@ class ServingServer:
     def _admit(self) -> None:
         while not self._draining and self.queue and any(
                 r is None for r in self._slot_req):
+            self._admitting_req = None
             slot = self._slot_req.index(None)
             idx = self.policy.next_index(self.queue)
             req = self.queue.pop(idx)
@@ -696,6 +815,7 @@ class ServingServer:
             chunked = (getattr(self._backend, "prefill_chunk", None)
                        is not None
                        and hasattr(self._backend, "prefill_begin"))
+            self._admitting_req = req
             try:
                 if chunked:
                     self._state, ticket = self._backend.prefill_begin(
@@ -740,6 +860,10 @@ class ServingServer:
             self._slot_req[slot] = req
             self._emitted[req.req_id] = []
             self._lps[req.req_id] = []
+            self._trace_event(req.req_id, "admitted", slot=slot,
+                              backend=self._backend_name(),
+                              chunked=chunked)
+        self._admitting_req = None
 
     def _expire_in_flight(self) -> None:
         now = self.clock()

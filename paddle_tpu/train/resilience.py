@@ -125,7 +125,8 @@ class Watchdog:
     def __init__(self, timeout_s: float,
                  on_timeout: Optional[Callable[[float], None]] = None,
                  *, poll_s: Optional[float] = None,
-                 name: str = "paddle-tpu-watchdog"):
+                 name: str = "paddle-tpu-watchdog",
+                 clock: Callable[[], float] = time.monotonic):
         if timeout_s <= 0:
             raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
         self.timeout_s = timeout_s
@@ -133,7 +134,12 @@ class Watchdog:
         self._poll_s = poll_s if poll_s is not None else min(
             timeout_s / 4.0, 1.0)
         self._name = name
-        self._last = time.monotonic()
+        # injectable like every other timeout surface in the repo
+        # (faults.ManualClock drives deterministic deadline tests);
+        # the poll cadence itself still rides the real
+        # threading.Event.wait
+        self.clock = clock
+        self._last = clock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.fired = False
@@ -148,7 +154,7 @@ class Watchdog:
             exit_code=self.EXIT_CODE)
 
     def start(self) -> "Watchdog":
-        self._last = time.monotonic()
+        self._last = self.clock()
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, name=self._name, daemon=True)
@@ -156,7 +162,7 @@ class Watchdog:
         return self
 
     def pet(self) -> None:
-        self._last = time.monotonic()
+        self._last = self.clock()
 
     def stop(self) -> None:
         self._stop.set()
@@ -166,7 +172,7 @@ class Watchdog:
 
     def _loop(self) -> None:
         while not self._stop.wait(self._poll_s):
-            elapsed = time.monotonic() - self._last
+            elapsed = self.clock() - self._last
             if elapsed >= self.timeout_s:
                 self.fired = True
                 try:
@@ -303,7 +309,11 @@ class ResilientTrainer:
                  watchdog_timeout_s: Optional[float] = None,
                  watchdog_on_timeout: Optional[Callable] = None,
                  install_signal_handlers: bool = True,
-                 checkpoint_manager: Optional[Any] = None):
+                 checkpoint_manager: Optional[Any] = None,
+                 tracer: Optional[Any] = None,
+                 flight: Optional[Any] = None,
+                 flight_dir: Optional[str] = None,
+                 pserver_client: Optional[Any] = None):
         if bad_step_policy not in ("skip", "rollback"):
             raise ValueError(
                 f"bad_step_policy must be skip|rollback, got "
@@ -341,7 +351,63 @@ class ResilientTrainer:
         # latest-step save dedupe must not treat them as durable
         self._corrupt_steps: set = set()
         self._watchdog: Optional[Watchdog] = None
+        # observability (paddle_tpu.obs) — host-side only, both
+        # default OFF. One span per EXECUTED step (a rollback replay
+        # is a fresh attempt span under the same step id); the flight
+        # ring dumps next to the checkpoints on divergence rollback,
+        # DivergenceError, and the preemption drain.
+        self.tracer = tracer
+        self.flight = flight
+        self.flight_dir = flight_dir or checkpoint_dir
+        # pserver push/pull events ride the live step span (the client's
+        # obs_hook seam) so the trainer step -> pserver trail is one trace
+        self.pserver_client = pserver_client
         self._build_step()
+
+    def counters(self) -> dict:
+        """Outcome counts, registry-source shaped (the
+        `obs.MetricsRegistry.register_source` contract: numeric
+        values only) — the SAME state the recovery policy decides on,
+        so exported metrics cannot drift from behavior."""
+        return {
+            "bad_steps": len(self.bad_steps),
+            "bad_used": self._bad_used,
+            "progress_since_bad": self._progress_since_bad,
+            "max_step_reached": self._max_step_reached,
+            "save_errors": len(self.save_errors),
+            "corrupt_steps": len(self._corrupt_steps),
+            "restored_step": (-1 if self.restored_step is None
+                              else self.restored_step),
+            "lr_scale": self._lr_scale,
+            "watchdog_fired": (self._watchdog is not None
+                               and self._watchdog.fired),
+        }
+
+    def bind_metrics(self, registry, *, prefix: str = "train",
+                     labels: Optional[dict] = None) -> None:
+        """Attach the trainer's outcome ledger (and tracer/flight
+        self-accounting) to an `obs.MetricsRegistry`."""
+        registry.register_source(prefix, self.counters, labels=labels)
+        if self.tracer is not None:
+            registry.register_source(f"{prefix}_trace",
+                                     self.tracer.counters,
+                                     labels=labels)
+        if self.flight is not None:
+            registry.register_source(f"{prefix}_flight",
+                                     self.flight.counters,
+                                     labels=labels)
+        if self.pserver_client is not None:
+            self.pserver_client.bind_metrics(
+                registry, prefix=f"{prefix}_pserver", labels=labels)
+
+    def _flight_dump(self, reason: str, /, **extra) -> None:
+        # positional-only: the fault paths also carry a `reason=` tag
+        # inside `extra` (the classifier's verdict), distinct from the
+        # dump trigger
+        if self.flight is None or not self.flight_dir:
+            return
+        self.flight.dump(self.flight_dir, reason,
+                         extra={**extra, "counters": self.counters()})
 
     def _build_step(self) -> None:
         tr = self.trainer
@@ -423,7 +489,13 @@ class ResilientTrainer:
     def _maybe_drain(self, state: TrainState) -> None:
         if self._preempt_signum is None:
             return
+        if self.flight is not None:
+            self.flight.record("signal", "preemption-drain",
+                               signum=self._preempt_signum,
+                               step=int(state.step))
         self._save(state, drain=True)
+        self._flight_dump(f"sigterm-{self._preempt_signum}",
+                          step=int(state.step))
         raise Preempted(int(state.step), self._preempt_signum)
 
     # -- divergence guard -------------------------------------------------
@@ -449,8 +521,16 @@ class ResilientTrainer:
             batch_id=batch_id, reason=reason, action=action, loss=loss))
         self._bad_used += 1
         self._progress_since_bad = 0
+        if self.flight is not None:
+            self.flight.record("fault", "bad-step",
+                               step=int(prev_state.step),
+                               pass_id=pass_id, batch_id=batch_id,
+                               reason=reason, action=action,
+                               loss=loss, bad_used=self._bad_used)
         if self._bad_used > self.max_bad_steps:
             self.bad_steps[-1].action = "fail"
+            self._flight_dump("divergence-budget-exhausted",
+                              reason=reason)
             raise DivergenceError(self.bad_steps)
         log.warning("bad step %d (pass %d batch %d): %s -> %s "
                     "(%d/%d recoveries used)", int(prev_state.step),
@@ -478,8 +558,12 @@ class ResilientTrainer:
                                                bad_steps=bad)
         self._corrupt_steps.update(bad)
         if step is None:
+            self._flight_dump("divergence-no-restore-target",
+                              reason=reason)
             raise DivergenceError(self.bad_steps)
         self._pet()
+        self._flight_dump("divergence-rollback", reason=reason,
+                          restored_step=step)
         raise _Rollback(restored)
 
     # -- the drive loop ---------------------------------------------------
@@ -565,6 +649,21 @@ class ResilientTrainer:
                     began = True
                 self._maybe_drain(state)
                 handler(E.BeginIteration(pass_id, batch_id))
+                span = None
+                if self.tracer is not None:
+                    # one span per EXECUTED attempt: a rollback replay
+                    # of the same gidx opens a fresh span under the
+                    # same id, so the audit trail shows every attempt
+                    span = self.tracer.start(
+                        f"step{gidx}", "train.step",
+                        pass_id=pass_id, batch_id=batch_id)
+                    if self.pserver_client is not None:
+                        # point the client's obs seam at THIS attempt's
+                        # span; Span.event on a closed span is a no-op,
+                        # so a stale hook between steps is harmless
+                        self.pserver_client.obs_hook = (
+                            lambda event, ctx, _s=span:
+                            _s.event(event, **ctx))
                 inputs, labels = self.trainer._split_batch(batch)
                 # device_put the fold data EXPLICITLY: a bare python
                 # int here is an implicit h2d transfer every step
@@ -588,10 +687,17 @@ class ResilientTrainer:
                             state, prev_state, pass_id, batch_id, lossf,
                             reason)
                     except (_Rollback, DivergenceError):
+                        if span is not None:
+                            self.tracer.end(
+                                span, self.bad_steps[-1].action,
+                                reason=reason, loss=lossf)
                         handler(E.EndIteration(
                             pass_id, batch_id, cost=loss,
                             outcome=self.bad_steps[-1].action))
                         raise
+                    if span is not None:
+                        self.tracer.end(span, "skip", reason=reason,
+                                        loss=lossf)
                     handler(E.EndIteration(pass_id, batch_id, cost=loss,
                                            outcome="skip"))
                     gidx += 1
@@ -613,6 +719,8 @@ class ResilientTrainer:
                             "one — recovery budget reset",
                             self._progress_since_bad)
                         self._bad_used = 0
+                if span is not None:
+                    self.tracer.end(span, "ok", loss=lossf)
                 handler(E.EndIteration(pass_id, batch_id, cost=loss,
                                        metrics=metrics))
                 gidx += 1

@@ -319,9 +319,9 @@ class Trainer:
                     from paddle_tpu.metrics.printer import (
                         format_parameter_stats, parameter_stats)
 
-                    print(f"--- parameter stats (pass {pass_id} batch "
+                    print(f"--- parameter stats (pass {pass_id} batch "  # graftlint: disable=GL007(user-facing parameter-stats dump, opt-in via parameter_stats_period)
                           f"{batch_id}) ---")
-                    print(format_parameter_stats(
+                    print(format_parameter_stats(  # graftlint: disable=GL007(user-facing parameter-stats dump, opt-in via parameter_stats_period)
                         parameter_stats(state.params)))
                 if (checkpoint_manager is not None
                         and checkpoint_every_n_batches

@@ -3,16 +3,21 @@
 # command (docs/ANALYSIS.md):
 #
 #   1. `python -m paddle_tpu.analysis --check` — graftlint (GL001-
-#      GL006 trace-safety/recompile discipline) + locklint (LK001
-#      lock discipline) over the whole repo against the committed
+#      GL006 trace-safety/recompile discipline, GL007 obs clock/
+#      logging discipline in serve/train) + locklint (LK001 lock
+#      discipline) over the whole repo against the committed
 #      baseline (paddle_tpu/analysis/baseline.json); any unbaselined
 #      finding fails the lane.
 #   2. `pytest -m analysis` — per-rule must-flag/near-miss fixtures
 #      and the RecompileGuard steady-state regressions (decode loop
 #      and train step compile once, then zero recompiles / implicit
 #      transfers).
+#   3. `python -m paddle_tpu obs schema` — the metrics-exporter
+#      golden-schema gate (the full obs lane incl. the span-audit
+#      chaos tests is scripts/obs_smoke.sh; the schema check rides
+#      here because exporter drift is a lint-class regression).
 #
-#     scripts/lint_smoke.sh              # gate + tests
+#     scripts/lint_smoke.sh              # gate + tests + obs schema
 #     scripts/lint_smoke.sh --check-only # just the lint gate (fast)
 #     scripts/lint_smoke.sh -k guard     # filter, passes through
 #
@@ -31,5 +36,6 @@ env JAX_PLATFORMS=cpu python -m paddle_tpu.analysis --check
 if [ "$1" = "--check-only" ]; then
     exit 0
 fi
-exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m analysis \
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m analysis \
     -p no:cacheprovider "$@"
+exec env JAX_PLATFORMS=cpu python -m paddle_tpu obs schema

@@ -1,0 +1,24 @@
+#!/usr/bin/env sh
+# Observability smoke lane (docs/OBSERVABILITY.md), one command:
+#
+#   1. `pytest -m obs` — registry/tracer/flight unit fixtures plus
+#      the kill-chaos span audit: a replica dies mid-burst and every
+#      minted rr id still has exactly one terminal span, span
+#      outcome tallies equal the fleet counters, the replica-death
+#      flight dump on disk reconciles with the ledger, and the whole
+#      instrumented run is clean under transfer_guard("disallow").
+#   2. `python -m paddle_tpu obs schema` — the exporter golden-schema
+#      gate: builds a registry with one instrument of each kind plus
+#      a source, and fails (exit 1) if the snapshot keys, the
+#      Prometheus text shape, or the JSON-lines form drift from the
+#      documented schema scrapers depend on.
+#
+#     scripts/obs_smoke.sh             # tests + schema gate
+#     scripts/obs_smoke.sh -k chaos    # filter, passes through
+#
+# CPU-only and deterministic; extra args pass through to pytest.
+set -e
+cd "$(dirname "$0")/.."
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m obs \
+    -p no:cacheprovider "$@"
+exec env JAX_PLATFORMS=cpu python -m paddle_tpu obs schema

@@ -71,6 +71,11 @@ def pytest_configure(config):
         "CPU-only, runs IN tier-1; `-m analysis` (or "
         "`scripts/lint_smoke.sh`) runs it alone")
     config.addinivalue_line(
+        "markers", "obs: unified observability suite (obs registry/"
+        "trace/flight, span exactly-once chaos audit, exporter "
+        "schema) — fast and CPU-only, runs IN tier-1; `-m obs` (or "
+        "`scripts/obs_smoke.sh`) runs it alone")
+    config.addinivalue_line(
         "markers", "router: multi-replica serving-fleet suite "
         "(serve.router affinity/failover/redistribution chaos) — a "
         "subset of the faults lane, runs IN tier-1; `-m router` (or "

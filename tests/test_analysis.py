@@ -336,6 +336,61 @@ class TestGL006ImportTimeCompute:
         """)
 
 
+class TestGL007ObsDiscipline:
+    """GL007 only bites inside serve/ and train/ — the modules under
+    the obs instrumentation contract."""
+
+    @staticmethod
+    def rules_at(src, path):
+        return {f.rule
+                for f in lint_source(textwrap.dedent(src), path)}
+
+    def test_time_time_flagged_in_serve(self):
+        assert "GL007" in self.rules_at("""
+            import time
+            def step(self):
+                t0 = time.time()
+                return t0
+        """, "paddle_tpu/serve/x.py")
+
+    def test_bare_print_flagged_in_train(self):
+        assert "GL007" in self.rules_at("""
+            def report(n):
+                print(n)
+        """, "paddle_tpu/train/x.py")
+
+    def test_near_miss_monotonic_and_other_module(self):
+        # the injectable-clock default is fine, and the same bare
+        # print outside the instrumented tree is out of scope
+        assert "GL007" not in self.rules_at("""
+            import time
+            def step(self):
+                return time.monotonic()
+        """, "paddle_tpu/serve/x.py")
+        assert "GL007" not in self.rules_at("""
+            def report(n):
+                print(n)
+        """, "paddle_tpu/native/x.py")
+
+    def test_traced_print_stays_gl001(self):
+        # print of a traced value is GL001's finding — GL007 must not
+        # double-report it
+        rules = self.rules_at("""
+            import jax
+            @jax.jit
+            def f(x):
+                print(x)
+                return x
+        """, "paddle_tpu/serve/x.py")
+        assert "GL001" in rules and "GL007" not in rules
+
+    def test_disable_with_reason_suppresses(self):
+        assert "GL007" not in self.rules_at("""
+            def report(n):
+                print(n)  # graftlint: disable=GL007(user-facing dump)
+        """, "paddle_tpu/train/x.py")
+
+
 class TestSuppression:
     SRC = """
         import jax

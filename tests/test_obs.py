@@ -1,0 +1,368 @@
+"""Unified observability layer: registry, tracing, flight recorder.
+
+Three layers under test, separately and then composed under chaos:
+
+- `obs.registry.MetricsRegistry`: bounded-cardinality counters /
+  gauges / histograms plus read-through sources, with Prometheus-text
+  and JSON-lines exporters reading the SAME books `reconcile()` does.
+- `obs.trace.Tracer`: request spans minted at `ServingRouter.submit`
+  (`rr<N>`), ended exactly once at the terminal outcome; second ends
+  and late events are tallied, never raised.
+- `obs.flight.FlightRecorder`: last-N ring, dumped on faults.
+
+THE acceptance chaos run (ISSUE 8): kill a replica mid-burst with
+full instrumentation on and assert every minted rr id has exactly one
+terminal span, span outcome tallies equal the fleet counters, the
+replica-death flight dump on disk reconciles with the fleet ledger,
+and the whole instrumented run stays clean under
+`jax.transfer_guard("disallow")` — observability adds zero implicit
+transfers.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.models import transformer as T
+from paddle_tpu.obs import (FlightRecorder, MetricsRegistry, Tracer,
+                            sanitize_value)
+from paddle_tpu.obs.flight import peek_default, set_default
+from paddle_tpu.serve.engine import DecodeEngine
+from paddle_tpu.serve.router import ServingRouter
+from paddle_tpu.serve.server import ServingServer
+from paddle_tpu.testing.faults import (FaultPlan, ManualClock,
+                                       garbage_prompts)
+
+pytestmark = pytest.mark.obs
+
+
+class FakeClock:
+    """Deterministic injectable clock (obs components never sleep, so
+    a manual tick is all the tests need)."""
+
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- registry ---------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_counter_gauge_histogram_roundtrip(self):
+        clk = FakeClock()
+        reg = MetricsRegistry(clock=clk)
+        c = reg.counter("reqs_total", "requests")
+        g = reg.gauge("queue_depth", "queued")
+        h = reg.histogram("latency_s", "latency",
+                          buckets=(0.1, 1.0))
+        c.inc()
+        c.inc(2, labels={"outcome": "completed"})
+        g.set(7)
+        h.observe(0.05)
+        h.observe(5.0)
+        snap = reg.snapshot()
+        assert snap["ts"] == clk.t
+        by_name = {}
+        for s in snap["series"]:
+            key = (s["name"], tuple(sorted(s["labels"].items())))
+            by_name[key] = s["value"]
+        assert by_name[("reqs_total", ())] == 1
+        assert by_name[("reqs_total",
+                        (("outcome", "completed"),))] == 2
+        assert by_name[("queue_depth", ())] == 7
+        assert by_name[("latency_s_count", ())] == 2
+        assert by_name[("latency_s_sum", ())] == pytest.approx(5.05)
+        assert by_name[("latency_s_bucket", (("le", "0.1"),))] == 1
+        assert by_name[("latency_s_bucket", (("le", "+Inf"),))] == 2
+
+    def test_same_name_returns_same_metric(self):
+        reg = MetricsRegistry()
+        a = reg.counter("x", "")
+        b = reg.counter("x", "")
+        assert a is b
+        with pytest.raises(TypeError):
+            reg.gauge("x", "")       # kind change is a bug, not a new metric
+
+    def test_cardinality_bound_overflows_not_grows(self):
+        reg = MetricsRegistry(max_series_per_metric=4)
+        c = reg.counter("per_req", "")
+        for i in range(50):
+            c.inc(labels={"req": str(i)})
+        rows = [s for s in reg.snapshot()["series"]
+                if s["name"] == "per_req"]
+        assert len(rows) <= 5         # 4 admitted + the overflow bucket
+        overflow = [s for s in rows
+                    if s["labels"].get("overflow") == "true"]
+        assert overflow and overflow[0]["value"] == 46
+        assert reg.snapshot()["dropped_series"] == 46
+
+    def test_register_source_reads_live_books(self):
+        reg = MetricsRegistry()
+        stats = {"completed": 0, "alive": True, "note": "text"}
+        reg.register_source("srv", lambda: dict(stats))
+        stats["completed"] = 3
+        vals = {s["name"]: s["value"]
+                for s in reg.snapshot()["series"]}
+        assert vals["srv_completed"] == 3     # read-through, not a copy
+        assert vals["srv_alive"] == 1         # bool -> 0/1
+        assert "srv_note" not in vals         # non-numeric dropped
+        assert sanitize_value("text") is None
+
+    def test_broken_source_counted_not_raised(self):
+        reg = MetricsRegistry()
+
+        def bad():
+            raise RuntimeError("source died")
+
+        reg.register_source("bad", bad)
+        reg.counter("ok", "").inc()
+        snap = reg.snapshot()
+        assert snap["source_errors"] == 1
+        assert any(s["name"] == "ok" for s in snap["series"])
+
+    def test_exporters_cover_the_same_series(self):
+        reg = MetricsRegistry(clock=FakeClock())
+        reg.counter("a_total", "help a").inc(4)
+        reg.gauge("b", "help b").set(1.5, labels={"shard": "0"})
+        prom = reg.to_prometheus()
+        assert "# TYPE a_total counter" in prom
+        assert "a_total 4" in prom
+        assert 'b{shard="0"} 1.5' in prom
+        lines = [json.loads(ln) for ln in
+                 reg.to_jsonl().strip().splitlines()]
+        names = {ln["name"] for ln in lines if "name" in ln}
+        assert {"a_total", "b"} <= names
+        assert lines[-1]["meta"] == {"dropped_series": 0,
+                                     "source_errors": 0}
+
+
+# -- tracer -----------------------------------------------------------------
+
+
+class TestTracer:
+    def test_span_lifecycle_and_duration(self):
+        clk = FakeClock()
+        tr = Tracer(clock=clk)
+        span = tr.start("rr1", "fleet.request", rr_id=1)
+        clk.advance(0.5)
+        span.event("admitted", replica=2)
+        clk.advance(0.5)
+        tr.end("rr1", "completed", replica=2)
+        assert span.duration() == pytest.approx(1.0)
+        assert span.outcome == "completed"
+        assert span.events[0]["name"] == "admitted"
+        assert tr.counters() == {
+            "spans_started": 1, "spans_ended": 1, "spans_live": 0,
+            "double_ends": 0, "late_events": 0}
+
+    def test_double_end_tallied_never_raises(self):
+        tr = Tracer(clock=FakeClock())
+        span = tr.start("rr1", "x")
+        tr.end("rr1", "completed")
+        tr.end(span, "failed")        # a second end must not flip it
+        assert span.outcome == "completed"
+        assert tr.counters()["double_ends"] == 1
+        assert tr.terminal_outcomes() == {"rr1": ["completed"]}
+
+    def test_late_event_is_noop(self):
+        tr = Tracer(clock=FakeClock())
+        span = tr.start("rr1", "x")
+        tr.end("rr1", "completed")
+        span.event("straggler")       # stale hook after terminal
+        assert span.events == []
+        assert tr.counters()["late_events"] == 1
+
+    def test_restart_live_id_does_not_fork(self):
+        tr = Tracer(clock=FakeClock())
+        a = tr.start("rr1", "x")
+        b = tr.start("rr1", "x")      # instrumentation bug: same id
+        assert a is b and a.tags["respan"] == 1
+        assert tr.counters()["spans_started"] == 1
+
+    def test_sink_receives_finished_spans(self):
+        fr = FlightRecorder(clock=FakeClock())
+        tr = Tracer(clock=FakeClock(), sink=fr.note_span)
+        tr.start("rr1", "x")
+        tr.end("rr1", "completed")
+        evts = fr.events()
+        assert len(evts) == 1 and evts[0]["kind"] == "span"
+        assert evts[0]["span"]["tags"]["outcome"] == "completed"
+
+
+# -- flight recorder --------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_ring_keeps_last_n(self):
+        fr = FlightRecorder(capacity=4, clock=FakeClock())
+        for i in range(10):
+            fr.record("pool", "admit", seq=i)
+        evts = fr.events()
+        assert [e["seq"] for e in evts] == [6, 7, 8, 9]
+        assert fr.counters() == {"events": 4, "recorded": 10,
+                                 "dumps": 0}
+
+    def test_dump_to_dir_is_loadable_json(self, tmp_path):
+        fr = FlightRecorder(clock=FakeClock())
+        fr.record("fault", "replica-death", replica=1)
+        path = fr.dump(str(tmp_path), "replica-death-r1",
+                       extra={"counters": {"requests": 5}})
+        assert path and os.path.dirname(path) == str(tmp_path)
+        with open(path) as f:
+            payload = json.load(f)
+        assert payload["kind"] == "flight_dump"
+        assert payload["reason"] == "replica-death-r1"
+        assert payload["n_events"] == 1
+        assert payload["extra"]["counters"]["requests"] == 5
+        assert fr.last_dump_path == path
+
+    def test_dump_failure_returns_none(self, tmp_path):
+        fr = FlightRecorder()
+        bad = tmp_path / "f"
+        bad.write_text("")
+        # a FILE where a directory component is expected: open fails,
+        # dump swallows it (fault paths must not raise from telemetry)
+        assert fr.dump(str(bad / "sub" / "x.json"), "r") is None
+
+    def test_module_default_is_peek_only(self):
+        prev = peek_default()
+        try:
+            set_default(None)
+            assert peek_default() is None   # no allocation on peek
+            fr = FlightRecorder()
+            set_default(fr)
+            assert peek_default() is fr
+        finally:
+            set_default(prev)
+
+
+# -- the chaos audit: spans exactly-once, dump reconciles -------------------
+
+CFG = T.TransformerConfig(vocab=61, dim=32, n_layers=2, n_heads=4,
+                          attn_impl="dense")
+
+
+@pytest.fixture(scope="module")
+def engines():
+    params = T.init_params(jax.random.key(0), CFG)
+    engs = [DecodeEngine(params, CFG, slots=2, max_len=32,
+                         page_size=4)
+            for _ in range(3)]
+    warm = np.arange(11, dtype=np.int32)
+    for e in engs:
+        e.serve([warm], max_new=2, buckets=(16,))
+    return engs
+
+
+def family_prompts(n, seed, n_families=3):
+    r = np.random.RandomState(seed)
+    prefixes = [r.randint(0, 61, (8,)).astype(np.int32)
+                for _ in range(n_families)]
+    return [np.concatenate(
+        [prefixes[i % n_families],
+         r.randint(0, 61, (3,)).astype(np.int32)]) for i in range(n)]
+
+
+class TestChaosSpanAudit:
+    def test_kill_midburst_every_request_one_terminal_span(
+            self, engines, tmp_path):
+        """Replica 0 dies at a decode step mid-burst with the full
+        obs stack on. The audit: exactly one terminal span per rr id,
+        span outcomes == fleet ledger, flight dump reconciles, zero
+        implicit transfers."""
+        clk = ManualClock()
+        registry = MetricsRegistry(clock=clk)
+        flight = FlightRecorder(clock=clk)
+        tracer = Tracer(clock=clk, sink=flight.note_span)
+        plan = FaultPlan()
+        servers = []
+        for i, eng in enumerate(engines):
+            if i == 0:
+                eng = plan.wrap_replica_engine(eng, clock=clk)
+            servers.append(ServingServer(
+                eng, max_queue=16, clock=clk, buckets=(16,),
+                max_retries=2, tracer=tracer, flight=flight))
+        router = ServingRouter(servers, clock=clk, tracer=tracer,
+                               flight=flight,
+                               flight_dir=str(tmp_path))
+        router.bind_metrics(registry)
+
+        # mixed burst: 9 family requests + 6 garbage rejections, the
+        # kill armed at the 5th decode step of the burst
+        plan.router_kill_decode_at = plan._router_decode_counter + 4
+        ids = [router.submit(p, max_new=4)
+               for p in family_prompts(9, seed=12)]
+        for g in garbage_prompts(61, 16).values():
+            try:
+                router.submit(g, max_new=2)
+            except ValueError:
+                pass
+        with jax.transfer_guard("disallow"):
+            res = router.run()
+        router.reconcile()
+        assert plan.count("replicakill") == 1
+        c = router.counters()
+        assert c["replicas_lost"] == 1
+        for rid in ids:
+            assert res[rid].outcome == "completed"
+
+        # -- exactly one terminal span per minted rr id
+        outcomes = tracer.terminal_outcomes()
+        assert set(outcomes) == {ServingRouter.trace_id(r)
+                                 for r in res}
+        assert all(len(v) == 1 for v in outcomes.values()), outcomes
+        tc = tracer.counters()
+        assert tc["double_ends"] == 0 and tc["spans_live"] == 0
+        assert tc["spans_started"] == tc["spans_ended"] == len(res)
+
+        # -- span outcome tallies are the ledger, number for number
+        tally = tracer.outcome_counts()
+        for oc in ("completed", "failed", "shed", "expired"):
+            assert tally.get(oc, 0) == c[oc], (oc, tally, c)
+
+        # -- a redistributed request's span names the handoff
+        moved = [r for r in ids if res[r].redistributions > 0]
+        assert moved
+        span = next(s for s in tracer.finished
+                    if s.trace_id == ServingRouter.trace_id(moved[0]))
+        assert any(e["name"] == "redistributed" for e in span.events)
+        assert res[moved[0]].replica != 0
+
+        # -- the registry exports the same books reconcile() read
+        vals = {s["name"]: s["value"]
+                for s in registry.snapshot()["series"]}
+        assert vals["fleet_requests"] == c["requests"]
+        assert vals["fleet_completed"] == c["completed"]
+        assert vals["fleet_replicas_lost"] == 1
+        assert vals["fleet_trace_double_ends"] == 0
+        assert vals["fleet_flight_dumps"] == 1
+
+        # -- the replica-death dump is on disk and reconciles
+        dumps = [f for f in os.listdir(tmp_path)
+                 if f.startswith("flight-replica-death")]
+        assert len(dumps) == 1
+        with open(tmp_path / dumps[0]) as f:
+            payload = json.load(f)
+        assert payload["kind"] == "flight_dump"
+        # the dump snapshot was taken AT death, mid-run: its request
+        # count is final (all submitted pre-kill) and its death event
+        # is in the ring
+        assert payload["extra"]["counters"]["requests"] \
+            == c["requests"]
+        assert payload["extra"]["counters"]["replicas_lost"] == 1
+        deaths = [e for e in payload["events"]
+                  if e["kind"] == "fault"
+                  and e["name"] == "replica-death"]
+        assert len(deaths) == 1 and deaths[0]["replica"] == 0
+        # span events rode the sink into the same ring
+        assert any(e["kind"] == "span" for e in payload["events"])
