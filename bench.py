@@ -392,6 +392,7 @@ def bench_serving() -> None:
          double_ends=tc["double_ends"],
          obs_snapshot=registry.snapshot()["series"])
     bench_router(cfg, params)
+    bench_speculative(cfg, params)
 
 
 def bench_router(cfg, params) -> None:
@@ -522,6 +523,97 @@ def bench_router(cfg, params) -> None:
              == c["requests"]),
          span_exactly_once=bool(span_once),
          obs_snapshot=registry.snapshot()["series"])
+
+
+def bench_speculative(cfg, params) -> None:
+    """Speculative-decoding stage (ISSUE 9): plain vs speculative
+    serving over IDENTICAL repetitive traffic — the n-gram proposer's
+    win case (templated replies, structured extraction: the model
+    re-emits spans it has already produced), which is what the stage
+    measures: the ceiling the one-launch verify step buys when drafts
+    mostly land. The stage uses its own small-vocab model whose
+    greedy output actually settles into re-emitted spans (the
+    bench_serving cfg's output is near-novel, which the proposer
+    correctly degrades to ~0-draft rounds on — that arm would measure
+    proposer overhead, not speculation). Protocol mirrors the obs
+    overhead gate (one warm server per arm, then interleaved timed
+    rounds, median vs median) because the 1.3x acceptance bound has
+    to be resolved through the same ±8% CPU scheduler jitter. Greedy
+    token parity between the arms is asserted on a dedicated untimed
+    round; acceptance rate comes from timed-window DELTA counters so
+    warmup drafts don't dilute it."""
+    import statistics
+
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.serve.engine import DecodeEngine
+    from paddle_tpu.serve.policy import SchedulerPolicy
+    from paddle_tpu.serve.server import ServingServer
+
+    del cfg, params                  # stage-local model (see above)
+    cfg = T.TransformerConfig(vocab=64, dim=64, n_layers=2,
+                              n_heads=4, attn_impl="dense")
+    params = T.init_params(jax.random.key(0), cfg)
+    slots, page, max_len, max_new = 4, 16, 160, 48
+    policy = SchedulerPolicy()
+    policy.spec_draft_max = 8
+    r = np.random.RandomState(7)
+    base = r.randint(0, 64, (12,)).astype(np.int32)
+    prompts = []
+    for i in range(12):
+        period = np.concatenate([base] * 4)
+        prompts.append(period[: 24 + 12 * (i % 3)].copy())
+
+    def mk(spec):
+        e = DecodeEngine(params, cfg, slots=slots, max_len=max_len,
+                         page_size=page,
+                         num_pages=slots * (max_len // page),
+                         prefill_chunk=32, policy=policy)
+        s = ServingServer(e, max_queue=64, max_retries=3,
+                          buckets=(64,), speculative=spec)
+        s.submit(prompts[0], max_new=2)
+        s.run()
+        return s
+
+    def round_results(s):
+        t0 = time.perf_counter()
+        rr = [s.submit(p, max_new=max_new) for p in prompts]
+        res = s.run()
+        dt = time.perf_counter() - t0
+        toks = [list(res[i].tokens) for i in rr]
+        return sum(len(t) for t in toks) / dt, toks
+
+    log("speculative: warmup/compile (plain + spec arms)")
+    srv_plain = mk(False)
+    srv_spec = mk(True)
+    log("speculative: parity round (untimed)")
+    _, toks_plain = round_results(srv_plain)
+    _, toks_spec = round_results(srv_spec)
+    parity = toks_plain == toks_spec
+    c0 = srv_spec.counters()
+    log("speculative: interleaved timed rounds")
+    plain_rounds, spec_rounds = [], []
+    for _ in range(5):
+        plain_rounds.append(round_results(srv_plain)[0])
+        spec_rounds.append(round_results(srv_spec)[0])
+    c1 = srv_spec.counters()
+    srv_plain.reconcile()
+    srv_spec.reconcile()
+    rate_plain = statistics.median(plain_rounds)
+    rate_spec = statistics.median(spec_rounds)
+    proposed = c1["draft_proposed"] - c0["draft_proposed"]
+    accepted = c1["draft_accepted"] - c0["draft_accepted"]
+    emit("serve_spec_tokens_per_sec", round(rate_spec, 1),
+         "tokens/sec", None,
+         tokens_per_sec_plain=round(rate_plain, 1),
+         speedup_vs_plain=round(rate_spec / rate_plain, 2),
+         meets_1_3x=bool(rate_spec >= 1.3 * rate_plain),
+         greedy_parity=bool(parity),
+         draft_max=policy.spec_draft_max,
+         acceptance_rate=round(accepted / max(proposed, 1), 3),
+         draft_proposed=proposed, draft_accepted=accepted,
+         spec_rounds=c1["spec_rounds"] - c0["spec_rounds"],
+         spec_rolled_back=(c1["spec_rolled_back"]
+                           - c0["spec_rolled_back"]))
 
 
 def run_resnet_child(batch, timeout_s: int):

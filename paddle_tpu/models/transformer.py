@@ -33,6 +33,7 @@ from paddle_tpu.nn import initializers
 from paddle_tpu.ops import linalg
 from paddle_tpu.ops import losses as losses_ops
 from paddle_tpu.ops import norm as norm_ops
+from paddle_tpu.ops import sampling as sampling_ops
 from paddle_tpu.ops.flash_attention import flash_attention
 from paddle_tpu.parallel.sharding import MEGATRON_RULES, MODEL_AXIS
 
@@ -918,11 +919,17 @@ def speculative_generate(params, cfg: TransformerConfig,
     t_end = t0 + steps
     karange = jnp.arange(draft_k + 1, dtype=jnp.int32)
 
-    def row_round(t, done, rounds, out_row, tgt_c, dft_c):
+    def row_round(t, done, rounds, out_row, tgt_c, dft_c, tgt_p, dft_p):
         """One speculative round for ONE row. Runs under vmap: every
         input arrives without its batch dim (caches [total, Hkv, Dh],
         out_row [total], t/done/rounds scalars) and is re-wrapped to
-        the batch-1 shapes window_forward expects."""
+        the batch-1 shapes window_forward expects. tgt_p/dft_p are the
+        round's dequantized params, computed OUTSIDE the vmap
+        (in_axes=None): `jax.lax.optimization_barrier` has no vmap
+        batching rule in this jax, so the int8 LICM barrier
+        (_int8_step_params) must fire in the while body before the
+        rows fan out — once per round instead of once per forward,
+        which streams the s8 weights all the same."""
         active = (~done) & (t < t_end)
         out1 = out_row[None]
         tgt1 = jax.tree.map(lambda a: a[None], tgt_c)
@@ -938,13 +945,13 @@ def speculative_generate(params, cfg: TransformerConfig,
         last2 = jax.lax.dynamic_slice(
             out1, (jnp.zeros((), t.dtype), t - 2), (1, 2))
         logits2, dft1 = window_forward(
-            dft_step_params(last2), draft_cfg, dft1, last2, t - 2)
+            dft_p, draft_cfg, dft1, last2, t - 2)
         d0 = jnp.argmax(logits2[:, -1], axis=-1).astype(out_row.dtype)
 
         def draft_step(c, i):
             dft, tok = c
             logits, dft = window_forward(
-                dft_step_params(tok), draft_cfg, dft, tok[:, None], t + i)
+                dft_p, draft_cfg, dft, tok[:, None], t + i)
             nxt = jnp.argmax(logits[:, -1], axis=-1).astype(out_row.dtype)
             return (dft, nxt), nxt
 
@@ -956,7 +963,7 @@ def speculative_generate(params, cfg: TransformerConfig,
         # --- target verifies the window in one forward --------------
         last = jax.lax.dynamic_slice_in_dim(out1, t - 1, 1, axis=1)
         window = jnp.concatenate([last, drafts], axis=1)   # [1, K+1]
-        logits, tgt1 = window_forward(tgt_step_params(window), cfg, tgt1,
+        logits, tgt1 = window_forward(tgt_p, cfg, tgt1,
                                       window, t - 1)
         greedy = jnp.argmax(logits, axis=-1).astype(out_row.dtype)
 
@@ -990,14 +997,22 @@ def speculative_generate(params, cfg: TransformerConfig,
                 jax.tree.map(lambda a: a[0], tgt1),
                 jax.tree.map(lambda a: a[0], dft1))
 
-    vround = jax.vmap(row_round)
+    vround = jax.vmap(row_round, in_axes=(0,) * 6 + (None, None))
 
     def cond(carry):
         t, done = carry[0], carry[1]
         return jnp.any((~done) & (t < t_end))
 
+    def body(c):
+        # dequant ONCE per round, before the rows fan out: the
+        # optimization_barrier keyed on the loop-varying pointer
+        # vector keeps LICM from hoisting it out of the while_loop,
+        # and running it here (not in row_round) keeps it out of vmap,
+        # which has no batching rule for the barrier
+        return vround(*c, tgt_step_params(c[0]), dft_step_params(c[0]))
+
     t, done, rounds, out_buf, _, _ = jax.lax.while_loop(
-        cond, lambda c: vround(*c),
+        cond, body,
         (jnp.full((b,), t0, jnp.int32), jnp.zeros((b,), bool),
          jnp.zeros((b,), jnp.int32), out_buf, tgt_caches, dft_caches))
     if eos_id is not None:
@@ -1073,7 +1088,12 @@ def speculative_sample(params, cfg: TransformerConfig,
         return jax.nn.log_softmax(_filter_logits(
             at_least_f32(logits), temperature, top_k, top_p), axis=-1)
 
-    def row_round(t, done, rounds, key, out_row, tgt_c, dft_c):
+    def row_round(t, done, rounds, key, out_row, tgt_c, dft_c,
+                  tgt_p, dft_p):
+        # tgt_p/dft_p: the round's dequantized params, computed in the
+        # while body OUTSIDE this vmapped round (in_axes=None) — see
+        # speculative_generate's row_round for why (the int8 LICM
+        # barrier has no vmap batching rule)
         active = (~done) & (t < t_end)
         key, k_draft, k_acc, k_res = jax.random.split(key, 4)
         out1 = out_row[None]
@@ -1086,7 +1106,7 @@ def speculative_sample(params, cfg: TransformerConfig,
         last2 = jax.lax.dynamic_slice(
             out1, (jnp.zeros((), t.dtype), t - 2), (1, 2))
         logits2, dft1 = _window_forward(
-            dft_step_params(last2), draft_cfg, dft1, last2, t - 2, total)
+            dft_p, draft_cfg, dft1, last2, t - 2, total)
         q0 = filt_logp(logits2[:, -1])                     # [1, V]
         d0 = jax.random.categorical(
             jax.random.fold_in(k_draft, 0), q0, axis=-1
@@ -1095,7 +1115,7 @@ def speculative_sample(params, cfg: TransformerConfig,
         def draft_step(c, i):
             dft, tok = c
             logits, dft = _window_forward(
-                dft_step_params(tok), draft_cfg, dft, tok[:, None],
+                dft_p, draft_cfg, dft, tok[:, None],
                 t + i, total)
             q = filt_logp(logits[:, -1])                   # [1, V]
             nxt = jax.random.categorical(
@@ -1112,7 +1132,7 @@ def speculative_sample(params, cfg: TransformerConfig,
         # --- target scores the window in one forward ----------------
         last = jax.lax.dynamic_slice_in_dim(out1, t - 1, 1, axis=1)
         window = jnp.concatenate([last, drafts], axis=1)   # [1, K+1]
-        logits, tgt1 = _window_forward(tgt_step_params(window), cfg,
+        logits, tgt1 = _window_forward(tgt_p, cfg,
                                        tgt1, window, t - 1, total)
         pdist = filt_logp(logits[0])                       # [K+1, V]
 
@@ -1162,14 +1182,19 @@ def speculative_sample(params, cfg: TransformerConfig,
                 jax.tree.map(lambda a: a[0], tgt1),
                 jax.tree.map(lambda a: a[0], dft1))
 
-    vround = jax.vmap(row_round)
+    vround = jax.vmap(row_round, in_axes=(0,) * 7 + (None, None))
 
     def cond(carry):
         t, done = carry[0], carry[1]
         return jnp.any((~done) & (t < t_end))
 
+    def body(c):
+        # per-round dequant outside the vmap (no barrier batching
+        # rule), inside the while loop (LICM barrier still binds)
+        return vround(*c, tgt_step_params(c[0]), dft_step_params(c[0]))
+
     t, done, rounds, _, out_buf, _, _ = jax.lax.while_loop(
-        cond, lambda c: vround(*c),
+        cond, body,
         (jnp.full((b,), t0, jnp.int32), jnp.zeros((b,), bool),
          jnp.zeros((b,), jnp.int32), jax.random.split(rng, b),
          out_buf, tgt_caches, dft_caches))
@@ -1322,47 +1347,11 @@ def _filter_logits(logits, temperature, top_k, top_p):
     return logits
 
 
-def per_row_filter_logits(logits, temperature, top_k, top_p):
-    """_filter_logits with PER-ROW parameters (the serving engine's
-    per-request sampling): logits [N, V]; temperature [N] f32 (>0 —
-    the temp=0 greedy degenerate is per_row_sample's job), top_k [N]
-    int (>= V means no truncation), top_p [N] f32 (1.0 = no nucleus).
-    Same sequential-filter semantics as _filter_logits — temperature,
-    then top-k, then nucleus over the top-k-filtered distribution —
-    and exactly equal to it when every row carries the same values."""
-    v = logits.shape[-1]
-    x = at_least_f32(logits) / jnp.maximum(temperature, 1e-6)[:, None]
-    desc = jnp.sort(x, axis=-1)[:, ::-1]
-    k_eff = jnp.clip(top_k, 1, v)
-    kth = jnp.take_along_axis(desc, (k_eff - 1)[:, None], axis=-1)
-    x = jnp.where(x >= kth, x, -jnp.inf)
-    desc = jnp.where(jnp.arange(
-        v, dtype=jnp.int32)[None, :] < k_eff[:, None], desc,
-                     -jnp.inf)
-    probs = jax.nn.softmax(desc, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1) - probs
-    cutoff = jnp.min(jnp.where(cum < top_p[:, None], desc, jnp.inf),
-                     axis=-1, keepdims=True)
-    return jnp.where(x >= cutoff, x, -jnp.inf)
-
-
-def per_row_sample(logits, temperature, top_k, top_p, rng):
-    """Per-row sampled next tokens [N]: rows with temperature 0 take
-    argmax (exact greedy), the rest draw from their own
-    temperature/top-k/top-p-filtered distribution.
-
-    rng: one key (shared draw, rows split internally by categorical)
-    or a [N] key vector — one INDEPENDENT stream per row (the serving
-    engine's per-slot streams: a row's draw depends only on its own
-    key, so pool co-tenants cannot perturb it)."""
-    filtered = per_row_filter_logits(logits, temperature, top_k, top_p)
-    if jnp.ndim(rng) == 1:
-        draw = jax.vmap(
-            lambda k, lg: jax.random.categorical(k, lg))(rng, filtered)
-    else:
-        draw = jax.random.categorical(rng, filtered, axis=-1)
-    greedy = jnp.argmax(at_least_f32(logits), axis=-1)
-    return jnp.where(temperature <= 0.0, greedy, draw)
+# The per-row sampler lives in ops.sampling now (the serving engine and
+# the speculative verify rule both draw through it without importing
+# models); these names remain the models-side aliases, like _kv_quantize.
+per_row_filter_logits = sampling_ops.per_row_filter_logits
+per_row_sample = sampling_ops.per_row_sample
 
 
 def make_sampler(*, temperature: float = 1.0, top_k: Optional[int] = None,

@@ -174,12 +174,8 @@ def paged_decode_attention(q, k, v, k_arena, v_arena, page_table, pos,
     off = pos % page_size
     k_arena = write_kv(k_arena, k[:, 0], pg, off)
     v_arena = write_kv(v_arena, v[:, 0], pg, off)
-    k_read = gather_kv(k_arena, page_table, max_len, q.dtype)
-    v_read = gather_kv(v_arena, page_table, max_len, q.dtype)
-    valid = (jnp.arange(max_len, dtype=jnp.int32)[None, :] <= pos[:, None]) \
-        & active[:, None]
-    out = grouped_masked_attention(q, k_read, v_read,
-                                   valid[:, None, None, :])
+    out = _ragged_read(q, k_arena, v_arena, page_table, pos, active,
+                       page_size=page_size, max_len=max_len)
     return out, k_arena, v_arena
 
 
@@ -203,10 +199,58 @@ def paged_chunk_attention(q, k, v, k_arena, v_arena, pages_row, start,
     pg, off = page_addresses(pages_row, ap, page_size=page_size)
     k_arena = write_kv(k_arena, k[0], pg, off)
     v_arena = write_kv(v_arena, v[0], pg, off)
-    k_read = gather_kv(k_arena, pages_row[None], max_len, q.dtype)
-    v_read = gather_kv(v_arena, pages_row[None], max_len, q.dtype)
-    valid = jnp.arange(
-        max_len, dtype=jnp.int32)[None, :] <= ap[:, None]   # [C, max_len]
-    out = grouped_masked_attention(q, k_read, v_read,
-                                   valid[None, None])
+    out = _ragged_read(q, k_arena, v_arena, pages_row[None],
+                       jnp.asarray(start, jnp.int32).reshape(1),
+                       jnp.ones((1,), bool),
+                       page_size=page_size, max_len=max_len)
     return out, k_arena, v_arena
+
+
+def paged_verify_attention(q, k, v, k_arena, v_arena, page_table, pos,
+                           active, *, page_size: int, max_len: int):
+    """The speculative VERIFY step: write TQ consecutive positions per
+    slot starting at its own `pos` (the window = last consumed token +
+    the draft), attend every window query over keys <= its absolute
+    position, all slots in one launch. Decode's multi-query
+    generalization — TQ=1 reproduces `paged_decode_attention`
+    bit-for-bit (same addressing, same write, same read).
+
+    Positions this round REwrites may hold a previous round's rejected
+    suffix; that's sound by construction — everything below a row's
+    `pos` is committed tokens, and every key a query can see (<= pos +
+    i < pos + TQ) is rewritten here before the read. The pool side
+    (PagePool.reserve/rollback) guarantees the blocks under
+    pos..pos+TQ-1 are mapped, so accepted tokens always land.
+
+    q/k/v [S, TQ, ·, Dh]; pos [S] (sentinel out-of-range on inactive
+    rows); active [S] bool. Returns (out [S, TQ, H, Dh], k_arena,
+    v_arena)."""
+    s, tq = q.shape[0], q.shape[1]
+    num_pages = (k_arena[0] if isinstance(k_arena, tuple)
+                 else k_arena).shape[0]
+    ap = pos[:, None] + jnp.arange(tq, dtype=jnp.int32)[None, :]
+    pg, off = jax.vmap(
+        lambda row, p: page_addresses(row, p, page_size=page_size))(
+            page_table, ap)
+    pg = jnp.where(active[:, None], pg, jnp.int32(num_pages))
+    k_arena = write_kv(k_arena, k.reshape((s * tq,) + k.shape[2:]),
+                       pg.reshape(-1), off.reshape(-1))
+    v_arena = write_kv(v_arena, v.reshape((s * tq,) + v.shape[2:]),
+                       pg.reshape(-1), off.reshape(-1))
+    out = _ragged_read(q, k_arena, v_arena, page_table, pos, active,
+                       page_size=page_size, max_len=max_len)
+    return out, k_arena, v_arena
+
+
+def _ragged_read(q, k_arena, v_arena, page_table, pos0, active, *,
+                 page_size: int, max_len: int):
+    """The shared read+attend tail: dispatch through the fused ragged
+    kernel (ops.ragged_paged_attention), whose auto mode returns the
+    bit-identical jnp gather everywhere the kernel isn't a win — the
+    drop-in upgrade this module's header promised, with nothing above
+    it changing."""
+    from paddle_tpu.ops import ragged_paged_attention as _rpa  # cycle
+
+    return _rpa.ragged_attention(q, k_arena, v_arena, page_table,
+                                 pos0, active, page_size=page_size,
+                                 max_len=max_len)

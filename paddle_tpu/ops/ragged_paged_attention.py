@@ -1,0 +1,222 @@
+"""Fused ragged paged-attention: the page-table walk as ONE kernel.
+
+`ops.paged_attention` reads a slot's cache by materializing a gathered
+`[R, max_len, Hkv, Dh]` KV copy per layer per launch (jnp.take), then
+runs the attention einsums over it — correct everywhere, but on TPU the
+gather round-trips HBM and the copy is pure waste on mixed-length
+batches where most rows are far short of `max_len`. The kernel here
+("Ragged Paged Attention", PAPERS.md arxiv 2604.15464) walks the page
+table DIRECTLY: the grid iterates rows, each program DMAs that row's
+mapped pages from the HBM arena into VMEM scratch (all block copies in
+flight at once, one semaphore per copy), and runs THE shared attention
+body — literally `paged_attention.grouped_masked_attention` — over the
+scratch, so no gathered copy ever exists in HBM.
+
+One launch covers the whole ragged mix because the query axis is
+per-row positional: `q [R, TQ, H, Dh]` with query i of row r sitting at
+absolute position `pos0[r] + i` and attending keys `<= pos0[r] + i`.
+Decode rows are TQ=1, prefill chunks TQ=C, speculative verify windows
+TQ=K+1 — same kernel, same math, mixed freely in one batch (pad TQ to
+the batch max; padded queries are computed and ignored, the engine's
+existing bucket discipline).
+
+Parity contract: `ragged_reference` below IS the jnp oracle — the same
+gather + `grouped_masked_attention` the engine has always run — and the
+kernel must match it BIT-FOR-BIT (tests/test_ragged_attention.py, run
+in interpret mode on CPU since the bench chip gate is wedged; the
+interpret path executes the same XLA CPU primitives as the oracle, so
+bit-identity is meaningful evidence, not a tolerance check). The jnp
+path stays the default fallback: dispatch picks the kernel only on a
+real TPU backend with a float arena that fits VMEM; int8 `(s8, scale)`
+pair arenas always take the jnp path (a dequant-fused DMA pipeline is
+the follow-up, not this kernel).
+
+Writes are NOT fused: scatters through the page table are cheap
+(`write_kv` is a drop-mode scatter of a few rows), it's the read-side
+materialization that burns the memory system — so callers write first
+with the existing jnp scatter and hand this kernel the read+attend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.paged_attention import (
+    gather_kv,
+    grouped_masked_attention,
+)
+
+try:  # pallas ships with jax, but keep the jnp oracle importable without it
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    PALLAS_AVAILABLE = True
+except Exception:  # pragma: no cover - exercised only on pallas-less builds
+    pl = None
+    pltpu = None
+    PALLAS_AVAILABLE = False
+
+# scratch budget: K and V page walks both live in VMEM at once; leave
+# headroom under the ~16 MB/core ceiling for the q/out blocks and the
+# score intermediates (same gate idiom as ops.pallas_lstm.fits_vmem)
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _num_key_blocks(page_size: int, max_len: int, max_pages: int) -> int:
+    """Blocks that can hold keys the `max_len` slice exposes — the walk
+    never fetches pages entirely beyond the oracle's static slice."""
+    return min(max_pages, -(-max_len // page_size))
+
+
+def fits_vmem(k_arena, page_table, *, page_size: int, max_len: int) -> bool:
+    """True when both per-row page walks fit the VMEM scratch budget."""
+    if isinstance(k_arena, tuple):
+        return False
+    nblk = _num_key_blocks(page_size, max_len, page_table.shape[1])
+    _, page, hkv, dh = k_arena.shape
+    per_walk = nblk * page * hkv * dh * k_arena.dtype.itemsize
+    return 2 * per_walk <= _VMEM_BUDGET_BYTES
+
+
+# -- the jnp oracle ------------------------------------------------------
+
+
+def ragged_reference(q, k_arena, v_arena, page_table, pos0, active, *,
+                     page_size: int, max_len: int):
+    """The gather-then-attend path, ragged-query shaped: exactly what
+    `paged_decode_attention` (TQ=1) and `paged_chunk_attention` (R=1)
+    have always computed, with the per-row causal bound `pos0 + i`.
+    The kernel's bit-identity target."""
+    del page_size  # addressing is baked into the table; kept for symmetry
+    k_read = gather_kv(k_arena, page_table, max_len, q.dtype)
+    v_read = gather_kv(v_arena, page_table, max_len, q.dtype)
+    tq = q.shape[1]
+    ap = pos0[:, None] + jnp.arange(tq, dtype=jnp.int32)[None, :]
+    valid = (jnp.arange(max_len, dtype=jnp.int32)[None, None, :]
+             <= ap[:, :, None]) & active[:, None, None]
+    return grouped_masked_attention(q, k_read, v_read, valid[:, None])
+
+
+# -- the fused kernel ----------------------------------------------------
+
+
+def _walk_kernel(page_size, max_len, nblk,
+                 pt_ref, meta_ref, q_ref, k_hbm, v_hbm, out_ref,
+                 k_scr, v_scr, sems):
+    """One grid program = one row: DMA the row's page walk into VMEM
+    (every block copy in flight before the first wait — the copies are
+    independent, so the walk overlaps itself), then run THE shared
+    attention body over the scratch."""
+    r = pl.program_id(0)
+    num_pages = k_hbm.shape[0]
+
+    def copy(b, which):
+        # sentinel/unmapped entries clip to the last page — same data
+        # the oracle's mode="clip" gather reads, masked identically
+        pg = jnp.minimum(pt_ref[r, b], num_pages - 1)
+        src, dst = (k_hbm, k_scr) if which == 0 else (v_hbm, v_scr)
+        return pltpu.make_async_copy(src.at[pg], dst.at[b],
+                                     sems.at[b, which])
+
+    def start(b, carry):
+        copy(b, 0).start()
+        copy(b, 1).start()
+        return carry
+
+    def wait(b, carry):
+        copy(b, 0).wait()
+        copy(b, 1).wait()
+        return carry
+
+    jax.lax.fori_loop(0, nblk, start, 0)
+    jax.lax.fori_loop(0, nblk, wait, 0)
+
+    q = q_ref[...]                                     # [1, TQ, H, Dh]
+    tq = q.shape[1]
+    hkv, dh = k_scr.shape[2], k_scr.shape[3]
+    # flatten the walk to the oracle's key axis: table order = position
+    # order, statically sliced to max_len
+    k_read = k_scr[...].reshape(1, nblk * page_size, hkv,
+                                dh)[:, :max_len].astype(q.dtype)
+    v_read = v_scr[...].reshape(1, nblk * page_size, hkv,
+                                dh)[:, :max_len].astype(q.dtype)
+    pos0 = meta_ref[r, 0]
+    act = meta_ref[r, 1] > 0
+    ap = pos0 + jnp.arange(tq, dtype=jnp.int32)
+    valid = (jnp.arange(max_len, dtype=jnp.int32)[None, :]
+             <= ap[:, None]) & act
+    out_ref[...] = grouped_masked_attention(q, k_read, v_read,
+                                            valid[None, None])
+
+
+def ragged_pallas(q, k_arena, v_arena, page_table, pos0, active, *,
+                  page_size: int, max_len: int, interpret=None):
+    """The fused launch. interpret=None follows the repo's Pallas idiom
+    (interpret everywhere except a real TPU backend); float arenas
+    only — dispatch through `ragged_attention` for the general case."""
+    if not PALLAS_AVAILABLE:  # pragma: no cover
+        raise RuntimeError("pallas is unavailable on this build; "
+                           "use ragged_attention (jnp fallback)")
+    if isinstance(k_arena, tuple):
+        raise ValueError("int8 (s8, scale) arenas take the jnp path; "
+                         "dispatch through ragged_attention")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    r, tq, h, dh = q.shape
+    _, page, hkv, _ = k_arena.shape
+    assert page == page_size, (page, page_size)
+    nblk = _num_key_blocks(page_size, max_len, page_table.shape[1])
+    meta = jnp.stack([pos0.astype(jnp.int32),
+                      active.astype(jnp.int32)], axis=1)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(r,),
+        in_specs=[
+            pl.BlockSpec((1, tq, h, dh), lambda i, pt, mt: (i, 0, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),   # K arena stays in HBM
+            pl.BlockSpec(memory_space=pltpu.ANY),   # V arena stays in HBM
+        ],
+        out_specs=pl.BlockSpec((1, tq, h, dh),
+                               lambda i, pt, mt: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nblk, page_size, hkv, dh), k_arena.dtype),
+            pltpu.VMEM((nblk, page_size, hkv, dh), v_arena.dtype),
+            pltpu.SemaphoreType.DMA((nblk, 2)),
+        ],
+    )
+    kernel = functools.partial(_walk_kernel, page_size, max_len, nblk)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((r, tq, h, dh), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), meta, q, k_arena, v_arena)
+
+
+def ragged_attention(q, k_arena, v_arena, page_table, pos0, active, *,
+                     page_size: int, max_len: int, impl=None):
+    """Dispatch: impl in {None, "jnp", "pallas"}. None auto-selects the
+    kernel only where it genuinely wins — a real TPU backend, a float
+    arena, and a walk that fits VMEM — and the jnp oracle everywhere
+    else, so CPU tier-1 and int8 pools are byte-for-byte unchanged.
+    impl="pallas" forces the kernel (interpret mode off-TPU — the
+    parity suite's lever); int8 arenas fall back to jnp even then."""
+    if isinstance(k_arena, tuple) or impl == "jnp":
+        impl = "jnp"
+    elif impl is None:
+        on_tpu = PALLAS_AVAILABLE and jax.default_backend() == "tpu"
+        impl = "pallas" if on_tpu and fits_vmem(
+            k_arena, page_table, page_size=page_size,
+            max_len=max_len) else "jnp"
+    elif impl == "pallas" and not PALLAS_AVAILABLE:  # pragma: no cover
+        impl = "jnp"
+    if impl == "pallas":
+        return ragged_pallas(q, k_arena, v_arena, page_table, pos0,
+                             active, page_size=page_size,
+                             max_len=max_len)
+    return ragged_reference(q, k_arena, v_arena, page_table, pos0,
+                            active, page_size=page_size,
+                            max_len=max_len)

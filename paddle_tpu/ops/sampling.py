@@ -1,10 +1,28 @@
-"""Sampled / tree-structured output losses for large vocabularies.
+"""Sampling ops: token selection for the decoders, and sampled /
+tree-structured output losses for large vocabularies.
 
-Reference: gserver/layers/NCELayer.cpp (noise-contrastive estimation over
-sampled negative classes) and gserver/layers/HierarchicalSigmoidLayer.cpp
-(binary-tree sigmoid over log(V) node decisions). Both exist to avoid a
-full V-way softmax; on TPU the full softmax is often fine up to ~100k
-classes (one big MXU matmul), but these remain the right tool for
+Token selection (the serving engine's sampler — seeded, per-row):
+`per_row_filter_logits` / `per_row_sample` are THE
+temperature/top-k/top-p convention every decode path draws through —
+`engine.serve(sampling=[...])` per-slot arrays, `transformer`'s
+samplers (the models-side names remain as aliases, like
+`_kv_quantize`), and the speculative verify below. Greedy (temperature
+0) is the exact argmax degenerate, which is what keeps it the parity
+gate. `ngram_spec_verify` is the rejection-sampling acceptance rule
+for DETERMINISTIC (prompt-lookup / n-gram) drafts: a draft token d is
+accepted with probability p(d) under the row's filtered target
+distribution and a rejection re-draws from the residual (p with d
+removed, renormalized) — q is a point mass at d, so
+min(1, p/q) = p(d) and (p - q)+ ∝ p·[x != d]; the emitted tokens are
+distributed EXACTLY as sampling token-by-token from the target with
+the same filters (Leviathan et al. 2023 specialized to a delta
+proposer), and temperature-0 rows degenerate to the greedy
+longest-agreeing-prefix rule.
+
+Losses (reference: gserver/layers/NCELayer.cpp noise-contrastive
+estimation, gserver/layers/HierarchicalSigmoidLayer.cpp binary-tree
+sigmoid): both avoid a full V-way softmax; on TPU the full softmax is
+often fine up to ~100k classes, but these remain the right tool for
 multi-million-class vocabularies, and are needed for reference parity.
 
 TPU-shaped design: fixed sample counts (static shapes), sampling outside
@@ -19,6 +37,196 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from paddle_tpu.core.dtypes import at_least_f32
+
+
+# -- per-row token sampling (the serving engine's sampler) ---------------
+
+
+def per_row_filter_logits(logits, temperature, top_k, top_p):
+    """Temperature scaling, then top-k truncation, then nucleus
+    filtering with PER-ROW parameters (the serving engine's
+    per-request sampling): logits [N, V]; temperature [N] f32 (>0 —
+    the temp=0 greedy degenerate is per_row_sample's job), top_k [N]
+    int (>= V means no truncation), top_p [N] f32 (1.0 = no nucleus).
+    Sequential-filter semantics — temperature, then top-k, then
+    nucleus over the top-k-FILTERED distribution; filtered-out tokens
+    become -inf."""
+    v = logits.shape[-1]
+    x = at_least_f32(logits) / jnp.maximum(temperature, 1e-6)[:, None]
+    desc = jnp.sort(x, axis=-1)[:, ::-1]
+    k_eff = jnp.clip(top_k, 1, v)
+    kth = jnp.take_along_axis(desc, (k_eff - 1)[:, None], axis=-1)
+    x = jnp.where(x >= kth, x, -jnp.inf)
+    desc = jnp.where(jnp.arange(
+        v, dtype=jnp.int32)[None, :] < k_eff[:, None], desc,
+                     -jnp.inf)
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1) - probs
+    cutoff = jnp.min(jnp.where(cum < top_p[:, None], desc, jnp.inf),
+                     axis=-1, keepdims=True)
+    return jnp.where(x >= cutoff, x, -jnp.inf)
+
+
+def per_row_sample(logits, temperature, top_k, top_p, rng):
+    """Per-row sampled next tokens [N]: rows with temperature 0 take
+    argmax (exact greedy — the serving parity gate), the rest draw
+    from their own temperature/top-k/top-p-filtered distribution.
+
+    rng: one key (shared draw, rows split internally by categorical)
+    or a [N] key vector — one INDEPENDENT stream per row (the serving
+    engine's per-slot streams: a row's draw depends only on its own
+    key, so pool co-tenants cannot perturb it)."""
+    filtered = per_row_filter_logits(logits, temperature, top_k, top_p)
+    if jnp.ndim(rng) == 1:
+        draw = jax.vmap(
+            lambda k, lg: jax.random.categorical(k, lg))(rng, filtered)
+    else:
+        draw = jax.random.categorical(rng, filtered, axis=-1)
+    greedy = jnp.argmax(at_least_f32(logits), axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, draw)
+
+
+def greedy_spec_verify(logits, window, draft_len):
+    """The all-greedy fast path of `ngram_spec_verify`: accept draft j
+    iff it IS the argmax, next token = the argmax at the break — no
+    filter sort, no rng, same return contract (the engine's verify
+    step conds between the two exactly like its plain step conds
+    between per_row_sample and argmax, so an all-greedy pool never
+    pays the O(S*K*V log V) filter)."""
+    s, k1, v = logits.shape
+    k = k1 - 1
+    raw = at_least_f32(logits)
+    greedy = jnp.argmax(raw, axis=-1)                      # [S, K+1]
+    logp = jax.nn.log_softmax(raw, axis=-1)
+    if k > 0:
+        drafts = window[:, 1:]
+        ok = (drafts == greedy[:, :k]) & (
+            jnp.arange(k, dtype=jnp.int32)[None, :]
+            < draft_len[:, None])
+        n_acc = jnp.argmin(jnp.concatenate(
+            [ok, jnp.zeros((s, 1), bool)], axis=1).astype(jnp.int32),
+            axis=1).astype(jnp.int32)
+        lp_draft = jnp.take_along_axis(
+            logp[:, :k], drafts[:, :, None], axis=-1)[:, :, 0]
+    else:
+        n_acc = jnp.zeros((s,), jnp.int32)
+        lp_draft = jnp.zeros((s, 0), jnp.float32)
+    next_tok = jnp.take_along_axis(
+        greedy, n_acc[:, None], axis=1)[:, 0].astype(jnp.int32)
+    lp_brk = jnp.take_along_axis(
+        logp, jnp.broadcast_to(n_acc[:, None, None], (s, 1, v)),
+        axis=1)[:, 0]
+    lp_next = jnp.take_along_axis(
+        lp_brk, next_tok[:, None], axis=-1)[:, 0]
+    return (next_tok, n_acc, lp_draft.astype(jnp.float32),
+            lp_next.astype(jnp.float32))
+
+
+def ngram_spec_verify(logits, window, draft_len, temperature, top_k,
+                      top_p, rng):
+    """The speculative ACCEPTANCE rule for deterministic drafts,
+    vectorized over a slot pool.
+
+    logits [S, K+1, V]: target logits at the verify window's
+    positions — logits[s, i] is the distribution over the token
+    FOLLOWING window[s, i]. window [S, K+1] int32: column 0 is the
+    token the row consumed to start the round (its previous
+    last_tok), columns 1..K are the proposed draft tokens.
+    draft_len [S] int32 in [0, K]: proposals beyond it are padding and
+    can never be accepted (a 0 row degenerates to a plain decode
+    step). temperature/top_k/top_p [S]: the rows' OWN sampler params
+    (temperature 0 = greedy accept: a draft is kept iff it equals the
+    argmax). rng [S]: one key per row.
+
+    Returns (next_tok [S] i32, n_acc [S] i32, lp_draft [S, K] f32,
+    lp_next [S] f32):
+    - n_acc in [0, draft_len]: accepted draft count. The row consumed
+      window[:, 0] plus drafts window[:, 1..n_acc] this round and its
+      new last token is next_tok — target-sampled at the break
+      position (greedy rows: the argmax; sampled rows: the residual
+      draw on a rejection, a plain filtered draw after full
+      acceptance), so every emitted token is target-distributed.
+    - lp_draft[s, j] = log p(window[s, j+1] | prefix) and lp_next
+      under the FULL softmax (transformer.score()'s rescoring
+      convention, same as the engine's last_lp)."""
+    s, k1, v = logits.shape
+    k = k1 - 1
+    drafts = window[:, 1:]                                 # [S, K]
+    raw = at_least_f32(logits)
+    greedy = jnp.argmax(raw, axis=-1)                      # [S, K+1]
+    keys = jax.vmap(lambda r: jax.random.split(r, 2))(rng)
+    u = jax.vmap(lambda r: jax.random.uniform(r, (k,)))(
+        keys[:, 0]) if k > 0 else jnp.zeros((s, 0))
+    # the row's filtered distribution at every window position — ONE
+    # flat filter call so per-row params broadcast over positions
+    filt = per_row_filter_logits(
+        raw.reshape(s * k1, v),
+        jnp.repeat(jnp.maximum(temperature, 1e-6), k1),
+        jnp.repeat(top_k, k1),
+        jnp.repeat(top_p, k1)).reshape(s, k1, v)
+    logp_f = jax.nn.log_softmax(filt, axis=-1)             # filtered
+    logp = jax.nn.log_softmax(raw, axis=-1)                # full
+    if k > 0:
+        p_d = jnp.take_along_axis(
+            logp_f[:, :k], drafts[:, :, None], axis=-1)[:, :, 0]
+        sampled_ok = u < jnp.exp(p_d)                      # q = delta_d
+        greedy_ok = drafts == greedy[:, :k]
+        ok = jnp.where(temperature[:, None] <= 0.0, greedy_ok,
+                       sampled_ok)
+        ok = ok & (jnp.arange(k, dtype=jnp.int32)[None, :]
+                   < draft_len[:, None])
+        # first non-accepted index (== draft_len on full acceptance)
+        n_acc = jnp.argmin(jnp.concatenate(
+            [ok, jnp.zeros((s, 1), bool)], axis=1).astype(jnp.int32),
+            axis=1)
+    else:
+        n_acc = jnp.zeros((s,), jnp.int32)
+    n_acc = n_acc.astype(jnp.int32)
+    # the break position's distributions
+    brk = n_acc[:, None, None]
+    filt_b = jnp.take_along_axis(
+        filt, jnp.broadcast_to(brk, (s, 1, v)), axis=1)[:, 0]
+    raw_b = jnp.take_along_axis(
+        raw, jnp.broadcast_to(brk, (s, 1, v)), axis=1)[:, 0]
+    # rejection residual: (p - delta_d)+ renormalized = p with the
+    # rejected draft removed. After FULL acceptance (n_acc ==
+    # draft_len) there is no rejected token — draw from p itself.
+    if k > 0:
+        d_brk = jnp.take_along_axis(
+            window[:, 1:], jnp.minimum(n_acc, k - 1)[:, None],
+            axis=1)[:, 0]
+    else:
+        d_brk = jnp.zeros((s,), window.dtype)
+    rejected = n_acc < draft_len
+    resid = jnp.where(
+        rejected[:, None] & (jnp.arange(
+            v, dtype=jnp.int32)[None, :] == d_brk[:, None]),
+        -jnp.inf, filt_b)
+    # degenerate residual (the filter kept ONLY the draft — e.g.
+    # top_k=1): p(d) = 1, so a rejection is measure-zero; any p-draw
+    # is correct, and p is the delta at d
+    resid = jnp.where(
+        jnp.all(jnp.isneginf(resid), axis=-1, keepdims=True),
+        filt_b, resid)
+    draw = jax.vmap(lambda r, lg: jax.random.categorical(r, lg))(
+        keys[:, 1], resid)
+    next_tok = jnp.where(temperature <= 0.0,
+                         jnp.take_along_axis(
+                             greedy, n_acc[:, None], axis=1)[:, 0],
+                         draw).astype(jnp.int32)
+    # full-softmax logprobs (the rescoring convention)
+    if k > 0:
+        lp_draft = jnp.take_along_axis(
+            logp[:, :k], drafts[:, :, None], axis=-1)[:, :, 0]
+    else:
+        lp_draft = jnp.zeros((s, 0), jnp.float32)
+    lp_next = jnp.take_along_axis(
+        jax.nn.log_softmax(raw_b, axis=-1),
+        next_tok[:, None], axis=-1)[:, 0]
+    return (next_tok, n_acc, lp_draft.astype(jnp.float32),
+            lp_next.astype(jnp.float32))
 
 
 def log_uniform_sample(rng, num_samples: int, vocab: int, shape=()):

@@ -75,9 +75,11 @@ import numpy as np
 from paddle_tpu.core.dtypes import default_policy
 from paddle_tpu.models import transformer as T
 from paddle_tpu.ops import paged_attention as pa
+from paddle_tpu.ops import sampling as sampling_ops
 from paddle_tpu.serve.paged import (PagePool, PoolExhaustedError,
                                     blocks_for)
 from paddle_tpu.serve.policy import SchedulerPolicy
+from paddle_tpu.serve.speculative import NGramProposer
 
 
 @lru_cache(maxsize=8192)
@@ -177,9 +179,26 @@ class PoolStats:
     prefix_hits: int = 0
     prefix_misses: int = 0
     prefill_chunks: int = 0
+    # speculative decoding (serve(speculative=True) verify rounds):
+    # draft_proposed/draft_accepted count DRAFT tokens (the carry
+    # token of each round is not a draft — a 0-draft round is a plain
+    # decode step), spec_reserved/spec_rolled_back are the pool's
+    # page-granular reserve/rollback ledger
+    spec_rounds: int = 0
+    draft_proposed: int = 0
+    draft_accepted: int = 0
+    spec_reserved: int = 0
+    spec_rolled_back: int = 0
 
     def utilization(self, slots: int) -> float:
         return self.tokens / max(self.steps * slots, 1)
+
+    def acceptance_rate(self) -> float:
+        """Accepted / proposed draft tokens — the speculative health
+        gauge (mean bonus tokens per round = rate x mean draft len;
+        a low rate means the proposer's traffic match is poor and the
+        verify rounds are mostly paying plain-step work)."""
+        return self.draft_accepted / max(self.draft_proposed, 1)
 
 
 def pad_to_bucket(prompt, buckets):
@@ -316,6 +335,7 @@ class DecodeEngine:
             self._chunk_impl,
             static_argnames=("chunk_w", "from_zero", "final"))
         self._step_jit = jax.jit(self._step_impl)
+        self._spec_jit = jax.jit(self._spec_step_impl)
         # jitted micro-updates for the HOST-side bookkeeping (page
         # map, slot retire): eager .at[] ops hand XLA implicit scalar
         # transfers per call (their negative-index fixup runs with
@@ -892,6 +912,179 @@ class DecodeEngine:
         HOST pool frees its pages."""
         return self._step_jit(state)
 
+    # -- the speculative verify round --------------------------------------
+
+    def _spec_step_impl(self, state: EngineState, drafts, draft_len):
+        cfg = self.cfg
+        params = self._step_params(state.last_tok)
+        s, L = self.slots, self.max_len
+        policy = default_policy()
+        k = drafts.shape[1]
+        # the verify WINDOW: the carry token plus the k drafts — one
+        # forward over [S, K+1] scores every draft against the target
+        # in a single launch (the plain step is exactly the k=0 case)
+        window = jnp.concatenate(
+            [state.last_tok[:, None], drafts.astype(jnp.int32)],
+            axis=1)
+        x = jnp.take(params["embed"]["table"], window, axis=0)
+        x = x.astype(policy.compute_dtype)
+        pos = (state.pos[:, None]
+               + jnp.arange(k + 1, dtype=jnp.int32)[None, :])
+        new_caches = []
+
+        def make_attn(k_buf, v_buf):
+            def attn(q, kk, vv):
+                # scatter the whole window's K/V through the page
+                # table (the caller reserved pages through pos+k),
+                # then the ragged masked read at per-row offsets —
+                # rejected positions are rolled back on the HOST
+                # (pool.commit) and rewritten before any later read
+                # (paged_verify_attention's rewrite-soundness note)
+                out, k2, v2 = pa.paged_verify_attention(
+                    q, kk, vv, k_buf, v_buf, state.page_table,
+                    state.pos, state.active,
+                    page_size=self.page_size, max_len=L)
+                new_caches.append((k2, v2))
+                return out
+
+            return attn
+
+        # positions past a row's draft_len are PADDING (every slot
+        # pads its drafts to policy.spec_draft_max so this body
+        # compiles ONCE): their compute is dead — writes land beyond
+        # the accepted frontier and are rewritten before exposure, the
+        # verify rule caps acceptance at draft_len — but they must not
+        # claim MoE expert capacity, same rule as inactive rows in the
+        # plain step
+        tok_mask = state.active[:, None] & (
+            jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+            <= draft_len[:, None])
+        for p, (k_buf, v_buf) in zip(params["blocks"], state.caches):
+            x, _, _, _ = T._block_parts(cfg, p, x, pos,
+                                        make_attn(k_buf, v_buf),
+                                        tok_mask)
+        keys = jax.vmap(jax.random.split)(state.rng)
+        rng, sub = keys[:, 0], keys[:, 1]
+        logits = T._head(params, x)                    # [S, K+1, V]
+        # all-greedy pools take the sort-free argmax verify, exactly
+        # like the plain step's per_row_sample/argmax cond; sampled
+        # pools run the distribution-preserving acceptance rule. One
+        # rng split per ROUND (not per token): a sampled row's draws
+        # stay deterministic per (seed, round index) but differ from
+        # the baseline's per-token stream — greedy rows ignore rng
+        # entirely, so the bit-exact greedy contract is untouched.
+        nxt, n_acc, lp_draft, lp_next = jax.lax.cond(
+            jnp.any(state.temp > 0.0),
+            lambda lg, r: sampling_ops.ngram_spec_verify(
+                lg, window, draft_len, state.temp, state.top_k,
+                state.top_p, r),
+            lambda lg, r: sampling_ops.greedy_spec_verify(
+                lg, window, draft_len),
+            logits, sub)
+        # a round CONSUMES window[:n_acc+1] (accepted prefix plus the
+        # break position's own token) and each consumed token is
+        # emitted — generate()'s emit-the-carry convention per token
+        emitted = window
+        emitted_lp = jnp.concatenate(
+            [state.last_lp[:, None], lp_draft], axis=1)
+        n_con = n_acc + 1
+        fin = jnp.zeros_like(state.active)
+        n_emit = n_con
+        if self.eos_id is not None:
+            # eos anywhere in the consumed prefix finishes the row at
+            # that token (eos is emitted, like generate); later
+            # accepted tokens are discarded with the row
+            is_eos = (window == self.eos_id) & (
+                jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+                < n_con[:, None])
+            has_eos = jnp.any(is_eos, axis=1)
+            n_emit = jnp.where(
+                has_eos,
+                jnp.argmax(is_eos.astype(jnp.int32), axis=1) + 1,
+                n_con).astype(jnp.int32)
+            fin = state.active & has_eos
+        # capacity retirement: the round's true advance against the
+        # plain step's pos+1 >= L (policy.draft_len clamps k so
+        # pos + n_emit <= L always — equality IS the bound)
+        fin = fin | (state.active & (state.pos + n_emit >= L))
+        cont = state.active & ~fin
+        new_state = EngineState(
+            caches=tuple(new_caches),
+            page_table=state.page_table,
+            pos=jnp.where(cont, state.pos + n_emit, jnp.int32(L)),
+            active=cont,
+            last_tok=nxt,
+            rng=rng,
+            temp=state.temp,
+            top_k=state.top_k,
+            top_p=state.top_p,
+            last_lp=lp_next)
+        return (new_state, emitted, emitted_lp, n_emit, state.active,
+                fin, n_acc)
+
+    def spec_step(self, state: EngineState, drafts, draft_len):
+        """One speculative verify round over the pool: score each
+        slot's drafts against the target in a single forward, accept
+        the distribution-preserving prefix, carry the redraw as the
+        next round's token. drafts [S, K] int32 / draft_len [S] int32
+        are HOST arrays (K = the policy's padded width; entries past
+        draft_len[r] arbitrary), staged explicitly here — they change
+        every round, so the `_staged` value-cache would not help.
+
+        Returns (state, emitted [S, K+1] int32, emitted_lp [S, K+1]
+        f32, n_emit [S] int32, was_active [S] bool, finished [S] bool,
+        n_accepted [S] int32): row r emitted emitted[r, :n_emit[r]]
+        this round (lps full-softmax, score()'s convention), finished
+        rows just emitted their final token. The caller must have
+        reserved pages covering positions pos..pos+draft_len[r]
+        (pool.reserve) BEFORE the call, and must settle continuing
+        rows with pool.commit(slot, n_emit) after — commit maps the
+        next write block and rolls the rejected tail's pages back."""
+        return self._spec_jit(
+            state,
+            jax.device_put(np.asarray(drafts, np.int32)),
+            jax.device_put(np.asarray(draft_len, np.int32)))
+
+    def reserve_spec_pages(self, state: EngineState, slot: int,
+                           k: int) -> EngineState:
+        """Map the verify window's write blocks for one slot BEFORE a
+        spec_step: pool.reserve (all-or-nothing, pos untouched) plus
+        the device page-table pushes, staged scalars through the same
+        jitted setter as every other mapping. Raises
+        PoolExhaustedError with pool AND device table unchanged — the
+        caller degrades the slot to a 0-draft round (never preempt a
+        co-tenant for SPECULATIVE work)."""
+        for blk, page in self.pool.reserve(slot, k):
+            state = state._replace(
+                page_table=self._pagemap_jit(
+                    state.page_table, _staged(slot, np.int32),
+                    _staged(blk, np.int32), _staged(page, np.int32)))
+        return state
+
+    def settle_spec(self, state: EngineState, slot: int,
+                    n_emit: int) -> EngineState:
+        """Settle one CONTINUING slot's pool state after a spec_step
+        consumed n_emit tokens: pool.commit advances pos, maps the
+        next write block when full acceptance crossed a boundary (may
+        raise PoolExhaustedError with pos NOT advanced — the caller
+        frees a victim and retries, exactly like ensure_decode_page),
+        and rolls the rejected tail's pages back; the dropped blocks'
+        device rows return to the drop sentinel so stale mappings
+        cannot resurface."""
+        added, dropped = self.pool.commit(slot, n_emit)
+        for blk, page in added:
+            state = state._replace(
+                page_table=self._pagemap_jit(
+                    state.page_table, _staged(slot, np.int32),
+                    _staged(blk, np.int32), _staged(page, np.int32)))
+        for blk in dropped:
+            state = state._replace(
+                page_table=self._pagemap_jit(
+                    state.page_table, _staged(slot, np.int32),
+                    _staged(blk, np.int32),
+                    _staged(self.num_pages, np.int32)))
+        return state
+
     def ensure_decode_page(self, state: EngineState,
                            slot: int) -> EngineState:
         """Advance the HOST page bookkeeping for one slot that just
@@ -941,7 +1134,8 @@ class DecodeEngine:
     # -- batteries-included host scheduler --------------------------------
 
     def serve(self, prompts, *, max_new: int, buckets=None,
-              sampling=None, return_logprobs: bool = False):
+              sampling=None, return_logprobs: bool = False,
+              speculative: bool = False, proposer=None):
         """Serve a list of 1-D int32 prompts through the S-slot pool:
         admit while slots AND pages are free, step, collect, refill —
         the continuous part. Returns per-request generated-token lists
@@ -970,7 +1164,20 @@ class DecodeEngine:
         return_logprobs: also return per-request per-token
         log p(token | prefix) lists (full-softmax convention — the
         reference's SequenceGenerator returns sequence scores the
-        same way, api/PaddleAPI.h:1025)."""
+        same way, api/PaddleAPI.h:1025).
+
+        speculative: decode via draft/verify rounds instead of
+        one-token steps — each round scores up to
+        policy.spec_draft_max host-proposed drafts per slot in ONE
+        forward and consumes the accepted prefix plus the verify's
+        own token (docs/SERVING.md "Speculative decoding"). Greedy
+        requests keep the exact generate() parity contract; sampled
+        requests keep the output DISTRIBUTION (rejection-sampling
+        acceptance) but draw from a per-round stream, so individual
+        draws differ from the plain loop's per-token stream. Paged
+        engines only. `proposer` (default NGramProposer()) supplies
+        propose(history, k) -> drafts; 0-draft rounds degrade to
+        plain decode steps."""
         if max_new < 1:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         if sampling is not None and len(sampling) != len(prompts):
@@ -1012,6 +1219,30 @@ class DecodeEngine:
                     raise ValueError(
                         f"prompt {i} needs {need} pages > page pool "
                         f"num_pages {self.num_pages}")
+
+        prompt_hist: list = []
+        if speculative:
+            if not self.paged:
+                raise ValueError(
+                    "speculative serving needs the paged engine "
+                    "(sliding-window configs decode plain)")
+            if self.select_fn is not None:
+                raise ValueError(
+                    "speculative serving composes with per-request "
+                    "sampling only: a pool-wide select_fn overrides "
+                    "the distribution the acceptance rule preserves")
+            if int(self.policy.spec_draft_max) < 1:
+                raise ValueError(
+                    f"policy.spec_draft_max must be >= 1, got "
+                    f"{self.policy.spec_draft_max}")
+            if proposer is None:
+                proposer = NGramProposer()
+            # the proposer's history view: the TRUE prompt (unpadded)
+            # plus everything emitted so far — host ints only
+            prompt_hist = [
+                [int(x) for x in
+                 np.asarray(jax.device_get(p)).reshape(-1)]
+                for p in prompts]
 
         state = self.init_state()
         stats = PoolStats(requests=len(prompts))
@@ -1115,40 +1346,120 @@ class DecodeEngine:
                            for s_ in range(self.slots))
             if not self.policy.should_decode(decoding, len(pending)):
                 continue        # only prefills in flight — no step
-            state, toks, tok_lps, was_active, fin = \
-                self.decode_step(state)
-            stats.steps += 1
-            # ONE host sync per step (the admission decision needs it)
-            toks, tok_lps, was_active_h, fin_h = jax.device_get(
-                (toks, tok_lps, was_active, fin))
-            freed = False
-            for slot in range(self.slots):
-                req = slot_req[slot]
-                if req == -1 or slot in pending \
-                        or not was_active_h[slot]:
-                    continue
-                emitted[req].append(int(toks[slot]))
-                lps[req].append(float(tok_lps[slot]))
-                stats.tokens += 1
-                remaining[req] -= 1
-                if fin_h[slot] or remaining[req] <= 0:
-                    # ONE retire path for device-finished and
-                    # budget-finished rows alike: the pool must free
-                    # the pages either way
-                    state = self.release_slot(state, slot)
-                    slot_req[slot] = -1
-                    stats.completed += 1
-                    freed = True
-                    continue
-                # continuing row: map the next write position's page
-                while True:
-                    try:
-                        state = self.ensure_decode_page(state, slot)
-                        break
-                    except PoolExhaustedError:
-                        if not preempt_or_retire(slot):
-                            freed = True
-                            break   # slot retired at pool capacity
+            if not speculative:
+                state, toks, tok_lps, was_active, fin = \
+                    self.decode_step(state)
+                stats.steps += 1
+                # ONE host sync per step (the admission decision
+                # needs it)
+                toks, tok_lps, was_active_h, fin_h = jax.device_get(
+                    (toks, tok_lps, was_active, fin))
+                freed = False
+                for slot in range(self.slots):
+                    req = slot_req[slot]
+                    if req == -1 or slot in pending \
+                            or not was_active_h[slot]:
+                        continue
+                    emitted[req].append(int(toks[slot]))
+                    lps[req].append(float(tok_lps[slot]))
+                    stats.tokens += 1
+                    remaining[req] -= 1
+                    if fin_h[slot] or remaining[req] <= 0:
+                        # ONE retire path for device-finished and
+                        # budget-finished rows alike: the pool must
+                        # free the pages either way
+                        state = self.release_slot(state, slot)
+                        slot_req[slot] = -1
+                        stats.completed += 1
+                        freed = True
+                        continue
+                    # continuing row: map the next write position's
+                    # page
+                    while True:
+                        try:
+                            state = self.ensure_decode_page(state,
+                                                            slot)
+                            break
+                        except PoolExhaustedError:
+                            if not preempt_or_retire(slot):
+                                freed = True
+                                break  # retired at pool capacity
+            else:
+                # -- speculative verify round: propose -> reserve ->
+                # verify-in-one-step -> commit/rollback -------------
+                kmax = int(self.policy.spec_draft_max)
+                drafts_np = np.zeros((self.slots, kmax), np.int32)
+                dlen_np = np.zeros((self.slots,), np.int32)
+                for slot in range(self.slots):
+                    req = slot_req[slot]
+                    if req == -1 or slot in pending:
+                        continue
+                    budget = self.policy.draft_len(
+                        pos=self.pool.slot_pos[slot],
+                        max_len=self.max_len,
+                        remaining=remaining[req])
+                    prop = []
+                    if budget > 0:
+                        # draft() self-extends through looped output;
+                        # custom proposers may only define propose()
+                        draft_fn = getattr(proposer, "draft",
+                                           proposer.propose)
+                        prop = draft_fn(
+                            prompt_hist[req] + emitted[req],
+                            budget)[:budget]
+                    if prop:
+                        try:
+                            state = self.reserve_spec_pages(
+                                state, slot, len(prop))
+                        except PoolExhaustedError:
+                            # no pages for drafts: degrade this slot
+                            # to a plain decode round — never preempt
+                            # for SPECULATIVE work
+                            prop = []
+                    drafts_np[slot, :len(prop)] = prop
+                    dlen_np[slot] = len(prop)
+                    stats.draft_proposed += len(prop)
+                state, em, em_lp, n_emit, was_active, fin, n_acc = \
+                    self.spec_step(state, drafts_np, dlen_np)
+                stats.steps += 1
+                stats.spec_rounds += 1
+                # ONE host sync per round, same as the plain step
+                em, em_lp, n_emit_h, was_active_h, fin_h, n_acc_h = \
+                    jax.device_get((em, em_lp, n_emit, was_active,
+                                    fin, n_acc))
+                freed = False
+                for slot in range(self.slots):
+                    req = slot_req[slot]
+                    if req == -1 or slot in pending \
+                            or not was_active_h[slot]:
+                        continue
+                    ne = int(n_emit_h[slot])
+                    stats.draft_accepted += int(n_acc_h[slot])
+                    for j in range(ne):
+                        emitted[req].append(int(em[slot, j]))
+                        lps[req].append(float(em_lp[slot, j]))
+                    stats.tokens += ne
+                    remaining[req] -= ne
+                    if fin_h[slot] or remaining[req] <= 0:
+                        # release frees reserved-but-rejected pages
+                        # with the rest of the row — no commit needed
+                        state = self.release_slot(state, slot)
+                        slot_req[slot] = -1
+                        stats.completed += 1
+                        freed = True
+                        continue
+                    # settle the pool at the accepted length: commit
+                    # maps the next write block (full acceptance may
+                    # cross a boundary) and unmaps the rejected
+                    # tail's blocks (device rows -> drop sentinel)
+                    while True:
+                        try:
+                            state = self.settle_spec(state, slot, ne)
+                            break
+                        except PoolExhaustedError:
+                            if not preempt_or_retire(slot):
+                                freed = True
+                                break  # retired at pool capacity
             if freed or queue:
                 admit()
         toks_out = [emitted[i] for i in range(len(prompts))]
@@ -1156,7 +1467,8 @@ class DecodeEngine:
             pc = self.pool.counters()
             for k in ("pages_in_use", "pages_free",
                       "peak_pages_in_use", "prefix_hits",
-                      "prefix_misses", "prefill_chunks"):
+                      "prefix_misses", "prefill_chunks",
+                      "spec_reserved", "spec_rolled_back"):
                 setattr(stats, k, pc[k])
         self.last_stats = stats
         if return_logprobs:

@@ -142,6 +142,9 @@ class PagePool:
         self.prefix_rejected = 0    # corrupted entries refused+evicted
         self.prefill_chunks = 0     # jitted chunk invocations
         self.peak_pages_in_use = 0
+        # speculative-decoding page traffic (reserve/commit below)
+        self.spec_reserved = 0      # pages pre-mapped for verify windows
+        self.spec_rolled_back = 0   # reserved pages returned on rejection
         # testing.faults seam: fault_hook(event, ctx) — "alloc" may
         # return truthy to force PoolExhaustedError, "lookup" may
         # mutate the _CacheEntry it is handed
@@ -390,6 +393,78 @@ class PagePool:
         self.slot_pos[slot] = new_pos
         return out
 
+    def reserve(self, slot: int, k: int) -> List[Tuple[int, int]]:
+        """Pre-map every block the speculative verify window needs —
+        positions slot_pos..slot_pos+k get written in ONE launch, so
+        their blocks must be mapped BEFORE it, unlike extend()'s
+        one-position-at-a-time walk. Does NOT advance the position
+        (commit() does, once the host knows how much was accepted).
+        Returns the new (block_index, page) mappings for the device
+        table. All-or-nothing: on PoolExhaustedError the pool is
+        untouched (alloc's own atomicity) — the caller degrades the
+        slot to a draft-free round or preempts, its choice.
+        reserve(slot, 0) is a no-op by construction: commit() always
+        leaves the current write position's block mapped."""
+        pos = self.slot_pos[slot]
+        assert pos is not None, f"slot {slot} not admitted"
+        last_blk = min((pos + k) // self.page_size,
+                       self.max_pages_per_slot - 1)
+        mapped = len(self.slot_pages[slot])
+        need = last_blk + 1 - mapped
+        if need <= 0:
+            return []
+        pages = self.alloc(need)                  # may raise: untouched
+        out = list(zip(range(mapped, mapped + need), pages))
+        self.slot_pages[slot].extend(pages)
+        self.spec_reserved += need
+        self._obs("page_reserve", slot=slot, pages=need,
+                  free=self.pages_free)
+        return out
+
+    def commit(self, slot: int, consumed: int
+               ) -> Tuple[List[Tuple[int, int]], List[int]]:
+        """Settle a speculative round: advance the slot `consumed`
+        positions (the accepted window) and ROLL BACK reserved blocks
+        the new position doesn't cover — the rejected suffix's pages
+        go back through the same refcount machinery every release
+        uses, so a rolled-back page a co-tenant or the prefix cache
+        still holds simply drops one reference. Returns (new_mappings,
+        dropped_block_indices): the former when full acceptance pushed
+        the next write position into a fresh block (the one alloc this
+        can need — on PoolExhaustedError the position does NOT advance
+        and nothing changed, mirroring extend()'s retry contract), the
+        latter for the engine to re-sentinel on the device table.
+        Callers only commit CONTINUING rows (finished rows release),
+        so the new position is always within the physical bound."""
+        pos = self.slot_pos[slot]
+        assert pos is not None, f"slot {slot} not admitted"
+        new_pos = pos + consumed
+        keep = new_pos // self.page_size + 1
+        assert keep <= self.max_pages_per_slot, (slot, new_pos)
+        mapped = len(self.slot_pages[slot])
+        added: List[Tuple[int, int]] = []
+        dropped: List[int] = []
+        if keep > mapped:
+            # full acceptance crossed past the reserve window into a
+            # fresh block; the rollback tail is empty by construction,
+            # so this alloc is the only mutation — a raise leaves the
+            # pool untouched for the caller's preempt-and-retry
+            assert keep == mapped + 1, (slot, keep, mapped)
+            page = self.alloc(1)[0]               # may raise: pos kept
+            self.slot_pages[slot].append(page)
+            added = [(mapped, page)]
+        elif keep < mapped:
+            for blk in range(keep, mapped):
+                self._decref(self.slot_pages[slot][blk])
+                dropped.append(blk)
+            del self.slot_pages[slot][keep:]
+            self.spec_rolled_back += len(dropped)
+        self.slot_pos[slot] = new_pos
+        if dropped:
+            self._obs("page_rollback", slot=slot, pages=len(dropped),
+                      free=self.pages_free)
+        return added, dropped
+
     def release(self, slot: int) -> None:
         """Drop the slot's references; pages with no other holder
         (no co-tenant share, not cached) return to the free list.
@@ -415,6 +490,8 @@ class PagePool:
             "prefix_misses": self.prefix_misses,
             "prefix_rejected": self.prefix_rejected,
             "prefill_chunks": self.prefill_chunks,
+            "spec_reserved": self.spec_reserved,
+            "spec_rolled_back": self.spec_rolled_back,
         }
 
     def reconcile(self) -> None:

@@ -108,6 +108,26 @@ class SchedulerPolicy:
         nothing (the chunked-prefill early-out)."""
         return decoding_slots > 0
 
+    # -- speculative decoding ----------------------------------------------
+
+    #: widest draft any round may carry — the engine pads every slot's
+    #: drafts to this, so the jitted verify step compiles ONCE (a
+    #: per-round width would recompile per distinct k)
+    spec_draft_max: int = 4
+
+    def draft_len(self, *, pos: int, max_len: int,
+                  remaining: int) -> int:
+        """Draft budget for ONE slot this round, 0 = plain decode.
+        Clamped so a full acceptance can never overrun anything: the
+        verify window writes positions pos..pos+k (k <= max_len-1-pos
+        keeps it inside the cache) and emits up to k+1 tokens
+        (k <= remaining-1 keeps it inside the request's max_new) —
+        so the engine loop needs NO after-the-fact truncation and
+        greedy parity stays exact. Override for adaptive draft
+        lengths (e.g. shrink on low recent acceptance)."""
+        return max(0, min(self.spec_draft_max, max_len - 1 - pos,
+                          remaining - 1))
+
     # -- fleet routing (serve.router) --------------------------------------
 
     def route(self, chain: Sequence[tuple], affinity: dict,

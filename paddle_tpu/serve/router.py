@@ -342,11 +342,17 @@ class ServingRouter:
     # -- failover ----------------------------------------------------------
 
     def _bank_pool_counters(self, rep: Replica) -> None:
+        # monotone counters only — live gauges (pages_in_use) and
+        # derived ratios (acceptance_rate) don't bank; the spec
+        # ledger banks EXACTLY ONCE here (death is the only transfer
+        # of a dead replica's counts into the aggregate)
         for k, v in rep.server.counters().items():
             if k in ("prefix_hits", "prefix_misses", "prefix_rejected",
                      "prefill_chunks", "requests", "completed",
                      "expired", "shed", "failed", "retried",
-                     "admitted"):
+                     "admitted", "spec_rounds", "draft_proposed",
+                     "draft_accepted", "spec_reserved",
+                     "spec_rolled_back"):
                 self._dead_base[k] = self._dead_base.get(k, 0) + v
 
     def _on_replica_death(self, rep: Replica, exc: Exception) -> None:
@@ -562,9 +568,17 @@ class ServingRouter:
             if not rep.alive:
                 continue
             for k, v in rep.server.counters().items():
+                if k == "acceptance_rate":
+                    continue    # a ratio: summing it is meaningless
                 agg[k] = agg.get(k, 0) + v
         for k, v in agg.items():
             out[f"fleet_{k}"] = v
+        # fleet acceptance from the SUMMED draft ledger (never an
+        # average of per-replica rates — replicas with more proposals
+        # must weigh more)
+        out["fleet_acceptance_rate"] = (
+            agg.get("draft_accepted", 0)
+            / max(agg.get("draft_proposed", 0), 1))
         return out
 
     def per_replica(self) -> Dict[int, Dict[str, int]]:

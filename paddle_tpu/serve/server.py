@@ -89,13 +89,15 @@ from paddle_tpu.obs.trace import Tracer
 from paddle_tpu.serve.engine import PoolStats, pad_to_bucket
 from paddle_tpu.serve.paged import PoolExhaustedError, blocks_for
 from paddle_tpu.serve.policy import SchedulerPolicy
+from paddle_tpu.serve.speculative import NGramProposer
 
 log = logging.getLogger(__name__)
 
 #: page-pool counter keys accumulated across pool generations
 #: (backend switches / decode-fault resets build a fresh PagePool)
 _POOL_COUNTER_KEYS = ("prefix_hits", "prefix_misses",
-                      "prefix_rejected", "prefill_chunks")
+                      "prefix_rejected", "prefill_chunks",
+                      "spec_reserved", "spec_rolled_back")
 
 #: terminal request outcomes — exactly one per submitted request
 COMPLETED = "completed"
@@ -239,7 +241,9 @@ class ServingServer:
                  install_signal_handlers: bool = False,
                  policy: Optional[SchedulerPolicy] = None,
                  tracer: Optional[Tracer] = None,
-                 flight: Optional[FlightRecorder] = None):
+                 flight: Optional[FlightRecorder] = None,
+                 speculative: bool = False,
+                 proposer=None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         if max_retries < 0:
@@ -252,6 +256,19 @@ class ServingServer:
                     f"buckets {too_big} exceed max_len "
                     f"{engine.max_len}: padded prefills cannot fit "
                     f"the cache")
+        if speculative:
+            if engine.cfg.attn_window is not None:
+                raise ValueError(
+                    "speculative serving needs the paged engine "
+                    "(sliding-window configs decode plain)")
+            if getattr(engine, "select_fn", None) is not None:
+                raise ValueError(
+                    "speculative serving composes with per-request "
+                    "sampling only: a pool-wide select_fn overrides "
+                    "the distribution the acceptance rule preserves")
+        self.speculative = speculative
+        self.proposer = (proposer if proposer is not None
+                         else NGramProposer() if speculative else None)
         self.engine = engine              # the pure-JAX fallback
         self.native_backend = native_backend
         # scheduling DECISIONS route through the policy surface
@@ -778,6 +795,80 @@ class ServingServer:
                 if s2 == slot:
                     return          # the needy request yielded
 
+    def _propose_and_reserve(self):
+        """Draft phase of one speculative round (speculative=True):
+        per decoding slot, the policy's clamped draft budget, the
+        proposer's tokens over prompt + emitted history, and the
+        verify window's page reservation. A slot whose reservation
+        the pool refuses degrades to a 0-draft plain round —
+        speculation never preempts a co-tenant. Returns the padded
+        (drafts [S, spec_draft_max], draft_len [S]) host arrays
+        spec_step stages."""
+        kmax = int(self.policy.spec_draft_max)
+        drafts = np.zeros((len(self._slot_req), kmax), np.int32)
+        dlen = np.zeros((len(self._slot_req),), np.int32)
+        pool = self._backend.pool
+        for slot, req in enumerate(self._slot_req):
+            if req is None or slot in self._prefilling:
+                continue
+            rid = req.req_id
+            budget = self.policy.draft_len(
+                pos=pool.slot_pos[slot],
+                max_len=self._backend.max_len,
+                remaining=req.max_new - len(self._emitted[rid]))
+            prop = []
+            if budget > 0:
+                hist = ([int(x) for x in req.prompt]
+                        + self._emitted[rid])
+                # draft() self-extends through looped output; custom
+                # proposers may only define propose()
+                draft_fn = getattr(self.proposer, "draft",
+                                   self.proposer.propose)
+                prop = draft_fn(hist, budget)[:budget]
+            if prop:
+                try:
+                    self._state = self._backend.reserve_spec_pages(
+                        self._state, slot, len(prop))
+                except PoolExhaustedError:
+                    prop = []
+            drafts[slot, :len(prop)] = prop
+            dlen[slot] = len(prop)
+            self.stats.draft_proposed += len(prop)
+        return drafts, dlen
+
+    def _settle_spec(self, slot: int, req: Request,
+                     n_emit: int) -> None:
+        """Commit/rollback for one CONTINUING slot after a verify
+        round: advance the pool to the accepted length, map the next
+        write block, return the rejected tail's pages. The boundary
+        alloc can exhaust an over-subscribed pool mid-round — same
+        preemption discipline as _ensure_pages (evict the junior
+        in-flight request and retry; the needy request yields when it
+        IS the junior one, or retires at pool capacity when alone)."""
+        while True:
+            try:
+                self._state = self._backend.settle_spec(
+                    self._state, slot, n_emit)
+                return
+            except PoolExhaustedError as e:
+                holders = [
+                    (s2, r2) for s2, r2 in enumerate(self._slot_req)
+                    if r2 is not None]
+                s2 = self.policy.preemption_victim(
+                    [(s_, r_.req_id) for s_, r_ in holders])
+                r2 = self._slot_req[s2]
+                if s2 == slot and len(holders) == 1:
+                    self._retire_slot(slot)
+                    self._finish(
+                        req, COMPLETED,
+                        retries=self.max_retries - req.retries_left)
+                    return
+                self._retire_slot(s2)
+                self._requeue_or_fail(
+                    r2, f"preempted on page-pool exhaustion: {e}")
+                if s2 == slot:
+                    return          # the needy request yielded
+
     def _expire_queued(self) -> None:
         now = self.clock()
         for req in [r for r in self.queue
@@ -931,9 +1022,24 @@ class ServingServer:
             # decoding one
             self._expire_in_flight()
             return True
+        # speculative rounds run only on the pure-JAX paged engine
+        # (a native backend without spec_step, or the ring pool,
+        # falls back to plain one-token steps — graceful degrade,
+        # not an error)
+        spec = (self.speculative
+                and self._backend is self.engine
+                and getattr(self._backend, "pool", None) is not None
+                and hasattr(self._backend, "spec_step"))
+        if spec:
+            drafts, dlen = self._propose_and_reserve()
         try:
-            (self._state, toks, tok_lps, was_active,
-             fin) = self._backend.decode_step(self._state)
+            if spec:
+                (self._state, em, em_lp, n_emit, was_active, fin,
+                 n_acc) = self._backend.spec_step(self._state,
+                                                  drafts, dlen)
+            else:
+                (self._state, toks, tok_lps, was_active,
+                 fin) = self._backend.decode_step(self._state)
         except Exception as e:
             if _replica_fatal(e):
                 raise           # dead backend: the router's problem
@@ -948,28 +1054,54 @@ class ServingServer:
         if self._backend is self.native_backend:
             self.breaker.record_success()
         self.stats.steps += 1
-        toks, tok_lps, was_active_h, fin_h = jax.device_get(
-            (toks, tok_lps, was_active, fin))
-        for slot, req in enumerate(self._slot_req):
-            if req is None or slot in self._prefilling \
-                    or not was_active_h[slot]:
-                continue
-            self._emitted[req.req_id].append(int(toks[slot]))
-            self._lps[req.req_id].append(float(tok_lps[slot]))
-            self.stats.tokens += 1
-            done = (bool(fin_h[slot]) or
-                    len(self._emitted[req.req_id])
-                    >= req.max_new)
-            if done:
-                # device-finished and budget-finished rows retire the
-                # same way: the paged pool frees this slot's pages in
-                # release_slot
-                self._retire_slot(slot)
-                self._finish(
-                    req, COMPLETED,
-                    retries=self.max_retries - req.retries_left)
-            else:
-                self._ensure_pages(slot, req)
+        if spec:
+            self.stats.spec_rounds += 1
+            (em, em_lp, n_emit_h, was_active_h, fin_h,
+             n_acc_h) = jax.device_get(
+                 (em, em_lp, n_emit, was_active, fin, n_acc))
+            for slot, req in enumerate(self._slot_req):
+                if req is None or slot in self._prefilling \
+                        or not was_active_h[slot]:
+                    continue
+                ne = int(n_emit_h[slot])
+                self.stats.draft_accepted += int(n_acc_h[slot])
+                rid = req.req_id
+                for j in range(ne):
+                    self._emitted[rid].append(int(em[slot, j]))
+                    self._lps[rid].append(float(em_lp[slot, j]))
+                self.stats.tokens += ne
+                done = (bool(fin_h[slot]) or
+                        len(self._emitted[rid]) >= req.max_new)
+                if done:
+                    self._retire_slot(slot)
+                    self._finish(
+                        req, COMPLETED,
+                        retries=self.max_retries - req.retries_left)
+                else:
+                    self._settle_spec(slot, req, ne)
+        else:
+            toks, tok_lps, was_active_h, fin_h = jax.device_get(
+                (toks, tok_lps, was_active, fin))
+            for slot, req in enumerate(self._slot_req):
+                if req is None or slot in self._prefilling \
+                        or not was_active_h[slot]:
+                    continue
+                self._emitted[req.req_id].append(int(toks[slot]))
+                self._lps[req.req_id].append(float(tok_lps[slot]))
+                self.stats.tokens += 1
+                done = (bool(fin_h[slot]) or
+                        len(self._emitted[req.req_id])
+                        >= req.max_new)
+                if done:
+                    # device-finished and budget-finished rows retire
+                    # the same way: the paged pool frees this slot's
+                    # pages in release_slot
+                    self._retire_slot(slot)
+                    self._finish(
+                        req, COMPLETED,
+                        retries=self.max_retries - req.retries_left)
+                else:
+                    self._ensure_pages(slot, req)
         self._expire_in_flight()
         for hook in list(self.on_step):
             hook(self, self.stats.steps)
@@ -1040,6 +1172,13 @@ class ServingServer:
             "shed": self.stats.shed,
             "failed": self.stats.failed,
             "retried": self.stats.retried,
+            # speculative decoding: draft tokens proposed/accepted
+            # and the derived acceptance rate (a float gauge — the
+            # obs registry's sources export numerics as-is)
+            "spec_rounds": self.stats.spec_rounds,
+            "draft_proposed": self.stats.draft_proposed,
+            "draft_accepted": self.stats.draft_accepted,
+            "acceptance_rate": self.stats.acceptance_rate(),
         }
         out.update(self._pool_base)
         out.setdefault("pages_in_use", 0)

@@ -505,7 +505,7 @@ class _FaultyEngine:
         self._prefill_fault()
         return self._engine.prefill_advance(state, ticket)
 
-    def decode_step(self, state):
+    def _decode_fault(self):
         plan = self._plan
         burst = self._burst()
         idx = plan._serve_decode_counter
@@ -521,7 +521,18 @@ class _FaultyEngine:
                 and not plan._spent("sdecode")):
             plan._note("sdecode", idx)
             raise FaultError(f"injected decode fault #{idx}")
+
+    def decode_step(self, state):
+        self._decode_fault()
         return self._engine.decode_step(state)
+
+    def spec_step(self, state, drafts, draft_len):
+        # a speculative verify round rides the SAME decode fault
+        # schedule (one round = one decode call), so
+        # serve_decode_error_at / bursts strike speculative serving
+        # at the same points as plain decoding
+        self._decode_fault()
+        return self._engine.spec_step(state, drafts, draft_len)
 
     def __getattr__(self, name):
         return getattr(self._engine, name)
@@ -575,7 +586,7 @@ class _DoomedReplicaEngine:
         self._check_dead()
         return self._engine.ensure_decode_page(*args, **kwargs)
 
-    def decode_step(self, state):
+    def _decode_tick(self):
         self._check_dead()
         plan = self._plan
         idx = plan._router_decode_counter
@@ -587,7 +598,17 @@ class _DoomedReplicaEngine:
             plan._note("replicakill", idx)
             self.dead = True
             raise self._dead_error()
+
+    def decode_step(self, state):
+        self._decode_tick()
         return self._engine.decode_step(state)
+
+    def spec_step(self, state, drafts, draft_len):
+        # verify rounds tick the same kill schedule as plain steps:
+        # router_kill_decode_at can strike MID-BURST during
+        # speculative serving (the counter-reconciliation chaos case)
+        self._decode_tick()
+        return self._engine.spec_step(state, drafts, draft_len)
 
     def __getattr__(self, name):
         return getattr(self._engine, name)

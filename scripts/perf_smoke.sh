@@ -1,16 +1,20 @@
 #!/usr/bin/env sh
 # Perf smoke lane: run ONLY the CPU-runnable performance tests
 # (marker `perf` — e.g. the paged-KV 2x-admission acceptance bound in
-# tests/test_paged_pool.py), then the serving bench stage, so the
-# perf trajectory is measurable without a live chip:
+# tests/test_paged_pool.py), then the pallas lane (the fused ragged
+# paged-attention kernel's interpret-mode parity suite plus the
+# speculative-decoding parity tests — markers `pallas`/`speculative`),
+# then the serving bench stage, so the perf trajectory is measurable
+# without a live chip:
 #
 #     scripts/perf_smoke.sh             # the whole perf lane + bench
 #     scripts/perf_smoke.sh --no-bench  # tests only
 #     scripts/perf_smoke.sh -k paged    # filter, passes through
 #
 # The bench stage prints one JSON line per metric (tokens/s, pool
-# occupancy, prefix-cache hit rate) — same format as bench.py, which
-# also runs this stage first, before the chip-liveness gate.
+# occupancy, prefix-cache hit rate, speculative speedup) — same
+# format as bench.py, which also runs this stage first, before the
+# chip-liveness gate.
 set -e
 cd "$(dirname "$0")/.."
 bench=1
@@ -20,6 +24,11 @@ if [ "$1" = "--no-bench" ]; then
 fi
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m perf \
     -p no:cacheprovider "$@"
+# pallas lane: kernel-vs-oracle bit-identity and speculative greedy
+# parity are perf-critical correctness gates — the bench numbers mean
+# nothing if either drifts
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m "pallas or speculative" -p no:cacheprovider "$@"
 if [ "$bench" = "1" ]; then
     env JAX_PLATFORMS=cpu python bench.py --serving-only
 fi

@@ -80,6 +80,17 @@ def pytest_configure(config):
         "(serve.router affinity/failover/redistribution chaos) — a "
         "subset of the faults lane, runs IN tier-1; `-m router` (or "
         "`scripts/fault_smoke.sh router`) runs it alone")
+    config.addinivalue_line(
+        "markers", "pallas: interpret-mode Pallas kernel parity suite "
+        "(ragged paged-attention vs the jnp oracle, bit-identity "
+        "under jit) — fast cases run IN tier-1, the heavy ragged "
+        "sweeps are additionally marked slow; `-m pallas` (or "
+        "`scripts/perf_smoke.sh pallas`) runs the lane alone")
+    config.addinivalue_line(
+        "markers", "speculative: speculative-decoding suite (n-gram "
+        "draft proposer, verify/commit/rollback, greedy parity vs "
+        "baseline under transfer_guard) — fast, runs IN tier-1; "
+        "`-m speculative` runs it alone")
 
 
 def pytest_runtest_logreport(report):

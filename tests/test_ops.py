@@ -320,3 +320,199 @@ class TestRound3LossGaps:
         np.testing.assert_allclose(
             float(losses.squared_l2_norm(x)),
             (np.asarray(x) ** 2).sum(), rtol=1e-5)
+
+
+class TestTokenSampling:
+    """Distribution-shape invariants for the ops.sampling per-row
+    sampler (the serving engine's sampler) and the speculative
+    acceptance rule."""
+
+    def _logits(self, np_rng, n=5, v=17):
+        return jnp.asarray(np_rng.randn(n, v), jnp.float32)
+
+    def test_top_k_masks_exactly_k(self, np_rng):
+        from paddle_tpu.ops import sampling as S
+
+        lg = self._logits(np_rng)
+        n, v = lg.shape
+        for k in (1, 3, v, v + 5):
+            out = S.per_row_filter_logits(
+                lg, jnp.ones((n,)), jnp.full((n,), k, jnp.int32),
+                jnp.ones((n,)))
+            kept = np.isfinite(np.asarray(out)).sum(axis=-1)
+            # gaussian logits: ties measure-zero, so exactly min(k, V)
+            np.testing.assert_array_equal(kept, min(k, v))
+
+    def test_per_row_k_varies_by_row(self, np_rng):
+        from paddle_tpu.ops import sampling as S
+
+        lg = self._logits(np_rng, n=4)
+        ks = jnp.asarray([1, 2, 5, 17], jnp.int32)
+        out = S.per_row_filter_logits(
+            lg, jnp.ones((4,)), ks, jnp.ones((4,)))
+        np.testing.assert_array_equal(
+            np.isfinite(np.asarray(out)).sum(axis=-1), np.asarray(ks))
+
+    def test_temperature_zero_is_greedy(self, np_rng):
+        from paddle_tpu.ops import sampling as S
+
+        lg = self._logits(np_rng)
+        n = lg.shape[0]
+        keys = jax.random.split(jax.random.key(0), n)
+        toks = S.per_row_sample(lg, jnp.zeros((n,)),
+                                jnp.full((n,), 17, jnp.int32),
+                                jnp.ones((n,)), keys)
+        np.testing.assert_array_equal(
+            np.asarray(toks), np.asarray(jnp.argmax(lg, axis=-1)))
+
+    def test_temperature_to_zero_converges_to_greedy(self, np_rng):
+        from paddle_tpu.ops import sampling as S
+
+        lg = self._logits(np_rng)
+        n = lg.shape[0]
+        keys = jax.random.split(jax.random.key(1), n)
+        greedy = np.asarray(jnp.argmax(lg, axis=-1))
+        for temp in (1e-2, 1e-4):
+            toks = S.per_row_sample(
+                lg, jnp.full((n,), temp),
+                jnp.full((n,), 17, jnp.int32), jnp.ones((n,)), keys)
+            np.testing.assert_array_equal(np.asarray(toks), greedy)
+
+    def test_nucleus_keeps_argmax_and_masks_tail(self, np_rng):
+        from paddle_tpu.ops import sampling as S
+
+        lg = self._logits(np_rng)
+        n, v = lg.shape
+        out = S.per_row_filter_logits(
+            lg, jnp.ones((n,)), jnp.full((n,), v, jnp.int32),
+            jnp.full((n,), 1e-6, jnp.float32))
+        kept = np.isfinite(np.asarray(out))
+        np.testing.assert_array_equal(kept.sum(axis=-1), 1)
+        assert kept[np.arange(n), np.asarray(jnp.argmax(lg, -1))].all()
+
+    def test_seeded_determinism_and_row_independence(self, np_rng):
+        from paddle_tpu.ops import sampling as S
+
+        lg = self._logits(np_rng)
+        n = lg.shape[0]
+        keys = jax.random.split(jax.random.key(7), n)
+        args = (jnp.ones((n,)), jnp.full((n,), 17, jnp.int32),
+                jnp.ones((n,)))
+        a = S.per_row_sample(lg, *args, keys)
+        b = S.per_row_sample(lg, *args, keys)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # a row's draw depends only on its own key: perturbing row 0's
+        # logits and key must not move the other rows
+        lg2 = lg.at[0].set(-lg[0])
+        keys2 = keys.at[0].set(jax.random.key(99))
+        c = S.per_row_sample(lg2, *args, keys2)
+        np.testing.assert_array_equal(np.asarray(a)[1:],
+                                      np.asarray(c)[1:])
+
+    def test_matches_models_filter_when_uniform(self, np_rng):
+        from paddle_tpu.ops import sampling as S
+        from paddle_tpu.models import transformer as T
+
+        lg = self._logits(np_rng)
+        n = lg.shape[0]
+        ref = T._filter_logits(T.at_least_f32(lg), 0.7, 3, 0.9)
+        out = S.per_row_filter_logits(
+            lg, jnp.full((n,), 0.7, jnp.float32),
+            jnp.full((n,), 3, jnp.int32),
+            jnp.full((n,), 0.9, jnp.float32))
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+class TestSpecVerifyRule:
+    """ngram_spec_verify: the rejection-sampling acceptance rule for
+    deterministic drafts."""
+
+    def _setup(self, np_rng, s=3, k=4, v=13):
+        lg = jnp.asarray(np_rng.randn(s, k + 1, v), jnp.float32)
+        greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        window = jnp.concatenate(
+            [jnp.full((s, 1), 5, jnp.int32), greedy[:, :k]], axis=1)
+        return lg, greedy, window
+
+    def test_greedy_accepts_agreeing_prefix(self, np_rng):
+        from paddle_tpu.ops import sampling as S
+
+        lg, greedy, window = self._setup(np_rng)
+        s, k = 3, 4
+        v = lg.shape[-1]
+        # row 1 disagrees at j=2; row 2 budget-capped at 2
+        window = window.at[1, 3].set(
+            (int(greedy[1, 2]) + 1) % v)
+        dl = jnp.asarray([4, 4, 2], jnp.int32)
+        keys = jax.random.split(jax.random.key(0), s)
+        nt, na, lpd, lpn = S.ngram_spec_verify(
+            lg, window, dl, jnp.zeros((s,)),
+            jnp.full((s,), v, jnp.int32), jnp.ones((s,)), keys)
+        np.testing.assert_array_equal(np.asarray(na), [4, 2, 2])
+        # next token is the target argmax at the break position
+        expect = np.asarray(jnp.take_along_axis(
+            greedy, na[:, None], axis=1)[:, 0])
+        np.testing.assert_array_equal(np.asarray(nt), expect)
+        # logprobs follow the full-softmax rescoring convention
+        full = jax.nn.log_softmax(lg, axis=-1)
+        want = np.asarray(jnp.take_along_axis(
+            full[:, :k], window[:, 1:, None], axis=-1)[:, :, 0])
+        np.testing.assert_allclose(np.asarray(lpd), want, rtol=1e-6)
+
+    def test_zero_draft_len_is_plain_decode(self, np_rng):
+        from paddle_tpu.ops import sampling as S
+
+        lg, greedy, window = self._setup(np_rng)
+        s = 3
+        v = lg.shape[-1]
+        dl = jnp.zeros((s,), jnp.int32)
+        keys = jax.random.split(jax.random.key(1), s)
+        nt, na, _, _ = S.ngram_spec_verify(
+            lg, window, dl, jnp.zeros((s,)),
+            jnp.full((s,), v, jnp.int32), jnp.ones((s,)), keys)
+        np.testing.assert_array_equal(np.asarray(na), 0)
+        np.testing.assert_array_equal(
+            np.asarray(nt), np.asarray(greedy[:, 0]))
+
+    def test_sampled_rows_preserve_target_distribution(self, np_rng):
+        """Empirical check of the Leviathan guarantee for a delta
+        proposer: over many seeded trials the emitted first token's
+        frequencies match the target softmax whether or not the draft
+        agrees, within statistical error."""
+        from paddle_tpu.ops import sampling as S
+
+        v = 5
+        lg = jnp.asarray(np_rng.randn(1, 2, v), jnp.float32)
+        p = np.asarray(jax.nn.softmax(lg[0, 0] / 0.8))
+        trials = 4000
+        draft = int(np.argsort(p)[-2])  # a likely-but-not-top draft
+        window = jnp.asarray([[3, draft]], jnp.int32)
+
+        def one(key):
+            nt, na, _, _ = S.ngram_spec_verify(
+                lg, window, jnp.ones((1,), jnp.int32),
+                jnp.full((1,), 0.8, jnp.float32),
+                jnp.full((1,), v, jnp.int32),
+                jnp.ones((1,)), key[None])
+            # the round's first emitted token: the draft if accepted,
+            # else the residual redraw
+            return jnp.where(na[0] > 0, window[0, 1], nt[0])
+
+        keys = jax.random.split(jax.random.key(2), trials)
+        toks = np.asarray(jax.jit(jax.vmap(one))(keys))
+        freq = np.bincount(toks, minlength=v) / trials
+        # 4k trials: se ~ sqrt(p(1-p)/n) <= 0.008; allow 4 sigma
+        np.testing.assert_allclose(freq, p, atol=0.035)
+
+    def test_greedy_never_accepts_beyond_disagreement(self, np_rng):
+        from paddle_tpu.ops import sampling as S
+
+        lg, greedy, window = self._setup(np_rng)
+        s, k = 3, 4
+        v = lg.shape[-1]
+        window = window.at[:, 1].set((greedy[:, 0] + 1) % v)
+        keys = jax.random.split(jax.random.key(3), s)
+        _, na, _, _ = S.ngram_spec_verify(
+            lg, window, jnp.full((s,), k, jnp.int32), jnp.zeros((s,)),
+            jnp.full((s,), v, jnp.int32), jnp.ones((s,)), keys)
+        np.testing.assert_array_equal(np.asarray(na), 0)

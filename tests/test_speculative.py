@@ -1,0 +1,398 @@
+"""Speculative decoding in the serving engine: n-gram drafting,
+page-pool reserve/commit, and the end-to-end contract — GREEDY
+requests served with `speculative=True` yield EXACTLY the baseline
+serve()/generate() tokens (acceptance only re-derives what the target
+would have said; a rejection redraws from the target itself), while
+the whole draft/verify/commit/rollback loop stays transfer-clean
+under `jax.transfer_guard("disallow")`.
+
+The acceptance RULE's math (distribution preservation, greedy
+argmax-prefix equivalence) is unit-tested per-call in
+tests/test_ops.py::TestSpecVerifyRule; this file owns the proposer,
+the pool's reserve/commit ledger, and the serve-loop integration."""
+
+import jax
+import numpy as np
+import pytest
+
+from paddle_tpu.models import transformer as T
+from paddle_tpu.serve.engine import DecodeEngine
+from paddle_tpu.serve.paged import PagePool, PoolExhaustedError
+from paddle_tpu.serve.policy import SchedulerPolicy
+from paddle_tpu.serve.speculative import NGramProposer
+
+pytestmark = pytest.mark.speculative
+
+CFG = T.TransformerConfig(vocab=61, dim=32, n_layers=2, n_heads=4,
+                          attn_impl="dense")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return T.init_params(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def eng(params):
+    """ONE engine for the serve tests — serve() resets all state, and
+    the per-instance jits (prefill, plain step, spec step) compile
+    once for the whole module instead of once per test (tier-1 time
+    budget)."""
+    return DecodeEngine(params, CFG, slots=2, max_len=48)
+
+
+def spec_prompts(seed=0):
+    """Mixed traffic: repetitive prompts (n-gram hits -> real
+    acceptance) beside a novel one (0-draft rounds -> the degrade
+    path), different lengths so slots churn."""
+    r = np.random.RandomState(seed)
+    base = r.randint(0, 61, (6,)).astype(np.int32)
+    return [np.concatenate([base, base, base[:3]]).astype(np.int32),
+            r.randint(0, 61, (7,)).astype(np.int32),
+            np.concatenate([base, base]).astype(np.int32),
+            r.randint(0, 61, (5,)).astype(np.int32)]
+
+
+class TestNGramProposer:
+    def test_suffix_match_proposes_continuation(self):
+        p = NGramProposer(max_ngram=3)
+        #            match v--v            suffix v--v
+        hist = [1, 2, 3, 4, 9, 8, 7, 1, 2, 3, 4]
+        assert p.propose(hist, 3) == [9, 8, 7]
+
+    def test_most_recent_occurrence_wins(self):
+        p = NGramProposer(max_ngram=2)
+        hist = [5, 6, 1, 5, 6, 2, 5, 6]
+        assert p.propose(hist, 1) == [2]
+
+    def test_deeper_ngram_beats_shallower(self):
+        # the 1-gram [4] recurs later with continuation 9, but the
+        # 2-gram [3, 4] matches with continuation 7 — depth wins
+        p = NGramProposer(max_ngram=2)
+        hist = [3, 4, 7, 0, 4, 9, 5, 3, 4]
+        assert p.propose(hist, 1) == [7]
+
+    def test_no_match_and_short_history_are_empty(self):
+        p = NGramProposer()
+        assert p.propose([1, 2, 3, 4], 3) == []      # nothing recurs
+        assert p.propose([7], 3) == []               # too short
+        assert p.propose([1, 2, 1, 2], 0) == []      # k = 0
+
+    def test_never_proposes_beyond_history(self):
+        # the match sits at the very end: fewer than k tokens follow
+        p = NGramProposer(max_ngram=1)
+        assert p.propose([9, 1, 2, 9], 4) == [1, 2, 9]
+
+    def test_draft_self_extends_through_loops(self):
+        # the suffix's most recent occurrence overlaps the history
+        # end, so one-shot propose() clips to a single period; draft()
+        # re-matches over its own output and fills the budget
+        p = NGramProposer()
+        assert p.propose([1, 2, 3, 3, 3], 4) == [3]
+        assert p.draft([1, 2, 3, 3, 3], 4) == [3, 3, 3, 3]
+        assert p.draft([5, 8, 5, 8, 5], 5) == [8, 5, 8, 5, 8]
+        assert p.draft([1, 2, 3, 4], 3) == []        # still no match
+
+    def test_validates_ngram_bounds(self):
+        with pytest.raises(ValueError):
+            NGramProposer(max_ngram=2, min_ngram=3)
+        with pytest.raises(ValueError):
+            NGramProposer(max_ngram=0)
+
+
+class TestPoolReserveCommit:
+    def _pool(self, **kw):
+        kw.setdefault("num_pages", 8)
+        kw.setdefault("page_size", 4)
+        kw.setdefault("slots", 2)
+        kw.setdefault("max_pages_per_slot", 4)
+        kw.setdefault("prefix_cache", False)
+        return PagePool(**kw)
+
+    def test_reserve_maps_window_blocks_not_pos(self):
+        pool = self._pool()
+        toks = np.arange(9, dtype=np.int32)
+        pool.admit(0, toks, 9)                  # pos 9, blocks 0..2
+        # window writes 9..12 -> needs block 3; pos must NOT move
+        out = pool.reserve(0, 3)
+        assert out == [(3, out[0][1])]
+        assert pool.slot_pos[0] == 9
+        assert len(pool.slot_pages[0]) == 4
+        # window inside mapped blocks: nothing to do
+        assert pool.reserve(0, 1) == []
+        assert pool.counters()["spec_reserved"] == 1
+        pool.release(0)
+        pool.reconcile()
+
+    def test_commit_rolls_back_rejected_tail(self):
+        pool = self._pool()
+        pool.admit(0, np.arange(9, dtype=np.int32), 9)
+        pool.reserve(0, 3)                      # block 3 mapped
+        in_use = pool.pages_in_use
+        added, dropped = pool.commit(0, 1)      # accepted 1: pos 10
+        assert (added, dropped) == ([], [3])
+        assert pool.slot_pos[0] == 10
+        assert pool.pages_in_use == in_use - 1
+        assert pool.counters()["spec_rolled_back"] == 1
+        pool.reconcile()
+        pool.release(0)
+        pool.reconcile()
+
+    def test_commit_full_acceptance_keeps_reserved_pages(self):
+        pool = self._pool()
+        pool.admit(0, np.arange(9, dtype=np.int32), 9)
+        pool.reserve(0, 3)
+        added, dropped = pool.commit(0, 4)      # pos 13: block 3 live
+        assert (added, dropped) == ([], [])
+        assert pool.slot_pos[0] == 13
+        pool.reconcile()
+
+    def test_commit_plain_round_crosses_boundary(self):
+        # a 0-draft round is a plain decode step: commit(slot, 1)
+        # must map the next write position's block exactly when it
+        # crosses into an unmapped one, like extend()
+        pool = self._pool()
+        pool.admit(0, np.arange(8, dtype=np.int32), 8)  # pos 8, 3 blks
+        for want_pos in (9, 10, 11):
+            added, dropped = pool.commit(0, 1)
+            assert (added, dropped) == ([], [])
+            assert pool.slot_pos[0] == want_pos
+        added, dropped = pool.commit(0, 1)      # pos 12 needs block 3
+        assert dropped == [] and [b for b, _ in added] == [3]
+        assert pool.slot_pos[0] == 12
+        pool.reconcile()
+
+    def test_reserve_exhaustion_is_atomic(self):
+        pool = self._pool(num_pages=3)
+        pool.admit(0, np.arange(9, dtype=np.int32), 9)  # all 3 pages
+        before = (pool.slot_pos[0], list(pool.slot_pages[0]),
+                  pool.pages_in_use)
+        with pytest.raises(PoolExhaustedError):
+            pool.reserve(0, 3)
+        assert before == (pool.slot_pos[0], list(pool.slot_pages[0]),
+                          pool.pages_in_use)
+        pool.reconcile()
+
+    def test_rollback_over_shared_blocks_only_drops_refs(self):
+        # reserve never maps shared pages (fresh allocs only), but the
+        # rollback path must stay refcount-honest when it crosses
+        # blocks a slot shares with the prefix cache: commit's decref
+        # on a shared page drops ONE ref, freeing nothing
+        pool = self._pool(prefix_cache=True)
+        toks = np.arange(9, dtype=np.int32)
+        pool.admit(0, toks, 9)
+        pool.register(0, toks, 9)               # blocks 0,1 published
+        pool.admit(1, toks.copy(), 9)           # shares blocks 0,1
+        shared = pool.slot_pages[1][1]
+        assert shared == pool.slot_pages[0][1]
+        in_use = pool.pages_in_use
+        pool.slot_pos[1] = 3                    # adversarial rewind
+        added, dropped = pool.commit(1, 0)      # keep=1: drop blks 1,2
+        assert (added, dropped) == ([], [1, 2])
+        # only slot 1's private page was freed; the shared page
+        # survives for slot 0 and the cache
+        assert pool.pages_in_use == in_use - 1
+        assert pool.slot_pages[0][1] == shared
+        pool.release(1)
+        pool.release(0)
+        pool.reconcile()
+
+
+class TestSpeculativeServe:
+    def test_greedy_parity_with_baseline_serve(self, eng):
+        ps = spec_prompts()
+        want = eng.serve([p.copy() for p in ps], max_new=14)
+        base_steps = eng.last_stats.steps
+        got = eng.serve([p.copy() for p in ps], max_new=14,
+                        speculative=True)
+        assert got == want
+        st = eng.last_stats
+        # the repetitive prompts must actually speculate (real
+        # acceptance), and the ledger must reconcile
+        assert st.draft_proposed > 0
+        assert 0 < st.draft_accepted <= st.draft_proposed
+        assert st.spec_rounds == st.steps
+        assert st.tokens == sum(len(g) for g in got)
+        assert st.spec_rounds < base_steps      # fewer launches
+
+    def test_eos_and_logprob_parity(self, params, eng):
+        ps = spec_prompts(seed=3)[:3]
+        # pick an eos that actually fires early: the most common
+        # first generated token (probed on the WARM shared engine —
+        # same prompt lengths, no fresh compiles)
+        firsts = [g[0] for g in
+                  eng.serve([p.copy() for p in ps], max_new=1)]
+        eos = max(set(firsts), key=firsts.count)
+        e = DecodeEngine(params, CFG, slots=2, max_len=48, eos_id=eos)
+        want, want_lp = e.serve([p.copy() for p in ps], max_new=10,
+                                return_logprobs=True)
+        got, got_lp = e.serve([p.copy() for p in ps], max_new=10,
+                              return_logprobs=True, speculative=True)
+        assert got == want
+        for a, b in zip(got_lp, want_lp):
+            np.testing.assert_allclose(a, b, rtol=0, atol=2e-5)
+
+    def test_chaos_transfer_guard_parity(self, eng):
+        """THE chaos gate: the full speculative loop — host drafting,
+        page reserve, the jitted verify round, commit/rollback
+        re-maps — under transfer_guard('disallow'), token-identical
+        to the plain guarded loop. Any implicit host<->device staging
+        in the new path dies here."""
+        ps = spec_prompts(seed=5)
+        with jax.transfer_guard("disallow"):
+            want = eng.serve([p.copy() for p in ps], max_new=12)
+            got = eng.serve([p.copy() for p in ps], max_new=12,
+                            speculative=True)
+        assert got == want
+
+    def test_sampled_requests_reproducible_and_bounded(self, eng):
+        """Sampled speculative serving: draws differ from the plain
+        loop's per-token stream (documented round-stream boundary)
+        but must be reproducible per seed and respect max_new; greedy
+        co-tenants keep exact parity beside them."""
+        ps = spec_prompts(seed=7)
+        sampling = [{"temperature": 0.8, "top_k": 20, "seed": 11},
+                    None,
+                    {"temperature": 0.6, "top_p": 0.9, "seed": 12},
+                    None]
+        runs = [eng.serve([p.copy() for p in ps], max_new=9,
+                          sampling=sampling, speculative=True)
+                for _ in range(2)]
+        assert runs[0] == runs[1]
+        assert all(len(g) == 9 for g in runs[0])
+        want = eng.serve([p.copy() for p in ps], max_new=9)
+        for i in (1, 3):                         # the greedy rows
+            assert runs[0][i] == want[i]
+
+    def test_oversubscribed_pool_preempts_and_recovers(self, params):
+        """Commit's boundary alloc can exhaust an over-subscribed
+        pool mid-round: the loop must preempt/retire through the same
+        policy path as the plain loop and still hand every request
+        its exact greedy tokens."""
+        ps = spec_prompts(seed=9)
+        e = DecodeEngine(params, CFG, slots=2, max_len=48,
+                         num_pages=7, prefix_cache=False)
+        want = e.serve([p.copy() for p in ps], max_new=12)
+        got = e.serve([p.copy() for p in ps], max_new=12,
+                      speculative=True)
+        assert got == want
+
+    def test_speculative_guards(self, params):
+        eng = DecodeEngine(params, CFG, slots=2, max_len=32,
+                           select_fn=lambda lg, r: lg.argmax(-1))
+        with pytest.raises(ValueError, match="select_fn"):
+            eng.serve(spec_prompts()[:1], max_new=2, speculative=True)
+        wcfg = T.TransformerConfig(vocab=61, dim=32, n_layers=2,
+                                   n_heads=4, attn_impl="dense",
+                                   attn_window=16)
+        wparams = T.init_params(jax.random.key(1), wcfg)
+        weng = DecodeEngine(wparams, wcfg, slots=2, max_len=32)
+        with pytest.raises(ValueError, match="paged"):
+            weng.serve(spec_prompts()[:1], max_new=2, speculative=True)
+
+    def test_draft_len_policy_clamps(self):
+        pol = SchedulerPolicy()
+        assert pol.draft_len(pos=10, max_len=48, remaining=9) == 4
+        assert pol.draft_len(pos=45, max_len=48, remaining=9) == 2
+        assert pol.draft_len(pos=47, max_len=48, remaining=9) == 0
+        assert pol.draft_len(pos=10, max_len=48, remaining=1) == 0
+        assert pol.draft_len(pos=10, max_len=48, remaining=3) == 2
+
+
+class TestSpeculativeServer:
+    def test_server_parity_and_counters(self, eng):
+        """ServingServer(speculative=True): same tokens as the plain
+        reliability loop, spec ledger in counters() (including the
+        float acceptance_rate the obs registry exports as a gauge),
+        books reconciled."""
+        from paddle_tpu.obs import MetricsRegistry
+        from paddle_tpu.serve.server import ServingServer
+
+        ps = spec_prompts()
+        base = ServingServer(eng, max_queue=16)
+        want = {base.submit(p.copy(), max_new=12): None for p in ps}
+        res = base.run()
+        base.reconcile()
+        want = {rid: res[rid].tokens for rid in want}
+
+        srv = ServingServer(eng, max_queue=16, speculative=True)
+        reg = MetricsRegistry()
+        srv.bind_metrics(reg)
+        ids = [srv.submit(p.copy(), max_new=12) for p in ps]
+        res2 = srv.run()
+        srv.reconcile()
+        for rid, base_rid in zip(ids, want):
+            assert res2[rid].outcome == "completed"
+            assert res2[rid].tokens == want[base_rid]
+        c = srv.counters()
+        assert c["spec_rounds"] == srv.stats.steps > 0
+        assert 0 < c["draft_accepted"] <= c["draft_proposed"]
+        assert c["acceptance_rate"] == pytest.approx(
+            c["draft_accepted"] / c["draft_proposed"])
+        assert c["spec_reserved"] >= c["spec_rolled_back"] >= 0
+        # the whole spec ledger reaches the metrics registry through
+        # the bound counters() source
+        names = {row["name"]: row["value"]
+                 for row in reg.snapshot()["series"]}
+        for k in ("serve_draft_proposed", "serve_draft_accepted",
+                  "serve_acceptance_rate", "serve_spec_rounds"):
+            assert k in names, sorted(names)
+        assert names["serve_draft_proposed"] == c["draft_proposed"]
+
+    def test_server_guards(self, params):
+        from paddle_tpu.serve.server import ServingServer
+
+        eng = DecodeEngine(params, CFG, slots=2, max_len=32,
+                           select_fn=lambda lg, r: lg.argmax(-1))
+        with pytest.raises(ValueError, match="select_fn"):
+            ServingServer(eng, speculative=True)
+
+
+class TestSpecFleetChaos:
+    def test_midburst_kill_counters_reconcile_exactly_once(self, eng,
+                                                           params):
+        """THE exactly-once gate for the spec ledger: kill a replica
+        mid-burst while every replica serves speculatively. Every
+        request still ends completed with its exact greedy tokens,
+        and the fleet's draft/acceptance counters equal the dead
+        replica's banked contribution plus the survivors' live ones —
+        counted once, never lost with the device, never re-added."""
+        from paddle_tpu.serve.router import ServingRouter
+        from paddle_tpu.serve.server import ServingServer
+        from paddle_tpu.testing.faults import FaultPlan, ManualClock
+
+        eng2 = DecodeEngine(params, CFG, slots=2, max_len=48)
+        clk = ManualClock()
+        plan = FaultPlan(router_kill_decode_at=1)
+        servers = [
+            ServingServer(plan.wrap_replica_engine(eng, clock=clk),
+                          max_queue=16, clock=clk, max_retries=2,
+                          speculative=True),
+            ServingServer(eng2, max_queue=16, clock=clk,
+                          max_retries=2, speculative=True),
+        ]
+        router = ServingRouter(servers, clock=clk)
+        ps = spec_prompts(seed=13)
+        ids = [router.submit(p.copy(), max_new=10) for p in ps]
+        res = router.run()
+        router.reconcile()
+        assert plan.count("replicakill") == 1
+        for p, rid in zip(ps, ids):
+            assert res[rid].outcome == "completed"
+            # parity oracle: the warm engine's own speculative serve
+            solo = eng.serve([p.copy()], max_new=10)[0]
+            assert res[rid].tokens == solo
+        c = router.counters()
+        # exactly-once: the aggregate equals banked-dead + live sums,
+        # re-derived from the primary sources
+        live = [rep.server.counters() for rep in router.replicas
+                if rep.alive]
+        for k in ("draft_proposed", "draft_accepted", "spec_rounds",
+                  "spec_reserved", "spec_rolled_back"):
+            want = (router._dead_base.get(k, 0)
+                    + sum(s[k] for s in live))
+            assert c[f"fleet_{k}"] == want, k
+        assert c["fleet_acceptance_rate"] == pytest.approx(
+            c["fleet_draft_accepted"]
+            / max(c["fleet_draft_proposed"], 1))
+        assert c["fleet_draft_proposed"] > 0
