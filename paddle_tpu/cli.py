@@ -42,6 +42,29 @@ def _transfer_guard(enabled: bool):
     return no_implicit_transfers()
 
 
+#: where `--compile-cache` lands when the flag is omitted — shared by
+#: every process on the box, namespaced inside by jax version +
+#: backend + topology (compilation_cache.cache_key)
+DEFAULT_COMPILE_CACHE = "~/.cache/paddle_tpu/xla"
+
+
+def _enable_compile_cache(args) -> None:
+    """Persistent XLA compile cache, ON BY DEFAULT for serve/train/
+    infer (docs/SERVING.md "AOT artifacts & compile cache"): a
+    warm-cache restart skips XLA compilation for every jitted body
+    the run builds — the fleet cold-start win `bench.py
+    --serving-only` measures. `--compile-cache DIR` moves it,
+    `--no-compile-cache` opts out. Must run before the first jit
+    compiles, so every cmd_* calls it up front; corrupt or
+    stale-version entries degrade to a miss, never an error."""
+    if getattr(args, "no_compile_cache", False):
+        return
+    from paddle_tpu import compilation_cache
+
+    compilation_cache.enable(
+        getattr(args, "compile_cache", None) or DEFAULT_COMPILE_CACHE)
+
+
 def _obs_stack(metrics_out=None, flight_dir=None):
     """Build the (registry, tracer, flight) triple for an instrumented
     run — or (None, None, None) when neither flag asked for it, so the
@@ -58,6 +81,16 @@ def _obs_stack(metrics_out=None, flight_dir=None):
         os.makedirs(flight_dir, exist_ok=True)
 
     registry = MetricsRegistry() if metrics_out else None
+    if registry is not None:
+        # compile-cache hit/miss counters ride the same export
+        # (docs/OBSERVABILITY.md) — process-global, so they register
+        # here ONCE rather than per server (a fleet run's router
+        # summing per-replica counters must not multiply-count them)
+        from paddle_tpu import compilation_cache
+
+        compilation_cache.install_listeners()
+        registry.register_source("compile_cache",
+                                 compilation_cache.counters)
     flight = FlightRecorder()
     # finished spans feed the ring; the module default makes
     # RecompileGuard / transfer-guard violations land there too
@@ -151,6 +184,10 @@ def cmd_train(args) -> int:
             coordinator_address=args.coordinator,
             num_processes=args.num_processes,
             process_id=args.process_id)
+
+    # after the multi-host join (cache keying touches the backend),
+    # before anything compiles
+    _enable_compile_cache(args)
 
     import jax.numpy as jnp
 
@@ -291,6 +328,7 @@ def cmd_export_native(args) -> int:
 def cmd_infer(args) -> int:
     import numpy as np
 
+    _enable_compile_cache(args)
     from paddle_tpu.serve import load_compiled_model
 
     m = load_compiled_model(args.artifact)
@@ -315,6 +353,7 @@ def cmd_serve(args) -> int:
     reference's id-based SequenceGenerator)."""
     import numpy as np
 
+    _enable_compile_cache(args)
     from paddle_tpu.serve import DecodeEngine
 
     ns = runpy.run_path(args.config)
@@ -354,8 +393,16 @@ def cmd_serve(args) -> int:
     # open the sink BEFORE the (possibly long) serve run: an
     # unwritable --output must fail fast, not discard the decode work
     sink = open(args.output, "w") if args.output else sys.stdout
+    # any of these flags needs the ServingServer wrapper: the queue /
+    # deadline knobs obviously, but also --engine-artifact (bundle
+    # adoption happens at server boot) and the obs flags (counters and
+    # flight events hang off the server) — silently ignoring them on
+    # the bare eng.serve() path would look like a no-op to the user
     reliable = (args.max_queue is not None
-                or args.default_deadline_ms is not None)
+                or args.default_deadline_ms is not None
+                or args.engine_artifact is not None
+                or args.metrics_out is not None
+                or args.flight_dir is not None)
     try:
         if args.replicas is not None and args.replicas > 1:
             # N single-box replicas behind the prefix-affinity router
@@ -409,7 +456,8 @@ def _serve_reliable(args, eng, prompts, sampling, buckets, sink):
         drain_grace_s=args.drain_grace,
         drain_report_path=args.drain_report,
         install_signal_handlers=True,
-        tracer=tracer, flight=flight)
+        tracer=tracer, flight=flight,
+        artifact_path=args.engine_artifact)
     if registry is not None:
         server.bind_metrics(registry)
     # feed the batch AS THE QUEUE DRAINS, like a well-behaved client:
@@ -502,7 +550,11 @@ def _serve_fleet(args, engines, prompts, sampling, buckets, sink):
             drain_grace_s=args.drain_grace,
             # replicas SHARE the fleet tracer: the router mints the
             # rr<N> span, the replica's _finish ends it
-            tracer=tracer, flight=flight)
+            tracer=tracer, flight=flight,
+            # every replica boots from the same bundle (manifest
+            # verified per replica — a mismatch degrades just that
+            # replica to the jit path, counted in its counters)
+            artifact_path=args.engine_artifact)
         for e in engines]
     router = ServingRouter(servers, tracer=tracer, flight=flight,
                            flight_dir=args.flight_dir)
@@ -766,6 +818,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "text); with --checkpoint-dir also enables "
                         "step tracing + the flight recorder "
                         "(docs/OBSERVABILITY.md)")
+    t.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compile-cache root (default "
+                        f"{DEFAULT_COMPILE_CACHE}; entries are "
+                        "namespaced by jax version+backend+topology)")
+    t.add_argument("--no-compile-cache", action="store_true",
+                   help="disable the persistent compile cache")
     t.add_argument("--coordinator", default=None,
                    help="host:port of process 0 for multi-host jobs")
     t.add_argument("--num-processes", type=int, default=None)
@@ -794,6 +852,11 @@ def build_parser() -> argparse.ArgumentParser:
     i = sub.add_parser("infer")
     i.add_argument("--artifact", required=True)
     i.add_argument("--output-prefix", default=None)
+    i.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compile-cache root (default "
+                        f"{DEFAULT_COMPILE_CACHE})")
+    i.add_argument("--no-compile-cache", action="store_true",
+                   help="disable the persistent compile cache")
     i.add_argument("inputs", nargs="+", help=".npy input files")
     i.set_defaults(fn=cmd_infer)
 
@@ -840,6 +903,20 @@ def build_parser() -> argparse.ArgumentParser:
     sv.add_argument("--drain-report", default=None,
                     help="write the drain report JSON here on "
                          "graceful shutdown")
+    sv.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persistent XLA compile-cache root (default "
+                         f"{DEFAULT_COMPILE_CACHE}; a warm-dir "
+                         "restart skips XLA compilation — "
+                         "docs/SERVING.md)")
+    sv.add_argument("--no-compile-cache", action="store_true",
+                    help="disable the persistent compile cache")
+    sv.add_argument("--engine-artifact", default=None, metavar="TAR",
+                    help="AOT engine bundle "
+                         "(serve.artifact.save_engine_artifact): "
+                         "replicas verify its manifest at boot and "
+                         "serve from pre-exported programs; any "
+                         "mismatch falls back to the jit path with "
+                         "an artifact_fallbacks counter")
     sv.add_argument("--transfer-guard", action="store_true",
                     help="enforce jax.transfer_guard('disallow') "
                          "around the decode loop: implicit "

@@ -1,0 +1,158 @@
+"""Persistent XLA compilation cache, wired for fleet restarts.
+
+A fleet serving millions of users restarts processes constantly —
+deploys, preemptions, router failover — and every restart used to pay
+full retrace+compile of the engine's jitted bodies before the first
+token moved (ROADMAP item 3). jax ships a persistent on-disk
+compilation cache; this module is the ONE place the repo configures
+it, with three production requirements the raw knobs don't enforce:
+
+- **Versioned keys.** Entries are only valid for the (jax version,
+  backend, device topology) that produced them, so the cache root is
+  namespaced by a version key subdirectory. A jax upgrade or a
+  CPU-host pointing at a TPU-host's cache lands in a sibling
+  directory and degrades to a cold cache — never a poisoned one.
+- **Corrupt/stale entries degrade to a MISS, never an error.**
+  `jax_raise_persistent_cache_errors` stays False (asserted, not
+  assumed: `enable()` pins it), so a truncated write from a killed
+  process or a garbage file costs one recompile, not an outage.
+- **Observable.** `install_listeners()` hooks jax.monitoring's
+  cache events; `counters()` reports `compile_cache_hits` /
+  `compile_cache_misses` for the obs registry, the serving server,
+  and the cold-start bench (docs/OBSERVABILITY.md).
+
+Everything the CLI compiles — serve engine bodies, the train step,
+infer forwards — flows through XLA's one compile entry point, so a
+single `enable()` near process start covers all of them. The serving
+cold-start numbers live in `bench.py --serving-only` (cold-start
+stage); docs/SERVING.md "AOT artifacts & compile cache" is the
+operational guide.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from typing import Dict, Optional
+
+import jax
+
+#: the cache entries written by a *tiny* test model still matter: a
+#: fleet restart wants EVERY jitted body cached, not just the ones XLA
+#: took >1s to compile (the upstream default threshold).
+_MIN_COMPILE_TIME_SECS = 0
+_MIN_ENTRY_SIZE_BYTES = -1
+
+_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_REQ_EVENT = "/jax/compilation_cache/compile_requests_use_cache"
+
+_lock = threading.Lock()
+_listeners_installed = False
+_counts = {"hits": 0, "requests": 0}
+_enabled_dir: Optional[str] = None
+
+
+def cache_key(backend: Optional[str] = None) -> str:
+    """The versioned namespace for cache entries: jax version +
+    backend + device topology. Anything that changes compiled-code
+    compatibility changes the key, so stale entries are unreachable
+    rather than trusted."""
+    backend = backend or jax.default_backend()
+    try:
+        ndev = jax.device_count()
+    except RuntimeError:
+        ndev = 0
+    raw = f"jax{jax.__version__}-{backend}-d{ndev}"
+    return re.sub(r"[^A-Za-z0-9._-]", "_", raw)
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if event == _HIT_EVENT:
+        _counts["hits"] += 1
+    elif event == _REQ_EVENT:
+        _counts["requests"] += 1
+
+
+def install_listeners() -> None:
+    """Idempotently hook jax.monitoring's persistent-cache events.
+    jax fires `cache_hits` on a successful disk read and
+    `compile_requests_use_cache` per cache-eligible compile; misses
+    are requests minus hits (there is no dedicated miss event)."""
+    global _listeners_installed
+    with _lock:
+        if _listeners_installed:
+            return
+        jax.monitoring.register_event_listener(_on_event)
+        _listeners_installed = True
+
+
+def reset_counters() -> None:
+    _counts["hits"] = 0
+    _counts["requests"] = 0
+
+
+def counters() -> Dict[str, int]:
+    """Hits/misses since the last reset. Keys are bare (`hits`,
+    `misses`): the obs registry prepends its source prefix, so
+    registering under "compile_cache" exports the documented
+    `compile_cache_hits` / `compile_cache_misses` series
+    (docs/OBSERVABILITY.md)."""
+    hits = _counts["hits"]
+    return {"hits": hits,
+            "misses": max(_counts["requests"] - hits, 0)}
+
+
+def enabled_dir() -> Optional[str]:
+    """The versioned directory entries are landing in, or None."""
+    return _enabled_dir
+
+
+def enable(cache_dir: str) -> str:
+    """Point jax's persistent compilation cache at
+    `cache_dir/<cache_key()>` and pin the fleet-safe knobs: cache
+    everything (no min compile time / entry size), enable XLA-level
+    subcaches, and NEVER raise on a corrupt entry — a bad read logs
+    a warning and recompiles (tests/test_artifact_cache.py proves
+    it). Returns the versioned directory. Idempotent; call near
+    process start, before the first jit executes, or early compiles
+    simply miss."""
+    global _enabled_dir
+    path = os.path.join(os.path.expanduser(cache_dir), cache_key())
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      _MIN_COMPILE_TIME_SECS)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                      _MIN_ENTRY_SIZE_BYTES)
+    jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    # corrupt/stale entries MUST degrade to a miss (the whole point
+    # of a cache a fleet can trust) — pin it, don't assume it
+    jax.config.update("jax_raise_persistent_cache_errors", False)
+    _reset_jax_cache_state()
+    install_listeners()
+    _enabled_dir = path
+    return path
+
+
+def _reset_jax_cache_state() -> None:
+    """jax latches its cache-backend singleton at the FIRST compile:
+    a process that compiled anything before `enable()` silently never
+    writes an entry (requests are counted, nothing lands). Resetting
+    the singleton makes the next compile re-read the config, so
+    enabling mid-process — tests, notebooks, a server that compiles a
+    probe before parsing flags — actually works."""
+    try:
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:   # private module: a jax upgrade may move it —
+        pass            # worst case is the old early-compiles-miss
+
+
+def disable() -> None:
+    """Turn the persistent cache off (in-memory jit caching is
+    untouched). Counters keep their values for post-mortem reads."""
+    global _enabled_dir
+    jax.config.update("jax_compilation_cache_dir", None)
+    _reset_jax_cache_state()
+    _enabled_dir = None

@@ -7,6 +7,7 @@ from paddle_tpu.parallel.sharding import (
     MEGATRON_RULES,
 )
 from paddle_tpu.parallel.train_step import (
+    aot_compile_train_step,
     make_sharded_train_step,
     shard_train_state,
     train_state_shardings,

@@ -113,3 +113,24 @@ def make_sharded_train_step(
         remat=remat, accum_steps=accum_steps,
         constrain_state_fn=constrain,
     )
+
+
+def aot_compile_train_step(step, state, rng, inputs, labels):
+    """Ahead-of-time lower+compile a train step (make_train_step /
+    make_sharded_train_step both return jax.jit objects) against
+    example arguments, WITHOUT executing a step.
+
+    Why a fleet cares (ROADMAP item 3): the first `step(...)` call of
+    a fresh trainer process pays trace+lower+compile mid-"training" —
+    after data pipelines spun up, inside the resilience layer's
+    watchdog window. This front-loads the whole cost to one explicit
+    boot-time point; with the persistent compile cache enabled
+    (paddle_tpu.compilation_cache — the CLI default) the XLA compile
+    inside is itself a disk hit on a warm restart, so the restarted
+    trainer reaches its first real step nearly compile-free.
+
+    Returns the compiled executable — call it exactly like the step
+    (same donation semantics; arguments must match the example
+    shapes/dtypes/shardings). The example args are only shape/dtype
+    templates here: lowering never runs the computation."""
+    return step.lower(state, rng, inputs, labels).compile()

@@ -2,10 +2,13 @@
 the C inference ABI (reference: paddle/capi + merge_model)."""
 
 from paddle_tpu.serve.artifact import (
+    ArtifactMismatchError,
     CompiledModel,
     export_compiled_model,
     export_decoder,
     load_compiled_model,
+    load_engine_artifact,
+    save_engine_artifact,
 )
 from paddle_tpu.serve import quant
 from paddle_tpu.serve.engine import (DecodeEngine, EngineState,

@@ -236,3 +236,277 @@ def load_compiled_model(path: str) -> CompiledModel:
         program = tar.extractfile(_PROGRAM_NAME).read()
     exported = jax.export.deserialize(program)
     return CompiledModel(exported, meta)
+
+
+# ---------------------------------------------------------------------------
+# Engine artifact bundles (ROADMAP item 3: fleet-scale cold start)
+# ---------------------------------------------------------------------------
+#
+# A DecodeEngine's serving hot path is a handful of jitted bodies —
+# decode step, speculative verify, prefill chunks, the page-table
+# micro-setters warmed in init_state. A fresh replica (deploy,
+# preemption, router failover) used to pay full retrace+compile of
+# every one before its first token. An engine BUNDLE is those bodies
+# pre-exported (jax.export, weights folded in) into one versioned tar
+# that ServingServer/ServingRouter replicas load at boot:
+#
+#   manifest.json               verified field-for-field against
+#                               engine.artifact_manifest() before a
+#                               single program is trusted
+#   programs/step               the batched decode step
+#   programs/spec               the speculative verify round
+#                               (K = policy.spec_draft_max baked in)
+#   programs/chunk_w{W}_z{Z}_f{F}  one per saved (chunk_w, from_zero,
+#                               final) prefill combo
+#   programs/pagemap|rowset|retire  the host-bookkeeping micro-bodies
+#
+# EngineState is a NamedTuple pytree; exported programs take FLAT
+# leaf arguments (treedefs are rebuilt host-side from
+# engine.state_spec(), never serialized) and PRNG keys cross the
+# boundary as raw key data (wrap/unwrap inside the program — the
+# export_decoder rng-seed idiom). Any mismatch — jax version, weights
+# hash, pool geometry, backend not in the export's platform list —
+# raises ArtifactMismatchError and the caller falls back to the jit
+# path with an `artifact_fallbacks` counter and a flight event:
+# never a wrong answer. Trade to know about: every program embeds
+# the weights as constants, so a bundle is O(programs x params) on
+# disk — fine for serving binaries, not a weight-distribution format
+# (checkpoints remain that).
+
+ENGINE_FORMAT_VERSION = 1
+_MANIFEST_NAME = "manifest.json"
+_PROGRAM_DIR = "programs"
+
+
+class ArtifactMismatchError(ValueError):
+    """The bundle's manifest does not match the loading engine (or
+    backend). Callers degrade to the jit path — never a wrong
+    answer."""
+
+
+def _chunk_key(w: int, from_zero: bool, final: bool) -> str:
+    return f"chunk_w{int(w)}_z{int(bool(from_zero))}_f{int(bool(final))}"
+
+
+def _data_rng_spec(spec):
+    """The state spec with the PRNG-key leaf replaced by its raw
+    key-data spec (uint32) — the form that crosses the export
+    boundary."""
+    kd = jax.eval_shape(jax.random.key_data, spec.rng)
+    return spec._replace(rng=jax.ShapeDtypeStruct(kd.shape, kd.dtype))
+
+
+def _engine_programs(engine, buckets):
+    """(name -> (flat_fn, arg_specs)) for every program the bundle
+    carries. Flat wrappers close over the engine's impl methods, so
+    the exported computation IS the jit body's computation — greedy
+    parity between the two paths is bit-exact on a fixed backend."""
+    spec = engine.state_spec()
+    dspec = _data_rng_spec(spec)
+    treedef = jax.tree.structure(dspec)
+    state_leaves = list(jax.tree.leaves(dspec))
+    n_state = len(state_leaves)
+    s = engine.slots
+
+    def sds(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    def unflat(leaves):
+        st = jax.tree.unflatten(treedef, list(leaves))
+        return st._replace(rng=jax.random.wrap_key_data(st.rng))
+
+    def reflat(tree):
+        return tuple(jax.tree.leaves(tree))
+
+    def step_flat(*leaves):
+        st = unflat(leaves)
+        out_state, em, lp, act, fin = engine._step_impl(st)
+        out_state = out_state._replace(
+            rng=jax.random.key_data(out_state.rng))
+        return reflat((out_state, em, lp, act, fin))
+
+    programs = {"step": (step_flat, state_leaves)}
+
+    kmax = int(engine.policy.spec_draft_max)
+    if kmax >= 1:
+        def spec_flat(*leaves):
+            st = unflat(leaves[:n_state])
+            drafts, dlen = leaves[n_state], leaves[n_state + 1]
+            out = engine._spec_step_impl(st, drafts, dlen)
+            st2 = out[0]._replace(rng=jax.random.key_data(out[0].rng))
+            return reflat((st2,) + tuple(out[1:]))
+
+        programs["spec"] = (
+            spec_flat,
+            state_leaves + [sds((s, kmax), jnp.int32),
+                            sds((s,), jnp.int32)])
+
+    combos = set()
+    if engine.prefill_chunk:
+        w = int(engine.prefill_chunk)
+        combos.update((w, z, f) for z in (True, False)
+                      for f in (True, False))
+    for b in (buckets or ()):
+        # the one-shot-per-bucket prefill: whole prompt, from zero,
+        # final (prefix-hit remainders take arbitrary widths — those
+        # stay on the jit path as expected misses)
+        combos.add((int(b), True, True))
+    for (w, z, f) in sorted(combos):
+        def make_chunk(w=w, z=z, f=f):
+            def chunk_flat(*leaves):
+                st = unflat(leaves[:n_state])
+                (slot, toks, start, true_len, temp, top_k, top_p,
+                 req_tag, req_seed) = leaves[n_state:]
+                out = engine._chunk_impl(
+                    st, slot, toks, start, true_len, temp, top_k,
+                    top_p, req_tag, req_seed,
+                    chunk_w=w, from_zero=z, final=f)
+                return reflat(out._replace(
+                    rng=jax.random.key_data(out.rng)))
+            return chunk_flat
+
+        programs[_chunk_key(w, z, f)] = (
+            make_chunk(),
+            state_leaves + [
+                sds((), jnp.int32), sds((w,), jnp.int32),
+                sds((), jnp.int32), sds((), jnp.int32),
+                sds((), jnp.float32), sds((), jnp.int32),
+                sds((), jnp.float32), sds((), jnp.int32),
+                sds((), jnp.int32)])
+
+    p = engine.max_pages_per_slot
+    programs["pagemap"] = (
+        lambda tbl, slot, blk, page: tbl.at[slot, blk].set(page),
+        [sds((s, p), jnp.int32), sds((), jnp.int32),
+         sds((), jnp.int32), sds((), jnp.int32)])
+    programs["rowset"] = (
+        lambda tbl, slot, row: tbl.at[slot].set(row),
+        [sds((s, p), jnp.int32), sds((), jnp.int32),
+         sds((p,), jnp.int32)])
+    programs["retire"] = (
+        lambda active, pos, slot, fill: (
+            active.at[slot].set(False), pos.at[slot].set(fill)),
+        [sds((s,), jnp.bool_), sds((s,), jnp.int32),
+         sds((), jnp.int32), sds((), jnp.int32)])
+    return programs
+
+
+def save_engine_artifact(engine, path: str, *, buckets=None,
+                         platforms=None) -> dict:
+    """Export the engine's serving bodies into a versioned bundle at
+    `path`; returns the manifest. `buckets` adds one one-shot prefill
+    program per bucket width (pass the serving buckets); engines
+    built with `prefill_chunk` get all four chunk combos
+    automatically. `platforms` (default: the current backend) lowers
+    each program for every named backend — ("cpu", "tpu") gives one
+    artifact a CPU canary and a TPU fleet can both boot."""
+    if platforms is None:
+        platforms = (jax.default_backend(),)
+    platforms = [str(p) for p in platforms]
+    manifest = dict(engine.artifact_manifest())  # validates support
+    blobs = {}
+    for name, (fn, arg_specs) in _engine_programs(engine,
+                                                  buckets).items():
+        # each wrapper is a DISTINCT program exported exactly once —
+        # there is no reusable jit to hoist out of this loop
+        jitted = jax.jit(fn)  # graftlint: disable=GL004(one-shot export)
+        exported = jax.export.export(
+            jitted, platforms=platforms)(*arg_specs)
+        blobs[name] = exported.serialize()
+    manifest.update({
+        "engine_format_version": ENGINE_FORMAT_VERSION,
+        "platforms": platforms,
+        "buckets": (sorted(int(b) for b in buckets)
+                    if buckets else None),
+        "prefill_chunk": (None if engine.prefill_chunk is None
+                          else int(engine.prefill_chunk)),
+        "programs": sorted(blobs),
+    })
+    with tarfile.open(path, "w") as tar:
+        mb = json.dumps(manifest, indent=1).encode()
+        info = tarfile.TarInfo(_MANIFEST_NAME)
+        info.size = len(mb)
+        tar.addfile(info, io.BytesIO(mb))
+        for name in sorted(blobs):
+            info = tarfile.TarInfo(f"{_PROGRAM_DIR}/{name}")
+            info.size = len(blobs[name])
+            tar.addfile(info, io.BytesIO(blobs[name]))
+    return manifest
+
+
+def load_engine_artifact(engine, path: str, *, expect_buckets=None):
+    """Load + verify a bundle for `engine`: returns (programs,
+    manifest) ready for `engine.bind_artifact`. EVERY manifest field
+    the engine's own `artifact_manifest()` produces must match
+    exactly (weights hash, config hash, pool geometry, jax version,
+    seed, spec_draft_max, dtypes), the current backend must be in the
+    export's platform list, and `expect_buckets` (pass the serving
+    buckets) must equal the saved ones — anything else raises
+    ArtifactMismatchError and the caller keeps the jit path."""
+    with tarfile.open(path, "r") as tar:
+        manifest = json.loads(
+            tar.extractfile(_MANIFEST_NAME).read().decode())
+        blobs = {}
+        for name in manifest.get("programs", []):
+            blobs[name] = tar.extractfile(
+                f"{_PROGRAM_DIR}/{name}").read()
+    if manifest.get("engine_format_version") != ENGINE_FORMAT_VERSION:
+        raise ArtifactMismatchError(
+            f"engine_format_version "
+            f"{manifest.get('engine_format_version')!r} != "
+            f"{ENGINE_FORMAT_VERSION}")
+    backend = jax.default_backend()
+    if backend not in manifest.get("platforms", []):
+        raise ArtifactMismatchError(
+            f"backend {backend!r} not in artifact platforms "
+            f"{manifest.get('platforms')!r}")
+    want = engine.artifact_manifest()
+    for k, v in want.items():
+        got = manifest.get(k, "<missing>")
+        if got != v:
+            raise ArtifactMismatchError(
+                f"manifest field {k!r}: artifact {got!r} != engine "
+                f"{v!r}")
+    if expect_buckets is not None:
+        want_b = sorted(int(b) for b in expect_buckets)
+        if manifest.get("buckets") != want_b:
+            raise ArtifactMismatchError(
+                f"buckets: artifact {manifest.get('buckets')!r} != "
+                f"serving {want_b!r}")
+
+    spec = engine.state_spec()
+    dspec = _data_rng_spec(spec)
+    treedef = jax.tree.structure(dspec)
+
+    def key_out(state):
+        return state._replace(rng=jax.random.wrap_key_data(state.rng))
+
+    def key_in(state):
+        return state._replace(rng=jax.random.key_data(state.rng))
+
+    def state_in_call(exported, out_tree, n_extra_out):
+        def call(state, *extra):
+            flat = exported.call(*jax.tree.leaves(key_in(state)),
+                                 *extra)
+            out = jax.tree.unflatten(out_tree, list(flat))
+            if n_extra_out == 0:
+                return key_out(out)
+            return (key_out(out[0]),) + tuple(out[1:])
+        return call
+
+    programs = {}
+    step_tree = jax.tree.structure((dspec, 0, 0, 0, 0))
+    spec_tree = jax.tree.structure((dspec, 0, 0, 0, 0, 0, 0))
+    state_tree = treedef
+    for name, blob in blobs.items():
+        exported = jax.export.deserialize(blob)
+        if name == "step":
+            programs[name] = state_in_call(exported, step_tree, 4)
+        elif name == "spec":
+            programs[name] = state_in_call(exported, spec_tree, 6)
+        elif name.startswith("chunk_"):
+            programs[name] = state_in_call(exported, state_tree, 0)
+        else:
+            # micro-setters are flat on both sides already
+            programs[name] = exported.call
+    return programs, manifest

@@ -348,6 +348,18 @@ class DecodeEngine:
         self._retire_jit = jax.jit(
             lambda active, pos, slot, fill: (
                 active.at[slot].set(False), pos.at[slot].set(fill)))
+        # AOT artifact surface (serve.artifact): `bind_artifact`
+        # installs pre-exported programs that replace the jitted
+        # bodies call-for-call — a fleet restart then skips
+        # retrace+compile entirely. None = the pure jit path. Any
+        # runtime failure of a bound program falls back to the jit
+        # body for that member FOREVER (the member is dropped), bumps
+        # `artifact_fallbacks` and notifies `_artifact_hook` — never
+        # a wrong answer, never a crash.
+        self._artifact: Optional[dict] = None
+        self.artifact_loads = 0
+        self.artifact_fallbacks = 0
+        self._artifact_hook = None
 
     def ping(self) -> None:
         """The health-probe surface: a cheap host-side liveness touch
@@ -356,6 +368,146 @@ class DecodeEngine:
         like a lost device would on its first RPC — which is what
         makes the fleet router's health checks honest."""
         return None
+
+    # -- AOT artifact surface (serve.artifact) ----------------------------
+
+    def state_spec(self) -> EngineState:
+        """ShapeDtypeStruct template of init_state()'s pytree, built
+        from config arithmetic alone — no tracing, no allocation.
+        serve.artifact uses it to flatten/unflatten EngineState across
+        the exported flat-argument programs. Paged engines only (the
+        artifact surface; ring configs keep the plain jit path)."""
+        if not self.paged:
+            raise ValueError(
+                "state_spec/engine artifacts support paged engines "
+                "only (attn_window configs keep the jit path)")
+        cfg, s = self.cfg, self.slots
+        policy = default_policy()
+        shape = (self.num_pages, self.page_size, cfg.kv_heads,
+                 cfg.head_dim)
+        if cfg.kv_cache_dtype == "int8":
+            buf = (jax.ShapeDtypeStruct(shape, jnp.int8),
+                   jax.ShapeDtypeStruct(shape[:-1], jnp.float32))
+        else:
+            buf = jax.ShapeDtypeStruct(shape, policy.compute_dtype)
+        sds = jax.ShapeDtypeStruct
+        return EngineState(
+            caches=tuple((buf, buf) for _ in self.params["blocks"]),
+            page_table=sds((s, self.max_pages_per_slot), jnp.int32),
+            pos=sds((s,), jnp.int32),
+            active=sds((s,), jnp.bool_),
+            last_tok=sds((s,), jnp.int32),
+            rng=sds((s,), jax.random.key(0).dtype),
+            temp=sds((s,), jnp.float32),
+            top_k=sds((s,), jnp.int32),
+            top_p=sds((s,), jnp.float32),
+            last_lp=sds((s,), jnp.float32))
+
+    def artifact_manifest(self) -> dict:
+        """Everything an artifact's correctness depends on, as JSON
+        primitives: the exported programs BAKE IN the weights, the
+        config, this engine's rng seed and the pool geometry, so a
+        loader must refuse a bundle whose manifest differs in ANY
+        field (serve.artifact.load_engine_artifact compares every
+        entry and falls back to the jit path on mismatch)."""
+        import hashlib
+
+        if self.select_fn is not None:
+            raise ValueError(
+                "engine artifacts need select_fn=None: a pool-wide "
+                "select_fn is a baked-in Python closure no manifest "
+                "can verify (per-request sampling rides traced "
+                "arrays and is fully supported)")
+        if not self.paged:
+            raise ValueError(
+                "engine artifacts support paged engines only")
+        h = hashlib.sha256()
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.params)[0]:
+            arr = np.asarray(jax.device_get(leaf))
+            h.update(str(path).encode())
+            h.update(str(arr.shape).encode())
+            h.update(str(arr.dtype).encode())
+            h.update(arr.tobytes())
+        policy = default_policy()
+        return {
+            "kind": "engine",
+            "jax_version": jax.__version__,
+            "x64": bool(jax.config.jax_enable_x64),
+            "compute_dtype": str(policy.compute_dtype),
+            "kv_cache_dtype": self.cfg.kv_cache_dtype,
+            "cfg_hash": hashlib.sha256(
+                repr(self.cfg).encode()).hexdigest()[:16],
+            "params_hash": h.hexdigest(),
+            "slots": int(self.slots),
+            "max_len": int(self.max_len),
+            "page_size": int(self.page_size),
+            "num_pages": int(self.num_pages),
+            "max_pages_per_slot": int(self.max_pages_per_slot),
+            "eos_id": None if self.eos_id is None else int(self.eos_id),
+            "seed": int(self.seed),
+            "spec_draft_max": int(self.policy.spec_draft_max),
+        }
+
+    def bind_artifact(self, programs: dict, manifest: dict) -> None:
+        """Install loaded artifact programs (serve.artifact builds
+        the dict — ALREADY manifest-verified against this engine).
+        Subsequent decode/spec/prefill-chunk/micro-setter calls route
+        through them instead of the jit bodies."""
+        self._artifact = dict(programs)
+        self._artifact_manifest = dict(manifest)
+        self.artifact_loads += 1
+
+    def artifact_fallback(self, member: str, error: str) -> None:
+        """Record one artifact->jit fallback (load-time mismatch or a
+        bound program failing at run time): bump the counter the
+        server/router export and notify the observability hook
+        (ServingServer points it at its flight recorder)."""
+        self.artifact_fallbacks += 1
+        if self._artifact_hook is not None:
+            self._artifact_hook(member, error)
+
+    def _art(self, name: str):
+        art = self._artifact
+        return None if art is None else art.get(name)
+
+    def _art_drop(self, name: str, exc: Exception) -> None:
+        # a program that failed once would fail every call — drop the
+        # member so the steady loop doesn't pay an exception per step
+        if self._artifact is not None:
+            self._artifact.pop(name, None)
+        self.artifact_fallback(name, repr(exc))
+
+    # the host-bookkeeping micro-bodies route through the same
+    # dispatch: tiny programs, but they are exactly what init_state
+    # warms — an artifact boot should compile NOTHING
+
+    def _set_pagemap(self, tbl, slot, blk, page):
+        fn = self._art("pagemap")
+        if fn is not None:
+            try:
+                return fn(tbl, slot, blk, page)
+            except Exception as e:
+                self._art_drop("pagemap", e)
+        return self._pagemap_jit(tbl, slot, blk, page)
+
+    def _set_row(self, tbl, slot, row):
+        fn = self._art("rowset")
+        if fn is not None:
+            try:
+                return fn(tbl, slot, row)
+            except Exception as e:
+                self._art_drop("rowset", e)
+        return self._rowset_jit(tbl, slot, row)
+
+    def _retire(self, active, pos, slot, fill):
+        fn = self._art("retire")
+        if fn is not None:
+            try:
+                return fn(active, pos, slot, fill)
+            except Exception as e:
+                self._art_drop("retire", e)
+        return self._retire_jit(active, pos, slot, fill)
 
     # -- state ------------------------------------------------------------
 
@@ -421,12 +573,12 @@ class DecodeEngine:
         # calls on the fresh state, so a first page-boundary crossing
         # or retire mid-serve never compiles inside the steady loop
         z = _staged(0, np.int32)
-        self._retire_jit(active, pos, z,
-                         _staged(self.max_len, np.int32))
+        self._retire(active, pos, z,
+                     _staged(self.max_len, np.int32))
         if self.paged:
-            self._pagemap_jit(page_table, z, z,
+            self._set_pagemap(page_table, z, z,
                               _staged(self.num_pages, np.int32))
-            self._rowset_jit(page_table, z, self._empty_row)
+            self._set_row(page_table, z, self._empty_row)
         return EngineState(
             caches=caches,
             page_table=page_table,
@@ -707,7 +859,7 @@ class DecodeEngine:
                       np.int32)
         row[:len(pages)] = pages
         state = state._replace(
-            page_table=self._rowset_jit(
+            page_table=self._set_row(
                 state.page_table, _staged(slot, np.int32),
                 jnp.asarray(row)))
         return state, PrefillTicket(
@@ -748,16 +900,32 @@ class DecodeEngine:
         toks = ticket.prompt[start:start + width]
         if toks.shape[0] < width:
             toks = np.pad(toks, (0, width - toks.shape[0]))
-        state = self._chunk_jit(
-            state, _staged(ticket.slot, np.int32),
-            jnp.asarray(toks, jnp.int32), _staged(start, np.int32),
-            _staged(ticket.true_len, np.int32),
-            _staged(ticket.temp, np.float32),
-            _staged(ticket.top_k, np.int32),
-            _staged(ticket.top_p, np.float32),
-            _staged_once(ticket.req_tag, np.int32),
-            _staged_once(ticket.req_seed, np.int32),
-            chunk_w=width, from_zero=(start == 0), final=final)
+        from_zero = (start == 0)
+        args = (_staged(ticket.slot, np.int32),
+                jnp.asarray(toks, jnp.int32), _staged(start, np.int32),
+                _staged(ticket.true_len, np.int32),
+                _staged(ticket.temp, np.float32),
+                _staged(ticket.top_k, np.int32),
+                _staged(ticket.top_p, np.float32),
+                _staged_once(ticket.req_tag, np.int32),
+                _staged_once(ticket.req_seed, np.int32))
+        # artifact bundles carry one program per (chunk_w, from_zero,
+        # final) combo actually saved; a width the bundle doesn't
+        # cover (e.g. a prefix-hit remainder) is an EXPECTED miss and
+        # takes the jit body silently — only a bound program FAILING
+        # is a fallback event
+        key = f"chunk_w{width}_z{int(from_zero)}_f{int(final)}"
+        fn = self._art(key)
+        if fn is not None:
+            try:
+                state = fn(state, *args)
+            except Exception as e:
+                self._art_drop(key, e)
+                fn = None
+        if fn is None:
+            state = self._chunk_jit(
+                state, *args,
+                chunk_w=width, from_zero=from_zero, final=final)
         self.pool.prefill_chunks += 1
         ticket.next_start = start + width
         if final:
@@ -910,6 +1078,12 @@ class DecodeEngine:
         token (eos or cache-full) and their slot is free for the next
         prefill — paged callers must still `release_slot` it so the
         HOST pool frees its pages."""
+        fn = self._art("step")
+        if fn is not None:
+            try:
+                return fn(state)
+            except Exception as e:
+                self._art_drop("step", e)
         return self._step_jit(state)
 
     # -- the speculative verify round --------------------------------------
@@ -1040,10 +1214,15 @@ class DecodeEngine:
         (pool.reserve) BEFORE the call, and must settle continuing
         rows with pool.commit(slot, n_emit) after — commit maps the
         next write block and rolls the rejected tail's pages back."""
-        return self._spec_jit(
-            state,
-            jax.device_put(np.asarray(drafts, np.int32)),
-            jax.device_put(np.asarray(draft_len, np.int32)))
+        d = jax.device_put(np.asarray(drafts, np.int32))
+        dl = jax.device_put(np.asarray(draft_len, np.int32))
+        fn = self._art("spec")
+        if fn is not None:
+            try:
+                return fn(state, d, dl)
+            except Exception as e:
+                self._art_drop("spec", e)
+        return self._spec_jit(state, d, dl)
 
     def reserve_spec_pages(self, state: EngineState, slot: int,
                            k: int) -> EngineState:
@@ -1056,7 +1235,7 @@ class DecodeEngine:
         co-tenant for SPECULATIVE work)."""
         for blk, page in self.pool.reserve(slot, k):
             state = state._replace(
-                page_table=self._pagemap_jit(
+                page_table=self._set_pagemap(
                     state.page_table, _staged(slot, np.int32),
                     _staged(blk, np.int32), _staged(page, np.int32)))
         return state
@@ -1074,12 +1253,12 @@ class DecodeEngine:
         added, dropped = self.pool.commit(slot, n_emit)
         for blk, page in added:
             state = state._replace(
-                page_table=self._pagemap_jit(
+                page_table=self._set_pagemap(
                     state.page_table, _staged(slot, np.int32),
                     _staged(blk, np.int32), _staged(page, np.int32)))
         for blk in dropped:
             state = state._replace(
-                page_table=self._pagemap_jit(
+                page_table=self._set_pagemap(
                     state.page_table, _staged(slot, np.int32),
                     _staged(blk, np.int32),
                     _staged(self.num_pages, np.int32)))
@@ -1104,7 +1283,7 @@ class DecodeEngine:
             # page-map update costs no implicit transfer and no
             # compile (transfer-guard regression, tests/test_analysis)
             state = state._replace(
-                page_table=self._pagemap_jit(
+                page_table=self._set_pagemap(
                     state.page_table, _staged(slot, np.int32),
                     _staged(blk, np.int32), _staged(page, np.int32)))
         return state
@@ -1123,10 +1302,10 @@ class DecodeEngine:
         if self.paged and self.pool is not None:
             self.pool.release(slot)
             state = state._replace(
-                page_table=self._rowset_jit(
+                page_table=self._set_row(
                     state.page_table, _staged(slot, np.int32),
                     self._empty_row))
-        active, pos = self._retire_jit(
+        active, pos = self._retire(
             state.active, state.pos, _staged(slot, np.int32),
             _staged(self.max_len, np.int32))
         return state._replace(active=active, pos=pos)

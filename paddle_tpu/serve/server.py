@@ -243,7 +243,8 @@ class ServingServer:
                  tracer: Optional[Tracer] = None,
                  flight: Optional[FlightRecorder] = None,
                  speculative: bool = False,
-                 proposer=None):
+                 proposer=None,
+                 artifact_path: Optional[str] = None):
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         if max_retries < 0:
@@ -311,6 +312,22 @@ class ServingServer:
         self._drain_reason: Optional[str] = None
         self.drain_report: Optional[dict] = None
 
+        # AOT engine artifacts (serve.artifact, docs/SERVING.md "AOT
+        # artifacts & compile cache"): a replica boots from the
+        # bundle when its manifest verifies against THIS engine —
+        # any mismatch (stale weights, different pool geometry,
+        # wrong jax version/backend) degrades to the jit path with
+        # an `artifact_fallbacks` counter and a flight event, never
+        # a failed boot and never a wrong answer.
+        self.artifact_path = artifact_path
+        if self.flight is not None and hasattr(engine,
+                                               "_artifact_hook"):
+            engine._artifact_hook = (
+                lambda member, err: self.flight.record(
+                    "artifact", "fallback", member=member, error=err))
+        if artifact_path:
+            self._load_artifact(artifact_path)
+
         # active backend + its device pool (rebuilt on backend switch)
         self._backend = (native_backend if native_backend is not None
                          else engine)
@@ -326,6 +343,19 @@ class ServingServer:
         self._pool_base: Dict[str, int] = {
             k: 0 for k in _POOL_COUNTER_KEYS}
         self._pool_base["peak_pages_in_use"] = 0
+
+    def _load_artifact(self, path: str) -> None:
+        """Boot-time artifact adoption: verify the bundle's manifest
+        against the engine and bind its programs; ANY failure —
+        mismatch, missing file, corrupt tar — keeps the jit path
+        with the fallback counter + flight event as evidence."""
+        from paddle_tpu.serve.artifact import load_engine_artifact
+        try:
+            programs, manifest = load_engine_artifact(
+                self.engine, path, expect_buckets=self.buckets)
+            self.engine.bind_artifact(programs, manifest)
+        except Exception as e:
+            self.engine.artifact_fallback("load", repr(e))
 
     @property
     def draining(self) -> bool:
@@ -1179,6 +1209,14 @@ class ServingServer:
             "draft_proposed": self.stats.draft_proposed,
             "draft_accepted": self.stats.draft_accepted,
             "acceptance_rate": self.stats.acceptance_rate(),
+            # AOT artifact adoption (per-replica, so the router's
+            # cross-replica sum stays meaningful): loads = bundles
+            # bound at boot, fallbacks = verify/runtime failures
+            # that degraded to the jit path
+            "artifact_loads": getattr(self.engine,
+                                      "artifact_loads", 0),
+            "artifact_fallbacks": getattr(self.engine,
+                                          "artifact_fallbacks", 0),
         }
         out.update(self._pool_base)
         out.setdefault("pages_in_use", 0)

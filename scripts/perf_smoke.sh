@@ -12,11 +12,23 @@
 #     scripts/perf_smoke.sh -k paged    # filter, passes through
 #
 # The bench stage prints one JSON line per metric (tokens/s, pool
-# occupancy, prefix-cache hit rate, speculative speedup) — same
-# format as bench.py, which also runs this stage first, before the
-# chip-liveness gate.
+# occupancy, prefix-cache hit rate, speculative speedup, cold-start
+# seconds per arm) — same format as bench.py, which also runs this
+# stage first, before the chip-liveness gate.
+#
+#     scripts/perf_smoke.sh aot        # cold-start lane only: the AOT
+#                                      # artifact + compile-cache tests
+#                                      # (-m aot) + the cold-start bench
+#                                      # stage (off/cold/warm/artifact)
 set -e
 cd "$(dirname "$0")/.."
+if [ "$1" = "aot" ]; then
+    shift
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m aot \
+        -p no:cacheprovider "$@"
+    env JAX_PLATFORMS=cpu python bench.py --cold-start-only
+    exit 0
+fi
 bench=1
 if [ "$1" = "--no-bench" ]; then
     bench=0
@@ -29,6 +41,10 @@ env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m perf \
 # nothing if either drifts
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
     -m "pallas or speculative" -p no:cacheprovider "$@"
+# cold-start lane: the AOT artifact/compile-cache correctness tests
+# (SERVING.md § AOT artifacts & compile cache)
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m aot \
+    -p no:cacheprovider "$@"
 if [ "$bench" = "1" ]; then
     env JAX_PLATFORMS=cpu python bench.py --serving-only
 fi

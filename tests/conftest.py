@@ -91,6 +91,12 @@ def pytest_configure(config):
         "draft proposer, verify/commit/rollback, greedy parity vs "
         "baseline under transfer_guard) — fast, runs IN tier-1; "
         "`-m speculative` runs it alone")
+    config.addinivalue_line(
+        "markers", "aot: AOT serving-artifact + persistent "
+        "compile-cache suite (engine bundle round-trip parity, "
+        "manifest-mismatch fallback, corrupt-entry miss, subprocess "
+        "cache-warm restart) — fast, runs IN tier-1; `-m aot` (or "
+        "`scripts/perf_smoke.sh aot`) runs it alone")
 
 
 def pytest_runtest_logreport(report):

@@ -198,6 +198,7 @@ class TestRouting:
 
 
 class TestChaosKill:
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_kill_midburst_exactly_once_and_hit_rate_recovers(
             self, params, engines):
         """THE acceptance chaos run (ISSUE 6): >= 3 replicas under a

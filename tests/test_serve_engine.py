@@ -51,6 +51,7 @@ class TestEngineConsistency:
         got = eng.serve([p], max_new=12)
         assert got[0] == ref_tokens(params, p, 12)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_pool_crosstalk_free(self, params):
         """4 requests of different lengths through 2 slots: every
         request must equal its SOLO generate() decode — co-tenants and
@@ -61,6 +62,7 @@ class TestEngineConsistency:
         for p, g in zip(ps, got):
             assert g == ref_tokens(params, p, 10), p
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_eos_frees_slot_and_is_emitted(self, params):
         """Pick an eos that actually occurs early for one prompt; the
         request must end WITH the eos token and its slot must serve the
@@ -96,6 +98,7 @@ class TestEngineConsistency:
                          dataclasses.replace(CFG, kv_cache_dtype="fp4"),
                          slots=2, max_len=16)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_int8_kv_pool_matches_int8_generate(self, params):
         """The int8-KV slot pool must reproduce generate()'s int8-KV
         decode: both quantize the same vectors with the same
@@ -114,6 +117,7 @@ class TestEngineConsistency:
             n_total += len(ref)
         assert agree_total / n_total >= 0.95, (agree_total, n_total)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_gqa_pool(self):
         cfg = dataclasses.replace(CFG, n_kv_heads=2)
         p_ = T.init_params(jax.random.key(5), cfg)
@@ -148,6 +152,7 @@ class TestBuckets:
             eng.serve(prompts_rng(1, [4], seed=9), max_new=0)
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_int8_weights_pool(params):
     """Quantized WEIGHTS through the engine (the generate() streaming
     split: hoisted dequant for prefill, in-body for the step): tokens
@@ -172,6 +177,7 @@ class TestEngineSampling:
             .serve(ps, max_new=6)
         assert greedy == t0
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_sampling_deterministic_per_seed_and_varies(self, params):
         ps = prompts_rng(4, [5, 6, 4, 7], seed=22)
         mk = lambda seed: DecodeEngine(
@@ -200,6 +206,7 @@ class TestPerRequestSampling:
             assert len(got[i]) == 6
             assert all(0 <= t < 61 for t in got[i])
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_reproducible_and_seed_sensitive(self, params):
         ps = prompts_rng(3, [5, 6, 4], seed=32)
         sampling = [{"temperature": 1.0}] * 3
@@ -224,6 +231,7 @@ class TestPerRequestSampling:
                        sampling=[{}])
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_scheduling_efficiency_vs_lockstep(params):
     """The utilization claim, measured chip-independently in STEP
     INVOCATIONS (each step = one fixed-size batch of device work):
@@ -327,6 +335,7 @@ class TestSlidingWindowPool:
         assert len(got[0]) == 18
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_moe_pool_matches_generate():
     """MoE configs through the pool: the shared _block_parts body makes
     the engine's per-request decode match solo generate() (capacity is
@@ -466,6 +475,7 @@ def test_engine_serve_golden():
     assert outs == golden["outputs"], (outs, golden["outputs"])
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_explicit_seed_is_cotenancy_invariant(params):
     """A sampled request with an explicit per-request seed draws from
     its OWN stream: identical tokens whether served alone, in a busy
@@ -487,6 +497,7 @@ def test_explicit_seed_is_cotenancy_invariant(params):
     assert solo == first == last, (solo, first, last)
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_logprobs_match_score(params):
     """serve(return_logprobs=True): each emitted token's logprob must
     equal transformer.score()'s gold log-probability at the same
