@@ -35,7 +35,10 @@ int8 KV pools ride through unchanged: an arena may be an
 `(s8 data, f32 scale)` pair — THE per-(position, kv-head) absmax
 convention (`kv_quantize` below, shared with the dense caches via
 `transformer._kv_quantize`) quantizes at write and dequantizes inside
-the gathered read.
+the gathered read. On the fused kernel path the same dequant runs
+per page block on VMEM scratch as each DMA lands
+(ops.ragged_paged_attention._walk_kernel_int8) — identical element
+math, so both reads stay bit-equal.
 """
 
 from __future__ import annotations
@@ -151,7 +154,8 @@ def page_addresses(pages_row, positions, *, page_size: int):
 
 
 def paged_decode_attention(q, k, v, k_arena, v_arena, page_table, pos,
-                           active, *, page_size: int, max_len: int):
+                           active, *, page_size: int, max_len: int,
+                           impl=None):
     """One decode step for every slot through the page table: write
     each row's single-position K/V at its own (page, offset), gather
     its mapped pages, attend over keys <= pos. The paged counterpart
@@ -160,6 +164,8 @@ def paged_decode_attention(q, k, v, k_arena, v_arena, page_table, pos,
     q/k/v [S, 1, ·, Dh]; page_table [S, max_pages] (sentinel =
     num_pages on unmapped entries); pos [S] absolute write positions
     (out-of-range sentinel on inactive rows); active [S] bool.
+    `impl` forwards to the ragged-read dispatcher (None = auto,
+    "jnp"/"pallas" force — the engine's ragged_impl knob).
     Returns (out [S, 1, H, Dh], k_arena, v_arena)."""
     s = q.shape[0]
     assert q.shape[1] == 1, "decode writes are single-position"
@@ -175,12 +181,12 @@ def paged_decode_attention(q, k, v, k_arena, v_arena, page_table, pos,
     k_arena = write_kv(k_arena, k[:, 0], pg, off)
     v_arena = write_kv(v_arena, v[:, 0], pg, off)
     out = _ragged_read(q, k_arena, v_arena, page_table, pos, active,
-                       page_size=page_size, max_len=max_len)
+                       page_size=page_size, max_len=max_len, impl=impl)
     return out, k_arena, v_arena
 
 
 def paged_chunk_attention(q, k, v, k_arena, v_arena, pages_row, start,
-                          *, page_size: int, max_len: int):
+                          *, page_size: int, max_len: int, impl=None):
     """One prefill CHUNK for one slot: write the chunk's K/V rows at
     positions start..start+C-1 through the slot's page-table row, then
     attend each chunk query over every cached key <= its own absolute
@@ -202,12 +208,13 @@ def paged_chunk_attention(q, k, v, k_arena, v_arena, pages_row, start,
     out = _ragged_read(q, k_arena, v_arena, pages_row[None],
                        jnp.asarray(start, jnp.int32).reshape(1),
                        jnp.ones((1,), bool),
-                       page_size=page_size, max_len=max_len)
+                       page_size=page_size, max_len=max_len, impl=impl)
     return out, k_arena, v_arena
 
 
 def paged_verify_attention(q, k, v, k_arena, v_arena, page_table, pos,
-                           active, *, page_size: int, max_len: int):
+                           active, *, page_size: int, max_len: int,
+                           impl=None):
     """The speculative VERIFY step: write TQ consecutive positions per
     slot starting at its own `pos` (the window = last consumed token +
     the draft), attend every window query over keys <= its absolute
@@ -238,19 +245,20 @@ def paged_verify_attention(q, k, v, k_arena, v_arena, page_table, pos,
     v_arena = write_kv(v_arena, v.reshape((s * tq,) + v.shape[2:]),
                        pg.reshape(-1), off.reshape(-1))
     out = _ragged_read(q, k_arena, v_arena, page_table, pos, active,
-                       page_size=page_size, max_len=max_len)
+                       page_size=page_size, max_len=max_len, impl=impl)
     return out, k_arena, v_arena
 
 
 def _ragged_read(q, k_arena, v_arena, page_table, pos0, active, *,
-                 page_size: int, max_len: int):
+                 page_size: int, max_len: int, impl=None):
     """The shared read+attend tail: dispatch through the fused ragged
     kernel (ops.ragged_paged_attention), whose auto mode returns the
     bit-identical jnp gather everywhere the kernel isn't a win — the
     drop-in upgrade this module's header promised, with nothing above
-    it changing."""
+    it changing. int8 `(s8, scale)` arenas take the dequant-fused
+    kernel under the same auto gate."""
     from paddle_tpu.ops import ragged_paged_attention as _rpa  # cycle
 
     return _rpa.ragged_attention(q, k_arena, v_arena, page_table,
                                  pos0, active, page_size=page_size,
-                                 max_len=max_len)
+                                 max_len=max_len, impl=impl)

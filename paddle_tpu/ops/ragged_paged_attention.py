@@ -20,21 +20,32 @@ TQ=K+1 — same kernel, same math, mixed freely in one batch (pad TQ to
 the batch max; padded queries are computed and ignored, the engine's
 existing bucket discipline).
 
+Int8 `(s8 data, f32 scale)` pair arenas get the SAME one-launch path
+with per-page dequantization fused into the DMA pipeline: each page's
+int8 data block and its scale plane stream to VMEM as independent
+copies, and the moment a block's two copies land it is dequantized in
+place on scratch — `(s8 -> f32) * scale`, the exact element sequence of
+`paged_attention.kv_dequantize` — while LATER blocks' DMAs are still in
+flight. The attend tail then runs over the dequantized scratch,
+identical to the float walk, so quantized pools (half the HBM — ~2x the
+concurrent users per chip) no longer forfeit the fused read.
+
 Parity contract: `ragged_reference` below IS the jnp oracle — the same
-gather + `grouped_masked_attention` the engine has always run — and the
-kernel must match it BIT-FOR-BIT (tests/test_ragged_attention.py, run
-in interpret mode on CPU since the bench chip gate is wedged; the
+gather + `grouped_masked_attention` the engine has always run (its
+int8 branch is the gather+`kv_dequantize` read) — and the kernel must
+match it BIT-FOR-BIT for float AND int8 arenas
+(tests/test_ragged_attention.py, tests/test_ragged_int8.py; run in
+interpret mode on CPU since the bench chip gate is wedged: the
 interpret path executes the same XLA CPU primitives as the oracle, so
 bit-identity is meaningful evidence, not a tolerance check). The jnp
-path stays the default fallback: dispatch picks the kernel only on a
-real TPU backend with a float arena that fits VMEM; int8 `(s8, scale)`
-pair arenas always take the jnp path (a dequant-fused DMA pipeline is
-the follow-up, not this kernel).
+path stays the default fallback off-TPU and whenever the walk (int8
+data + scale planes + dequant scratch included) would overflow VMEM.
 
 Writes are NOT fused: scatters through the page table are cheap
-(`write_kv` is a drop-mode scatter of a few rows), it's the read-side
-materialization that burns the memory system — so callers write first
-with the existing jnp scatter and hand this kernel the read+attend.
+(`write_kv` is a drop-mode scatter of a few rows — it already
+quantizes for int8 arenas), it's the read-side materialization that
+burns the memory system — so callers write first with the existing jnp
+scatter and hand this kernel the read+attend.
 """
 
 from __future__ import annotations
@@ -72,12 +83,24 @@ def _num_key_blocks(page_size: int, max_len: int, max_pages: int) -> int:
 
 
 def fits_vmem(k_arena, page_table, *, page_size: int, max_len: int) -> bool:
-    """True when both per-row page walks fit the VMEM scratch budget."""
-    if isinstance(k_arena, tuple):
-        return False
+    """True when both per-row page walks fit the VMEM scratch budget.
+
+    Float arenas cost one data block per page per side. Int8 `(s8,
+    scale)` pairs cost the s8 data block + the f32 scale plane + the
+    dequantized block (budgeted at f32 — the widest dtype the engine
+    dequantizes to, so the gate can't admit a walk a bf16 engine fits
+    but an f32 one doesn't)."""
     nblk = _num_key_blocks(page_size, max_len, page_table.shape[1])
-    _, page, hkv, dh = k_arena.shape
-    per_walk = nblk * page * hkv * dh * k_arena.dtype.itemsize
+    if isinstance(k_arena, tuple):
+        data, scale = k_arena
+        _, page, hkv, dh = data.shape
+        per_walk = nblk * page * hkv * (
+            dh * data.dtype.itemsize        # s8 arena block
+            + scale.dtype.itemsize          # per-(position, head) scale
+            + dh * 4)                       # dequant scratch (f32 bound)
+    else:
+        _, page, hkv, dh = k_arena.shape
+        per_walk = nblk * page * hkv * dh * k_arena.dtype.itemsize
     return 2 * per_walk <= _VMEM_BUDGET_BYTES
 
 
@@ -89,7 +112,9 @@ def ragged_reference(q, k_arena, v_arena, page_table, pos0, active, *,
     """The gather-then-attend path, ragged-query shaped: exactly what
     `paged_decode_attention` (TQ=1) and `paged_chunk_attention` (R=1)
     have always computed, with the per-row causal bound `pos0 + i`.
-    The kernel's bit-identity target."""
+    The kernel's bit-identity target — for int8 pairs `gather_kv`
+    dequantizes inside the gathered read, same element math as the
+    kernel's fused per-block dequant."""
     del page_size  # addressing is baked into the table; kept for symmetry
     k_read = gather_kv(k_arena, page_table, max_len, q.dtype)
     v_read = gather_kv(v_arena, page_table, max_len, q.dtype)
@@ -103,6 +128,28 @@ def ragged_reference(q, k_arena, v_arena, page_table, pos0, active, *,
 # -- the fused kernel ----------------------------------------------------
 
 
+def _attend_tail(max_len, nblk, r, meta_ref, q_ref, k_scr, v_scr,
+                 out_ref):
+    """THE shared attend tail over a row's VMEM scratch walk: flatten
+    the blocks to the oracle's key axis (table order = position order,
+    statically sliced to max_len) and run the shared attention body
+    with the per-row causal/active mask."""
+    q = q_ref[...]                                     # [1, TQ, H, Dh]
+    tq = q.shape[1]
+    page_size, hkv, dh = k_scr.shape[1], k_scr.shape[2], k_scr.shape[3]
+    k_read = k_scr[...].reshape(1, nblk * page_size, hkv,
+                                dh)[:, :max_len].astype(q.dtype)
+    v_read = v_scr[...].reshape(1, nblk * page_size, hkv,
+                                dh)[:, :max_len].astype(q.dtype)
+    pos0 = meta_ref[r, 0]
+    act = meta_ref[r, 1] > 0
+    ap = pos0 + jnp.arange(tq, dtype=jnp.int32)
+    valid = (jnp.arange(max_len, dtype=jnp.int32)[None, :]
+             <= ap[:, None]) & act
+    out_ref[...] = grouped_masked_attention(q, k_read, v_read,
+                                            valid[None, None])
+
+
 def _walk_kernel(page_size, max_len, nblk,
                  pt_ref, meta_ref, q_ref, k_hbm, v_hbm, out_ref,
                  k_scr, v_scr, sems):
@@ -110,6 +157,7 @@ def _walk_kernel(page_size, max_len, nblk,
     (every block copy in flight before the first wait — the copies are
     independent, so the walk overlaps itself), then run THE shared
     attention body over the scratch."""
+    del page_size
     r = pl.program_id(0)
     num_pages = k_hbm.shape[0]
 
@@ -133,52 +181,106 @@ def _walk_kernel(page_size, max_len, nblk,
 
     jax.lax.fori_loop(0, nblk, start, 0)
     jax.lax.fori_loop(0, nblk, wait, 0)
+    _attend_tail(max_len, nblk, r, meta_ref, q_ref, k_scr, v_scr,
+                 out_ref)
 
-    q = q_ref[...]                                     # [1, TQ, H, Dh]
-    tq = q.shape[1]
-    hkv, dh = k_scr.shape[2], k_scr.shape[3]
-    # flatten the walk to the oracle's key axis: table order = position
-    # order, statically sliced to max_len
-    k_read = k_scr[...].reshape(1, nblk * page_size, hkv,
-                                dh)[:, :max_len].astype(q.dtype)
-    v_read = v_scr[...].reshape(1, nblk * page_size, hkv,
-                                dh)[:, :max_len].astype(q.dtype)
-    pos0 = meta_ref[r, 0]
-    act = meta_ref[r, 1] > 0
-    ap = pos0 + jnp.arange(tq, dtype=jnp.int32)
-    valid = (jnp.arange(max_len, dtype=jnp.int32)[None, :]
-             <= ap[:, None]) & act
-    out_ref[...] = grouped_masked_attention(q, k_read, v_read,
-                                            valid[None, None])
+
+def _walk_kernel_int8(page_size, max_len, nblk,
+                      pt_ref, meta_ref, q_ref,
+                      kd_hbm, ks_hbm, vd_hbm, vs_hbm, out_ref,
+                      kd_scr, ks_scr, vd_scr, vs_scr,
+                      kf_scr, vf_scr, sems):
+    """The int8 walk: four independent copy streams per block (K data,
+    K scale, V data, V scale — semaphore lanes 0..3), all in flight
+    before the first wait. Dequantization is FUSED into the pipeline:
+    the moment block b's K copies land it is dequantized onto the
+    q-dtype scratch — `(s8 -> f32) * scale`, the exact
+    `paged_attention.kv_dequantize` element sequence, which is what
+    makes the oracle bit-identity hold — while blocks b+1.. are still
+    streaming. The attend tail then reads the dequantized scratch,
+    identical to the float walk."""
+    del page_size
+    r = pl.program_id(0)
+    num_pages = kd_hbm.shape[0]
+    srcs = (kd_hbm, ks_hbm, vd_hbm, vs_hbm)
+    dsts = (kd_scr, ks_scr, vd_scr, vs_scr)
+
+    def copy(b, which):
+        pg = jnp.minimum(pt_ref[r, b], num_pages - 1)
+        return pltpu.make_async_copy(srcs[which].at[pg],
+                                     dsts[which].at[b],
+                                     sems.at[b, which])
+
+    # nblk is static: Python loops unroll so the per-block dequant
+    # below can index scratch statically
+    for b in range(nblk):
+        for which in range(4):
+            copy(b, which).start()
+    dtype = q_ref.dtype
+    for b in range(nblk):
+        copy(b, 0).wait()
+        copy(b, 1).wait()
+        kf_scr[b] = (kd_scr[b].astype(jnp.float32)
+                     * ks_scr[b][..., None]).astype(dtype)
+        copy(b, 2).wait()
+        copy(b, 3).wait()
+        vf_scr[b] = (vd_scr[b].astype(jnp.float32)
+                     * vs_scr[b][..., None]).astype(dtype)
+    _attend_tail(max_len, nblk, r, meta_ref, q_ref, kf_scr, vf_scr,
+                 out_ref)
 
 
 def ragged_pallas(q, k_arena, v_arena, page_table, pos0, active, *,
                   page_size: int, max_len: int, interpret=None):
     """The fused launch. interpret=None follows the repo's Pallas idiom
-    (interpret everywhere except a real TPU backend); float arenas
-    only — dispatch through `ragged_attention` for the general case."""
+    (interpret everywhere except a real TPU backend). Accepts float
+    arenas AND int8 `(s8, scale)` pairs — dispatch through
+    `ragged_attention` for the general case."""
     if not PALLAS_AVAILABLE:  # pragma: no cover
         raise RuntimeError("pallas is unavailable on this build; "
                            "use ragged_attention (jnp fallback)")
-    if isinstance(k_arena, tuple):
-        raise ValueError("int8 (s8, scale) arenas take the jnp path; "
-                         "dispatch through ragged_attention")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     r, tq, h, dh = q.shape
-    _, page, hkv, _ = k_arena.shape
+    quantized = isinstance(k_arena, tuple)
+    k_data = k_arena[0] if quantized else k_arena
+    _, page, hkv, _ = k_data.shape
     assert page == page_size, (page, page_size)
     nblk = _num_key_blocks(page_size, max_len, page_table.shape[1])
     meta = jnp.stack([pos0.astype(jnp.int32),
                       active.astype(jnp.int32)], axis=1)
+    q_spec = pl.BlockSpec((1, tq, h, dh), lambda i, pt, mt: (i, 0, 0, 0))
+    hbm = pl.BlockSpec(memory_space=pltpu.ANY)
+    if quantized:
+        (kd, ks), (vd, vs) = k_arena, v_arena
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(r,),
+            in_specs=[q_spec, hbm, hbm, hbm, hbm],
+            out_specs=pl.BlockSpec((1, tq, h, dh),
+                                   lambda i, pt, mt: (i, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((nblk, page_size, hkv, dh), kd.dtype),
+                pltpu.VMEM((nblk, page_size, hkv), ks.dtype),
+                pltpu.VMEM((nblk, page_size, hkv, dh), vd.dtype),
+                pltpu.VMEM((nblk, page_size, hkv), vs.dtype),
+                pltpu.VMEM((nblk, page_size, hkv, dh), q.dtype),
+                pltpu.VMEM((nblk, page_size, hkv, dh), q.dtype),
+                pltpu.SemaphoreType.DMA((nblk, 4)),
+            ],
+        )
+        kernel = functools.partial(_walk_kernel_int8, page_size,
+                                   max_len, nblk)
+        return pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct((r, tq, h, dh), q.dtype),
+            interpret=interpret,
+        )(page_table.astype(jnp.int32), meta, q, kd, ks, vd, vs)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(r,),
-        in_specs=[
-            pl.BlockSpec((1, tq, h, dh), lambda i, pt, mt: (i, 0, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),   # K arena stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),   # V arena stays in HBM
-        ],
+        in_specs=[q_spec, hbm, hbm],
         out_specs=pl.BlockSpec((1, tq, h, dh),
                                lambda i, pt, mt: (i, 0, 0, 0)),
         scratch_shapes=[
@@ -199,13 +301,15 @@ def ragged_pallas(q, k_arena, v_arena, page_table, pos0, active, *,
 def ragged_attention(q, k_arena, v_arena, page_table, pos0, active, *,
                      page_size: int, max_len: int, impl=None):
     """Dispatch: impl in {None, "jnp", "pallas"}. None auto-selects the
-    kernel only where it genuinely wins — a real TPU backend, a float
-    arena, and a walk that fits VMEM — and the jnp oracle everywhere
-    else, so CPU tier-1 and int8 pools are byte-for-byte unchanged.
-    impl="pallas" forces the kernel (interpret mode off-TPU — the
-    parity suite's lever); int8 arenas fall back to jnp even then."""
-    if isinstance(k_arena, tuple) or impl == "jnp":
-        impl = "jnp"
+    kernel only where it genuinely wins — a real TPU backend and a
+    walk that fits VMEM (float arenas and int8 `(s8, scale)` pairs
+    alike; the int8 gate budgets data + scale planes + dequant
+    scratch) — and the jnp oracle everywhere else, so CPU tier-1 is
+    byte-for-byte unchanged. impl="pallas" forces the kernel
+    (interpret mode off-TPU — the parity suite's and the int8 serving
+    parity tests' lever); impl="jnp" forces the oracle."""
+    if impl == "jnp":
+        pass
     elif impl is None:
         on_tpu = PALLAS_AVAILABLE and jax.default_backend() == "tpu"
         impl = "pallas" if on_tpu and fits_vmem(

@@ -13,6 +13,16 @@ from paddle_tpu.parallel.train_step import (
     train_state_shardings,
 )
 from paddle_tpu.parallel import collectives
+from paddle_tpu.parallel import blocked_matmul
+from paddle_tpu.parallel.blocked_matmul import (
+    blocked_matmul as make_blocked_matmul,
+    collective_matmul,
+    matmul_reference,
+    ring_matmul_gather,
+    ring_matmul_reduce,
+    stream_matmul,
+    tp_dense,
+)
 # NB: the bare in-shard_map `ring_attention` fn stays on the submodule —
 # re-exporting it here would shadow the `parallel.ring_attention` module.
 from paddle_tpu.parallel.ring_attention import (

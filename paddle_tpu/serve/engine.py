@@ -261,7 +261,8 @@ class DecodeEngine:
                  prefill_chunk: Optional[int] = None,
                  prefix_cache: bool = True,
                  prefix_cache_blocks: int = 512,
-                 policy: Optional[SchedulerPolicy] = None):
+                 policy: Optional[SchedulerPolicy] = None,
+                 ragged_impl: Optional[str] = None):
         """Pool geometry: full-attention configs hold a block-paged KV
         pool of `num_pages` pages of `page_size` positions per layer
         (default num_pages = slots * ceil(max_len / page_size) — the
@@ -280,7 +281,20 @@ class DecodeEngine:
         the default) — or a pool-wide select_fn(logits [B, V], rng)
         -> [B] override applied to every request (mutually exclusive
         with per-request sampling). Draws are reproducible per (seed,
-        admission order)."""
+        admission order).
+
+        `ragged_impl` pins the paged read path every jitted body
+        traces: None (default) lets ops.ragged_paged_attention
+        auto-select (fused kernel on TPU where the walk fits VMEM —
+        float and int8 arenas alike — jnp gather elsewhere);
+        "pallas"/"jnp" force one side everywhere, which is how the
+        serving-parity suites drive the kernel in interpret mode on
+        CPU. Baked into every traced program, so it is an artifact
+        manifest field."""
+        if ragged_impl not in (None, "jnp", "pallas"):
+            raise ValueError(
+                f"ragged_impl must be None|jnp|pallas, got "
+                f"{ragged_impl!r}")
         if cfg.kv_cache_dtype not in ("compute", "int8"):
             raise ValueError(
                 f"kv_cache_dtype must be compute|int8, got "
@@ -312,6 +326,7 @@ class DecodeEngine:
         self.eos_id = eos_id
         self.select_fn = select_fn
         self.seed = seed
+        self.ragged_impl = ragged_impl
         self.paged = cfg.attn_window is None
         self.page_size = page_size
         self.max_pages_per_slot = -(-max_len // page_size)
@@ -447,6 +462,10 @@ class DecodeEngine:
             "eos_id": None if self.eos_id is None else int(self.eos_id),
             "seed": int(self.seed),
             "spec_draft_max": int(self.policy.spec_draft_max),
+            # the traced read path: a bundle exported with the kernel
+            # must not be trusted by a jnp-pinned engine (or vice
+            # versa) — same program-identity rule as the dtypes
+            "ragged_impl": self.ragged_impl or "auto",
         }
 
     def bind_artifact(self, programs: dict, manifest: dict) -> None:
@@ -740,7 +759,8 @@ class DecodeEngine:
                 def attn_fn(q, k, v, k_buf=k_buf, v_buf=v_buf):
                     out, k2, v2 = pa.paged_chunk_attention(
                         q, k, v, k_buf, v_buf, pages_row, start,
-                        page_size=self.page_size, max_len=self.max_len)
+                        page_size=self.page_size, max_len=self.max_len,
+                        impl=self.ragged_impl)
                     new_caches.append((k2, v2))
                     return out
 
@@ -1000,7 +1020,8 @@ class DecodeEngine:
                     out, k2, v2 = pa.paged_decode_attention(
                         q, k, v, k_buf, v_buf, state.page_table,
                         state.pos, state.active,
-                        page_size=self.page_size, max_len=L)
+                        page_size=self.page_size, max_len=L,
+                        impl=self.ragged_impl)
                     new_caches.append((k2, v2))
                     return out
 
@@ -1117,7 +1138,8 @@ class DecodeEngine:
                 out, k2, v2 = pa.paged_verify_attention(
                     q, kk, vv, k_buf, v_buf, state.page_table,
                     state.pos, state.active,
-                    page_size=self.page_size, max_len=L)
+                    page_size=self.page_size, max_len=L,
+                    impl=self.ragged_impl)
                 new_caches.append((k2, v2))
                 return out
 

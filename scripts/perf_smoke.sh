@@ -20,6 +20,14 @@
 #                                      # artifact + compile-cache tests
 #                                      # (-m aot) + the cold-start bench
 #                                      # stage (off/cold/warm/artifact)
+#     scripts/perf_smoke.sh kernels    # kernel-portfolio lane only:
+#                                      # the pallas parity suites (incl.
+#                                      # the int8 dequant-fused walk) +
+#                                      # the sharded-matmul primitives
+#                                      # (-m kernels) + the kernels
+#                                      # bench stage (int8-vs-float
+#                                      # admit A/B, overlap-vs-naive
+#                                      # matmul step times)
 set -e
 cd "$(dirname "$0")/.."
 if [ "$1" = "aot" ]; then
@@ -29,6 +37,13 @@ if [ "$1" = "aot" ]; then
     env JAX_PLATFORMS=cpu python bench.py --cold-start-only
     exit 0
 fi
+if [ "$1" = "kernels" ]; then
+    shift
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m "pallas or kernels" -p no:cacheprovider "$@"
+    env JAX_PLATFORMS=cpu python bench.py --kernels-only
+    exit 0
+fi
 bench=1
 if [ "$1" = "--no-bench" ]; then
     bench=0
@@ -36,11 +51,12 @@ if [ "$1" = "--no-bench" ]; then
 fi
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m perf \
     -p no:cacheprovider "$@"
-# pallas lane: kernel-vs-oracle bit-identity and speculative greedy
-# parity are perf-critical correctness gates — the bench numbers mean
-# nothing if either drifts
+# pallas + kernels lane: kernel-vs-oracle bit-identity (float AND the
+# int8 dequant-fused walk), sharded-matmul-vs-oracle parity, and
+# speculative greedy parity are perf-critical correctness gates — the
+# bench numbers mean nothing if any drifts
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
-    -m "pallas or speculative" -p no:cacheprovider "$@"
+    -m "pallas or kernels or speculative" -p no:cacheprovider "$@"
 # cold-start lane: the AOT artifact/compile-cache correctness tests
 # (SERVING.md § AOT artifacts & compile cache)
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m aot \

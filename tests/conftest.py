@@ -87,6 +87,13 @@ def pytest_configure(config):
         "sweeps are additionally marked slow; `-m pallas` (or "
         "`scripts/perf_smoke.sh pallas`) runs the lane alone")
     config.addinivalue_line(
+        "markers", "kernels: sharded-matmul primitive suite "
+        "(parallel.blocked_matmul ring/stream forms vs the jnp oracle "
+        "across shard counts, pipeline tensor-parallel opt-in parity) "
+        "— fast cases run IN tier-1; `-m kernels` (or "
+        "`scripts/perf_smoke.sh kernels`, which adds the pallas lane "
+        "and `bench.py --kernels-only`) runs the lane alone")
+    config.addinivalue_line(
         "markers", "speculative: speculative-decoding suite (n-gram "
         "draft proposer, verify/commit/rollback, greedy parity vs "
         "baseline under transfer_guard) — fast, runs IN tier-1; "
@@ -145,6 +152,26 @@ def pytest_sessionfinish(session, exitstatus):
     if limit is not None and any(d > limit
                                  for _, d in _budget_records):
         session.exitstatus = 1
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _hermetic_compile_cache(tmp_path_factory):
+    """Point the CLI's default persistent compile cache at a per-
+    session tmp dir. In-process `cli.main(["serve"/"train"/"infer",
+    ...])` calls (test_cli, test_serve_server, test_router) enable the
+    cache PROCESS-GLOBALLY at DEFAULT_COMPILE_CACHE — the user-global
+    ~/.cache/paddle_tpu/xla — and every later jit in the pytest
+    process then reads whatever entries previous runs on the box left
+    there. A stale entry deserializes into a wrong executable
+    SILENTLY (observed: the HostOffloadEmbedding host-scatter update
+    becoming a no-op whenever a CLI serve test ran first — a
+    wrong-ANSWER ordering flake, not a crash). Tests must never read
+    or write the operator's real cache; the default-enabled code path
+    itself stays exercised against the fresh dir."""
+    from paddle_tpu import cli
+
+    cli.DEFAULT_COMPILE_CACHE = str(tmp_path_factory.mktemp("xla-cache"))
+    yield
 
 
 @pytest.fixture

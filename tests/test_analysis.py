@@ -612,6 +612,38 @@ class TestDecodeLoopSteadyState:
                 jax.device_get((toks, lps, was, fin))  # explicit: ok
         assert g.compiles == 0
 
+    def test_int8_kernel_dispatch_adds_zero_compiles(self):
+        """ISSUE 12 acceptance: routing an int8 pool through the
+        ragged dispatcher (`ragged_impl` pinned to the kernel) must
+        add ZERO steady-state compiles — the dequant-fused walk is
+        baked into the one decode program at warmup, same as the jnp
+        gather it replaced, and page-boundary churn must not re-trace
+        the tuple-arena plumbing."""
+        from paddle_tpu.models import transformer as T
+        from paddle_tpu.serve.engine import DecodeEngine
+
+        cfg = T.TransformerConfig(vocab=31, dim=16, n_layers=1,
+                                  n_heads=2, attn_impl="dense",
+                                  kv_cache_dtype="int8")
+        params = T.init_params(jax.random.key(0), cfg)
+        eng = DecodeEngine(params, cfg, slots=2, max_len=16,
+                           page_size=4, ragged_impl="pallas")
+        state = eng.init_state()
+        r = np.random.RandomState(0)
+        state = eng.prefill(
+            state, 0, r.randint(0, 31, (3,)).astype(np.int32))
+        with RecompileGuard(max_compiles=64, name="int8 warmup") as warm:
+            state, *_ = eng.decode_step(state)
+            state = eng.ensure_decode_page(state, 0)
+        assert warm.compiles >= 1
+        with steady_state("int8 kernel decode loop",
+                          transfers="disallow") as g:
+            for _ in range(4):
+                state, toks, lps, was, fin = eng.decode_step(state)
+                state = eng.ensure_decode_page(state, 0)
+                jax.device_get((toks, lps, was, fin))
+        assert g.compiles == 0
+
     def test_full_serve_is_transfer_clean(self):
         """`serve --transfer-guard`'s contract: the WHOLE serve path —
         pool init (explicit device_put staging), admission, decode,

@@ -196,7 +196,12 @@ class TestDispatchAndIntegration:
         # auto off-TPU must be the EAGER jnp path, byte-for-byte
         np.testing.assert_array_equal(np.asarray(auto), np.asarray(ref))
 
-    def test_int8_arena_falls_back_to_jnp(self, np_rng):
+    def test_int8_arena_dispatches_through_kernel(self, np_rng):
+        """int8 `(s8, scale)` pair arenas no longer exclude the kernel:
+        forced pallas runs the dequant-fused walk and must match the
+        jnp dequant-gather oracle bit-for-bit (the deep parity zoo
+        lives in tests/test_ragged_int8.py; this pins the DISPATCH
+        contract flip from the pre-fusion fallback behaviour)."""
         ka, va = _arena(np_rng, 6)
         ka8 = PA.kv_quantize(ka)
         va8 = PA.kv_quantize(va)
@@ -206,14 +211,15 @@ class TestDispatchAndIntegration:
         pos0 = jnp.asarray([2, 7], jnp.int32)
         active = jnp.ones((2,), bool)
         kw = dict(page_size=PAGE, max_len=9)
-        forced = RPA.ragged_attention(q, ka8, va8, pt, pos0, active,
-                                      impl="pallas", **kw)
-        ref = RPA.ragged_reference(q, ka8, va8, pt, pos0, active, **kw)
+        forced = _jit(RPA.ragged_attention, impl="pallas", **kw)(
+            q, ka8, va8, pt, pos0, active)
+        ref = _jit(RPA.ragged_reference, **kw)(q, ka8, va8, pt, pos0,
+                                               active)
         np.testing.assert_array_equal(np.asarray(forced),
                                       np.asarray(ref))
-        assert not RPA.fits_vmem(ka8, pt, page_size=PAGE, max_len=9)
-        with pytest.raises(ValueError):
-            RPA.ragged_pallas(q, ka8, va8, pt, pos0, active, **kw)
+        # a small int8 walk fits VMEM (data + scale planes + dequant
+        # scratch all accounted) — auto-dispatch on TPU would fuse it
+        assert RPA.fits_vmem(ka8, pt, page_size=PAGE, max_len=9)
 
     def test_fits_vmem_gate(self, np_rng):
         ka, _ = _arena(np_rng, 6)
