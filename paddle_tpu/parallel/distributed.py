@@ -72,6 +72,7 @@ def initialize(coordinator_address: Optional[str] = None,
         raise RuntimeError(
             "distributed.initialize(coordinator_address=...) must be the "
             "first jax-touching call in the process")
+    _enable_cpu_collectives()
     if auto:
         try:
             jax.distributed.initialize()
@@ -86,6 +87,30 @@ def initialize(coordinator_address: Optional[str] = None,
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
     _initialized = True
+
+
+def _enable_cpu_collectives() -> None:
+    """When the job is pinned to the CPU backend (scripts/cpu_guard, CI
+    gangs), XLA:CPU refuses multi-process computations unless a
+    cross-process collectives transport is configured — the default is
+    none, and every collective then dies with INVALID_ARGUMENT
+    "Multiprocess computations aren't implemented on the CPU backend".
+    Selecting jax's bundled gloo TCP transport before the coordinator
+    handshake makes CPU gangs first-class. TPU/GPU paths are untouched
+    (their collectives ride ICI/DCN/NCCL and ignore this flag)."""
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    try:
+        cfg = jax.config.jax_platforms  # set by scripts/cpu_guard
+    except AttributeError:
+        cfg = None
+    if "cpu" not in (cfg or platforms or ""):
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        logging.getLogger(__name__).warning(
+            "could not enable gloo CPU collectives; multi-process CPU "
+            "collectives will fail", exc_info=True)
 
 
 def process_count() -> int:

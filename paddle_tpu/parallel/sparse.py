@@ -322,8 +322,16 @@ class ShardedEmbedding:
         self.init_scale = init_scale
 
     def init(self, rng):
+        # Draw over the REAL vocab, then zero-pad to the sharded shape:
+        # jax.random draws are shape-dependent, so sampling the padded
+        # shape directly would give every row different init values on
+        # every mesh-axis size (an n-way table would not reproduce the
+        # single-device run even bit-near). Pad rows are unreachable —
+        # ids are < vocab, so no lookup reads them and no grad push
+        # touches them — making zeros semantically inert.
         table = jax.random.normal(
-            rng, (self.padded_vocab, self.dim), jnp.float32) * self.init_scale
+            rng, (self.vocab, self.dim), jnp.float32) * self.init_scale
+        table = jnp.pad(table, ((0, self.padded_vocab - self.vocab), (0, 0)))
         return shard_rows(table, self.mesh, self.axis)
 
     def lookup(self, table, ids):

@@ -240,6 +240,26 @@ class PrefillTicket:
     windowed: bool = False      # ring pool: one-shot legacy prefill
 
 
+@dataclass
+class DecodeSeed:
+    """Host-side snapshot of one slot's per-row decode state, taken by
+    `pause_slot` at the prefill-complete seam and replayed by
+    `resume_slot` on the migration destination. Everything the jitted
+    step reads per row EXCEPT the KV blocks (those ride the page
+    export): carrying last_tok/last_lp means the destination's first
+    decode step emits exactly the token the source's would have — the
+    bit-exact handoff contract — and carrying the raw rng key data
+    keeps a sampled request's stream identical across the move."""
+
+    pos: int
+    last_tok: int
+    last_lp: float
+    temp: float
+    top_k: int
+    top_p: float
+    rng_key_data: np.ndarray     # raw per-slot key bits (wrap on import)
+
+
 class DecodeEngine:
     """The EXECUTOR half of the serving stack (the policy half is
     `serve.policy.SchedulerPolicy` — see its docstring for the split):
@@ -363,6 +383,18 @@ class DecodeEngine:
         self._retire_jit = jax.jit(
             lambda active, pos, slot, fill: (
                 active.at[slot].set(False), pos.at[slot].set(fill)))
+        # KV-block migration bodies (disaggregated prefill/decode).
+        # Static [max_pages_per_slot] page-id vectors keep each body at
+        # ONE compile regardless of how many blocks a request maps:
+        # export gathers with mode="clip" (host slices the real count),
+        # import scatters with mode="drop" (sentinel ids — padding and
+        # shared blocks alike — vanish). Compiled lazily at the first
+        # migration; every later transfer reuses them, which is what
+        # the RecompileGuard chaos test pins down.
+        self._pause_jit = jax.jit(self._pause_impl)
+        self._kvread_jit = jax.jit(self._kvread_impl)
+        self._kvwrite_jit = jax.jit(self._kvwrite_impl)
+        self._resume_jit = jax.jit(self._resume_impl)
         # AOT artifact surface (serve.artifact): `bind_artifact`
         # installs pre-exported programs that replace the jitted
         # bodies call-for-call — a fleet restart then skips
@@ -1331,6 +1363,226 @@ class DecodeEngine:
             state.active, state.pos, _staged(slot, np.int32),
             _staged(self.max_len, np.int32))
         return state._replace(active=active, pos=pos)
+
+    # -- KV-block migration (disaggregated prefill/decode) -----------------
+
+    def _pause_impl(self, state: EngineState, slot, fill):
+        """Read one slot's per-row decode state and PARK the row in a
+        single launch: active False + pos on the drop sentinel, so the
+        pool's decode/spec steps skip it (writes drop, reads masked)
+        while the host still owns its pages for the transfer window."""
+        row = lambda a: a[slot]
+        vals = (row(state.pos), row(state.last_tok), row(state.last_lp),
+                row(state.temp), row(state.top_k), row(state.top_p),
+                jax.random.key_data(state.rng)[slot])
+        return (vals, state.active.at[slot].set(False),
+                state.pos.at[slot].set(fill))
+
+    def _kvread_impl(self, state: EngineState, pages):
+        """Gather `pages` (padded [max_pages_per_slot] int32, clip on
+        the pad tail) from every layer's arenas: per layer ((k, v)) —
+        int8 arenas yield (data, scale) pairs, exported verbatim so the
+        destination receives bit-identical quantized content."""
+        def g(buf):
+            if isinstance(buf, tuple):
+                return tuple(jnp.take(b, pages, axis=0, mode="clip")
+                             for b in buf)
+            return jnp.take(buf, pages, axis=0, mode="clip")
+
+        return tuple((g(k_buf), g(v_buf))
+                     for k_buf, v_buf in state.caches)
+
+    def _kvwrite_impl(self, state: EngineState, pages, data):
+        """Scatter exported block contents into this pool's arenas at
+        `pages` (padded [max_pages_per_slot] int32; sentinel entries —
+        the pad tail AND blocks satisfied by the local prefix cache —
+        drop, so shared pages are never written)."""
+        def s(buf, new):
+            if isinstance(buf, tuple):
+                return tuple(b.at[pages].set(n, mode="drop")
+                             for b, n in zip(buf, new))
+            return buf.at[pages].set(new.astype(buf.dtype), mode="drop")
+
+        caches = tuple((s(k_buf, dk), s(v_buf, dv))
+                       for (k_buf, v_buf), (dk, dv)
+                       in zip(state.caches, data))
+        return state._replace(caches=caches)
+
+    def _resume_impl(self, state: EngineState, slot, pos, tok, lp,
+                     temp, top_k, top_p, key_data):
+        """Install a migrated slot's decode state: the row goes live
+        with exactly the source's pos/last_tok/last_lp/sampler params
+        and rng stream (wrap_key_data of the exported key bits)."""
+        return state._replace(
+            pos=state.pos.at[slot].set(pos),
+            active=state.active.at[slot].set(True),
+            last_tok=state.last_tok.at[slot].set(tok),
+            rng=state.rng.at[slot].set(
+                jax.random.wrap_key_data(key_data)),
+            temp=state.temp.at[slot].set(temp),
+            top_k=state.top_k.at[slot].set(top_k),
+            top_p=state.top_p.at[slot].set(top_p),
+            last_lp=state.last_lp.at[slot].set(lp))
+
+    def _padded_pages(self, pages, start_block: int = 0) -> np.ndarray:
+        """[max_pages_per_slot] int32 page-id vector: `pages` in block
+        order with entries before `start_block` and past len(pages)
+        replaced by the drop/clip sentinel."""
+        row = np.full((self.max_pages_per_slot,), self.num_pages,
+                      np.int32)
+        row[start_block:len(pages)] = pages[start_block:]
+        return row
+
+    def pause_slot(self, state: EngineState, slot: int):
+        """Pause one ACTIVE slot at the prefill-complete seam (the
+        disaggregation handoff point): snapshot its per-row decode
+        state to the host and park the device row, leaving its pages
+        mapped in the pool and the page table untouched. Returns
+        (state, DecodeSeed). The slot decodes nothing while parked;
+        `resume_slot` (here after a cancelled handoff, or on the
+        migration destination) continues bit-exactly where the row
+        stopped. Paged engines only."""
+        fn, out = self._art("pause"), None
+        args = (_staged(slot, np.int32),
+                _staged(self.max_len, np.int32))
+        if fn is not None:
+            try:
+                out = fn(state, *args)
+            except Exception as e:
+                self._art_drop("pause", e)
+        if out is None:
+            out = self._pause_jit(state, *args)
+        vals, active, pos = out
+        vals = jax.device_get(vals)
+        seed = DecodeSeed(
+            pos=int(vals[0]), last_tok=int(vals[1]),
+            last_lp=float(vals[2]), temp=float(vals[3]),
+            top_k=int(vals[4]), top_p=float(vals[5]),
+            rng_key_data=np.asarray(vals[6]))
+        return state._replace(active=active, pos=pos), seed
+
+    def export_slot_kv(self, state: EngineState, pages) -> list:
+        """Read the arena contents of `pages` (one slot's mapped
+        blocks, in block order) to the host: per layer (k, v), each an
+        ndarray [n_pages, page_size, Hkv, Dh] — or an (int8 data,
+        scale) pair under kv_cache_dtype="int8", exported verbatim.
+        The caller holds the pages (slot mapping or a pool export pin)
+        for the duration, so the ids cannot be recycled under us."""
+        padded = jnp.asarray(self._padded_pages(pages))
+        fn, out = self._art("kvread"), None
+        if fn is not None:
+            try:
+                out = fn(state, padded)
+            except Exception as e:
+                self._art_drop("kvread", e)
+        if out is None:
+            out = self._kvread_jit(state, padded)
+        n = len(pages)
+        sl = lambda a: np.asarray(a)[:n]
+
+        def host(buf):
+            if isinstance(buf, tuple):
+                return tuple(sl(b) for b in buf)
+            return sl(buf)
+
+        out = jax.device_get(out)
+        return [(host(k), host(v)) for k, v in out]
+
+    def import_slot_kv(self, state: EngineState, slot: int, pages,
+                       start_block: int, kv) -> EngineState:
+        """Write exported block contents into this pool's arenas for a
+        freshly `import_blocks`-mapped slot, and push the slot's full
+        page-table row. Blocks before `start_block` were satisfied by
+        the LOCAL prefix cache (their pages are shared, read-only —
+        the inbound copy is redundant) and are skipped via the scatter
+        sentinel. `kv` is `export_slot_kv`'s output from the source;
+        geometry must match this engine (asserted)."""
+        if len(kv) != len(state.caches):
+            raise ValueError(
+                f"migrated KV has {len(kv)} layers, engine has "
+                f"{len(state.caches)}")
+        pad_rows = self._padded_pages(pages, start_block)
+        arena_shape = (self.max_pages_per_slot, self.page_size,
+                       self.cfg.kv_heads, self.cfg.head_dim)
+
+        def pad(buf):
+            if isinstance(buf, tuple):
+                return tuple(self._pad_blocks(b) for b in buf)
+            return self._pad_blocks(buf)
+
+        data = []
+        for k, v in kv:
+            first = k[0] if isinstance(k, tuple) else k
+            if tuple(first.shape[1:]) != arena_shape[1:]:
+                raise ValueError(
+                    f"migrated KV block shape {first.shape[1:]} does "
+                    f"not match arena {arena_shape[1:]}")
+            data.append((pad(k), pad(v)))
+        data = jax.device_put(tuple(data))
+        padded = jnp.asarray(pad_rows)
+        fn, out = self._art("kvwrite"), None
+        if fn is not None:
+            try:
+                out = fn(state, padded, data)
+            except Exception as e:
+                self._art_drop("kvwrite", e)
+        if out is None:
+            out = self._kvwrite_jit(state, padded, data)
+        state = out
+        row = np.full((self.max_pages_per_slot,), self.num_pages,
+                      np.int32)
+        row[:len(pages)] = pages
+        return state._replace(
+            page_table=self._set_row(
+                state.page_table, _staged(slot, np.int32),
+                jnp.asarray(row)))
+
+    def _pad_blocks(self, b) -> np.ndarray:
+        """Pad a [n, ...] host block stack to [max_pages_per_slot, ...]
+        (zeros — the scatter drops the tail anyway, the pad just keeps
+        the jitted write body's shapes static)."""
+        b = np.asarray(b)
+        padn = self.max_pages_per_slot - b.shape[0]
+        return np.pad(b, [(0, padn)] + [(0, 0)] * (b.ndim - 1))
+
+    def resume_slot(self, state: EngineState, slot: int,
+                    seed: DecodeSeed) -> EngineState:
+        """Bring a slot live from a DecodeSeed: on the migration
+        destination after `import_slot_kv`, or locally after a
+        cancelled handoff. The row's next decode step emits exactly
+        the token the paused source row would have."""
+        args = (_staged(slot, np.int32),
+                _staged(seed.pos, np.int32),
+                _staged(seed.last_tok, np.int32),
+                _staged(seed.last_lp, np.float32),
+                _staged(seed.temp, np.float32),
+                _staged(seed.top_k, np.int32),
+                _staged(seed.top_p, np.float32),
+                _staged_once(seed.rng_key_data,
+                             seed.rng_key_data.dtype))
+        fn = self._art("resume")
+        if fn is not None:
+            try:
+                return fn(state, *args)
+            except Exception as e:
+                self._art_drop("resume", e)
+        return self._resume_jit(state, *args)
+
+    def kv_geometry(self) -> dict:
+        """The fields two engines must agree on for a KV-block
+        migration between them to be meaningful (the server's import
+        gate; the fleet builds same-model replicas by construction,
+        this catches mis-wiring): arena geometry + cache dtype +
+        paging convention."""
+        return {
+            "page_size": int(self.page_size),
+            "max_pages_per_slot": int(self.max_pages_per_slot),
+            "kv_heads": int(self.cfg.kv_heads),
+            "head_dim": int(self.cfg.head_dim),
+            "kv_cache_dtype": self.cfg.kv_cache_dtype,
+            "vocab": int(self.cfg.vocab),
+            "max_len": int(self.max_len),
+        }
 
     # -- batteries-included host scheduler --------------------------------
 

@@ -145,6 +145,11 @@ class PagePool:
         # speculative-decoding page traffic (reserve/commit below)
         self.spec_reserved = 0      # pages pre-mapped for verify windows
         self.spec_rolled_back = 0   # reserved pages returned on rejection
+        # KV-block migration (disaggregated prefill/decode)
+        self._exports: Dict[int, List[int]] = {}   # export id -> pinned pages
+        self._next_export = 0
+        self.migrated_out_pages = 0  # pages pinned for an outbound transfer
+        self.migrated_in_pages = 0   # freshly allocated pages on import
         # testing.faults seam: fault_hook(event, ctx) — "alloc" may
         # return truthy to force PoolExhaustedError, "lookup" may
         # mutate the _CacheEntry it is handed
@@ -465,6 +470,65 @@ class PagePool:
                       free=self.pages_free)
         return added, dropped
 
+    # -- KV-block migration (disaggregated prefill/decode) -----------------
+
+    def export_blocks(self, slot: int) -> Tuple[int, List[int]]:
+        """Pin the slot's mapped pages for an outbound KV transfer:
+        each page takes one extra reference under a fresh export id, so
+        the physical pages stay valid — not freed, not recycled into
+        another slot — for as long as the transfer is in flight, even
+        if the source slot itself releases meanwhile (deadline expiry,
+        preemption, or the post-ACK handoff release). THE refcount
+        discipline the migration fault model leans on: a destination
+        dying mid-transfer costs nothing, the source copy is still
+        whole until `release_export` (which the orchestrator calls only
+        after the destination ACKs or the request is re-routed).
+        Returns (export_id, the slot's pages in block order)."""
+        pages = list(self.slot_pages[slot])
+        assert pages, f"slot {slot} holds no pages to export"
+        eid = self._next_export
+        self._next_export += 1
+        for p in pages:
+            self._refcount[p] += 1
+        self._exports[eid] = pages
+        self.migrated_out_pages += len(pages)
+        self._obs("page_export", slot=slot, pages=len(pages),
+                  export_id=eid)
+        return eid, pages
+
+    def release_export(self, export_id: int) -> None:
+        """Drop an export's pins (destination ACKed, or the transfer
+        was abandoned); pages with no other holder free as usual."""
+        pages = self._exports.pop(export_id)
+        for p in pages:
+            self._decref(p)
+        self._obs("page_export_release", export_id=export_id,
+                  pages=len(pages), free=self.pages_free)
+
+    @property
+    def exports_outstanding(self) -> int:
+        return len(self._exports)
+
+    def import_blocks(self, slot: int, tokens, true_len: int
+                      ) -> Tuple[List[int], int]:
+        """Map a slot for a MIGRATED finished prefill. Identical
+        alloc/refcount semantics to `admit` — cached leading blocks
+        under the same `chain_keys` derivation are shared (the inbound
+        copy of those blocks is redundant and the engine skips writing
+        them), the rest allocate fresh. Returns (the slot's full page
+        list, shared_blocks): the engine writes arena contents only
+        for blocks >= shared_blocks, then `register` publishes the
+        full blocks so the migrated prefix seeds THIS pool's cache.
+        Raises PoolExhaustedError with the pool untouched (admit's
+        atomicity) — the transfer orchestrator picks another
+        destination or retries later; the source pins are unaffected."""
+        pages, shared_len = self.admit(slot, tokens, true_len)
+        shared_blocks = shared_len // self.page_size
+        self.migrated_in_pages += len(pages) - shared_blocks
+        self._obs("page_import", slot=slot, pages=len(pages),
+                  shared=shared_blocks, free=self.pages_free)
+        return pages, shared_blocks
+
     def release(self, slot: int) -> None:
         """Drop the slot's references; pages with no other holder
         (no co-tenant share, not cached) return to the free list.
@@ -492,6 +556,8 @@ class PagePool:
             "prefill_chunks": self.prefill_chunks,
             "spec_reserved": self.spec_reserved,
             "spec_rolled_back": self.spec_rolled_back,
+            "migrated_out_pages": self.migrated_out_pages,
+            "migrated_in_pages": self.migrated_in_pages,
         }
 
     def reconcile(self) -> None:
@@ -508,6 +574,9 @@ class PagePool:
                 holders[p] += 1
         for entry in self._cache.values():
             holders[entry.page] += 1
+        for pages in self._exports.values():
+            for p in pages:
+                holders[p] += 1
         free = set(self._free)
         assert len(free) == len(self._free), "free list duplicates"
         assert self.pages_in_use + self.pages_free == self.num_pages

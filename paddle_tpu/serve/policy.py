@@ -158,6 +158,43 @@ class SchedulerPolicy:
         cold prefixes warm exactly one replica each."""
         return min(candidates, key=lambda r: r.load())
 
+    # -- disaggregated prefill/decode (serve.router tiered mode) -----------
+
+    def route_tiered(self, chain: Sequence[tuple], affinity: dict,
+                     prefill_cands: Sequence,
+                     decode_cands: Sequence) -> Optional[object]:
+        """The tiered routing order for a disaggregated fleet:
+        cached-prefix replica -> prefill tier -> decode tier. The
+        deepest affinity hit wins REGARDLESS of tier — a decode
+        replica whose cache was seeded by an earlier migration serves
+        the repeat prefix without a cross-tier hop at all (the
+        prefix-seeding payoff). A cold prompt lands on the
+        least-loaded prefill-tier replica (compute-bound work where
+        it belongs; its KV blocks migrate after prefill); with NO
+        routable prefill replica the decode tier serves end-to-end —
+        graceful degrade, never an outage."""
+        cand = set(prefill_cands) | set(decode_cands)
+        if not cand:
+            return None
+        for key in reversed(list(chain)):       # deepest first
+            rep = affinity.get(key)
+            if rep is not None and rep in cand:
+                return rep
+        if prefill_cands:
+            return self.spill(prefill_cands)
+        return self.spill(decode_cands)
+
+    def migration_target(self, candidates: Sequence):
+        """Destination for one KV-block migration: the least-loaded
+        routable decode-tier replica (decode is memory-bound, so load
+        — queued + in-flight streams — is the right pressure gauge).
+        Returns None when no decode replica can take it; the
+        orchestrator then cancels the handoff and the source decodes
+        locally."""
+        if not candidates:
+            return None
+        return min(candidates, key=lambda r: r.load())
+
 
 class RandomRoutingPolicy(SchedulerPolicy):
     """Affinity-blind control arm: route every request to a

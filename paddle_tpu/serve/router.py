@@ -67,7 +67,8 @@ from paddle_tpu.obs.trace import Tracer
 from paddle_tpu.serve.paged import chain_keys
 from paddle_tpu.serve.policy import SchedulerPolicy
 from paddle_tpu.serve.server import (COMPLETED, EXPIRED, FAILED, OUTCOMES,
-                                     SHED, CircuitBreaker, QueueFullError,
+                                     SHED, CircuitBreaker,
+                                     MigrationRefusedError, QueueFullError,
                                      Request, ServingServer)
 
 
@@ -167,6 +168,16 @@ class ServingRouter:
                 failure_threshold=failure_threshold,
                 cooldown_s=cooldown_s, clock=clock))
             for i, srv in enumerate(servers)]
+        # disaggregated prefill/decode: any non-unified replica role
+        # turns on tiered routing + the migration sweep. A prefill
+        # tier without a decode tier would park every request forever
+        # and cancel every handoff — reject the mis-wiring up front.
+        roles = [getattr(s, "role", "unified") for s in servers]
+        self._disagg = any(r != "unified" for r in roles)
+        if "prefill" in roles and "decode" not in roles:
+            raise ValueError(
+                "a prefill-tier replica needs at least one "
+                "decode-tier replica to migrate to")
         # affinity key geometry comes from the replica engines; a
         # non-paged fleet (ring pools have no prefix cache) routes by
         # load alone
@@ -188,7 +199,13 @@ class ServingRouter:
         self.stats: Dict[str, int] = {
             "requests": 0, "completed": 0, "expired": 0, "shed": 0,
             "failed": 0, "redistributed": 0, "replicas_lost": 0,
-            "affinity_hits": 0, "affinity_spills": 0}
+            "affinity_hits": 0, "affinity_spills": 0,
+            # disaggregation: completed cross-tier KV migrations,
+            # transfers that had to retry another destination
+            # (refused / destination died mid-import), and handoffs
+            # cancelled back to source-local decode
+            "migrations": 0, "migration_retargets": 0,
+            "migration_failed": 0}
         # dead replicas' pool counters, banked at death so aggregate
         # prefix-hit observability never goes backwards
         self._dead_base: Dict[str, int] = {}
@@ -235,7 +252,14 @@ class ServingRouter:
         # all candidates stay in, so the replica-level displacement
         # shed still decides genuine fleet-wide overload
         roomy = [r for r in cands if r.server.queue_space > 0]
-        rep = self.policy.route(chain, self._affinity, roomy or cands)
+        pool = roomy or cands
+        if self._disagg:
+            rep = self.policy.route_tiered(
+                chain, self._affinity,
+                [r for r in pool if r.server.role != "decode"],
+                [r for r in pool if r.server.role == "decode"])
+        else:
+            rep = self.policy.route(chain, self._affinity, pool)
         if rep is not None:
             hit = any(self._affinity.get(k) is rep
                       for k in reversed(chain))
@@ -352,7 +376,9 @@ class ServingRouter:
                      "expired", "shed", "failed", "retried",
                      "admitted", "spec_rounds", "draft_proposed",
                      "draft_accepted", "spec_reserved",
-                     "spec_rolled_back"):
+                     "spec_rolled_back", "migrated_in", "migrated_out",
+                     "migrated_out_pages", "migrated_in_pages",
+                     "handoffs_cancelled"):
                 self._dead_base[k] = self._dead_base.get(k, 0) + v
 
     def _on_replica_death(self, rep: Replica, exc: Exception) -> None:
@@ -430,6 +456,88 @@ class ServingRouter:
             return
         rep.pending[rep_id] = rr_id
         self._note_affinity(chain, rep)
+
+    # -- KV-block migration (disaggregated mode) ---------------------------
+
+    def _harvest_handoffs(self, rep: Replica) -> int:
+        """Migrate every prefill-complete request parked on `rep` to
+        the decode tier. Returns how many requests MOVED (migrated or
+        cancelled back to local decode) so the sweep knows new work
+        exists somewhere."""
+        moved = 0
+        for req_id in rep.server.ready_handoffs():
+            moved += self._migrate(rep, req_id)
+        return moved
+
+    def _migrate(self, src: Replica, req_id: int) -> int:
+        """One live KV-block migration: export the parked request's
+        payload once, then offer it to decode-tier replicas in
+        policy order. A transient refusal (MigrationRefusedError) or
+        a destination dying mid-import costs nothing — the source's
+        export pins keep its copy whole, so the SAME payload retries
+        the next destination; only after every destination refused
+        does the handoff cancel back to source-local decode (graceful
+        degrade, never a lost request). On success the destination
+        ACK (`handoff_complete`) releases the source copy, the fleet
+        ledger re-homes the rr id, and the affinity map repoints the
+        prompt's chain at the destination — whose prefix cache the
+        migrated blocks just seeded."""
+        rr_id = src.pending.get(req_id)
+        try:
+            payload = src.server.export_request(req_id)
+        except KeyError:
+            return 0        # expired/retired between harvest and here
+        chain = self._chain(payload["prompt"])
+        tried: set = set()
+        while True:
+            cands = [r for r in self.replicas
+                     if r.routable() and r.server.role == "decode"
+                     and r.rid not in tried]
+            dst = self.policy.migration_target(cands)
+            if dst is None:
+                break
+            tried.add(dst.rid)
+            try:
+                dst_id = dst.server.import_request(payload)
+            except MigrationRefusedError as e:
+                self.stats["migration_retargets"] += 1
+                if self.tracer is not None and rr_id is not None:
+                    self.tracer.event(self.trace_id(rr_id),
+                                      "migration_refused",
+                                      dst=dst.rid, why=str(e))
+                continue
+            except Exception as e:
+                if not getattr(e, "replica_fatal", False):
+                    raise
+                # destination died MID-TRANSFER: its commit-last
+                # import never registered the request, the source
+                # pins are intact — mark it dead (redistributing ITS
+                # other pending work) and retry the next destination
+                self.stats["migration_retargets"] += 1
+                if self.flight is not None:
+                    self.flight.record(
+                        "fault", "migration-dst-death", src=src.rid,
+                        dst=dst.rid, req_id=req_id, error=str(e))
+                self._on_replica_death(dst, e)
+                continue
+            src.server.handoff_complete(req_id)
+            if rr_id is not None:
+                src.pending.pop(req_id, None)
+                dst.pending[dst_id] = rr_id
+            self._note_affinity(chain, dst)
+            self.stats["migrations"] += 1
+            if self.tracer is not None and rr_id is not None:
+                self.tracer.event(self.trace_id(rr_id), "migrated",
+                                  src=src.rid, dst=dst.rid,
+                                  pages=payload["n_pages"])
+            return 1
+        # no destination could take it: decode where the KV already is
+        src.server.cancel_handoff(req_id)
+        self.stats["migration_failed"] += 1
+        if self.tracer is not None and rr_id is not None:
+            self.tracer.event(self.trace_id(rr_id),
+                              "migration_cancelled", src=src.rid)
+        return 1
 
     def drain(self, reason: str = "drain requested") -> None:
         """Fleet-wide graceful drain (the SIGTERM path): every live
@@ -532,6 +640,26 @@ class ServingRouter:
                         continue
                     raise
                 self._mirror(rep)
+                if (self._disagg and rep.alive
+                        and rep.server.role == "prefill"
+                        and rep.server.ready_handoffs()):
+                    try:
+                        # migrations hand the decode tier (or,
+                        # cancelled, this replica) new work mid-sweep
+                        busy = self._harvest_handoffs(rep) > 0 or busy
+                    except Exception as e:
+                        if getattr(e, "replica_fatal", False):
+                            # the SOURCE died with requests parked:
+                            # its pinned blocks died with it and no
+                            # destination ever committed — both copies
+                            # lost, so the parked requests ride the
+                            # standard redistribution path (full
+                            # re-prefill on a survivor, exactly one
+                            # outcome each)
+                            self._on_replica_death(rep, e)
+                            busy = True
+                            continue
+                        raise
             if not busy:
                 break
         return self.results

@@ -97,7 +97,8 @@ log = logging.getLogger(__name__)
 #: (backend switches / decode-fault resets build a fresh PagePool)
 _POOL_COUNTER_KEYS = ("prefix_hits", "prefix_misses",
                       "prefix_rejected", "prefill_chunks",
-                      "spec_reserved", "spec_rolled_back")
+                      "spec_reserved", "spec_rolled_back",
+                      "migrated_out_pages", "migrated_in_pages")
 
 #: terminal request outcomes — exactly one per submitted request
 COMPLETED = "completed"
@@ -111,6 +112,16 @@ class QueueFullError(RuntimeError):
     """The admission queue is full and the INCOMING request was the
     cheapest to retry — the explicit-backpressure signal. The request
     is recorded shed; the caller should back off and resubmit."""
+
+
+class MigrationRefusedError(RuntimeError):
+    """A decode-tier replica declined `import_request` TRANSIENTLY —
+    no free slot, page pool too full to map the migrated blocks, or
+    the server is draining. Nothing changed on either side: the
+    source's export pins are intact, so the orchestrator picks
+    another destination or retries later. Contrast ValueError from
+    import_request (geometry mismatch), which is deterministic and
+    means the fleet is mis-wired."""
 
 
 def _replica_fatal(exc: Exception) -> bool:
@@ -244,7 +255,25 @@ class ServingServer:
                  flight: Optional[FlightRecorder] = None,
                  speculative: bool = False,
                  proposer=None,
-                 artifact_path: Optional[str] = None):
+                 artifact_path: Optional[str] = None,
+                 role: str = "unified"):
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'unified', 'prefill' or 'decode', "
+                f"got {role!r}")
+        if role != "unified":
+            # disaggregation moves paged KV blocks between replicas:
+            # both tiers need the pure-JAX paged engine's migration
+            # surface (pause/export/import/resume)
+            if not getattr(engine, "paged", False):
+                raise ValueError(
+                    f"role={role!r} needs a paged engine "
+                    f"(KV-block migration)")
+            if native_backend is not None:
+                raise ValueError(
+                    "disaggregated roles run the pure-JAX paged "
+                    "engine only (no native fallback pair)")
+        self.role = role
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         if max_retries < 0:
@@ -343,6 +372,15 @@ class ServingServer:
         self._pool_base: Dict[str, int] = {
             k: 0 for k in _POOL_COUNTER_KEYS}
         self._pool_base["peak_pages_in_use"] = 0
+        # disaggregation handoff state: req_id -> {slot, seed,
+        # export_id, pages} for prefill-complete requests parked for
+        # migration (role="prefill" parks every finished prefill; the
+        # router exports/ACKs them). Server-level migration counters
+        # are separate from the pool's page counters.
+        self._handoff: Dict[int, dict] = {}
+        self.migrated_in = 0
+        self.migrated_out = 0
+        self.handoffs_cancelled = 0
 
     def _load_artifact(self, path: str) -> None:
         """Boot-time artifact adoption: verify the bundle's manifest
@@ -597,6 +635,185 @@ class ServingServer:
                 return req
         return None
 
+    # -- disaggregated prefill/decode handoff ------------------------------
+    #
+    # The migration protocol (docs/SERVING.md "Disaggregated
+    # prefill/decode"): a role="prefill" replica parks every finished
+    # prefill (pause_slot + an export pin on its pages) instead of
+    # decoding it; the fleet router harvests `ready_handoffs()`, pulls
+    # the transferable payload with `export_request()`, feeds it to a
+    # decode-tier replica's `import_request()`, and ACKs with
+    # `handoff_complete()` — which releases the source copy and backs
+    # the request out of this server's ledger (withdraw_queued
+    # semantics: the request's ONE terminal outcome lands on the
+    # destination). Until that ACK the source pages stay pinned, so a
+    # destination dying mid-transfer costs nothing: the router retries
+    # another destination or falls back to `cancel_handoff()` (decode
+    # locally — graceful degrade, never a lost request).
+
+    def _park_for_handoff(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        self._state, seed = self.engine.pause_slot(self._state, slot)
+        eid, pages = self._active_pool.export_blocks(slot)
+        self._handoff[req.req_id] = {
+            "slot": slot, "seed": seed, "export_id": eid,
+            "pages": pages}
+        self._trace_event(req.req_id, "handoff_ready", slot=slot,
+                          pages=len(pages))
+
+    def ready_handoffs(self) -> List[int]:
+        """req_ids parked at prefill-complete, awaiting migration —
+        the router's harvest surface (host-side, no device sync)."""
+        return list(self._handoff)
+
+    def export_request(self, req_id: int) -> dict:
+        """The transferable migration payload for one parked request:
+        scheduling identity (prompt/sampling/budgets, deadline as
+        REMAINING milliseconds — absolute clocks don't cross
+        replicas), the DecodeSeed, the raw arena contents of its
+        pages, and the source geometry for the destination's import
+        gate. Reads the device; the export pin guarantees the pages
+        are still whole even if the slot was retired meanwhile."""
+        h = self._handoff[req_id]
+        req = self._slot_req[h["slot"]]
+        assert req is not None and req.req_id == req_id, (
+            req_id, h["slot"])
+        remaining_ms = (
+            None if req.deadline is None
+            else max(0.0, (req.deadline - self.clock()) * 1000.0))
+        span = self._trace_ids.get(req_id)
+        self._trace_event(req_id, "handoff_export",
+                          pages=len(h["pages"]))
+        return {
+            "prompt": req.prompt,
+            "true_len": req.true_len,
+            "max_new": req.max_new,
+            "sampling": req.sampling,
+            "retries_left": req.retries_left,
+            "remaining_ms": remaining_ms,
+            "seed": h["seed"],
+            "kv": self.engine.export_slot_kv(self._state, h["pages"]),
+            "n_pages": len(h["pages"]),
+            "geometry": self.engine.kv_geometry(),
+            "trace_id": getattr(span, "trace_id", None),
+        }
+
+    def handoff_complete(self, req_id: int) -> None:
+        """Destination ACK: release the source copy (export pin +
+        slot pages) and back the request out of this ledger with NO
+        terminal outcome here — the destination now owns it, and the
+        fleet-wide 'requests' sum keeps counting each request once.
+        `prefills` and the migration counters keep the work visible."""
+        h = self._handoff.pop(req_id)
+        slot = h["slot"]
+        self._active_pool.release_export(h["export_id"])
+        self._retire_slot(slot)
+        self._emitted.pop(req_id, None)
+        self._lps.pop(req_id, None)
+        self.stats.requests -= 1
+        self.stats.admitted -= 1
+        self.migrated_out += 1
+        self._trace_event(req_id, "migrated_out", pages=len(h["pages"]))
+        # the span itself lives on: the destination's tracer.start
+        # dedupes the live trace_id, so ONE span follows the request
+        self._trace_ids.pop(req_id, None)
+
+    def cancel_handoff(self, req_id: int) -> None:
+        """Abandon a parked migration and decode the request HERE —
+        the graceful degrade when no decode-tier replica can take it.
+        Resumes the paused row bit-exactly; the slot then rides the
+        ordinary decode path on this server."""
+        h = self._handoff.pop(req_id)
+        self._active_pool.release_export(h["export_id"])
+        self._state = self.engine.resume_slot(
+            self._state, h["slot"], h["seed"])
+        self.handoffs_cancelled += 1
+        self._trace_event(req_id, "handoff_cancelled", slot=h["slot"])
+
+    def import_request(self, payload: dict) -> int:
+        """Decode-tier intake for a migrated finished prefill. Gates
+        first (geometry must match — ValueError, mis-wired fleet;
+        capacity must exist RIGHT NOW — MigrationRefusedError,
+        transient, nothing changed), then maps pages
+        (`pool.import_blocks`: cached leading blocks under the same
+        chain_keys derivation are shared, the inbound copy of those
+        is skipped), writes the arena contents, resumes the row from
+        the DecodeSeed, and registers the full blocks so the migrated
+        prefix SEEDS this pool's cache. The ledger commits LAST: a
+        replica-fatal fault mid-import leaves this server never
+        having known the request (the source still holds it parked),
+        so exactly-once needs no distributed transaction. Returns the
+        destination req_id."""
+        if payload["geometry"] != self.engine.kv_geometry():
+            raise ValueError(
+                f"migration geometry mismatch: source "
+                f"{payload['geometry']} vs destination "
+                f"{self.engine.kv_geometry()}")
+        if self._draining:
+            raise MigrationRefusedError(
+                "import refused: server is draining")
+        if self._state is None:
+            self._reset_pool()
+        pool = self._active_pool
+        try:
+            slot = self._slot_req.index(None)
+        except ValueError:
+            raise MigrationRefusedError(
+                "import refused: no free slot") from None
+        prompt = np.asarray(payload["prompt"], np.int32)
+        true_len = int(payload["true_len"])
+        if not pool.admissible(prompt, true_len):
+            raise MigrationRefusedError(
+                "import refused: page pool cannot map the migrated "
+                "blocks right now")
+        try:
+            pages, shared_blocks = pool.import_blocks(
+                slot, prompt, true_len)
+        except PoolExhaustedError as e:
+            raise MigrationRefusedError(
+                f"import refused: {e}") from None
+        try:
+            self._state = self.engine.import_slot_kv(
+                self._state, slot, pages, shared_blocks,
+                payload["kv"])
+            self._state = self.engine.resume_slot(
+                self._state, slot, payload["seed"])
+        except Exception:
+            # the engine died (or faulted) mid-import: balance the
+            # HOST books (release the slot's page refs — host-side,
+            # works over a dead device) and let the error propagate;
+            # the source copy is still pinned, the router redirects
+            pool.release(slot)
+            raise
+        pool.register(slot, prompt, true_len)
+        req_id = self._next_id
+        self._next_id += 1
+        self.stats.requests += 1
+        self.stats.admitted += 1
+        self.migrated_in += 1
+        now = self.clock()
+        rem = payload.get("remaining_ms")
+        req = Request(
+            req_id=req_id, prompt=prompt, true_len=true_len,
+            max_new=int(payload["max_new"]),
+            sampling=payload.get("sampling"),
+            deadline=(None if rem is None
+                      else now + float(rem) / 1000.0),
+            submitted_at=now,
+            retries_left=int(payload.get("retries_left",
+                                         self.max_retries)))
+        self._slot_req[slot] = req
+        self._emitted[req_id] = []
+        self._lps[req_id] = []
+        if self.tracer is not None:
+            tid = payload.get("trace_id") or f"req{req_id}"
+            self._trace_ids[req_id] = self.tracer.start(
+                tid, "serve.request", req_id=req_id)
+            self._trace_event(req_id, "migrated_in", slot=slot,
+                              pages=len(pages),
+                              shared_blocks=shared_blocks)
+        return req_id
+
     # -- drain -------------------------------------------------------------
 
     def drain(self, *, grace_s: Optional[float] = None,
@@ -663,6 +880,10 @@ class ServingServer:
 
     def _reset_pool(self) -> None:
         self._fold_pool_counters()
+        # a fresh pool generation invalidates any parked handoffs —
+        # their export pins die with the old pool, and the requests
+        # themselves ride the requeue path (_evict_in_flight)
+        self._handoff.clear()
         self._state = self._backend.init_state()
         self._slot_req = [None] * self._backend.slots
         self._prefilling.clear()
@@ -745,9 +966,20 @@ class ServingServer:
         and on a paged engine release_slot is ALSO what frees the
         slot's pages, so every retirement (device-finished rows
         included) must route here."""
+        req = self._slot_req[slot]
+        if req is not None:
+            # a parked handoff retired locally (deadline expiry,
+            # drain grace, preemption) abandons its transfer: drop
+            # the export pin so the pool's books stay balanced
+            self._drop_handoff_pin(req.req_id)
         self._state = self._backend.release_slot(self._state, slot)
         self._slot_req[slot] = None
         self._prefilling.pop(slot, None)
+
+    def _drop_handoff_pin(self, req_id: int) -> None:
+        h = self._handoff.pop(req_id, None)
+        if h is not None and self._active_pool is not None:
+            self._active_pool.release_export(h["export_id"])
 
     # -- the drive loop ----------------------------------------------------
 
@@ -785,6 +1017,11 @@ class ServingServer:
                 self.breaker.record_success()
             if done:
                 self._prefilling.pop(slot, None)
+                if self.role == "prefill":
+                    # the disaggregation seam: a prefill-tier replica
+                    # never decodes — park the finished prefill for
+                    # KV-block migration to the decode tier
+                    self._park_for_handoff(slot)
 
     def _ensure_pages(self, slot: int, req: Request) -> None:
         """Map the next write position's page for a continuing slot.
@@ -839,7 +1076,8 @@ class ServingServer:
         dlen = np.zeros((len(self._slot_req),), np.int32)
         pool = self._backend.pool
         for slot, req in enumerate(self._slot_req):
-            if req is None or slot in self._prefilling:
+            if (req is None or slot in self._prefilling
+                    or req.req_id in self._handoff):
                 continue
             rid = req.req_id
             budget = self.policy.draft_len(
@@ -984,6 +1222,11 @@ class ServingServer:
             self._trace_event(req.req_id, "admitted", slot=slot,
                               backend=self._backend_name(),
                               chunked=chunked)
+            if self.role == "prefill" and not chunked:
+                # one-shot prefill finished inside admission: park
+                # immediately (the chunked path parks at its final
+                # chunk in _advance_prefills)
+                self._park_for_handoff(slot)
         self._admitting_req = None
 
     def _expire_in_flight(self) -> None:
@@ -1028,8 +1271,15 @@ class ServingServer:
         self._maybe_probe_native()
         self._admit()
         self._advance_prefills()
-        inflight = [r for r in self._slot_req if r is not None]
+        parked = {h["slot"] for h in self._handoff.values()}
+        inflight = [r for s, r in enumerate(self._slot_req)
+                    if r is not None and s not in parked]
         if not inflight:
+            # parked handoffs progress via the router's export/ACK
+            # cycle, not the drive loop — but their deadlines still
+            # bind while they wait for a destination
+            if parked:
+                self._expire_in_flight()
             return bool(self.queue) and not self._draining
         if self._drain_expired():
             # before the mid-prefill early-out: the drain grace must
@@ -1044,6 +1294,7 @@ class ServingServer:
                     self._retire_slot(slot)
             return True
         decoding = sum(r is not None and s not in self._prefilling
+                       and s not in parked
                        for s, r in enumerate(self._slot_req))
         if not self.policy.should_decode(decoding,
                                          len(self._prefilling)):
@@ -1217,6 +1468,14 @@ class ServingServer:
                                       "artifact_loads", 0),
             "artifact_fallbacks": getattr(self.engine,
                                           "artifact_fallbacks", 0),
+            # disaggregation: whole-request migrations through this
+            # replica (the pool's migrated_*_pages count pages). A
+            # migrated-out request leaves `requests`/`admitted` (the
+            # destination owns its outcome) but stays visible here.
+            "migrated_in": self.migrated_in,
+            "migrated_out": self.migrated_out,
+            "handoffs_ready": len(self._handoff),
+            "handoffs_cancelled": self.handoffs_cancelled,
         }
         out.update(self._pool_base)
         out.setdefault("pages_in_use", 0)

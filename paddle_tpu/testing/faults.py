@@ -64,7 +64,13 @@ model") prove the multi-replica story:
 - a SLOW replica (`router_slow_decode_s` on `wrap_replica_engine`
   with a ManualClock): every decode step on that replica burns clock
   — deadline skew concentrates on its own requests, and the fleet's
-  round-robin drive keeps the other replicas at full rate.
+  round-robin drive keeps the other replicas at full rate;
+- a MIGRATION DESTINATION killed mid-transfer
+  (`router_kill_import_at`): the nth `import_slot_kv` on a wrapped
+  engine raises ReplicaDeadError and the replica stays dead — the
+  disaggregated fleet's exactly-once contract must hold (source
+  export pins intact, the transfer retried on another destination or
+  cancelled to source-local decode, fleet counters reconciled).
 
 Parameter-server faults (native.pserver + parallel.pserver_client,
 docs/RELIABILITY.md "Parameter-server fault model") use the shard's
@@ -121,6 +127,7 @@ class FaultPlan:
     serve_prefix_corrupt_at: Optional[int] = None    # nth cache lookup
     # -- router/fleet faults (serve.router, via wrap_replica_engine) --
     router_kill_decode_at: Optional[int] = None   # nth decode on wrapped
+    router_kill_import_at: Optional[int] = None   # nth KV-block import
     router_probe_drop_first_n: Optional[int] = None  # blackholed probes
     router_slow_decode_s: float = 0.0             # clock skew per decode
     # -- parameter-server faults (native.pserver, via wrap_pserver_shard) --
@@ -143,6 +150,7 @@ class FaultPlan:
         self._page_alloc_counter = 0
         self._prefix_lookup_counter = 0
         self._router_decode_counter = 0
+        self._router_import_counter = 0
         self._router_probe_counter = 0
         self._pserver_push_counter = 0
         self._pserver_ack_counter = 0
@@ -295,7 +303,12 @@ class FaultPlan:
         - `router_slow_decode_s` (+ ManualClock): EVERY decode step on
           this replica advances `clock` first — a persistently slow
           replica skews deadlines for its own requests without one
-          wall-clock sleep.
+          wall-clock sleep;
+        - `router_kill_import_at`: the nth `import_slot_kv` call (a
+          KV-block migration landing on this replica) raises
+          ReplicaDeadError MID-TRANSFER and the replica stays dead —
+          the shape the disaggregated fleet's refcount discipline
+          must survive without losing or double-serving the request.
 
         Everything else delegates, so an unkilled wrapped replica is
         bit-identical to the real engine."""
@@ -609,6 +622,38 @@ class _DoomedReplicaEngine:
         # speculative serving (the counter-reconciliation chaos case)
         self._decode_tick()
         return self._engine.spec_step(state, drafts, draft_len)
+
+    # -- disaggregation migration surface (dead-stays-dead too) --------
+
+    def pause_slot(self, *args, **kwargs):
+        self._check_dead()
+        return self._engine.pause_slot(*args, **kwargs)
+
+    def export_slot_kv(self, *args, **kwargs):
+        self._check_dead()
+        return self._engine.export_slot_kv(*args, **kwargs)
+
+    def resume_slot(self, *args, **kwargs):
+        self._check_dead()
+        return self._engine.resume_slot(*args, **kwargs)
+
+    def import_slot_kv(self, *args, **kwargs):
+        """The migration-destination kill point: the
+        `router_kill_import_at`-th import across engines wrapped by
+        this plan dies MID-TRANSFER — after the destination pool
+        mapped pages, before the arena write lands — and the replica
+        is dead from then on, exactly like a device lost with the DMA
+        in flight."""
+        self._check_dead()
+        plan = self._plan
+        idx = plan._router_import_counter
+        plan._router_import_counter += 1
+        if (idx == plan.router_kill_import_at
+                and not plan._spent("importkill")):
+            plan._note("importkill", idx)
+            self.dead = True
+            raise self._dead_error()
+        return self._engine.import_slot_kv(*args, **kwargs)
 
     def __getattr__(self, name):
         return getattr(self._engine, name)

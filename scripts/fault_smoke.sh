@@ -11,6 +11,9 @@
 #                                       #   (leases/replication/failover)
 #     scripts/fault_smoke.sh router     # just the serving-fleet lane
 #                                       #   (affinity/failover/redistribute)
+#     scripts/fault_smoke.sh disagg     # just the migration chaos lane
+#                                       #   (dst killed mid-transfer,
+#                                       #   source death while parked)
 #     scripts/fault_smoke.sh -k serve   # just the serving chaos suite
 #
 # CPU-only and deterministic (testing.faults FaultPlan + ManualClock;
@@ -21,6 +24,9 @@ cd "$(dirname "$0")/.."
 marker=faults
 if [ "$1" = "pserver" ] || [ "$1" = "router" ]; then
     marker=$1
+    shift
+elif [ "$1" = "disagg" ]; then
+    marker="disagg and faults"
     shift
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "$marker" \

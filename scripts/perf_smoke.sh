@@ -28,8 +28,21 @@
 #                                      # bench stage (int8-vs-float
 #                                      # admit A/B, overlap-vs-naive
 #                                      # matmul step times)
+#     scripts/perf_smoke.sh disagg     # disaggregated-fleet lane only:
+#                                      # tiered routing + live KV-block
+#                                      # migration suite (-m disagg) +
+#                                      # the disagg bench stage (p99
+#                                      # inter-token decode gap,
+#                                      # disaggregated vs unified A/B)
 set -e
 cd "$(dirname "$0")/.."
+if [ "$1" = "disagg" ]; then
+    shift
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m disagg \
+        -p no:cacheprovider "$@"
+    env JAX_PLATFORMS=cpu python bench.py --disagg-only
+    exit 0
+fi
 if [ "$1" = "aot" ]; then
     shift
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m aot \

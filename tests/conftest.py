@@ -99,6 +99,12 @@ def pytest_configure(config):
         "baseline under transfer_guard) — fast, runs IN tier-1; "
         "`-m speculative` runs it alone")
     config.addinivalue_line(
+        "markers", "disagg: disaggregated prefill/decode fleet suite "
+        "(tiered routing, live KV-block migration, prefix seeding, "
+        "migration chaos) — fast, runs IN tier-1; `-m disagg` (or "
+        "`scripts/fault_smoke.sh disagg` / `scripts/perf_smoke.sh "
+        "disagg`) runs it alone")
+    config.addinivalue_line(
         "markers", "aot: AOT serving-artifact + persistent "
         "compile-cache suite (engine bundle round-trip parity, "
         "manifest-mismatch fallback, corrupt-entry miss, subprocess "

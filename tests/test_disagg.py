@@ -1,0 +1,366 @@
+"""Disaggregated prefill/decode fleet: tiered routing + live KV-block
+migration chaos harness.
+
+The tentpole claim (ROADMAP item 2, docs/SERVING.md "Disaggregated
+prefill/decode"): a role="prefill" replica parks every finished
+prefill, its paged KV blocks migrate to a role="decode" replica keyed
+by the SAME `chain_keys` derivation the prefix caches hash with, and
+the destination's first decode step emits exactly the token the source
+would have — bit-exact greedy parity through the handoff. Proven here
+the way every reliability layer in this repo is proven (deterministic
+`testing.faults` injection, `ManualClock`, no sleeps):
+
+- greedy AND speculative parity vs solo `generate()` through a full
+  cross-tier migration;
+- migrated blocks SEED the destination's prefix cache: a repeat
+  prefix routes straight to the decode tier and hits, no re-prefill,
+  no second migration;
+- a destination killed MID-TRANSFER (`router_kill_import_at`) costs
+  nothing: the source's export pins keep its copy whole, the same
+  payload retries the next destination (or cancels to source-local
+  decode when none is left), every request still ends in exactly one
+  outcome and the fleet counters reconcile;
+- the migration path adds ZERO steady-state compiles after its first
+  warm-up (RecompileGuard) — static [max_pages_per_slot] padding keeps
+  every transfer on one set of compiled bodies.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.analysis import RecompileGuard
+from paddle_tpu.models import transformer as T
+from paddle_tpu.serve.engine import DecodeEngine
+from paddle_tpu.serve.router import ServingRouter
+from paddle_tpu.serve.server import (MigrationRefusedError, ServingServer)
+from paddle_tpu.testing.faults import FaultPlan, ManualClock
+
+pytestmark = [pytest.mark.disagg]
+
+CFG = T.TransformerConfig(vocab=61, dim=32, n_layers=2, n_heads=4,
+                          attn_impl="dense")
+BUCKETS = (16,)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return T.init_params(jax.random.key(0), CFG)
+
+
+# ONE module-scoped engine set: replica 0 serves as the prefill tier,
+# 1..2 as the decode tier (fleets differ only in servers/wrappers).
+# Engines are stateless between runs (init_state resets the pool) and
+# their jitted compiles — including the four migration bodies, which
+# compile lazily at the module's FIRST migration — dominate test cost.
+@pytest.fixture(scope="module")
+def engines(params):
+    engs = [DecodeEngine(params, CFG, slots=2, max_len=32, page_size=4,
+                         prefill_chunk=8)
+            for _ in range(3)]
+    warm = np.arange(11, dtype=np.int32)
+    for e in engs:
+        e.serve([warm], max_new=2, buckets=BUCKETS)
+    return engs
+
+
+def make_fleet(engines, clk, *, roles=("prefill", "decode", "decode"),
+               wrap=None, speculative=False, max_queue=16,
+               max_retries=2, **router_kw):
+    """Disaggregated fleet on a shared ManualClock. `wrap[i]`
+    optionally wraps replica i's engine (fault proxies); decode-tier
+    replicas optionally serve speculatively (the prefill tier never
+    decodes, so speculation there is meaningless)."""
+    servers = []
+    for i, (eng, role) in enumerate(zip(engines, roles)):
+        if wrap and wrap.get(i) is not None:
+            eng = wrap[i](eng)
+        servers.append(ServingServer(
+            eng, role=role, max_queue=max_queue, clock=clk,
+            buckets=BUCKETS, max_retries=max_retries,
+            speculative=(speculative and role == "decode")))
+    return ServingRouter(servers, clock=clk, probe_interval_s=1e9,
+                         **router_kw)
+
+
+def ref_tokens(params, prompt, max_new):
+    out = T.generate(params, CFG, jax.numpy.asarray(prompt)[None, :],
+                     steps=max_new)
+    return [int(t) for t in np.asarray(out[0, len(prompt):])]
+
+
+def prompts_for(n, seed, lo=9, hi=14):
+    r = np.random.RandomState(seed)
+    return [r.randint(1, 60, (int(r.randint(lo, hi)),)).astype(np.int32)
+            for _ in range(n)]
+
+
+class TestHandoffSeam:
+    """The ServingServer-level handoff API, driven directly."""
+
+    def test_prefill_role_parks_and_pins(self, params, engines):
+        srv = ServingServer(engines[0], role="prefill",
+                            buckets=BUCKETS, clock=lambda: 0.0)
+        prompt = np.arange(1, 12, dtype=np.int32)
+        rid = srv.submit(prompt, max_new=4)
+        srv.run()           # returns with the request PARKED, not done
+        assert srv.ready_handoffs() == [rid]
+        pool = srv._active_pool
+        assert pool.exports_outstanding == 1
+        pool.reconcile()    # export pins are counted holders
+        payload = srv.export_request(rid)
+        assert payload["n_pages"] == len(payload["kv"][0][0])
+        assert payload["geometry"] == engines[0].kv_geometry()
+        assert payload["seed"].pos == prompt.size
+        # destination ACK: source copy released, ledger backed out
+        srv.handoff_complete(rid)
+        assert pool.exports_outstanding == 0
+        assert srv.stats.requests == 0 and not srv.results
+        assert srv.counters()["migrated_out"] == 1
+        srv.reconcile()
+
+    def test_cancel_handoff_decodes_locally(self, params, engines):
+        srv = ServingServer(engines[0], role="prefill",
+                            buckets=BUCKETS, clock=lambda: 0.0)
+        prompt = np.arange(2, 13, dtype=np.int32)
+        rid = srv.submit(prompt, max_new=4)
+        srv.run()
+        assert srv.ready_handoffs() == [rid]
+        srv.cancel_handoff(rid)         # graceful degrade
+        res = srv.run()
+        assert res[rid].outcome == "completed"
+        assert res[rid].tokens == ref_tokens(params, prompt, 4)
+        assert srv._active_pool.exports_outstanding == 0
+        assert srv.counters()["handoffs_cancelled"] == 1
+        srv.reconcile()
+
+    def test_deadline_expires_while_parked(self, params, engines):
+        clk = ManualClock()
+        srv = ServingServer(engines[0], role="prefill",
+                            buckets=BUCKETS, clock=clk)
+        rid = srv.submit(np.arange(1, 10, dtype=np.int32), max_new=4,
+                         deadline_ms=500)
+        srv.run()
+        assert srv.ready_handoffs() == [rid]
+        clk.advance(1.0)
+        srv.step()          # expiry retires the slot AND drops the pin
+        assert srv.results[rid].outcome == "expired"
+        assert srv.ready_handoffs() == []
+        assert srv._active_pool.exports_outstanding == 0
+        srv.reconcile()
+
+    def test_import_gates(self, params, engines):
+        src = ServingServer(engines[0], role="prefill",
+                            buckets=BUCKETS, clock=lambda: 0.0)
+        rid = src.submit(np.arange(3, 14, dtype=np.int32), max_new=4)
+        src.run()
+        payload = src.export_request(rid)
+        dst = ServingServer(engines[1], role="decode",
+                            buckets=BUCKETS, clock=lambda: 0.0)
+        # a draining destination refuses TRANSIENTLY
+        dst.drain(reason="test")
+        with pytest.raises(MigrationRefusedError):
+            dst.import_request(payload)
+        # a geometry mismatch is deterministic mis-wiring
+        bad = dict(payload)
+        bad["geometry"] = dict(payload["geometry"], page_size=999)
+        dst2 = ServingServer(engines[2], role="decode",
+                             buckets=BUCKETS, clock=lambda: 0.0)
+        with pytest.raises(ValueError):
+            dst2.import_request(bad)
+        # nothing changed anywhere: source copy intact, books balance
+        assert src._active_pool.exports_outstanding == 1
+        src.cancel_handoff(rid)
+        src.run()
+        src.reconcile()
+
+    def test_role_validation(self, params, engines):
+        with pytest.raises(ValueError):
+            ServingServer(engines[0], role="verifier")
+        with pytest.raises(ValueError):
+            # a prefill tier needs a decode tier to migrate to
+            ServingRouter([
+                ServingServer(engines[0], role="prefill",
+                              buckets=BUCKETS)])
+
+
+class TestDisaggFleet:
+    def test_greedy_parity_through_migration(self, params, engines):
+        clk = ManualClock()
+        router = make_fleet(engines, clk)
+        prompts = prompts_for(3, seed=7)
+        ids = [router.submit(p, max_new=5) for p in prompts]
+        res = router.run()
+        for p, rr in zip(prompts, ids):
+            assert res[rr].outcome == "completed"
+            assert res[rr].tokens == ref_tokens(params, p, 5)
+            # the outcome landed on the DECODE tier
+            assert res[rr].replica in (1, 2), res[rr]
+        c = router.counters()
+        assert c["migrations"] == 3
+        assert c["fleet_migrated_out"] == 3
+        assert c["fleet_migrated_in"] == 3
+        assert c["fleet_migrated_out_pages"] >= 3
+        assert (c["fleet_migrated_out_pages"]
+                >= c["fleet_migrated_in_pages"])
+        assert c["fleet_requests"] == 3     # each request counted ONCE
+        router.reconcile()
+
+    def test_migrated_blocks_seed_decode_prefix_cache(
+            self, params, engines):
+        clk = ManualClock()
+        router = make_fleet(engines, clk)
+        prefix = np.asarray(
+            [5, 9, 2, 44, 17, 3, 28, 51], np.int32)   # two full blocks
+        p1 = np.concatenate([prefix, np.asarray([7, 11, 30], np.int32)])
+        p2 = np.concatenate([prefix, np.asarray([19, 4, 55], np.int32)])
+        r1 = router.submit(p1, max_new=5)
+        router.run()
+        c1 = router.counters()
+        assert c1["migrations"] == 1
+        # the repeat prefix routes by affinity STRAIGHT to the decode
+        # replica whose cache the migration seeded — served end-to-end
+        # there with a prefix hit, no second migration
+        r2 = router.submit(p2, max_new=5)
+        res = router.run()
+        c2 = router.counters()
+        assert res[r2].outcome == "completed"
+        assert res[r2].tokens == ref_tokens(params, p2, 5)
+        assert res[r2].replica == res[r1].replica
+        assert c2["migrations"] == 1                   # no new transfer
+        assert c2["fleet_prefix_hits"] > c1["fleet_prefix_hits"]
+        assert c2["affinity_hits"] >= 1
+        router.reconcile()
+
+    @pytest.mark.slow  # tier-1 budget guard: the disagg lane runs it
+    def test_speculative_parity_through_migration(self, params,
+                                                  engines):
+        clk = ManualClock()
+        router = make_fleet(engines, clk, speculative=True)
+        prompts = prompts_for(2, seed=11)
+        ids = [router.submit(p, max_new=6) for p in prompts]
+        res = router.run()
+        for p, rr in zip(prompts, ids):
+            assert res[rr].outcome == "completed"
+            assert res[rr].tokens == ref_tokens(params, p, 6)
+        c = router.counters()
+        assert c["migrations"] == 2
+        assert c["fleet_spec_rounds"] > 0   # decode tier speculated
+        router.reconcile()
+
+    def test_migration_zero_steady_state_compiles(self, params,
+                                                  engines):
+        """One warm migration compiles the pause/kvread/kvwrite/resume
+        bodies; every later transfer — different prompt, different
+        block count — reuses them (static page-vector padding)."""
+        clk = ManualClock()
+        router = make_fleet(engines, clk)
+        router.submit(np.arange(1, 12, dtype=np.int32), max_new=4)
+        router.run()                        # warm-up migration
+        with RecompileGuard(name="steady-state migration") as g:
+            rr = router.submit(np.arange(4, 17, dtype=np.int32),
+                               max_new=4)
+            res = router.run()
+        assert g.compiles == 0
+        assert res[rr].outcome == "completed"
+        assert router.counters()["migrations"] == 2
+        router.reconcile()
+
+
+class TestMigrationChaos:
+    pytestmark = [pytest.mark.faults]
+
+    def test_destination_death_mid_transfer_retries(self, params,
+                                                    engines):
+        """The first migration's destination dies MID-IMPORT: the
+        source export pins keep its copy whole, the SAME payload lands
+        on the surviving decode replica, parity holds, exactly-once
+        holds, and the fleet counters reconcile."""
+        clk = ManualClock()
+        plan = FaultPlan(router_kill_import_at=0)
+        router = make_fleet(
+            engines, clk,
+            wrap={1: lambda e: plan.wrap_replica_engine(e, clock=clk)})
+        prompt = np.arange(2, 14, dtype=np.int32)
+        rr = router.submit(prompt, max_new=6)
+        res = router.run()
+        assert plan.count("importkill") == 1
+        assert res[rr].outcome == "completed"
+        assert res[rr].tokens == ref_tokens(params, prompt, 6)
+        assert res[rr].replica == 2         # the surviving destination
+        c = router.counters()
+        assert c["replicas_lost"] == 1
+        assert c["migration_retargets"] == 1
+        assert c["migrations"] == 1
+        assert c["migration_failed"] == 0
+        # the source released its copy only after the final ACK
+        src = router.replicas[0].server
+        assert src._active_pool.exports_outstanding == 0
+        assert src.counters()["migrated_out"] == 1
+        router.reconcile()
+
+    def test_destination_death_with_no_survivor_cancels(self, params,
+                                                        engines):
+        """Only ONE decode replica, and it dies mid-import: the
+        handoff cancels back to the source, which decodes the request
+        locally from its still-pinned blocks — graceful degrade,
+        never a lost request."""
+        clk = ManualClock()
+        plan = FaultPlan(router_kill_import_at=0)
+        router = make_fleet(
+            engines[:2], clk, roles=("prefill", "decode"),
+            wrap={1: lambda e: plan.wrap_replica_engine(e, clock=clk)})
+        prompt = np.arange(1, 13, dtype=np.int32)
+        rr = router.submit(prompt, max_new=6)
+        res = router.run()
+        assert plan.count("importkill") == 1
+        assert res[rr].outcome == "completed"
+        assert res[rr].tokens == ref_tokens(params, prompt, 6)
+        assert res[rr].replica == 0         # decoded at the source
+        c = router.counters()
+        assert c["replicas_lost"] == 1
+        assert c["migration_failed"] == 1
+        assert c["migrations"] == 0
+        src = router.replicas[0].server
+        assert src._active_pool.exports_outstanding == 0
+        assert src.counters()["handoffs_cancelled"] == 1
+        router.reconcile()
+
+    def test_source_death_while_parked_resubmits_exactly_once(
+            self, params, engines):
+        """Both copies lost: the PREFILL replica dies while requests
+        are parked (its pinned blocks die with it, and no destination
+        ever imported). The PR6 harvest path resubmits each request
+        to a survivor — decode replicas serve end-to-end as the
+        degrade tier — with exactly one outcome each."""
+        clk = ManualClock()
+        plan = FaultPlan()
+        router = make_fleet(engines, clk)
+        src = router.replicas[0]
+        prompt = np.arange(3, 15, dtype=np.int32)
+        rr = router.submit(prompt, max_new=6)
+        # park it (one sweep of the source alone), then kill the
+        # source BEFORE the router's migration harvest runs
+        src.server.step()
+        while src.server._prefilling:
+            src.server.step()
+        assert src.server.ready_handoffs()
+        src.server.engine = plan.wrap_replica_engine(src.server.engine,
+                                                     clock=clk)
+        src.server.engine.dead = True
+        src.server._backend = src.server.engine
+        res = router.run()
+        assert res[rr].outcome == "completed"
+        assert res[rr].tokens == ref_tokens(params, prompt, 6)
+        assert res[rr].replica in (1, 2)
+        c = router.counters()
+        assert c["replicas_lost"] == 1
+        assert c["redistributed"] == 1
+        assert c["migrations"] == 0
+        # redistribution is a RESUBMIT (per-replica submission counted
+        # on source and survivor both — the PR6 semantic); contrast
+        # the migrated path, where the destination ACK backs the
+        # request out of the source ledger and fleet_requests stays 1
+        assert c["fleet_requests"] == 2
+        router.reconcile()

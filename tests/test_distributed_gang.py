@@ -8,6 +8,17 @@ join path that parallel/launch.py promises (reference analog: the
 in-process multi-node simulation of
 paddle/trainer/tests/test_TrainerOnePass.cpp:245-258 with real server
 objects, and go/pserver/etcd_client.go's init barrier).
+
+Historical note (these three failed from the seed until diagnosed):
+two independent root causes. (1) XLA:CPU refuses multi-process
+computations unless a cross-process collectives transport is
+configured — distributed.initialize() now selects jax's bundled gloo
+TCP transport when the job is pinned to CPU, which un-wedged all
+three gangs. (2) The CTR gang then still diverged from the
+single-process reference in the FIRST forward pass: ShardedEmbedding
+drew its init over the PADDED table shape, and jax.random draws are
+shape-dependent, so every row's init differed per mesh-axis size —
+fixed by drawing over the real vocab and zero-padding.
 """
 
 import json
@@ -130,7 +141,7 @@ for _ in range(2):
 D.sync_hosts("after-steps")
 
 # compare REAL rows only: ShardedEmbedding pads the vocab to a
-# multiple of the mesh axis, so the n=2 table has one extra random
+# multiple of the mesh axis, so the n=2 table has one extra (zero)
 # pad row the n=1 reference doesn't
 rsum = jax.jit(lambda t: jnp.sum(jnp.abs(t[:65])),
                out_shardings=NamedSharding(gmesh, P()))
@@ -268,6 +279,12 @@ def test_two_process_gang_matches_single_process(tmp_path):
         rtol=1e-5)
 
 
+# the two workload variants are ~10s each (two fresh python processes
+# + gloo bootstrap + their own compiles): slow-demoted under the
+# tier-1 870s cap discipline. The transport/bootstrap fix they share
+# stays tier-1-proven by the 4s two-process test above; run these via
+# `pytest tests/test_distributed_gang.py` (or -m slow).
+@pytest.mark.slow
 def test_ctr_sparse_alltoall_gang_matches_single_process(tmp_path):
     """The collective-heavy path across a REAL process boundary (r4
     verdict weak #7: the only gang case was a toy MLP): the CTR train
@@ -309,6 +326,7 @@ def test_ctr_sparse_alltoall_gang_matches_single_process(tmp_path):
         rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_moe_expert_parallel_gang_matches_single_process(tmp_path):
     """Third gang case: the MoE expert-parallel shard_map (all-to-all
     token dispatch + combine, and its BACKWARD) across a real

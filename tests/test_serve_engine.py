@@ -130,6 +130,7 @@ class TestEngineConsistency:
 
 
 class TestBuckets:
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_bucketed_prompts_match_unpadded(self, params):
         """Padding to a bucket + true_len must not change a single
         token vs the unpadded solo decode (the masked-prefill
@@ -352,6 +353,7 @@ def test_moe_pool_matches_generate():
         assert g == [int(t) for t in np.asarray(out[0, len(pr):])], pr
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_moe_buckets_tight_capacity_matches_generate():
     """MoE + bucket padding + inactive slots under a TIGHT capacity
     factor: bucket-pad tokens (prefill) and inactive slots (decode)
