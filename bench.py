@@ -703,6 +703,132 @@ def bench_disagg() -> None:
          obs_snapshot=registry.snapshot()["series"])
 
 
+def bench_fleet() -> None:
+    """Cross-process fleet stage (ISSUE 14): the two latencies that
+    decide whether elastic process replicas are worth running — how
+    fast the supervisor REACTS to a load spike (burst arrival ->
+    first replacement spawned and routable), and how fast the fleet
+    RECOVERS a real SIGKILL (kill observed -> last redistributed
+    request completed, exactly-once books intact). Real spawned
+    processes booted from a PR9 artifact; forces the CPU backend;
+    `scripts/perf_smoke.sh fleet` drives it as `bench.py
+    --fleet-only`."""
+    import tempfile
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.obs import MetricsRegistry
+    from paddle_tpu.serve.fleet import (AutoscalePolicy,
+                                        FleetSupervisor, ReplicaSpec)
+    from paddle_tpu.testing.faults import FaultPlan
+    from paddle_tpu.testing.fleet import save_tiny_artifact
+
+    tmp = tempfile.mkdtemp(prefix="fleet_bench_")
+    art = os.path.join(tmp, "engine.tar")
+    log("fleet: writing engine artifact (replica boots skip compiles)")
+    save_tiny_artifact(art, buckets=(16,))
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+
+    def mk_spec():
+        return ReplicaSpec(
+            builder="paddle_tpu.testing.fleet:build_tiny_server",
+            kwargs=dict(artifact=art, buckets=(16,), max_retries=1),
+            env=env)
+
+    r = np.random.RandomState(7)
+    prompts = [r.randint(0, 61, (6 + i % 5,)).astype(np.int32)
+               for i in range(10)]
+
+    # -- stage A: scale-out reaction + scale back to floor ---------------
+    log("fleet: scale-out reaction (burst into a 1-replica floor)")
+    registry = MetricsRegistry()
+    sup = FleetSupervisor(
+        mk_spec(), min_replicas=1, max_replicas=3,
+        policy=AutoscalePolicy(queue_high=1.0, cooldown_sweeps=2,
+                               idle_sweeps=4),
+        registry=registry)
+    sup.start()
+    for p in prompts:
+        sup.submit(p, max_new=8)
+    t0 = time.monotonic()
+    before = sup.stats["scale_out_events"]
+    sweeps, peak = 0, 1
+    reaction_s, reaction_sweeps = None, None
+    while True:
+        busy = sup.sweep()
+        sweeps += 1
+        routable = sup.counters()["replicas_routable"]
+        peak = max(peak, routable)
+        if (reaction_s is None
+                and sup.stats["scale_out_events"] > before):
+            reaction_s = round(time.monotonic() - t0, 3)
+            reaction_sweeps = sweeps
+        if not busy:
+            break
+    completed = sum(1 for res in sup.router.results.values()
+                    if res.outcome == "completed")
+    back_to_floor = None
+    for extra in range(64):        # idle: autoscaler retires + reaps
+        sup.sweep()
+        if (sup.counters()["replicas_routable"] <= sup.min_replicas
+                and not sup._retiring):
+            back_to_floor = extra + 1
+            break
+    sup.reconcile()
+    emit("serve_fleet_scaleout_reaction_s", reaction_s,
+         "seconds burst->first spawn routable", None,
+         reaction_sweeps=reaction_sweeps, peak_routable=peak,
+         scale_out_events=sup.stats["scale_out_events"],
+         scale_in_events=sup.stats["scale_in_events"],
+         back_to_floor_sweeps=back_to_floor,
+         completed=completed, requests=len(prompts),
+         obs_snapshot=registry.snapshot()["series"])
+    sup.shutdown(drain=False)
+
+    # -- stage B: SIGKILL recovery latency -------------------------------
+    log("fleet: SIGKILL recovery (3 procs, kill one mid-burst)")
+    registry = MetricsRegistry()
+    sup = FleetSupervisor(mk_spec(), min_replicas=3, max_replicas=3,
+                          registry=registry)
+    sup.start()
+    FaultPlan(fleet_sigkill_at=4, fleet_sigkill_replica=1).wrap_fleet(sup)
+    # recovery latency = kill observed -> last redistributed request
+    # done; done_at is stamped child-side on CLOCK_MONOTONIC, which
+    # is system-wide on Linux, so it compares with our clock
+    kill_t = [None]
+    orig_death = sup.router._on_replica_death
+
+    def timed_death(rep, exc):
+        if kill_t[0] is None:
+            kill_t[0] = time.monotonic()
+        orig_death(rep, exc)
+
+    sup.router._on_replica_death = timed_death
+    rids = [sup.submit(p, max_new=8) for p in prompts]
+    res = sup.run()
+    sup.reconcile()
+    c = sup.router.counters()
+    recovered = [res[i] for i in rids
+                 if res[i].redistributions > 0
+                 and res[i].outcome == "completed"]
+    latency = (round(max(x.done_at for x in recovered) - kill_t[0], 3)
+               if recovered and kill_t[0] is not None else None)
+    emit("serve_fleet_kill_recovery_latency_s", latency,
+         "seconds kill->last recovered", None,
+         requests_recovered=len(recovered),
+         replicas_lost=c["replicas_lost"],
+         redistributed=c["redistributed"],
+         completed=c["completed"],
+         procs_respawned=sup.stats["spawned"] - 3,
+         all_exactly_once=bool(
+             c["completed"] + c["expired"] + c["shed"] + c["failed"]
+             == c["requests"]),
+         obs_snapshot=registry.snapshot()["series"])
+    sup.shutdown(drain=False)
+
+
 def bench_speculative(cfg, params) -> None:
     """Speculative-decoding stage (ISSUE 9): plain vs speculative
     serving over IDENTICAL repetitive traffic — the n-gram proposer's
@@ -1219,6 +1345,8 @@ if __name__ == "__main__":
         bench_kernels()
     elif len(sys.argv) > 1 and sys.argv[1] == "--disagg-only":
         bench_disagg()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--fleet-only":
+        bench_fleet()
     elif len(sys.argv) > 1 and sys.argv[1] == "--cold-start-only":
         bench_cold_start()
     elif len(sys.argv) > 1 and sys.argv[1] == "--cold-start-child":

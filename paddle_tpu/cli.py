@@ -377,7 +377,14 @@ def cmd_serve(args) -> int:
                      else args.max_len),
             eos_id=sc.get("eos_id"), seed=args.seed)
 
-    eng = make_engine()
+    if args.fleet_procs is not None and args.replicas is not None:
+        raise SystemExit(
+            "--fleet-procs and --replicas are mutually exclusive: "
+            "one fleet of threads OR one fleet of processes")
+    # --fleet-procs replicas build their engines IN THE CHILD
+    # processes (serve.fleet builder); the parent never compiles a
+    # pool of its own
+    eng = None if args.fleet_procs else make_engine()
 
     with open(args.prompts) as f:
         prompts = [np.asarray([int(t) for t in line.split()], np.int32)
@@ -404,6 +411,13 @@ def cmd_serve(args) -> int:
                 or args.metrics_out is not None
                 or args.flight_dir is not None)
     try:
+        if args.fleet_procs:
+            # N replica PROCESSES behind the fleet supervisor
+            # (docs/SERVING.md "Elastic autoscaling & rolling
+            # upgrades"): SIGKILL-safe failover, elastic scale
+            with _transfer_guard(args.transfer_guard):
+                return _serve_fleet_procs(args, prompts, sampling,
+                                          buckets, sink)
         if args.replicas is not None and args.replicas > 1:
             # N single-box replicas behind the prefix-affinity router
             # (docs/SERVING.md "Multi-replica routing"): one engine
@@ -617,6 +631,89 @@ def _serve_fleet(args, engines, prompts, sampling, buckets, sink):
         import os
 
         os.replace(tmp, args.drain_report)
+    return 0
+
+
+def _serve_fleet_procs(args, prompts, sampling, buckets, sink):
+    """`serve --fleet-procs N`: the cross-process fleet
+    (serve.fleet). Each replica runs its ServingServer in its own OS
+    process over the socket transport; the supervisor owns spawn /
+    reap / autoscale (up to --fleet-max) and the router owns
+    exactly-once failover, so a replica SIGKILL mid-batch
+    redistributes its ledger instead of losing requests. The batch
+    feeds the fleet between sweeps (child queues drain as we submit),
+    SIGTERM/SIGINT drains the whole fleet, and the transcript is the
+    shared ordered format plus the fleet `# outcomes` trailer."""
+    import os
+    import signal
+
+    from paddle_tpu.serve.fleet import FleetSupervisor, ReplicaSpec
+    from paddle_tpu.serve.router import QueueFullError
+
+    # the parent-side tracer has no replica to hand spans to across
+    # the process boundary; children run their own obs stacks
+    registry, _tracer, flight = _obs_stack(args.metrics_out,
+                                           args.flight_dir)
+    # children must land on the parent's platform: pass the selection
+    # through the spec env (the child re-asserts it at jax config
+    # level — see serve.fleet._replica_main)
+    env = {k: v for k, v in ((n, os.environ.get(n))
+                             for n in ("JAX_PLATFORMS", "XLA_FLAGS"))
+           if v is not None}
+    spec = ReplicaSpec(
+        builder="paddle_tpu.serve.fleet:build_server_from_config",
+        kwargs=dict(
+            config=os.path.abspath(args.config),
+            slots=args.slots, max_len=args.max_len, seed=args.seed,
+            max_queue=(args.max_queue if args.max_queue is not None
+                       else 64),
+            default_deadline_ms=args.default_deadline_ms,
+            max_retries=args.max_retries, buckets=buckets,
+            drain_grace_s=args.drain_grace,
+            artifact=args.engine_artifact),
+        env=env)
+    sup = FleetSupervisor(
+        spec, min_replicas=args.fleet_procs,
+        max_replicas=max(args.fleet_procs,
+                         args.fleet_max or args.fleet_procs),
+        registry=registry, flight=flight,
+        flight_dir=args.flight_dir)
+    sup.start()
+
+    def handler(signum, frame):
+        sup.drain(reason=f"signal {signum}")
+
+    prev = {s: signal.signal(s, handler)
+            for s in (signal.SIGTERM, signal.SIGINT)}
+    ids = {}
+    try:
+        cursor = 0
+        while cursor < len(prompts) and not sup.router.draining:
+            if (sup.router.queue_space() <= 0
+                    and any(r.routable()
+                            for r in sup.router.replicas)):
+                # queues full but the fleet is healthy: a sweep
+                # drains them (and may scale out), then keep feeding
+                sup.sweep()
+                continue
+            try:
+                ids[cursor] = sup.submit(
+                    prompts[cursor], max_new=args.max_new,
+                    sampling=(sampling[cursor] if sampling else None))
+            except (ValueError, QueueFullError) as e:
+                ids[cursor] = e.rr_id   # ledgered under its id
+            cursor += 1
+            sup.sweep()
+        results = sup.run()
+        sup.reconcile()
+        counters = sup.router.counters()
+        counters.update(sup.counters())
+    finally:
+        for s, h in prev.items():
+            signal.signal(s, h)
+        sup.shutdown(drain=False)
+    _render_serve_results(args, sink, prompts, ids, results, counters)
+    _write_metrics(registry, args.metrics_out)
     return 0
 
 
@@ -874,6 +971,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "prefix-affinity router (serve.router): one "
                          "engine pool per replica, health-checked "
                          "failover, replica-loss redistribution")
+    sv.add_argument("--fleet-procs", type=int, default=None,
+                    help="serve through N replica PROCESSES behind "
+                         "the fleet supervisor (serve.fleet): each "
+                         "replica runs its ServingServer in its own "
+                         "OS process over the socket transport, with "
+                         "SIGKILL-safe exactly-once failover and "
+                         "elastic autoscaling up to --fleet-max")
+    sv.add_argument("--fleet-max", type=int, default=None,
+                    help="autoscale ceiling for --fleet-procs "
+                         "(default: the floor — no elastic headroom)")
     sv.add_argument("--slots", type=int, default=None)
     sv.add_argument("--max-len", type=int, default=None)
     sv.add_argument("--buckets", default=None,

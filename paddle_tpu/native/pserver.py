@@ -55,6 +55,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from paddle_tpu.wire import MAX_FRAME, recv_frame, send_frame
+from paddle_tpu.wire import recv_full as _recv_full
+
 log = logging.getLogger(__name__)
 
 # -- wire protocol (MasterClient framing: <I length, then payload) -------
@@ -83,7 +86,7 @@ _REPL_STATE = 2          # full-state resync after a degraded repl link
 # Row traffic moves in bounded chunks, but SYNC / resync frames carry a
 # whole shard's state — size shards below this (1 GiB ≈ 4M rows × 64
 # f32 dims); anything larger is a protocol error, not a workload.
-_MAX_FRAME = 1 << 30
+_MAX_FRAME = MAX_FRAME
 
 
 class FaultSignal(Exception):
@@ -102,28 +105,10 @@ class DropConnection(FaultSignal):
     shape) — the shard itself stays alive."""
 
 
-def send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(struct.pack("<I", len(payload)) + payload)
-
-
-def recv_frame(sock: socket.socket) -> bytes:
-    hdr = _recv_full(sock, 4)
-    (n,) = struct.unpack("<I", hdr)
-    if n > _MAX_FRAME:
-        raise ConnectionError(f"frame of {n} bytes exceeds the "
-                              f"{_MAX_FRAME}-byte cap")
-    return _recv_full(sock, n)
-
-
-def _recv_full(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        b = sock.recv(n)
-        if not b:
-            raise ConnectionError("peer closed")
-        chunks.append(b)
-        n -= len(b)
-    return b"".join(chunks)
+# The framing itself (4-byte-LE prefix, bounded-before-allocation,
+# EINTR/short-read-safe loops) lives in `paddle_tpu.wire` — shared
+# with the trainer-side shard client and the serving fleet's replica
+# transport, re-exported here for the existing call sites.
 
 
 # -- shard state ---------------------------------------------------------

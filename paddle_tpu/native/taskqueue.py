@@ -19,6 +19,7 @@ import threading
 from typing import Optional, Tuple
 
 from paddle_tpu.native.build import ensure_built
+from paddle_tpu.wire import recv_frame, send_frame
 
 
 class TaskStatus(enum.IntEnum):
@@ -301,11 +302,8 @@ class MasterClient:
 
     def _call(self, payload: bytes, idempotent: bool = True) -> bytes:
         def send_recv():
-            self._sock.sendall(
-                struct.pack("<I", len(payload)) + payload)
-            hdr = self._recv_full(4)
-            (n,) = struct.unpack("<I", hdr)
-            return self._recv_full(n)
+            send_frame(self._sock, payload)
+            return recv_frame(self._sock)
 
         if idempotent:
             return self._with_retry(send_recv)
@@ -322,16 +320,6 @@ class MasterClient:
                 f"non-idempotent op to {self.host}:{self.port} failed "
                 f"mid-flight ({e}); NOT retried — the master may or "
                 f"may not have applied it") from e
-
-    def _recv_full(self, n: int) -> bytes:
-        chunks = []
-        while n:
-            b = self._sock.recv(n)
-            if not b:
-                raise ConnectionError("master connection closed")
-            chunks.append(b)
-            n -= len(b)
-        return b"".join(chunks)
 
     def add_task(self, payload: bytes) -> int:
         resp = self._call(bytes([_OP_ADD]) + payload, idempotent=False)

@@ -175,6 +175,31 @@ class Histogram:
         row = self._series.get(_label_key(labels))
         return row[-2] if row else 0.0
 
+    def quantile(self, q: float,
+                 labels: Optional[Mapping[str, str]] = None
+                 ) -> Optional[float]:
+        """Bucket-resolution quantile estimate: the UPPER BOUND of
+        the first bucket whose cumulative count reaches `q` of the
+        total — the standard Prometheus-style read, conservative by
+        one bucket width. Returns None with no observations, and the
+        highest FINITE bound when the quantile lands in the +Inf
+        bucket (there is no meaningful number past it). The fleet
+        autoscaler reads p99 from here."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        row = self._series.get(_label_key(labels))
+        if row is None or row[-1] == 0:
+            return None
+        need = q * row[-1]
+        for i, b in enumerate(self.buckets):
+            if row[i] >= need and row[i] > 0:
+                if b == float("inf"):
+                    finite = [x for x in self.buckets
+                              if x != float("inf")]
+                    return finite[-1] if finite else None
+                return b
+        return None             # pragma: no cover (inf is cumulative)
+
     def _rows(self) -> List[Tuple[LabelKey, str, float]]:
         out: List[Tuple[LabelKey, str, float]] = []
         for key, row in sorted(self._series.items()):

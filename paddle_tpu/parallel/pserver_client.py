@@ -53,9 +53,8 @@ from paddle_tpu.native.pserver import (
     ST_LEASE_EXPIRED,
     ST_OK,
     ShardSpec,
-    recv_frame,
-    send_frame,
 )
+from paddle_tpu.wire import recv_frame, send_frame
 
 
 class PServerError(RuntimeError):

@@ -13,6 +13,8 @@ from paddle_tpu.serve.artifact import (
 from paddle_tpu.serve import quant
 from paddle_tpu.serve.engine import (DecodeEngine, EngineState,
                                      PoolStats, PrefillTicket)
+from paddle_tpu.serve.fleet import (AutoscalePolicy, FleetSupervisor,
+                                    ReplicaProcess, ReplicaSpec)
 from paddle_tpu.serve.paged import (PagePool, PoolExhaustedError,
                                     chain_keys)
 from paddle_tpu.serve.policy import RandomRoutingPolicy, SchedulerPolicy
@@ -21,6 +23,11 @@ from paddle_tpu.serve.router import (Replica, ReplicaDeadError,
 from paddle_tpu.serve.server import (CircuitBreaker, QueueFullError,
                                      Request, RequestResult,
                                      ServingServer)
+from paddle_tpu.serve.transport import (ProcessReplica, ReplicaClient,
+                                        ReplicaTransportServer,
+                                        TransportCallError,
+                                        TransportConnectError,
+                                        TransportError)
 from paddle_tpu.serve.quant import (
     QuantizedTensor,
     dequantize_params,

@@ -163,6 +163,11 @@ class ServingRouter:
                        else SchedulerPolicy())
         self.probe_interval_s = probe_interval_s
         self.affinity_blocks = affinity_blocks
+        # kept for add_replica(): late joiners (the fleet
+        # supervisor's scale-out path) get the same breaker contract
+        # as the founding members
+        self._failure_threshold = failure_threshold
+        self._cooldown_s = cooldown_s
         self.replicas = [
             Replica(i, srv, CircuitBreaker(
                 failure_threshold=failure_threshold,
@@ -205,7 +210,7 @@ class ServingRouter:
             # (refused / destination died mid-import), and handoffs
             # cancelled back to source-local decode
             "migrations": 0, "migration_retargets": 0,
-            "migration_failed": 0}
+            "migration_failed": 0, "replicas_reaped": 0}
         # dead replicas' pool counters, banked at death so aggregate
         # prefix-hit observability never goes backwards
         self._dead_base: Dict[str, int] = {}
@@ -283,37 +288,48 @@ class ServingRouter:
         if self.tracer is not None:
             self.tracer.start(tid, "fleet.request", rr_id=rr_id)
         chain = self._chain(prompt)
-        rep = self._pick(chain)
-        if rep is None:
-            res = RouterResult(
-                rr_id=rr_id, outcome=SHED,
-                error="load shed: no routable replica (fleet "
-                      "unhealthy or draining)")
-            self._record(res)
-            err = QueueFullError(res.error)
-            err.rr_id = rr_id
-            raise err
-        try:
-            rep_id = rep.server.submit(
-                prompt, max_new=max_new, deadline_ms=deadline_ms,
-                sampling=sampling, trace_id=tid)
-        except ValueError as e:
-            # deterministic rejection by the replica's validator —
-            # mirror its (already ledgered) FAILED result
-            self._record(RouterResult(
-                rr_id=rr_id, outcome=FAILED, error=str(e),
-                replica=rep.rid))
-            e.rr_id = rr_id
-            raise
-        except QueueFullError as e:
-            # the replica shed the INCOMING request as cheapest to
-            # retry (a displaced QUEUED victim is mirrored on the
-            # next sweep instead)
-            self._record(RouterResult(
-                rr_id=rr_id, outcome=SHED, error=str(e),
-                replica=rep.rid))
-            e.rr_id = rr_id
-            raise
+        while True:
+            rep = self._pick(chain)
+            if rep is None:
+                res = RouterResult(
+                    rr_id=rr_id, outcome=SHED,
+                    error="load shed: no routable replica (fleet "
+                          "unhealthy or draining)")
+                self._record(res)
+                err = QueueFullError(res.error)
+                err.rr_id = rr_id
+                raise err
+            try:
+                rep_id = rep.server.submit(
+                    prompt, max_new=max_new, deadline_ms=deadline_ms,
+                    sampling=sampling, trace_id=tid)
+            except ValueError as e:
+                # deterministic rejection by the replica's validator —
+                # mirror its (already ledgered) FAILED result
+                self._record(RouterResult(
+                    rr_id=rr_id, outcome=FAILED, error=str(e),
+                    replica=rep.rid))
+                e.rr_id = rr_id
+                raise
+            except QueueFullError as e:
+                # the replica shed the INCOMING request as cheapest to
+                # retry (a displaced QUEUED victim is mirrored on the
+                # next sweep instead)
+                self._record(RouterResult(
+                    rr_id=rr_id, outcome=SHED, error=str(e),
+                    replica=rep.rid))
+                e.rr_id = rr_id
+                raise
+            except Exception as e:
+                # a PROCESS replica can die at submission time (the
+                # socket is the first to know): standard failover —
+                # mark it dead, redistribute ITS pending work, and
+                # re-pick a survivor for THIS request
+                if not getattr(e, "replica_fatal", False):
+                    raise
+                self._on_replica_death(rep, e)
+                continue
+            break
         rep.pending[rep_id] = rr_id
         self._note_affinity(chain, rep)
         return rr_id
@@ -581,6 +597,41 @@ class ServingRouter:
                 continue
             self._redistribute(rr_id, req, why=reason)
 
+    # -- elastic membership (the fleet supervisor's surface) ---------------
+
+    def add_replica(self, server) -> int:
+        """Join a new replica to the fleet mid-flight (scale-out,
+        rolling-upgrade replacement). It gets the same breaker
+        contract as the founding members and enters the NEXT sweep;
+        rids are append-only, so a reaped rid is never reused and
+        per-replica records stay unambiguous."""
+        rid = len(self.replicas)
+        self.replicas.append(Replica(rid, server, CircuitBreaker(
+            failure_threshold=self._failure_threshold,
+            cooldown_s=self._cooldown_s, clock=self.clock)))
+        return rid
+
+    def reap_replica(self, rid: int) -> None:
+        """Drop an EMPTY retired replica from the sweep — the
+        graceful symmetric of `_on_replica_death`: outcomes already
+        mirrored, counters banked (aggregate observability stays
+        monotone), affinity entries dropped. The caller (the fleet
+        supervisor) guarantees the replica finished its in-flight
+        work; anything still pending would violate exactly-once, so
+        it is asserted, not redistributed."""
+        rep = self.replicas[rid]
+        if not rep.alive:
+            return              # death already banked everything
+        self._mirror(rep)
+        assert not rep.pending, (
+            f"reap of replica {rid} with work still pending "
+            f"{rep.pending} — retire and drain first")
+        self._bank_pool_counters(rep)
+        for key in [k for k, r in self._affinity.items() if r is rep]:
+            del self._affinity[key]
+        rep.alive = False
+        self.stats["replicas_reaped"] += 1
+
     # -- health ------------------------------------------------------------
 
     def _probe_due(self) -> bool:
@@ -618,50 +669,58 @@ class ServingRouter:
 
     # -- the drive loop ----------------------------------------------------
 
-    def run(self) -> Dict[int, RouterResult]:
-        """Serve until every replica is idle: round-robin one
-        `step()` per live replica per sweep, probing on the
-        `probe_interval_s` cadence, harvesting outcomes, and
-        redistributing on any replica-fatal error. Safe to call
-        repeatedly — later `submit()`s extend the same ledger."""
-        while True:
-            if self._probe_due():
-                self.probe_all()
-            busy = False
-            for rep in self.replicas:
-                if not rep.alive:
+    def sweep(self) -> bool:
+        """ONE drive sweep: probe if due, round-robin one `step()`
+        per live replica, mirror outcomes, harvest disagg handoffs,
+        redistribute on any replica-fatal error. Returns True while
+        the fleet has work — `run()` is just this in a loop, and the
+        fleet supervisor interleaves its autoscale/reap ticks at
+        exactly this boundary."""
+        if self._probe_due():
+            self.probe_all()
+        busy = False
+        # list(): a supervisor callback (autoscale inside a fault
+        # hook) may append replicas mid-sweep; they join NEXT sweep
+        for rep in list(self.replicas):
+            if not rep.alive:
+                continue
+            try:
+                busy = rep.server.step() or busy
+            except Exception as e:
+                if getattr(e, "replica_fatal", False):
+                    self._on_replica_death(rep, e)
+                    busy = True     # survivors just got work
                     continue
+                raise
+            self._mirror(rep)
+            if (self._disagg and rep.alive
+                    and rep.server.role == "prefill"
+                    and rep.server.ready_handoffs()):
                 try:
-                    busy = rep.server.step() or busy
+                    # migrations hand the decode tier (or,
+                    # cancelled, this replica) new work mid-sweep
+                    busy = self._harvest_handoffs(rep) > 0 or busy
                 except Exception as e:
                     if getattr(e, "replica_fatal", False):
+                        # the SOURCE died with requests parked:
+                        # its pinned blocks died with it and no
+                        # destination ever committed — both copies
+                        # lost, so the parked requests ride the
+                        # standard redistribution path (full
+                        # re-prefill on a survivor, exactly one
+                        # outcome each)
                         self._on_replica_death(rep, e)
-                        busy = True     # survivors just got work
+                        busy = True
                         continue
                     raise
-                self._mirror(rep)
-                if (self._disagg and rep.alive
-                        and rep.server.role == "prefill"
-                        and rep.server.ready_handoffs()):
-                    try:
-                        # migrations hand the decode tier (or,
-                        # cancelled, this replica) new work mid-sweep
-                        busy = self._harvest_handoffs(rep) > 0 or busy
-                    except Exception as e:
-                        if getattr(e, "replica_fatal", False):
-                            # the SOURCE died with requests parked:
-                            # its pinned blocks died with it and no
-                            # destination ever committed — both copies
-                            # lost, so the parked requests ride the
-                            # standard redistribution path (full
-                            # re-prefill on a survivor, exactly one
-                            # outcome each)
-                            self._on_replica_death(rep, e)
-                            busy = True
-                            continue
-                        raise
-            if not busy:
-                break
+        return busy
+
+    def run(self) -> Dict[int, RouterResult]:
+        """Serve until every replica is idle: `sweep()` in a loop.
+        Safe to call repeatedly — later `submit()`s extend the same
+        ledger."""
+        while self.sweep():
+            pass
         return self.results
 
     # -- observability -----------------------------------------------------

@@ -70,7 +70,14 @@ model") prove the multi-replica story:
   engine raises ReplicaDeadError and the replica stays dead — the
   disaggregated fleet's exactly-once contract must hold (source
   export pins intact, the transfer retried on another destination or
-  cancelled to source-local decode, fleet counters reconciled).
+  cancelled to source-local decode, fleet counters reconciled);
+- a replica PROCESS SIGKILLed mid-burst (`wrap_fleet`,
+  `fleet_sigkill_at` + `fleet_sigkill_replica`): a real `kill -9` on
+  one of a `FleetSupervisor`'s children right before the nth sweep —
+  no drain, no goodbye frame, the child's sockets die with it. The
+  fleet must harvest the router-side mirror ledger, redistribute with
+  exactly-once outcomes, and reconcile counters across the process
+  boundary (docs/RELIABILITY.md "Process-fleet fault model").
 
 Parameter-server faults (native.pserver + parallel.pserver_client,
 docs/RELIABILITY.md "Parameter-server fault model") use the shard's
@@ -130,6 +137,9 @@ class FaultPlan:
     router_kill_import_at: Optional[int] = None   # nth KV-block import
     router_probe_drop_first_n: Optional[int] = None  # blackholed probes
     router_slow_decode_s: float = 0.0             # clock skew per decode
+    # -- fleet process faults (serve.fleet, via wrap_fleet) --
+    fleet_sigkill_at: Optional[int] = None        # nth supervisor sweep
+    fleet_sigkill_replica: int = 0                # rid of the victim child
     # -- parameter-server faults (native.pserver, via wrap_pserver_shard) --
     pserver_kill_push_at: Optional[int] = None    # nth push received
     pserver_lost_ack_at: Optional[int] = None     # nth push ACK dropped
@@ -152,6 +162,7 @@ class FaultPlan:
         self._router_decode_counter = 0
         self._router_import_counter = 0
         self._router_probe_counter = 0
+        self._fleet_sweep_counter = 0
         self._pserver_push_counter = 0
         self._pserver_ack_counter = 0
         self._pserver_repl_counter = 0
@@ -334,6 +345,37 @@ class FaultPlan:
 
         replica.probe_hook = hook
         return replica
+
+    def wrap_fleet(self, supervisor):
+        """Install a REAL process kill on a `serve.fleet`
+        FleetSupervisor: right before the `fleet_sigkill_at`-th
+        supervisor sweep, the child process of replica
+        `fleet_sigkill_replica` gets SIGKILL — no drain, no goodbye
+        frame, its sockets and in-flight decode state die with the
+        address space (the kernel reaps; `join` makes the death
+        visible before the sweep runs, so the fault is deterministic
+        rather than racing the scheduler). The sweep must then
+        discover the corpse through the transport (connect failures /
+        a dead `proc.alive()`), harvest the router-side mirror
+        ledger, and redistribute with exactly-once outcomes."""
+        plan = self
+
+        inner_sweep = supervisor.sweep
+
+        def sweep():
+            idx = plan._fleet_sweep_counter
+            plan._fleet_sweep_counter += 1
+            if (idx == plan.fleet_sigkill_at
+                    and not plan._spent("fleetkill")):
+                proc = supervisor.procs.get(plan.fleet_sigkill_replica)
+                if proc is not None and proc.alive():
+                    plan._note("fleetkill", idx)
+                    os.kill(proc.pid, signal.SIGKILL)
+                    proc.proc.join(10.0)
+            return inner_sweep()
+
+        supervisor.sweep = sweep
+        return supervisor
 
     # -- parameter-server faults ------------------------------------------
 

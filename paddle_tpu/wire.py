@@ -1,0 +1,97 @@
+"""Length-prefixed socket framing — THE wire idiom, defined once.
+
+Every in-house socket protocol (the pserver taskqueue RPC in
+`native/pserver.py`, the trainer-side shard client in
+`parallel/pserver_client.py`, and the serving fleet's replica
+transport in `serve/transport.py`) frames messages the same way: a
+4-byte little-endian length prefix followed by the payload. This
+module is the single definition of that framing, hardened on both
+ends:
+
+- **Bounded before allocation.** `recv_frame` rejects a length prefix
+  over `max_frame` BEFORE allocating anything — a corrupted header (or
+  hostile bytes: garbage on the port parses as a length up to ~4 GiB)
+  costs a closed connection, never an OOM-sized allocation.
+- **Short-read/EINTR safe.** Kernels hand back partial reads at any
+  byte boundary and a signal (SIGCHLD from the fleet's reaped
+  children, a profiler's SIGPROF) can interrupt `recv` with EINTR.
+  `recv_full` loops until the exact byte count arrives, retrying
+  EINTR; Python 3.5+ retries EINTR internally (PEP 475) UNLESS a
+  signal handler raises or the socket has a timeout on some
+  platforms, so the explicit retry keeps the framing correct under
+  both.
+- **Oversized sends refused.** `send_frame` refuses a payload the
+  peer's `recv_frame` is guaranteed to reject — the error surfaces at
+  the sender, where the stack trace names the oversized object.
+
+A frame boundary failure anywhere raises `ConnectionError`: the
+stream is desynced and the only safe recovery is a fresh socket
+(which is exactly what every client here does — see
+`parallel.pserver_client.ShardConn.call`).
+
+Host-side only: no jax, no numpy — importable from any layer.
+"""
+
+from __future__ import annotations
+
+import errno
+import socket
+import struct
+
+__all__ = ["MAX_FRAME", "recv_frame", "recv_full", "send_frame"]
+
+#: Default frame cap. Row traffic and fleet RPCs move in small bounded
+#: chunks, but pserver SYNC / resync frames carry a whole shard's
+#: state — size shards below this (1 GiB ≈ 4M rows × 64 f32 dims);
+#: anything larger is a protocol error, not a workload.
+MAX_FRAME = 1 << 30
+
+
+def send_frame(sock: socket.socket, payload: bytes, *,
+               max_frame: int = MAX_FRAME) -> None:
+    """Write one length-prefixed frame. Refuses oversized payloads at
+    the sender (the receiver would reject them anyway — failing here
+    names the object that grew past the protocol bound)."""
+    n = len(payload)
+    if n > max_frame:
+        raise ValueError(
+            f"refusing to send a {n}-byte frame over the "
+            f"{max_frame}-byte cap")
+    sock.sendall(struct.pack("<I", n) + payload)
+
+
+def recv_frame(sock: socket.socket, *,
+               max_frame: int = MAX_FRAME) -> bytes:
+    """Read one frame. The length prefix is validated BEFORE any
+    payload allocation: garbage bytes on the socket decode as an
+    arbitrary 32-bit length, and honoring it would let one corrupt
+    header allocate gigabytes."""
+    hdr = recv_full(sock, 4)
+    (n,) = struct.unpack("<I", hdr)
+    if n > max_frame:
+        raise ConnectionError(f"frame of {n} bytes exceeds the "
+                              f"{max_frame}-byte cap")
+    return recv_full(sock, n)
+
+
+def recv_full(sock: socket.socket, n: int) -> bytes:
+    """Read exactly `n` bytes: short reads loop, EINTR retries, and a
+    peer close mid-frame raises `ConnectionError` (a truncated frame
+    is a dead stream, not a short message)."""
+    chunks = []
+    got = 0
+    while got < n:
+        try:
+            b = sock.recv(n - got)
+        except InterruptedError:
+            continue                    # EINTR: retry the same read
+        except OSError as e:
+            if e.errno == errno.EINTR:
+                continue
+            raise
+        if not b:
+            raise ConnectionError(
+                "peer closed mid-frame" if chunks else "peer closed")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)

@@ -14,6 +14,9 @@
 #     scripts/fault_smoke.sh disagg     # just the migration chaos lane
 #                                       #   (dst killed mid-transfer,
 #                                       #   source death while parked)
+#     scripts/fault_smoke.sh fleet      # just the cross-process fleet
+#                                       #   lane (socket replicas, real
+#                                       #   SIGKILL, orphan watchdog)
 #     scripts/fault_smoke.sh -k serve   # just the serving chaos suite
 #
 # CPU-only and deterministic (testing.faults FaultPlan + ManualClock;
@@ -27,6 +30,9 @@ if [ "$1" = "pserver" ] || [ "$1" = "router" ]; then
     shift
 elif [ "$1" = "disagg" ]; then
     marker="disagg and faults"
+    shift
+elif [ "$1" = "fleet" ]; then
+    marker="fleet and faults"
     shift
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "$marker" \

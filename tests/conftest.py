@@ -105,6 +105,18 @@ def pytest_configure(config):
         "`scripts/fault_smoke.sh disagg` / `scripts/perf_smoke.sh "
         "disagg`) runs it alone")
     config.addinivalue_line(
+        "markers", "fleet: cross-process serving-fleet suite "
+        "(serve.fleet/serve.transport: socket-transport replicas, "
+        "SIGKILL chaos, elastic autoscaling, rolling upgrades, the "
+        "orphan watchdog) — runs IN tier-1; `-m fleet` (or "
+        "`scripts/fault_smoke.sh fleet`, which runs "
+        "-m 'fleet and faults') runs it alone")
+    config.addinivalue_line(
+        "markers", "heavyweight: the ONE deliberate chaos heavyweight "
+        "a suite may carry — exempt from the tier-1 budget guard "
+        "(real process boots + a mid-burst SIGKILL cannot fit the "
+        "per-test threshold; everything else must)")
+    config.addinivalue_line(
         "markers", "aot: AOT serving-artifact + persistent "
         "compile-cache suite (engine bundle round-trip parity, "
         "manifest-mismatch fallback, corrupt-entry miss, subprocess "
@@ -118,7 +130,11 @@ def pytest_runtest_logreport(report):
     870s gate, so only fast-lane creep matters)."""
     if report.when != "call":
         return
-    if "slow" in getattr(report, "keywords", {}):
+    keywords = getattr(report, "keywords", {})
+    # `heavyweight` is the budget guard's one sanctioned exemption:
+    # the chaos test that boots real replica processes and SIGKILLs
+    # one mid-burst cannot meet the per-test threshold
+    if "slow" in keywords or "heavyweight" in keywords:
         return
     # stash on the report's session via terminal summary access below
     _budget_records.append((report.nodeid, report.duration))

@@ -1,0 +1,596 @@
+"""Cross-process serving fleet: socket transport + supervisor chaos.
+
+The process-fleet claim (docs/RELIABILITY.md "Process-fleet fault
+model"), proven at three depths:
+
+- **Wire + transport edge cases** — the shared framing helper
+  (`paddle_tpu.wire`) against scripted sockets (EINTR, short reads,
+  the cap rejected BEFORE allocation, truncation mid-payload), and
+  the replica RPC surface against real sockets (tag-replay
+  idempotence, result redelivery until ACKed, garbage bytes answered
+  in-band, connect-loss vs mid-flight-loss told apart).
+- **Supervisor mechanics in-process** — the `spawn` seam swaps real
+  children for duck types, so autoscale out/in, below-floor repair,
+  submit failover, and rolling upgrades run in milliseconds.
+- **The real thing** — actual spawned replica processes booted from a
+  PR9 engine artifact: a supervisor SIGKILLed without drain leaves no
+  orphans (the parent-death watchdog alone), and THE chaos
+  heavyweight SIGKILLs a replica process mid-burst and asserts
+  exactly-once outcomes, intact retry budgets, reconciled fleet
+  counters, bit-exact greedy parity, and the below-floor respawn.
+"""
+
+import os
+import pickle
+import signal
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.models import transformer as T
+from paddle_tpu.serve.engine import DecodeEngine
+from paddle_tpu.serve.fleet import (EXIT_ORPHANED, AutoscalePolicy,
+                                    FleetSupervisor, ReplicaSpec)
+from paddle_tpu.serve.router import (QueueFullError, ReplicaDeadError,
+                                     ServingRouter)
+from paddle_tpu.serve.server import ServingServer
+from paddle_tpu.testing.faults import FaultPlan
+from paddle_tpu.testing.fleet import TINY, _IdleServer, save_tiny_artifact
+from paddle_tpu.serve.transport import (ProcessReplica, ReplicaClient,
+                                        ReplicaTransportServer,
+                                        TransportCallError,
+                                        TransportConnectError)
+from paddle_tpu.wire import MAX_FRAME, recv_frame, recv_full, send_frame
+
+pytestmark = [pytest.mark.fleet, pytest.mark.faults]
+
+CFG = T.TransformerConfig(**TINY)
+
+#: env every replica child gets: the parent conftest pins cpu +
+#: 8 virtual devices, children re-assert cpu and need only 1
+CHILD_ENV = {"JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+
+
+@pytest.fixture(scope="module")
+def params():
+    return T.init_params(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def engines(params):
+    """Two warmed engines for the in-process transport / upgrade
+    tests (two, because an old and a new replica are live at once
+    during a rolling upgrade and may not share slot state)."""
+    engs = [DecodeEngine(params, CFG, slots=2, max_len=32, page_size=4)
+            for _ in range(2)]
+    warm = np.arange(5, dtype=np.int32)
+    for e in engs:
+        e.serve([warm], max_new=2, buckets=(16,))
+    return engs
+
+
+def ref_tokens(params, prompt, max_new):
+    out = T.generate(params, CFG, jax.numpy.asarray(prompt)[None, :],
+                     steps=max_new)
+    return [int(t) for t in np.asarray(out[0, len(prompt):])]
+
+
+def mk_prompts(n, seed=5):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, CFG.vocab, (4 + i % 5,)).astype(np.int32)
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# wire framing (the shared helper all three protocols adopted)
+
+
+class FakeSock:
+    """Scripted `recv`: each entry is bytes handed back once (short
+    reads by construction) or an exception instance raised in place."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.sent = b""
+
+    def recv(self, n):
+        if not self.script:
+            return b""
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        assert len(item) <= n
+        return item
+
+    def sendall(self, b):
+        self.sent += bytes(b)
+
+
+def test_wire_roundtrip_over_real_socket():
+    a, b = socket.socketpair()
+    try:
+        payload = b"x" * 70000        # several recv() chunks
+        send_frame(a, payload)
+        assert recv_frame(b) == payload
+    finally:
+        a.close()
+        b.close()
+
+
+def test_wire_oversized_rejected_before_allocation():
+    sock = FakeSock([struct.pack("<I", MAX_FRAME + 1)])
+    with pytest.raises(ConnectionError, match="exceeds the"):
+        recv_frame(sock)
+    assert sock.script == []          # nothing read past the header
+
+
+def test_wire_send_refuses_oversized():
+    sock = FakeSock([])
+    with pytest.raises(ValueError, match="refusing to send"):
+        send_frame(sock, b"xx", max_frame=1)
+    assert sock.sent == b""
+
+
+def test_wire_eintr_and_short_reads():
+    import errno
+    sock = FakeSock([
+        InterruptedError(),                   # EINTR on the header
+        struct.pack("<I", 5)[:2],             # short header read
+        struct.pack("<I", 5)[2:],
+        OSError(errno.EINTR, "interrupted"),  # EINTR mid-payload
+        b"he", b"llo",                        # short payload reads
+    ])
+    assert recv_frame(sock) == b"hello"
+
+
+def test_wire_truncated_mid_payload():
+    sock = FakeSock([struct.pack("<I", 5), b"he"])
+    with pytest.raises(ConnectionError, match="mid-frame"):
+        recv_frame(sock)
+
+
+def test_wire_peer_closed_before_header():
+    with pytest.raises(ConnectionError, match="peer closed"):
+        recv_full(FakeSock([]), 4)
+
+
+# ---------------------------------------------------------------------------
+# transport RPC surface (in-thread server, real sockets)
+
+
+@pytest.fixture
+def transport(engines):
+    """A real `ServingServer` behind an in-thread transport, plus a
+    raw client. Torn down per test so idempotency ledgers and queue
+    state never leak between tests."""
+    srv = ServingServer(engines[0], max_queue=8, max_retries=2,
+                        buckets=(16,))
+    ts = ReplicaTransportServer(srv).start()
+    client = ReplicaClient(ts.addr, connect_timeout=2.0,
+                           io_timeout=30.0)
+    yield ts, srv, client
+    ts.shutdown()
+
+
+def _submit_kwargs(prompt, tag="t-a", max_new=2):
+    return dict(tag=tag, prompt=np.asarray(prompt, np.int32),
+                max_new=max_new, deadline_ms=-1, sampling=None,
+                retries_left=None, trace_id=None)
+
+
+def test_submit_tag_replay_is_idempotent(transport):
+    ts, srv, client = transport
+    st1, rid1, state1 = client.call("submit",
+                                    _submit_kwargs([1, 2, 3]))
+    # the retry of a lost reply: same tag, same bytes
+    st2, rid2, state2 = client.call("submit",
+                                    _submit_kwargs([1, 2, 3]))
+    assert (st1, st2) == ("ok", "ok")
+    assert rid1 == rid2
+    assert state2["counters"]["requests"] == 1   # never double-admitted
+
+
+def test_submit_rejection_replays_the_same_verdict(transport):
+    ts, srv, client = transport
+    bad = _submit_kwargs(np.arange(40, dtype=np.int32) % CFG.vocab,
+                         tag="t-bad")            # 40 > max_len=32
+    st1, err1, _ = client.call("submit", bad)
+    st2, err2, _ = client.call("submit", bad)
+    assert (st1, st2) == ("err", "err")
+    assert isinstance(err1, ValueError) and isinstance(err2, ValueError)
+    # the cached verdict carries the SAME ledgered req_id
+    assert getattr(err1, "req_id", None) == getattr(err2, "req_id",
+                                                    None)
+
+
+def test_results_redelivered_until_acked(transport):
+    ts, srv, client = transport
+    _, rid, _ = client.call("submit", _submit_kwargs([4, 5, 6]))
+    state = None
+    for _ in range(64):
+        _, _, state = client.call("step")
+        if rid in state["results"]:
+            break
+    assert rid in state["results"]
+    # un-ACKed: every later reply redelivers it
+    _, _, state = client.call("sync")
+    assert rid in state["results"]
+    # ACKed: gone from the next state block
+    _, _, state = client.call("sync", acks=(rid,))
+    assert rid not in state["results"]
+
+
+def test_garbage_bytes_answered_in_band(transport):
+    ts, srv, client = transport
+    sock = socket.create_connection(ts.addr, timeout=5.0)
+    try:
+        send_frame(sock, b"\x80\x04 this is not a pickle")
+        status, payload, state = pickle.loads(recv_frame(sock))
+        assert status == "err"
+        assert "undecodable" in str(payload)
+        # the connection is dropped after a desynced-content frame
+        assert sock.recv(1) == b""
+    finally:
+        sock.close()
+    # the server survives and serves fresh connections
+    assert client.call("ping")[0] == "ok"
+
+
+def test_truncated_frame_does_not_kill_the_server(transport):
+    ts, srv, client = transport
+    sock = socket.create_connection(ts.addr, timeout=5.0)
+    sock.sendall(struct.pack("<I", 100) + b"only ten b")
+    sock.close()                      # peer closes mid-frame
+    assert client.call("ping")[0] == "ok"
+
+
+def test_oversized_frame_rejected_without_allocation(transport):
+    ts, srv, client = transport
+    sock = socket.create_connection(ts.addr, timeout=5.0)
+    try:
+        sock.sendall(struct.pack("<I", MAX_FRAME + 1))
+        # the server refuses the header and closes; it never tries to
+        # read (or allocate) the advertised 1 GiB body
+        assert sock.recv(1) == b""
+    finally:
+        sock.close()
+    assert client.call("ping")[0] == "ok"
+
+
+def test_connect_loss_vs_midflight_loss():
+    # CONNECT exhaustion: nothing listening — the op never ran
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    dead_addr = probe.getsockname()
+    probe.close()                     # port now has no listener
+    client = ReplicaClient(dead_addr, connect_timeout=0.2,
+                           retries=2, sleep=lambda s: None)
+    with pytest.raises(TransportConnectError):
+        client.call("ping")
+
+    # MID-FLIGHT loss: the peer accepts, reads, then hangs — the op
+    # may or may not have executed, and the error must say so
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(4)
+    hung = []
+
+    def black_hole():
+        for _ in range(2):
+            try:
+                conn, _ = lst.accept()
+            except OSError:
+                return
+            hung.append(conn)         # never reply
+
+    t = threading.Thread(target=black_hole, daemon=True)
+    t.start()
+    try:
+        client = ReplicaClient(lst.getsockname(), connect_timeout=2.0,
+                               io_timeout=0.1, retries=2,
+                               sleep=lambda s: None)
+        with pytest.raises(TransportCallError):
+            client.call("ping")
+    finally:
+        lst.close()
+        for c in hung:
+            c.close()
+
+
+def test_double_handoff_complete_releases_once(transport):
+    ts, srv, client = transport
+    calls = []
+    srv.handoff_complete = lambda rid: calls.append(("complete", rid))
+    srv.cancel_handoff = lambda rid: calls.append(("cancel", rid))
+    assert client.call("handoff_complete", dict(req_id=7))[0] == "ok"
+    # the ACK replay (reply lost, destination resends): no-op
+    assert client.call("handoff_complete", dict(req_id=7))[0] == "ok"
+    # a stale cancel racing the completed handoff: also suppressed
+    assert client.call("cancel_handoff", dict(req_id=7))[0] == "ok"
+    assert calls == [("complete", 7)]
+
+
+def test_process_replica_mirror_ledger(transport):
+    """The router-side mirror answers the harvest surfaces without
+    RPC and keeps the budgets the redistribution path carries."""
+    ts, srv, client = transport
+    rep = ProcessReplica(client)
+    prompts = mk_prompts(2, seed=9)
+    rids = [rep.submit(p, max_new=2) for p in prompts]
+    pending = rep.pending_requests()
+    assert [r.req_id for r in pending] == sorted(rids)
+    assert all(r.retries_left == rep.max_retries for r in pending)
+    for _ in range(64):
+        if all(r in rep.results for r in rids):
+            break
+        rep.step()
+    assert all(rep.results[r].outcome == "completed" for r in rids)
+    assert rep.pending_requests() == []          # mirror drained
+    assert rep.counters()["completed"] == 2
+    # withdraw pops the mirror: submit-then-withdraw leaves no ghost
+    rid = rep.submit(prompts[0], max_new=2)
+    req = rep.withdraw_queued(rid)
+    assert req is not None and req.req_id == rid
+    assert rep.pending_requests() == []
+
+
+# ---------------------------------------------------------------------------
+# supervisor mechanics (spawn seam — no processes, no model)
+
+
+class SeamServer(_IdleServer):
+    """Idle replica duck type with a scriptable load and a shutdown
+    counter — enough surface for the autoscaler and reap paths."""
+
+    def __init__(self):
+        super().__init__()
+        self.live_load = 0
+        self.shutdowns = 0
+
+    def load(self):
+        return self.live_load
+
+    def shutdown(self):
+        self.shutdowns += 1
+
+
+class DyingSeam(SeamServer):
+    def __init__(self):
+        super().__init__()
+        self.die = False
+
+    def step(self):
+        if self.die:
+            raise ReplicaDeadError("seam replica killed")
+        return False
+
+
+def _seam_spec():
+    return ReplicaSpec(builder="unused:unused")
+
+
+def test_autoscale_out_under_load_and_back_to_floor():
+    spawned = []
+
+    def seam(spec):
+        s = SeamServer()
+        spawned.append(s)
+        return s
+
+    sup = FleetSupervisor(
+        _seam_spec(), min_replicas=1, max_replicas=3,
+        policy=AutoscalePolicy(queue_high=1.0, cooldown_sweeps=2,
+                               idle_sweeps=3),
+        spawn=seam)
+    sup.start()
+    spawned[0].live_load = 4          # the spike
+    for _ in range(8):
+        sup.sweep()
+    assert sup.counters()["replicas_routable"] == 3   # hit the ceiling
+    assert sup.stats["scale_out_events"] == 2
+    for s in spawned:                 # the spike subsides
+        s.live_load = 0
+    for _ in range(30):
+        sup.sweep()
+    assert sup.counters()["replicas_routable"] == 1   # back to floor
+    assert sup.stats["scale_in_events"] == 2
+    assert sup.stats["reaped"] == 2
+    assert sup.router.stats["replicas_reaped"] == 2
+    # retired members were shut down exactly once, floor member never
+    assert [s.shutdowns for s in spawned] == [0, 1, 1]
+    sup.shutdown(drain=False)
+
+
+def test_below_floor_repair_skips_cooldown():
+    spawned = []
+
+    def seam(spec):
+        s = DyingSeam()
+        spawned.append(s)
+        return s
+
+    sup = FleetSupervisor(
+        _seam_spec(), min_replicas=2, max_replicas=3,
+        policy=AutoscalePolicy(cooldown_sweeps=1000),   # cooldown huge
+        spawn=seam)
+    sup.start()
+    sup.sweep()                       # healthy tick (starts cooldown)
+    spawned[0].die = True
+    sup.sweep()                       # death harvested + repaired
+    assert sup.router.stats["replicas_lost"] == 1
+    # repair bypassed the 1000-sweep cooldown: floor restored NOW
+    assert sup.counters()["replicas_routable"] == 2
+    assert sup.stats["scale_out_events"] == 1
+    sup.shutdown(drain=False)
+
+
+class AcceptingSeam(SeamServer):
+    _next = [0]
+
+    def __init__(self):
+        super().__init__()
+        self.submitted = []
+
+    @property
+    def queue_space(self):
+        return 8
+
+    def submit(self, prompt, **kwargs):
+        self._next[0] += 1
+        self.submitted.append(self._next[0])
+        self.live_load += 1
+        return self._next[0]
+
+
+class FatalOnSubmit(AcceptingSeam):
+    def submit(self, prompt, **kwargs):
+        raise ReplicaDeadError("transport lost on submit")
+
+
+def test_submit_fails_over_when_the_picked_replica_is_dead():
+    """The router's submit retry loop: a replica-fatal failure during
+    admission marks the replica dead and re-picks a survivor instead
+    of surfacing the loss to the caller."""
+    bad, good = FatalOnSubmit(), AcceptingSeam()
+    good.live_load = 1                # least-loaded pick lands on bad
+    router = ServingRouter([bad, good])
+    rr = router.submit(np.arange(3, dtype=np.int32), max_new=2)
+    assert good.submitted             # the survivor admitted it
+    assert router.stats["replicas_lost"] == 1
+    assert rr in router.replicas[1].pending.values()
+
+
+def test_rolling_upgrade_zero_sheds(engines, params):
+    built = []
+
+    def seam(spec):
+        srv = ServingServer(engines[len(built) % 2], max_queue=8,
+                            max_retries=1, buckets=(16,))
+        built.append(srv)
+        return srv
+
+    sup = FleetSupervisor(_seam_spec(), min_replicas=1,
+                          max_replicas=2, spawn=seam)
+    sup.start()
+    prompts = mk_prompts(3, seed=11)
+    rids = [sup.submit(p, max_new=3) for p in prompts]
+    sup.sweep()                       # get work in flight on the old
+    sup.rolling_upgrade(_seam_spec())
+    res = sup.run()
+    sup.reconcile()
+    c = sup.router.counters()
+    assert c["shed"] == 0 and c["completed"] == 3
+    assert sup.stats["upgrades"] == 1 and sup.stats["reaped"] == 1
+    assert len(built) == 2            # replacement spawned exactly once
+    for p, rid in zip(prompts, rids):
+        assert res[rid].outcome == "completed"
+        assert res[rid].tokens == ref_tokens(params, p, 3)
+    sup.shutdown(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# real processes
+
+
+def _proc_gone(pid):
+    """True when `pid` is dead (missing or a zombie awaiting reap)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            state = f.read().rsplit(")", 1)[1].split()[0]
+    except (FileNotFoundError, ProcessLookupError):
+        return True
+    return state == "Z"
+
+
+def _await(cond, timeout_s=20.0, poll_s=0.1):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(poll_s)
+    return cond()
+
+
+def test_supervisor_sigkill_leaves_no_orphan_children():
+    """Kill the SUPERVISOR (not a replica) with SIGKILL — no drain,
+    no atexit — and assert every replica child exits on the
+    parent-death watchdog alone. This is the orphan-leak fix: before
+    the watchdog, children kept serving into the void."""
+    import multiprocessing
+    from paddle_tpu.testing.fleet import orphan_fleet_main
+
+    ctx = multiprocessing.get_context("spawn")
+    parent_conn, child_conn = ctx.Pipe()
+    sup_proc = ctx.Process(target=orphan_fleet_main,
+                           args=(child_conn,))
+    sup_proc.start()
+    child_conn.close()
+    assert parent_conn.poll(60.0), "supervisor never reported pids"
+    grandchildren = parent_conn.recv()
+    assert len(grandchildren) == 2
+    assert all(not _proc_gone(pid) for pid in grandchildren)
+    os.kill(sup_proc.pid, signal.SIGKILL)     # no cleanup runs
+    sup_proc.join(10.0)
+    assert _await(lambda: all(_proc_gone(p) for p in grandchildren)), \
+        f"orphaned replica processes survive: {grandchildren}"
+    parent_conn.close()
+
+
+@pytest.mark.heavyweight
+def test_sigkill_replica_mid_burst_exactly_once(tmp_path, params):
+    """THE chaos acceptance bar, on real OS processes: 3 replica
+    children booted from a PR9 artifact, one SIGKILLed mid-burst by
+    `FaultPlan.wrap_fleet`. Every request must end in exactly one
+    outcome, redistributed work must carry its retry budget (not burn
+    it), fleet counters must reconcile across the process boundary
+    (dead-banked + live sums == the router ledger), completions must
+    match the solo decode bit-exactly, and the supervisor must repair
+    the fleet back to its floor."""
+    art = str(tmp_path / "engine.tar")
+    save_tiny_artifact(art, buckets=(16,))
+    spec = ReplicaSpec(
+        builder="paddle_tpu.testing.fleet:build_tiny_server",
+        kwargs=dict(artifact=art, buckets=(16,), max_retries=1),
+        env=dict(CHILD_ENV))
+    sup = FleetSupervisor(spec, min_replicas=3, max_replicas=3)
+    sup.start()
+    pids = [p.pid for p in sup.procs.values()]
+    try:
+        FaultPlan(fleet_sigkill_at=6,
+                  fleet_sigkill_replica=1).wrap_fleet(sup)
+        prompts = mk_prompts(9)
+        rids = [sup.submit(p, max_new=4) for p in prompts]
+        res = sup.run()
+        sup.reconcile()               # the exactly-once audit
+        c = sup.router.counters()
+        # the kill landed and was harvested through the dead socket
+        assert c["replicas_lost"] == 1
+        assert c["redistributed"] >= 1
+        # exactly one terminal outcome per request, all completed
+        assert sorted(res) == sorted(rids)
+        assert all(res[i].outcome == "completed" for i in rids)
+        # budgets intact: redistribution is NOT a retry
+        moved = [res[i] for i in rids if res[i].redistributions > 0]
+        assert moved
+        assert all(r.retries == 0 for r in res.values())
+        # fleet counters reconcile across the process boundary
+        assert c["completed"] == len(rids) == c["fleet_completed"]
+        assert c["fleet_shed"] == 0 and c["fleet_failed"] == 0
+        # bit-exact greedy parity with the solo decode
+        for p, rid in zip(prompts, rids):
+            assert res[rid].tokens == ref_tokens(params, p, 4)
+        # below-floor repair: a replacement process was spawned and
+        # the fleet is back at its floor
+        assert sup.stats["spawned"] == 4
+        assert sup.counters()["procs_alive"] == 3
+    finally:
+        sup.shutdown(drain=False)
+    live = [p for p in pids if p is not None and not _proc_gone(p)]
+    assert not live, f"replica processes outlived shutdown: {live}"

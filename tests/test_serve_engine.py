@@ -281,6 +281,7 @@ class TestSlidingWindowPool:
         base.update(kw)
         return T.TransformerConfig(**base)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_pool_matches_generate_rolling(self):
         cfg = self._cfg()
         p = T.init_params(jax.random.key(6), cfg)

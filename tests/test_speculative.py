@@ -199,6 +199,7 @@ class TestPoolReserveCommit:
 
 
 class TestSpeculativeServe:
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_greedy_parity_with_baseline_serve(self, eng):
         ps = spec_prompts()
         want = eng.serve([p.copy() for p in ps], max_new=14)
@@ -215,6 +216,7 @@ class TestSpeculativeServe:
         assert st.tokens == sum(len(g) for g in got)
         assert st.spec_rounds < base_steps      # fewer launches
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_eos_and_logprob_parity(self, params, eng):
         ps = spec_prompts(seed=3)[:3]
         # pick an eos that actually fires early: the most common

@@ -140,6 +140,7 @@ def test_tp_sharded_loss_matches(params):
         np.testing.assert_allclose(a, np.asarray(b), rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
 def test_transformer_serving_artifact(tmp_path, params):
     """The generic StableHLO artifact path serves the transformer LM
     (weights folded; greedy next-token head)."""
@@ -509,6 +510,7 @@ class TestBeamDecode:
         assert seqs2.shape == (2, 3, 5)
         assert np.isfinite(np.asarray(scores2)).all()
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_eos_finishes_beams(self):
         params = T.init_params(jax.random.key(2), self.CFG)
         prompt = jnp.asarray(
@@ -554,6 +556,7 @@ class TestGQA:
         assert params["blocks"][0]["qkv"]["kernel"].shape == (32, 96)
 
     @pytest.mark.parametrize("kv", [1, 2])
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_decode_matches_forward(self, kv):
         """Greedy decode's token-by-token cached path must reproduce the
         teacher-forced argmax of the full forward — the grouped cached
@@ -564,6 +567,7 @@ class TestGQA:
             np.random.RandomState(0).randint(1, 32, (2, 6)), jnp.int32)
         assert_decode_matches_teacher_forcing(params, cfg, prompt, 4)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_beam1_matches_greedy(self):
         cfg = self._cfg(2)
         params = T.init_params(jax.random.key(2), cfg)
@@ -624,6 +628,7 @@ class TestSpeculativeDecode:
             draft_k=k))
         np.testing.assert_array_equal(got, want)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_matches_greedy_with_perfect_draft(self):
         """draft == target: every window fully accepts, so `steps`
         tokens take exactly ceil(steps/(k+1)) rounds — the observable
@@ -711,6 +716,7 @@ class TestSpeculativeDecode:
                 target, self.CFG, prompt[i:i + 1], steps=8))
             np.testing.assert_array_equal(np.asarray(got)[i:i + 1], want)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_eos_matches_greedy_fill(self):
         """Early-stop parity: pick the eos id that greedy actually
         emits mid-stream, then the speculative output (tokens AND the
@@ -770,6 +776,7 @@ class TestSpeculativeSampling:
             jnp.asarray(logits, jnp.float32) / 0.9))
         assert np.abs(freq - want).max() < 0.05, (freq, want)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_top_k1_equals_greedy_exactly(self):
         """top_k=1 collapses both filtered distributions to one-hots:
         the sampler must reproduce the target's greedy decode token for
@@ -797,6 +804,7 @@ class TestSpeculativeSampling:
             return_stats=True)
         np.testing.assert_array_equal(np.asarray(rounds), [2, 2])
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_eos_stops_and_pads(self):
         target, draft, draft_cfg = self._models()
         prompt = jnp.asarray(
@@ -935,6 +943,7 @@ class TestSlidingWindowAttention:
             np.random.RandomState(2).randint(1, 32, (2, 6)), jnp.int32)
         assert_decode_matches_teacher_forcing(params, cfg, prompt, 5)
 
+    @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
     def test_beam_and_spec_respect_window(self):
         cfg = self._cfg(window=4)
         params = T.init_params(jax.random.key(3), cfg)
