@@ -829,6 +829,132 @@ def bench_fleet() -> None:
     sup.shutdown(drain=False)
 
 
+def bench_elastic() -> None:
+    """Elastic gang-training stage (ISSUE 15): the three numbers that
+    decide whether ZeRO + gang supervision is worth running — the
+    optimizer-state memory win per replica (the point of ZeRO), the
+    step-time overhead of the sharded update vs the replicated arm
+    (same psum_scatter, so it should be noise), and the wall-clock
+    cost of a real host loss (SIGKILL observed -> first step COMPLETED
+    by the reformed gang, which prices boot + gloo rejoin + reshard
+    restore + recompile together). Forces the CPU backend;
+    `scripts/fault_smoke.sh elastic` drives it as `bench.py
+    --elastic-only`."""
+    import tempfile
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    prev = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in prev:
+        os.environ["XLA_FLAGS"] = (
+            prev + " --xla_force_host_platform_device_count=8").strip()
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu import nn
+    from paddle_tpu.core.mesh import (MeshConfig, batch_sharding,
+                                      build_mesh)
+    from paddle_tpu.obs import MetricsRegistry
+    from paddle_tpu.optim import optimizers as O
+    from paddle_tpu.parallel import (make_zero_train_step,
+                                     opt_state_bytes_per_replica)
+    from paddle_tpu.parallel.launch import GangSupervisor
+    from paddle_tpu.parallel.sharding import replicated
+    from paddle_tpu.testing.faults import FaultPlan
+    from paddle_tpu.train.state import TrainState
+
+    # -- stage A: ZeRO memory win + sharded-update overhead -------------
+    log("elastic: ZeRO opt bytes/replica + step overhead (in-process)")
+    mesh = build_mesh(MeshConfig(data=8))
+    model = nn.Sequential([nn.Dense(256, name="fc1", activation="relu"),
+                           nn.Dense(256, name="fc2", activation="relu"),
+                           nn.Dense(16, name="out")])
+    loss_fn = lambda out, y: jnp.mean((out - y) ** 2)
+    opt = O.adam(1e-3)
+    params, mstate = model.init(jax.random.key(0),
+                                jnp.zeros((8, 64), jnp.float32))
+    sz = TrainState.create_zero(params, mstate, opt, mesh)
+    sb = TrainState.create_zero(params, mstate, opt, mesh)
+    sb = sb._replace(opt_state=jax.tree.map(
+        lambda v: jax.device_put(np.asarray(v), replicated(mesh)),
+        sb.opt_state))
+    bytes_zero = opt_state_bytes_per_replica(sz.opt_state)
+    bytes_repl = opt_state_bytes_per_replica(sb.opt_state)
+    emit("train_zero_opt_state_bytes_per_replica", bytes_zero,
+         "bytes (max over replicas)", None,
+         replicated_bytes=bytes_repl,
+         shrink_x=round(bytes_repl / max(bytes_zero, 1), 2),
+         data_shards=8)
+
+    r = np.random.RandomState(0)
+    x = jax.device_put(r.randn(64, 64).astype(np.float32),
+                       batch_sharding(mesh))
+    y = jax.device_put(r.randn(64, 16).astype(np.float32),
+                       batch_sharding(mesh))
+    rng = jax.random.key(7)
+    step_z = make_zero_train_step(model, loss_fn, opt, mesh,
+                                  donate=False)
+    step_b = make_zero_train_step(model, loss_fn, opt, mesh,
+                                  donate=False, zero_update=False)
+    iters = 30
+
+    def timed(step, state):
+        state, l, _ = step(state, rng, x, y)          # warmup compile
+        jax.block_until_ready(l)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, l, _ = step(state, rng, x, y)
+        jax.block_until_ready(l)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    ms_zero = timed(step_z, sz)
+    ms_repl = timed(step_b, sb)
+    emit("train_zero_step_overhead_pct",
+         round((ms_zero - ms_repl) / ms_repl * 100.0, 2),
+         "% vs replicated-update arm (same psum_scatter)", None,
+         zero_step_ms=round(ms_zero, 3),
+         replicated_step_ms=round(ms_repl, 3), iters=iters)
+
+    # -- stage B: SIGKILL -> reformed-gang first step --------------------
+    log("elastic: gang kill->resume latency (2 real procs, SIGKILL)")
+    tmp = tempfile.mkdtemp(prefix="elastic_bench_")
+    registry = MetricsRegistry()
+    sup = GangSupervisor(
+        "paddle_tpu.testing.gang:build_tiny_job", {},
+        workdir=os.path.join(tmp, "work"),
+        checkpoint_dir=os.path.join(tmp, "ckpt"),
+        num_processes=2, total_steps=8, checkpoint_every=2, seed=0,
+        grace_s=3.0)
+    sup.bind_metrics(registry)
+    plan = FaultPlan(gang_kill_step_at=2, gang_kill_rank=1)
+    plan.wrap_gang(sup)
+    kill_t = [None]
+    inner_tick = sup._tick
+
+    def tick():
+        before = plan.count("gangkill")
+        inner_tick()
+        if kill_t[0] is None and plan.count("gangkill") > before:
+            kill_t[0] = time.time()
+
+    sup._tick = tick
+    out = sup.run(deadline_s=300.0)
+    res = sorted(out["results"], key=lambda q: q["rank"])[0]
+    # heartbeats are written on EndIteration with wall time, so the
+    # reformed gang's FIRST heartbeat stamps "first step completed"
+    hb = json.load(open(os.path.join(tmp, "work", "hb_1_0.json")))
+    latency = (round(hb["t"] - kill_t[0], 3)
+               if kill_t[0] is not None else None)
+    c = sup.counters()
+    emit("train_gang_kill_resume_latency_s", latency,
+         "seconds SIGKILL->reformed gang's first step done", None,
+         restored_step=res["restored_step"],
+         final_step=res["final_step"],
+         reforms=c["reforms"], members_lost=c["members_lost"],
+         reshard_restores=res["counters"].get("reshard_restores"),
+         exactly_once=bool(
+             res["steps"] == list(range(res["restored_step"], 8))),
+         obs_snapshot=registry.snapshot()["series"])
+
+
 def bench_speculative(cfg, params) -> None:
     """Speculative-decoding stage (ISSUE 9): plain vs speculative
     serving over IDENTICAL repetitive traffic — the n-gram proposer's
@@ -1347,6 +1473,8 @@ if __name__ == "__main__":
         bench_disagg()
     elif len(sys.argv) > 1 and sys.argv[1] == "--fleet-only":
         bench_fleet()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--elastic-only":
+        bench_elastic()
     elif len(sys.argv) > 1 and sys.argv[1] == "--cold-start-only":
         bench_cold_start()
     elif len(sys.argv) > 1 and sys.argv[1] == "--cold-start-child":

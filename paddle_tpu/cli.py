@@ -175,7 +175,120 @@ def _named_leaves(tree):
         yield keys, leaf
 
 
+def _gang_job_from_config(*, config: str, batch_size: int,
+                          learning_rate: float = 0.01) -> dict:
+    """Gang-builder (the `parallel.launch` contract) over a train
+    config script: `train --elastic N` ships THIS function's
+    "module:function" name across the spawn boundary, and every gang
+    member — including ones booted after a reform — rebuilds the job
+    from the config file. The reader must therefore be deterministic:
+    a reformed member replays the same batch sequence from the resume
+    cursor, which is what makes the exactly-once step accounting hold.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu import data as data_mod
+    from paddle_tpu import optim
+    from paddle_tpu.data.batch import stack_columns
+    from paddle_tpu.ops import losses
+
+    cfg = _load_config(config)
+    loss_fn = cfg.get("loss_fn") or (
+        lambda lo, la: jnp.mean(losses.softmax_cross_entropy(lo, la)))
+
+    def batches(total_steps):
+        # materialized (not streamed): the gang contract wants GLOBAL
+        # batches indexable from any resume cursor; ragged tails are
+        # dropped because every member slices batch/num_processes rows
+        out = []
+        while len(out) < total_steps:
+            produced = False
+            for samples in data_mod.batch_reader(
+                    cfg["reader"], batch_size, drop_last=True)():
+                cols = stack_columns(samples)
+                if len(cols) != 2:
+                    raise SystemExit(
+                        "--elastic needs (input, label) samples, got "
+                        f"{len(cols)}-field samples")
+                out.append((np.asarray(cols[0]), np.asarray(cols[1])))
+                produced = True
+                if len(out) == total_steps:
+                    break
+            if not produced:
+                raise SystemExit(
+                    "config reader yielded no full batches of "
+                    f"{batch_size}")
+        return out
+
+    return {
+        "model": cfg["model"],
+        "loss_fn": loss_fn,
+        "optimizer": cfg.get("optimizer") or optim.sgd(learning_rate),
+        "input_specs": (_input_spec(cfg),),
+        "batches": batches,
+    }
+
+
+def _cmd_train_elastic(args) -> int:
+    """`train --elastic N` (docs/RELIABILITY.md "Elastic training
+    fault model"): the CLI process becomes the GangSupervisor — it
+    never touches jax itself — and N child trainers run the ZeRO
+    step over a shared coordinator. Dead/wedged members are detected
+    (heartbeats + the watchdog's exit 75), the gang tears down,
+    reforms at the surviving count and resumes from the durable
+    sharded checkpoint. Checkpoints stay in --checkpoint-dir; a later
+    plain `train --checkpoint-dir` run (or `--elastic M`) resumes
+    from them at any topology."""
+    from paddle_tpu.parallel.launch import GangFailedError, GangSupervisor
+
+    if not args.checkpoint_dir:
+        raise SystemExit("--elastic requires --checkpoint-dir (the gang "
+                         "resumes from durable sharded checkpoints)")
+    registry = None
+    if args.metrics_out:
+        from paddle_tpu.obs import MetricsRegistry
+
+        registry = MetricsRegistry()
+    sup = GangSupervisor(
+        "paddle_tpu.cli:_gang_job_from_config",
+        {"config": args.config, "batch_size": args.batch_size,
+         "learning_rate": args.learning_rate},
+        workdir=os.path.join(args.checkpoint_dir, "gang"),
+        checkpoint_dir=args.checkpoint_dir,
+        num_processes=args.elastic,
+        total_steps=args.total_steps,
+        checkpoint_every=args.checkpoint_every or 2,
+        seed=args.seed,
+        min_procs=args.min_procs,
+        watchdog_timeout_s=args.watchdog_timeout)
+    if registry is not None:
+        sup.bind_metrics(registry)
+    try:
+        out = sup.run(deadline_s=args.gang_deadline)
+    except GangFailedError as e:
+        print(f"elastic gang failed: {e}")
+        _write_metrics(registry, args.metrics_out)
+        return 1
+    c = sup.counters()
+    print(f"elastic gang done: {len(out['results'])} member(s) at "
+          f"gang epoch {int(c['gang_epoch'])}, reforms "
+          f"{int(c['reforms'])}, members lost {int(c['members_lost'])}, "
+          f"wedged fenced {int(c['fenced_wedged'])}")
+    for res in sorted(out["results"], key=lambda r: r["rank"]):
+        tail = (f" cost {res['losses'][-1]:.6f}" if res["losses"] else "")
+        print(f"  rank {res['rank']}: resumed@{res['restored_step']} "
+              f"finished step {res['final_step']}{tail}")
+    _write_metrics(registry, args.metrics_out)
+    return 0
+
+
 def cmd_train(args) -> int:
+    # the elastic gang path forks trainer processes; the supervisor
+    # itself must stay jax-free, so it dispatches before anything else
+    if getattr(args, "elastic", None):
+        return _cmd_train_elastic(args)
+
     # multi-host join must precede any other jax-touching call
     if getattr(args, "coordinator", None):
         from paddle_tpu.parallel import distributed
@@ -214,7 +327,35 @@ def cmd_train(args) -> int:
         raise SystemExit("config provides no 'reader' for training")
     feeder = data_mod.DataFeeder()
     batches = lambda: feeder(data_mod.batch_reader(reader, args.batch_size))
-    if args.transfer_guard:
+    zero_mesh = None
+    if args.zero:
+        import jax
+
+        from paddle_tpu.core.mesh import (MeshConfig, batch_sharding,
+                                          build_mesh)
+        from paddle_tpu.parallel import make_zero_train_step
+        from paddle_tpu.train.state import TrainState
+
+        ndev = len(jax.devices())
+        if args.batch_size % ndev:
+            raise SystemExit(
+                f"--zero: batch size {args.batch_size} must divide the "
+                f"{ndev}-device data mesh")
+        zero_mesh = build_mesh(MeshConfig(data=ndev))
+        # same init-rng consumption as the replicated path — only the
+        # optimizer-state LAYOUT changes (flat, padded, sharded over
+        # the data axis); the update itself stays bit-identical
+        state = TrainState.create_zero(state.params, state.model_state,
+                                       trainer.optimizer, zero_mesh)
+        trainer._train_step = make_zero_train_step(
+            cfg["model"], loss_fn, trainer.optimizer, zero_mesh,
+            metrics_fn=cfg.get("metrics_fn"))
+        zero_shard = batch_sharding(zero_mesh)
+        raw_zero = batches
+        batches = lambda: (
+            jax.tree.map(lambda a: jax.device_put(a, zero_shard), b)
+            for b in raw_zero())
+    if args.transfer_guard and zero_mesh is None:
         # the input feed is the hot loop's ONE sanctioned transfer —
         # stage it explicitly so `disallow` holds for everything else
         import jax
@@ -245,6 +386,20 @@ def cmd_train(args) -> int:
         # obs stack only when asked: flight dumps land beside the
         # checkpoints (ResilientTrainer's flight_dir default)
         registry, tracer, flight = _obs_stack(args.metrics_out)
+        manager = step_builder = None
+        if zero_mesh is not None:
+            # reshard-on-restore: a ZeRO checkpoint written at one
+            # device count restores bit-exactly at this one, and the
+            # lr-backoff rebuild goes through the zero step, not the
+            # replicated make_train_step
+            from paddle_tpu.train.checkpoint import (
+                ElasticCheckpointManager)
+
+            manager = ElasticCheckpointManager(args.checkpoint_dir,
+                                               mesh=zero_mesh)
+            step_builder = lambda opt: make_zero_train_step(
+                cfg["model"], loss_fn, opt, zero_mesh,
+                metrics_fn=cfg.get("metrics_fn"), donate=False)
         rt = ResilientTrainer(
             trainer, args.checkpoint_dir,
             checkpoint_every_n_batches=args.checkpoint_every,
@@ -252,6 +407,7 @@ def cmd_train(args) -> int:
             max_bad_steps=args.max_bad_steps,
             lr_backoff=args.lr_backoff,
             watchdog_timeout_s=args.watchdog_timeout,
+            checkpoint_manager=manager, step_builder=step_builder,
             tracer=tracer, flight=flight)
         if registry is not None:
             rt.bind_metrics(registry)
@@ -925,6 +1081,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="host:port of process 0 for multi-host jobs")
     t.add_argument("--num-processes", type=int, default=None)
     t.add_argument("--process-id", type=int, default=None)
+    t.add_argument("--zero", action="store_true",
+                   help="ZeRO-shard the optimizer state over all local "
+                        "devices (parallel.make_zero_train_step): "
+                        "bit-identical updates at ~1/N optimizer bytes "
+                        "per replica; batch size must divide the "
+                        "device count (docs/RELIABILITY.md)")
+    t.add_argument("--elastic", type=int, default=None, metavar="N",
+                   help="run an N-process elastic gang (parallel."
+                        "GangSupervisor): dead/wedged members are "
+                        "detected via heartbeats + the watchdog, the "
+                        "gang reforms at the surviving count and "
+                        "resumes from the durable ZeRO checkpoint — "
+                        "requires --checkpoint-dir and a "
+                        "deterministic reader (docs/RELIABILITY.md "
+                        "'Elastic training fault model')")
+    t.add_argument("--total-steps", type=int, default=100,
+                   help="with --elastic: total optimizer steps for "
+                        "the gang (the elastic path is step-, not "
+                        "pass-, oriented)")
+    t.add_argument("--min-procs", type=int, default=1,
+                   help="with --elastic: fail the run rather than "
+                        "reform below this many members")
+    t.add_argument("--gang-deadline", type=float, default=3600.0,
+                   help="with --elastic: wall-clock bound on the "
+                        "whole gang run")
     t.set_defaults(fn=cmd_train)
 
     d = sub.add_parser("dump-config")

@@ -9,8 +9,14 @@ from paddle_tpu.parallel.sharding import (
 from paddle_tpu.parallel.train_step import (
     aot_compile_train_step,
     make_sharded_train_step,
+    make_zero_train_step,
+    opt_state_bytes_per_replica,
     shard_train_state,
     train_state_shardings,
+    zero_init_opt_state,
+    zero_opt_shardings,
+    zero_state_shardings,
+    zero_true_sizes,
 )
 from paddle_tpu.parallel import collectives
 from paddle_tpu.parallel import blocked_matmul
@@ -48,6 +54,14 @@ from paddle_tpu.parallel.pserver_client import (
     ShardConn,
 )
 from paddle_tpu.parallel import distributed
+from paddle_tpu.parallel import launch
+from paddle_tpu.parallel.launch import (
+    GangFailedError,
+    GangSpec,
+    GangSupervisor,
+    gang_child_main,
+    run_gang_worker,
+)
 from paddle_tpu.parallel import moe
 from paddle_tpu.parallel.moe import (
     expert_choice_ffn,

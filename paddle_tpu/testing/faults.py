@@ -140,6 +140,11 @@ class FaultPlan:
     # -- fleet process faults (serve.fleet, via wrap_fleet) --
     fleet_sigkill_at: Optional[int] = None        # nth supervisor sweep
     fleet_sigkill_replica: int = 0                # rid of the victim child
+    # -- training gang faults (parallel.launch, via wrap_gang) --
+    gang_kill_step_at: Optional[int] = None       # victim heartbeat step
+    gang_kill_rank: int = 1                       # rank of the victim
+    gang_wedge_step_at: Optional[int] = None      # SIGSTOP, not SIGKILL
+    gang_wedge_rank: int = 1                      # rank of the victim
     # -- parameter-server faults (native.pserver, via wrap_pserver_shard) --
     pserver_kill_push_at: Optional[int] = None    # nth push received
     pserver_lost_ack_at: Optional[int] = None     # nth push ACK dropped
@@ -375,6 +380,48 @@ class FaultPlan:
             return inner_sweep()
 
         supervisor.sweep = sweep
+        return supervisor
+
+    def wrap_gang(self, supervisor):
+        """Install REAL process faults on a `parallel.launch`
+        GangSupervisor: once the victim rank's heartbeat file reports
+        step >= `gang_kill_step_at`, the member gets SIGKILL mid-burst
+        — its address space, its gloo connections, and any
+        half-written checkpoint die with it, and the SURVIVORS are
+        left blocked inside a collective that can never complete
+        (`proc.wait` after the kill makes the corpse visible before
+        the supervisor's classification runs, so the fault is
+        deterministic rather than racing the scheduler).
+        `gang_wedge_step_at` is the wedged-NOT-dead variant: SIGSTOP —
+        the process stays alive, stops heartbeating, and the
+        supervisor must fence it with its own SIGKILL before the gang
+        can reform."""
+        plan = self
+
+        inner_tick = supervisor._tick
+
+        def tick():
+            for step_attr, rank_attr, sig, kind in (
+                    ("gang_kill_step_at", "gang_kill_rank",
+                     signal.SIGKILL, "gangkill"),
+                    ("gang_wedge_step_at", "gang_wedge_rank",
+                     signal.SIGSTOP, "gangwedge")):
+                at = getattr(plan, step_attr)
+                if at is None or plan._spent(kind):
+                    continue
+                rank = getattr(plan, rank_attr)
+                proc = supervisor.procs.get(rank)
+                if proc is None or proc.poll() is not None:
+                    continue
+                hb = supervisor.member_heartbeat(rank)
+                if hb is not None and hb.get("step", -1) >= at:
+                    plan._note(kind, hb.get("step"))
+                    os.kill(proc.pid, sig)
+                    if sig == signal.SIGKILL:
+                        proc.wait(timeout=10)
+            return inner_tick()
+
+        supervisor._tick = tick
         return supervisor
 
     # -- parameter-server faults ------------------------------------------

@@ -5,9 +5,12 @@ from paddle_tpu.train.state import TrainState
 from paddle_tpu.train.trainer import Trainer, make_train_step, make_eval_step
 from paddle_tpu.train.checkpoint import (
     CheckpointManager,
+    ElasticCheckpointManager,
+    ManifestMismatchError,
     export_inference_artifact,
     load_inference_artifact,
     load_parameters_tar,
+    param_tree_hash,
     save_parameters_tar,
 )
 from paddle_tpu.train.resilience import (

@@ -111,6 +111,224 @@ class CheckpointManager:
         self._mgr.close()
 
 
+# ---- elastic (ZeRO) checkpoints: topology manifest + reshard-on-restore ----
+
+
+class ManifestMismatchError(ValueError):
+    """The checkpoint's topology manifest does not describe THIS model:
+    the saved param-tree hash (or optimizer-state layout) disagrees with
+    the restore template. Raised INSTEAD of resharding — a silent
+    misreshard would scatter one model's moments into another's slots
+    and train on garbage. Unlike ordinary corruption this is not
+    walk-back-able: every older step of the same run mismatches the
+    same way, so `restore_with_fallback` re-raises it."""
+
+
+def param_tree_hash(params) -> str:
+    """Structure hash of a parameter tree: names, shapes, dtypes — the
+    things a reshard must agree on. Values are deliberately excluded
+    (the whole point is restoring DIFFERENT values into this shape)."""
+    import hashlib
+
+    flat, _ = jax.tree_util.tree_flatten_with_path(params)
+    items = [(jax.tree_util.keystr(kp), tuple(np.shape(leaf)),
+              str(np.dtype(leaf.dtype) if hasattr(leaf, "dtype")
+                  else np.asarray(leaf).dtype))
+             for kp, leaf in flat]
+    return hashlib.sha256(repr(items).encode()).hexdigest()[:16]
+
+
+class ElasticCheckpointManager(CheckpointManager):
+    """CheckpointManager for ZeRO-layout TrainStates that records HOW the
+    optimizer state was sharded (a topology manifest beside each step)
+    and reshards on restore when the current mesh's data-axis size
+    differs from the one that saved — a run checkpointed on N replicas
+    resumes on M, bit-exactly, because the flat layout's only
+    N-dependence is trailing zero padding.
+
+    The manifest is written AFTER orbax's commit, atomically
+    (tmp+rename): a SIGKILL between the two leaves a committed step
+    without a manifest, which restore treats as torn — the caller's
+    `restore_with_fallback` walks back past it. A manifest whose
+    param-tree hash disagrees with the restore template raises
+    `ManifestMismatchError` (named, never a silent misreshard)."""
+
+    MANIFEST_FORMAT = 1
+
+    def __init__(self, directory: str, *, mesh, max_to_keep: int = 3,
+                 async_save: bool = False):
+        super().__init__(directory, max_to_keep=max_to_keep,
+                         async_save=async_save)
+        self.mesh = mesh
+        self.reshard_restores = 0
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, f"zero_topology_{step}.json")
+
+    def save(self, state: TrainState, step: Optional[int] = None) -> int:
+        from paddle_tpu.core.mesh import DATA_AXIS
+        from paddle_tpu.parallel.train_step import zero_true_sizes
+
+        step = super().save(state, step)
+        if jax.process_index() != 0:
+            return step     # one writer; the data save was collective
+        sizes = jax.tree.leaves(
+            zero_true_sizes(state.params, state.opt_state))
+        leaves = jax.tree.leaves(state.opt_state)
+        manifest = {
+            "format": self.MANIFEST_FORMAT,
+            "kind": "zero_topology",
+            "step": int(step),
+            "data_shards": int(self.mesh.shape[DATA_AXIS]),
+            "param_hash": param_tree_hash(state.params),
+            "opt_leaves": [
+                {"true_size": int(t),
+                 "shape": list(np.shape(l)),
+                 "dtype": str(np.dtype(l.dtype))}
+                for t, l in zip(sizes, leaves)
+            ],
+        }
+        path = self._manifest_path(step)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+        os.replace(tmp, path)
+        self._prune_manifests()
+        return step
+
+    def _prune_manifests(self) -> None:
+        """Drop manifests whose step orbax retention already deleted."""
+        live = set(self.all_steps())
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith("zero_topology_")
+                    and name.endswith(".json")):
+                continue
+            try:
+                s = int(name[len("zero_topology_"):-len(".json")])
+            except ValueError:
+                continue
+            if s not in live:
+                try:
+                    os.unlink(os.path.join(self.directory, name))
+                except OSError:
+                    pass
+
+    def _load_manifest(self, step: int) -> dict:
+        path = self._manifest_path(step)
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            raise ValueError(
+                f"checkpoint step {step} has no topology manifest "
+                f"({path}) — torn save or a non-elastic checkpoint; "
+                f"treating as unrestorable") from None
+        except (json.JSONDecodeError, UnicodeDecodeError, OSError) as e:
+            raise ValueError(
+                f"checkpoint step {step}: corrupt topology manifest: "
+                f"{e}") from e
+        if manifest.get("kind") != "zero_topology":
+            raise ValueError(
+                f"checkpoint step {step}: {path} is not a zero topology "
+                f"manifest")
+        return manifest
+
+    def restore(self, template: TrainState,
+                step: Optional[int] = None) -> TrainState:
+        from paddle_tpu.core.mesh import DATA_AXIS
+        from paddle_tpu.parallel.train_step import (
+            reshard_zero_leaf, zero_leaf_spec, zero_pad)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        step = self.latest_step() if step is None else int(step)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        manifest = self._load_manifest(step)
+        want = param_tree_hash(template.params)
+        got = manifest.get("param_hash")
+        if got != want:
+            raise ManifestMismatchError(
+                f"checkpoint step {step} was saved for a different "
+                f"parameter tree (manifest hash {got}, template hash "
+                f"{want}) — refusing to reshard")
+        m = int(self.mesh.shape[DATA_AXIS])
+        n = int(manifest["data_shards"])
+        if n == m:
+            return super().restore(template, step)
+
+        import orbax.checkpoint as ocp
+
+        entries = manifest["opt_leaves"]
+        opt_leaves, opt_def = jax.tree_util.tree_flatten(
+            template.opt_state)
+        if len(entries) != len(opt_leaves):
+            raise ManifestMismatchError(
+                f"checkpoint step {step}: manifest records "
+                f"{len(entries)} optimizer-state leaves, template has "
+                f"{len(opt_leaves)} — optimizer changed since save")
+
+        def np_like(x):
+            return np.zeros(np.shape(x),
+                            np.dtype(getattr(x, "dtype",
+                                             np.asarray(x).dtype)))
+
+        np_tmpl = {
+            "params": jax.tree.map(np_like, template.params),
+            "model_state": jax.tree.map(np_like, template.model_state),
+            "opt_state": opt_def.unflatten(
+                [np.zeros(tuple(e["shape"]), np.dtype(e["dtype"]))
+                 for e in entries]),
+            "step": np_like(template.step),
+        }
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(np_tmpl))
+
+        def place_like(arr, tleaf):
+            arr = np.asarray(arr)
+            sh = getattr(tleaf, "sharding", None)
+            if sh is None:
+                sh = NamedSharding(self.mesh, P())
+            return jax.make_array_from_callback(
+                arr.shape, sh, lambda idx: arr[idx])
+
+        new_opt = []
+        for e, saved, tl in zip(entries,
+                                jax.tree.leaves(restored["opt_state"]),
+                                opt_leaves):
+            true = int(e["true_size"])
+            tshape = tuple(np.shape(tl))
+            if (len(tshape) == 1
+                    and zero_leaf_spec(tl, m) == P(DATA_AXIS)):
+                if tshape[0] != zero_pad(true, m):
+                    raise ManifestMismatchError(
+                        f"checkpoint step {step}: flat leaf of true "
+                        f"size {true} wants padded length "
+                        f"{zero_pad(true, m)} on {m} shards, template "
+                        f"has {tshape[0]} — layout mismatch")
+                new_opt.append(reshard_zero_leaf(saved, true, self.mesh))
+            elif tuple(np.shape(saved)) == tshape:
+                new_opt.append(place_like(saved, tl))
+            else:
+                raise ManifestMismatchError(
+                    f"checkpoint step {step}: optimizer leaf saved as "
+                    f"{np.shape(saved)} does not fit template shape "
+                    f"{tshape} and is not a flat ZeRO buffer")
+        self.reshard_restores += 1
+        return TrainState(
+            params=jax.tree.map(place_like, restored["params"],
+                                template.params),
+            model_state=jax.tree.map(place_like,
+                                     restored["model_state"],
+                                     template.model_state),
+            opt_state=opt_def.unflatten(new_opt),
+            step=place_like(restored["step"], template.step),
+        )
+
+
 # ---- v2 Parameters tar parity (reference: v2/parameters.py:328,358) ----
 
 def _tar_member(tar: tarfile.TarFile, name: str, path: str) -> bytes:

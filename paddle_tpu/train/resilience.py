@@ -50,7 +50,8 @@ import jax
 import numpy as np
 
 from paddle_tpu.train import events as E
-from paddle_tpu.train.checkpoint import CheckpointManager
+from paddle_tpu.train.checkpoint import (CheckpointManager,
+                                         ManifestMismatchError)
 from paddle_tpu.train.state import TrainState
 from paddle_tpu.train.trainer import Trainer, make_train_step
 
@@ -221,6 +222,13 @@ def restore_with_fallback(manager: CheckpointManager,
     for step in steps:
         try:
             return manager.restore(template, step=step), step
+        except ManifestMismatchError:
+            # NOT corruption: the template describes a different model
+            # (or optimizer layout) than the whole run — every older
+            # step mismatches identically, so walking back would only
+            # end in the noisier RuntimeError below. Re-raise the named
+            # error; a silent misreshard must be impossible.
+            raise
         except Exception as e:
             errors.append((step, e))
             if bad_steps is not None:
@@ -313,7 +321,9 @@ class ResilientTrainer:
                  tracer: Optional[Any] = None,
                  flight: Optional[Any] = None,
                  flight_dir: Optional[str] = None,
-                 pserver_client: Optional[Any] = None):
+                 pserver_client: Optional[Any] = None,
+                 step_builder: Optional[Callable] = None,
+                 gang_epoch: int = 0):
         if bad_step_policy not in ("skip", "rollback"):
             raise ValueError(
                 f"bad_step_policy must be skip|rollback, got "
@@ -362,6 +372,14 @@ class ResilientTrainer:
         # pserver push/pull events ride the live step span (the client's
         # obs_hook seam) so the trainer step -> pserver trail is one trace
         self.pserver_client = pserver_client
+        # elastic gang seams: step_builder(optimizer) -> jitted step lets
+        # a ZeRO/sharded step replace the plain one while keeping the
+        # LR-backoff rebuild path (the builder receives the possibly
+        # grad-scaled optimizer); gang_epoch tags every step span and
+        # counters() so a reformed gang's spans are distinguishable from
+        # the gang that died
+        self.step_builder = step_builder
+        self.gang_epoch = int(gang_epoch)
         self._build_step()
 
     def counters(self) -> dict:
@@ -381,6 +399,12 @@ class ResilientTrainer:
             "lr_scale": self._lr_scale,
             "watchdog_fired": (self._watchdog is not None
                                and self._watchdog.fired),
+            "gang_epoch": self.gang_epoch,
+            # cross-topology restores the checkpoint manager performed
+            # (0 for a plain CheckpointManager — the attribute only
+            # exists on ElasticCheckpointManager)
+            "reshard_restores": int(getattr(self.manager,
+                                            "reshard_restores", 0)),
         }
 
     def bind_metrics(self, registry, *, prefix: str = "train",
@@ -414,6 +438,9 @@ class ResilientTrainer:
         opt = tr.optimizer
         if self._lr_scale != 1.0:
             opt = _scale_grads(opt, self._lr_scale)
+        if self.step_builder is not None:
+            self._step = self.step_builder(opt)
+            return
         # donate=False: the previous state must survive the step so a
         # bad update can be discarded without touching the checkpoint
         self._step = make_train_step(
@@ -656,7 +683,8 @@ class ResilientTrainer:
                     # same id, so the audit trail shows every attempt
                     span = self.tracer.start(
                         f"step{gidx}", "train.step",
-                        pass_id=pass_id, batch_id=batch_id)
+                        pass_id=pass_id, batch_id=batch_id,
+                        gang_epoch=self.gang_epoch)
                     if self.pserver_client is not None:
                         # point the client's obs seam at THIS attempt's
                         # span; Span.event on a closed span is a no-op,

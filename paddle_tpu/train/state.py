@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 
@@ -26,4 +27,23 @@ class TrainState(NamedTuple):
             model_state=model_state,
             opt_state=optimizer.init(params),
             step=jnp.zeros((), jnp.int32),
+        )
+
+    @classmethod
+    def create_zero(cls, params, model_state, optimizer, mesh):
+        """TrainState in the ZeRO layout for `mesh`: params/model-state/
+        step replicated over the mesh, optimizer moments flat-padded and
+        sharded over the data axis (parallel.zero_init_opt_state) — the
+        state `parallel.make_zero_train_step` consumes."""
+        # local import: parallel.train_step imports this module
+        from paddle_tpu.parallel.sharding import replicated
+        from paddle_tpu.parallel.train_step import zero_init_opt_state
+
+        repl = replicated(mesh)
+        return cls(
+            params=jax.tree.map(lambda p: jax.device_put(p, repl), params),
+            model_state=jax.tree.map(
+                lambda s: jax.device_put(s, repl), model_state),
+            opt_state=zero_init_opt_state(optimizer, params, mesh),
+            step=jax.device_put(jnp.zeros((), jnp.int32), repl),
         )

@@ -17,6 +17,11 @@
 #     scripts/fault_smoke.sh fleet      # just the cross-process fleet
 #                                       #   lane (socket replicas, real
 #                                       #   SIGKILL, orphan watchdog)
+#     scripts/fault_smoke.sh elastic    # just the elastic gang-training
+#                                       #   lane (ZeRO parity, reshard
+#                                       #   restore, gang SIGKILL/wedge
+#                                       #   chaos incl. the slow cases,
+#                                       #   then bench.py --elastic-only)
 #     scripts/fault_smoke.sh -k serve   # just the serving chaos suite
 #
 # CPU-only and deterministic (testing.faults FaultPlan + ManualClock;
@@ -34,6 +39,14 @@ elif [ "$1" = "disagg" ]; then
 elif [ "$1" = "fleet" ]; then
     marker="fleet and faults"
     shift
+elif [ "$1" = "elastic" ]; then
+    # the whole elastic lane, INCLUDING the slow wedge-fencing case
+    # tier-1 excludes, then the perf stage (memory win, sharded-update
+    # overhead, kill->resume latency)
+    shift
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m elastic \
+        -p no:cacheprovider "$@"
+    exec env JAX_PLATFORMS=cpu python bench.py --elastic-only
 fi
 exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m "$marker" \
     -p no:cacheprovider "$@"

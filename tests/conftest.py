@@ -122,6 +122,12 @@ def pytest_configure(config):
         "manifest-mismatch fallback, corrupt-entry miss, subprocess "
         "cache-warm restart) — fast, runs IN tier-1; `-m aot` (or "
         "`scripts/perf_smoke.sh aot`) runs it alone")
+    config.addinivalue_line(
+        "markers", "elastic: elastic gang-training suite (ZeRO-"
+        "sharded optimizer state, reshard-on-restore checkpoints, "
+        "gang supervision chaos) — fast cases run IN tier-1, the "
+        "real-process chaos cases are heavyweight/slow; `-m elastic` "
+        "(or `scripts/fault_smoke.sh elastic`) runs the lane alone")
 
 
 def pytest_runtest_logreport(report):
