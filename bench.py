@@ -829,6 +829,127 @@ def bench_fleet() -> None:
     sup.shutdown(drain=False)
 
 
+def bench_cluster() -> None:
+    """Multi-host control-plane stage (ISSUE 16): the two latencies
+    that price lease-based membership — how fast a host death
+    PROPAGATES (agent SIGKILL -> the supervisor observes the eviction
+    view change; bounded below by the lease TTL), and how fast the
+    reformed fleet produces its first recovered COMPLETION (kill ->
+    first redistributed request done). Three real agent processes
+    with distinct fake host-ids on one box, topology resolved through
+    membership, real clocks with a short TTL; `scripts/fault_smoke.sh
+    cluster` drives it as `bench.py --cluster-only`."""
+    import tempfile
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.cluster.agent import AgentProcess, AgentSpec
+    from paddle_tpu.cluster.membership import (MembershipClient,
+                                               MembershipServer,
+                                               MembershipService)
+    from paddle_tpu.obs import MetricsRegistry
+    from paddle_tpu.serve.fleet import FleetSupervisor, ReplicaSpec
+    from paddle_tpu.testing.fleet import save_tiny_artifact
+
+    tmp = tempfile.mkdtemp(prefix="cluster_bench_")
+    art = os.path.join(tmp, "engine.tar")
+    log("cluster: writing engine artifact (replica boots skip compiles)")
+    save_tiny_artifact(art, buckets=(16,))
+    env = {"JAX_PLATFORMS": "cpu",
+           "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+    rspec = ReplicaSpec(
+        builder="paddle_tpu.testing.fleet:build_tiny_server",
+        kwargs=dict(artifact=art, buckets=(16,), max_retries=1),
+        env=env)
+
+    ttl_s = 2.0
+    registry = MetricsRegistry()
+    svc = MembershipService(default_ttl_s=ttl_s)
+    svc.bind_metrics(registry)          # membership_* counter source
+    server = MembershipServer(svc).start()
+    log("cluster: booting 3 per-host agents (1 replica each)")
+    agents = {}
+    sup = None
+    try:
+        for i in range(3):
+            host = f"host-{i}"
+            agents[host] = AgentProcess(AgentSpec(
+                host_id=host, replica_spec=rspec,
+                membership_addr=server.addr, ttl_s=ttl_s,
+                renew_interval_s=0.05, report_every=10)).start()
+        for a in agents.values():
+            a.wait_ready(180.0)
+        sup = FleetSupervisor(
+            rspec, min_replicas=1, max_replicas=3,
+            membership=MembershipClient(server.addr),
+            registry=registry)
+        sup.start()
+
+        r = np.random.RandomState(7)
+        prompts = [r.randint(0, 61, (6 + i % 5,)).astype(np.int32)
+                   for i in range(10)]
+        rids = [sup.submit(p, max_new=8) for p in prompts]
+        log("cluster: SIGKILL host-1's agent mid-burst")
+        kill_t = None
+        eviction_seen_t = None
+        sweeps = 0
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            busy = sup.sweep()
+            sweeps += 1
+            if sweeps == 4 and kill_t is None:
+                victim = agents["host-1"]
+                victim.kill()
+                victim.proc.join(10.0)
+                kill_t = time.monotonic()
+            if (kill_t is not None and eviction_seen_t is None
+                    and sup.stats["hosts_lost"] >= 1):
+                eviction_seen_t = time.monotonic()
+            # keep sweeping past the drain until the lease expiry has
+            # propagated (that is the latency being measured)
+            if not busy and (kill_t is None
+                             or eviction_seen_t is not None):
+                break
+            time.sleep(0.01)
+        sup.reconcile()
+        res = sup.router.results
+        c = sup.router.counters()
+        recovered = [res[i] for i in rids
+                     if i in res and res[i].redistributions > 0
+                     and res[i].outcome == "completed"]
+        first_completion = (
+            round(min(x.done_at for x in recovered) - kill_t, 3)
+            if recovered and kill_t is not None else None)
+        view_prop = (round(eviction_seen_t - kill_t, 3)
+                     if eviction_seen_t is not None else None)
+        snapshot = registry.snapshot()["series"]
+        mc = svc.counters()
+        emit("cluster_view_propagation_s", view_prop,
+             "seconds agent SIGKILL->eviction view change observed",
+             None, lease_ttl_s=ttl_s, sweeps=sweeps,
+             epoch=mc["epoch"], evictions=mc["evictions"],
+             hosts_live=mc["hosts_live"],
+             agent_renews=mc.get("agent_renews"),
+             obs_snapshot=snapshot)
+        emit("cluster_kill_first_completion_s", first_completion,
+             "seconds agent SIGKILL->first recovered completion",
+             None, requests_recovered=len(recovered),
+             replicas_lost=c["replicas_lost"],
+             redistributed=c["redistributed"],
+             completed=c["completed"],
+             hosts_live_after=sup.counters()["hosts_live"],
+             all_exactly_once=bool(
+                 c["completed"] + c["expired"] + c["shed"] + c["failed"]
+                 == c["requests"]))
+    finally:
+        if sup is not None:
+            sup.shutdown(drain=False)
+        for a in agents.values():
+            a.stop()
+        server.shutdown()
+
+
 def bench_elastic() -> None:
     """Elastic gang-training stage (ISSUE 15): the three numbers that
     decide whether ZeRO + gang supervision is worth running — the
@@ -1473,6 +1594,8 @@ if __name__ == "__main__":
         bench_disagg()
     elif len(sys.argv) > 1 and sys.argv[1] == "--fleet-only":
         bench_fleet()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--cluster-only":
+        bench_cluster()
     elif len(sys.argv) > 1 and sys.argv[1] == "--elastic-only":
         bench_elastic()
     elif len(sys.argv) > 1 and sys.argv[1] == "--cold-start-only":

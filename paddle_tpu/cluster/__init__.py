@@ -1,0 +1,45 @@
+"""Multi-host control plane: lease-based membership + per-host agents.
+
+The paper's v2 generation ran its distributed runtime over etcd: hosts
+were discovered by REGISTRATION and evicted by LEASE EXPIRY, never by
+a parent reaping PIDs. This package is that capability for the
+reproduction — small enough to read, chaos-tested like the rest:
+
+- ``cluster.lease``       — the ONE lease table (TTL + renew + expiry,
+  injectable clock) shared by the pserver's trainer leases, the gang
+  supervisor's heartbeat staleness, and membership itself.
+- ``cluster.membership``  — the replicated membership service: host
+  registration, a monotonically increasing cluster epoch bumped on
+  every view change, epoch-fenced writes, watch/poll for view changes,
+  and a warm standby fed by log shipping.
+- ``cluster.agent``       — the per-host agent process: owns local
+  spawn/fence for its replicas, registers inventory, renews its
+  lease, executes fenced teardown on eviction.
+
+Attribute access is LAZY (PEP 562): `cluster.lease` is imported by
+host-side hot paths (the pserver, gang worker children) that must not
+drag the serving stack in — only the submodule you touch loads.
+Nothing here imports jax.
+"""
+
+_EXPORTS = {
+    "Lease": "paddle_tpu.cluster.lease",
+    "LeaseTable": "paddle_tpu.cluster.lease",
+    "ClusterView": "paddle_tpu.cluster.membership",
+    "MembershipClient": "paddle_tpu.cluster.membership",
+    "MembershipServer": "paddle_tpu.cluster.membership",
+    "MembershipService": "paddle_tpu.cluster.membership",
+    "AgentProcess": "paddle_tpu.cluster.agent",
+    "AgentSpec": "paddle_tpu.cluster.agent",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+    return getattr(importlib.import_module(mod), name)

@@ -55,6 +55,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from paddle_tpu.cluster.lease import LeaseTable
 from paddle_tpu.wire import MAX_FRAME, recv_frame, send_frame
 from paddle_tpu.wire import recv_full as _recv_full
 
@@ -324,10 +325,10 @@ class PServerShard:
         self.last_snapshot_error: Optional[str] = None
         self.killed = False
         self._lock = threading.Lock()
-        # trainer -> (token, deadline, granted ttl) — renewals must use
-        # the TTL the trainer REGISTERED with, not the shard default
-        self._leases: Dict[int, Tuple[int, float, float]] = {}
-        self._next_token = 1
+        # trainer leases: the shared cluster.lease table (renewals use
+        # the TTL the trainer REGISTERED with, not the shard default —
+        # LeaseTable's renew contract)
+        self._leases = LeaseTable(default_ttl_s=lease_ttl_s, clock=clock)
         self._pass_num = 0
         self._pass_finished: set = set()
         self._stats = {"pushes": 0, "duplicates": 0, "gets": 0,
@@ -490,23 +491,20 @@ class PServerShard:
     # -- leases ----------------------------------------------------------
 
     def _expire_leases(self) -> None:
-        now = self.clock()
-        for t, (tok, deadline, _ttl) in list(self._leases.items()):
-            if now >= deadline:
-                # an expired lease releases the trainer's in-flight
-                # pass: it stops counting toward the finish barrier so
-                # the survivors' pass can complete
-                del self._leases[t]
-                self._pass_finished.discard(t)
-                self._stats["lease_expirations"] += 1
-                log.warning("pserver %s: trainer %d lease expired — "
-                            "released from pass %d", self.name, t,
-                            self._pass_num)
+        for t in self._leases.expire():
+            # an expired lease releases the trainer's in-flight
+            # pass: it stops counting toward the finish barrier so
+            # the survivors' pass can complete
+            self._pass_finished.discard(t)
+            self._stats["lease_expirations"] += 1
+            log.warning("pserver %s: trainer %d lease expired — "
+                        "released from pass %d", self.name, t,
+                        self._pass_num)
         self._check_pass_done()
 
     def _lease_ok(self, trainer: int, token: int) -> bool:
         lease = self._leases.get(trainer)
-        return lease is not None and lease[0] == token
+        return lease is not None and lease.token == token
 
     def _check_pass_done(self) -> None:
         if self._leases and self._pass_finished >= set(self._leases):
@@ -595,10 +593,8 @@ class PServerShard:
 
     def _h_register(self, body: bytes) -> bytes:
         trainer, ttl = struct.unpack_from("<qd", body)
-        ttl = ttl if ttl > 0 else self.lease_ttl_s
-        token = self._next_token
-        self._next_token += 1
-        self._leases[trainer] = (token, self.clock() + ttl, ttl)
+        token = self._leases.grant(trainer,
+                                   ttl if ttl > 0 else None).token
         # (re-)registering mid-pass does NOT resurrect a finished vote:
         # a fresh lease joins the CURRENT pass unfinished
         self._pass_finished.discard(trainer)
@@ -612,10 +608,8 @@ class PServerShard:
 
     def _h_heartbeat(self, body: bytes) -> bytes:
         trainer, token = struct.unpack_from("<qQ", body)
-        if not self._lease_ok(trainer, token):
+        if not self._leases.renew(trainer, token):
             return bytes([ST_LEASE_EXPIRED])
-        ttl = self._leases[trainer][2]
-        self._leases[trainer] = (token, self.clock() + ttl, ttl)
         return bytes([ST_OK])
 
     def _h_get_rows(self, body: bytes) -> bytes:
@@ -634,11 +628,10 @@ class PServerShard:
             body, np.float32, n * self.state.dim,
             offset=off + n * 8).reshape(n, self.state.dim)
         self._fault("push_recv")
-        lease = self._leases.get(trainer)
-        if lease is None:
+        # a push implicitly renews (any token incarnation: the push
+        # epoch check is the dedup authority, not the lease token)
+        if not self._leases.renew(trainer):
             return bytes([ST_LEASE_EXPIRED])
-        self._leases[trainer] = (lease[0], self.clock() + lease[2],
-                                 lease[2])
         applied = self.state.apply_push(trainer, epoch, ids, grads, lr)
         if applied:
             self._stats["pushes"] += 1

@@ -48,6 +48,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from paddle_tpu.cluster.lease import LeaseTable
+
 
 def _stream(proc: subprocess.Popen, prefix: str) -> None:
     for line in proc.stdout:  # type: ignore[union-attr]
@@ -411,7 +413,9 @@ class GangSupervisor:
                  grace_s: float = 5.0, poll_s: float = 0.25,
                  pin_cpu: bool = True,
                  extra_env: Optional[Dict[str, str]] = None,
-                 flight: Optional[Any] = None):
+                 flight: Optional[Any] = None,
+                 membership: Optional[Any] = None,
+                 host_prefix: str = "gang"):
         if num_processes < 1 or min_procs < 1:
             raise ValueError("num_processes and min_procs must be >= 1")
         self.builder = builder
@@ -431,6 +435,15 @@ class GangSupervisor:
         self.pin_cpu = pin_cpu
         self.extra_env = dict(extra_env or {})
         self.flight = flight
+        # optional membership mirror (MembershipService or -Client
+        # duck type): each rank is a fake host `{prefix}-{rank}`; its
+        # lease renews on observed heartbeats, and an EVICTION seen in
+        # the view is a lost member — the teardown/reform path fires
+        # from a view change, not only from a local waitpid
+        self.membership = membership
+        self.host_prefix = host_prefix
+        self._member_creds: Dict[int, Tuple[int, int]] = {}
+        self.membership_evictions = 0
         # ledger (registry-source shaped: numeric values only)
         self.gang_epoch = 0
         self.reforms = 0
@@ -438,9 +451,15 @@ class GangSupervisor:
         self.fenced_wedged = 0
         self.watchdog_exits = 0
         self.spawned = 0
-        # live gang
+        # live gang. Staleness is lease-based (the shared
+        # cluster.lease semantics): every member holds a lease that
+        # starts on the boot budget and re-arms with the heartbeat
+        # ttl each time the supervisor OBSERVES a fresh heartbeat
+        # write — expiry is the one staleness verdict
         self.procs: Dict[int, subprocess.Popen] = {}
-        self._spawned_at: Dict[int, float] = {}
+        self._hb_leases = LeaseTable(default_ttl_s=boot_timeout_s,
+                                     clock=time.monotonic)
+        self._hb_seen: Dict[int, Tuple] = {}
         self._logs: List[Any] = []
 
     # -- observability -----------------------------------------------------
@@ -453,6 +472,7 @@ class GangSupervisor:
             "fenced_wedged": self.fenced_wedged,
             "watchdog_exits": self.watchdog_exits,
             "spawned": self.spawned,
+            "membership_evictions": self.membership_evictions,
             "active": sum(1 for p in self.procs.values()
                           if p.poll() is None),
         }
@@ -501,8 +521,9 @@ class GangSupervisor:
                 env={**env, "PADDLE_TPU_GANG_RANK": str(rank)},
                 stdout=log_f, stderr=subprocess.STDOUT)
             self.procs[rank] = p
-            self._spawned_at[rank] = time.monotonic()
+            self._hb_leases.grant(rank)     # the boot budget
             self.spawned += 1
+        self._membership_register(count, spec.coordinator)
 
     def _teardown(self, reason: str) -> None:
         """SIGTERM (a member at a step boundary drains one save and
@@ -533,7 +554,9 @@ class GangSupervisor:
                 pass
         self._logs.clear()
         self.procs.clear()
-        self._spawned_at.clear()
+        self._hb_leases.clear()
+        self._hb_seen.clear()
+        self._membership_deregister()
         if self.flight is not None and reason != "done":
             self.flight.dump(str(self.workdir),
                              f"gang-teardown-{reason}",
@@ -557,13 +580,94 @@ class GangSupervisor:
         except OSError:
             return False
 
-    def _stale(self, rank: int, now_wall: float) -> bool:
-        hb = self.member_heartbeat(rank)
-        if hb is not None:
-            return now_wall - hb.get("t", 0.0) > self.heartbeat_timeout_s
-        # no heartbeat yet: compile + gloo join ride the boot budget
-        return (time.monotonic() - self._spawned_at[rank]
-                > self.boot_timeout_s)
+    def _observe_heartbeats(self, ranks: List[int]) -> None:
+        """Fold freshly WRITTEN heartbeats into lease renewals: a new
+        (step, t) value proves the member progressed since the last
+        poll, so its lease re-arms with the steady-state heartbeat
+        ttl (the first heartbeat moves it off the boot budget). A
+        fresh heartbeat is ground truth — if the lease lapsed only
+        because the SUPERVISOR stalled past the ttl, it re-grants
+        rather than declaring a progressing member stale."""
+        for r in ranks:
+            hb = self.member_heartbeat(r)
+            booting = r not in self._hb_seen
+            fresh = False
+            if hb is not None:
+                key = (hb.get("step"), hb.get("t"))
+                if self._hb_seen.get(r) != key:
+                    self._hb_seen[r] = key
+                    fresh = True
+                    if not self._hb_leases.renew(
+                            r, ttl_s=self.heartbeat_timeout_s):
+                        self._hb_leases.grant(
+                            r, self.heartbeat_timeout_s)
+            if fresh or (booting and hb is None):
+                # membership mirrors liveness: progress renews, and a
+                # still-booting member is alive by definition (its
+                # boot budget is the local lease's concern)
+                self._membership_renew(r)
+
+    def _stale(self, rank: int) -> bool:
+        return not self._hb_leases.alive(rank)
+
+    # -- membership mirror (optional) --------------------------------------
+
+    def _member_host(self, rank: int) -> str:
+        return f"{self.host_prefix}-{rank}"
+
+    def _membership_register(self, count: int,
+                             coordinator: str) -> None:
+        if self.membership is None:
+            return
+        for rank in range(count):
+            try:
+                r = self.membership.register(
+                    self._member_host(rank),
+                    {"rank": rank, "gang_epoch": self.gang_epoch,
+                     "coordinator": coordinator},
+                    ttl_s=self.heartbeat_timeout_s)
+            except (OSError, ConnectionError, RuntimeError):
+                return          # membership down: local paths still run
+            self._member_creds[rank] = (r["token"], r["epoch"])
+
+    def _membership_renew(self, rank: int) -> None:
+        creds = self._member_creds.get(rank)
+        if self.membership is None or creds is None:
+            return
+        token, epoch = creds
+        try:
+            resp = self.membership.renew(self._member_host(rank),
+                                         token, epoch)
+        except (OSError, ConnectionError, RuntimeError):
+            return
+        if resp["status"] == "ok":
+            self._member_creds[rank] = (token, resp["epoch"])
+
+    def _membership_lost(self, alive: List[int]) -> List[int]:
+        """Ranks whose fake host has LEFT the membership view (lease
+        expiry or external eviction) — host death arriving as a view
+        change, the multi-host analog of a waitpid."""
+        if self.membership is None:
+            return []
+        try:
+            self.membership.tick()
+            view = self.membership.view()
+        except (OSError, ConnectionError, RuntimeError):
+            return []
+        return [r for r in alive
+                if r in self._member_creds
+                and self._member_host(r) not in view.hosts]
+
+    def _membership_deregister(self) -> None:
+        if self.membership is None:
+            return
+        for rank, (token, epoch) in list(self._member_creds.items()):
+            try:
+                self.membership.deregister(self._member_host(rank),
+                                           token, epoch)
+            except (OSError, ConnectionError, RuntimeError):
+                pass            # eviction will reap it eventually
+        self._member_creds.clear()
 
     def _fence(self, ranks: List[int]) -> None:
         for r in ranks:
@@ -600,6 +704,7 @@ class GangSupervisor:
             self._tick()
             codes = {r: p.poll() for r, p in self.procs.items()}
             alive = [r for r, c in codes.items() if c is None]
+            self._observe_heartbeats(alive)
             crashed = [r for r, c in codes.items()
                        if c not in (None, 0, 75)]
             wd = [r for r, c in codes.items() if c == 75]
@@ -608,18 +713,24 @@ class GangSupervisor:
             if wd:
                 self.watchdog_exits += len(wd)
                 victims = self._pick_wedged(
-                    [r for r in alive if self._stale(r, time.time())]
-                    or alive)
+                    [r for r in alive if self._stale(r)] or alive)
                 self._fence(victims)
                 return "lost", victims
             if not alive:
                 return "done", []
-            now = time.time()
-            stale = [r for r in alive if self._stale(r, now)]
+            stale = [r for r in alive if self._stale(r)]
             if stale:
                 victims = self._pick_wedged(stale)
                 self._fence(victims)
                 return "lost", victims
+            evicted = self._membership_lost(alive)
+            if evicted:
+                # the view says these hosts are GONE: fence locally
+                # and reform at the surviving count, exactly like a
+                # local staleness verdict
+                self.membership_evictions += len(evicted)
+                self._fence(evicted)
+                return "lost", evicted
             time.sleep(self.poll_s)
 
     # -- drive -------------------------------------------------------------

@@ -172,9 +172,57 @@ class ShardConn:
             f"no pserver endpoint of {self.endpoints} answered after "
             f"{self.retries + 1} attempts: {last}") from last
 
+    def update_endpoints(self,
+                         endpoints: Sequence[Tuple[str, int]]) -> None:
+        """Re-point the chain (membership topology refresh). The live
+        socket drops so the next call dials the new chain from its
+        head — primary first, per the inventory's ordering."""
+        if not endpoints:
+            raise ValueError("ShardConn needs at least one endpoint")
+        self.endpoints = [tuple(e) for e in endpoints]
+        self._active = 0
+        self._drop()
+
     def close(self) -> None:
         self._closed = True
         self._drop()
+
+
+def shard_specs_from_view(view) -> List[ShardSpec]:
+    """Resolve the pserver tier's `ShardSpec` list from a membership
+    `ClusterView`: each serving host folds
+    ``{"shards": [{"shard_id", "row_lo", "row_hi", "endpoints":
+    [[host, port], ...], "role": "primary"|"backup"}, ...]}`` into its
+    inventory, and this merges them per shard — primary endpoints
+    first (the failover chain's head), then backups, each group in
+    host_id order. The hardcoded-endpoint-list constructor stays for
+    single-box runs; the multi-host path resolves HERE."""
+    by_shard: dict = {}
+    for host_id in sorted(view.hosts):
+        for entry in view.hosts[host_id].get("shards", ()):
+            rec = by_shard.setdefault(
+                int(entry["shard_id"]),
+                {"row_lo": int(entry["row_lo"]),
+                 "row_hi": int(entry["row_hi"]),
+                 "primary": [], "backup": []})
+            if (rec["row_lo"], rec["row_hi"]) != (
+                    int(entry["row_lo"]), int(entry["row_hi"])):
+                raise ValueError(
+                    f"hosts disagree on shard {entry['shard_id']} row "
+                    f"range — a stale inventory is still registered")
+            role = entry.get("role", "primary")
+            eps = [(e[0], int(e[1])) for e in entry["endpoints"]]
+            rec["backup" if role == "backup" else "primary"].extend(eps)
+    specs = []
+    for sid in sorted(by_shard):
+        rec = by_shard[sid]
+        endpoints = rec["primary"] + rec["backup"]
+        if not endpoints:
+            raise ValueError(f"shard {sid} has no endpoints in view")
+        specs.append(ShardSpec(shard_id=sid, row_lo=rec["row_lo"],
+                               row_hi=rec["row_hi"],
+                               endpoints=endpoints))
+    return specs
 
 
 class PServerClient:
@@ -227,6 +275,47 @@ class PServerClient:
         # an RPC settles, exceptions swallowed — ResilientTrainer points
         # this at the live step span so push/pull land on its trail.
         self.obs_hook: Optional[Callable] = None
+
+    @classmethod
+    def from_membership(cls, membership, dim: int,
+                        **kw) -> "PServerClient":
+        """Build a client whose shard topology comes from the
+        membership view instead of a hardcoded endpoint list (the
+        multi-host path). The membership handle is kept so
+        `refresh_topology` can re-resolve after a view change."""
+        client = cls(shard_specs_from_view(membership.view()), dim, **kw)
+        client._membership = membership
+        return client
+
+    def refresh_topology(self) -> bool:
+        """Re-resolve shard endpoints from the current membership view
+        and re-point each shard's failover chain. The shard LAYOUT
+        (count + row ranges) must be unchanged — rows don't move when a
+        backup takes over, only endpoints do. Returns True if any
+        chain actually changed. Raises RuntimeError when the client
+        was not built via `from_membership`."""
+        membership = getattr(self, "_membership", None)
+        if membership is None:
+            raise RuntimeError(
+                "refresh_topology needs a membership-backed client "
+                "(use PServerClient.from_membership)")
+        fresh = shard_specs_from_view(membership.view())
+        with self._lock:
+            if [(s.shard_id, s.row_lo, s.row_hi) for s in fresh] != \
+                    [(s.shard_id, s.row_lo, s.row_hi) for s in self.specs]:
+                raise ValueError(
+                    "membership view changed the shard layout; "
+                    "rebuild the client instead of refreshing it")
+            changed = False
+            for i, spec in enumerate(fresh):
+                if spec.endpoints != self._conns[i].endpoints:
+                    self._conns[i].update_endpoints(spec.endpoints)
+                    self.specs[i].endpoints = list(spec.endpoints)
+                    changed = True
+        if changed:
+            self._obs("pserver.topology_refresh",
+                      shards=len(fresh))
+        return changed
 
     def _obs(self, event: str, **ctx) -> None:
         if self.obs_hook is None:

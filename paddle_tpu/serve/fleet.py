@@ -310,7 +310,8 @@ class FleetSupervisor:
                  boot_timeout_s: float = 120.0,
                  flight: Optional[FlightRecorder] = None,
                  flight_dir: Optional[str] = None,
-                 router_kwargs: Optional[dict] = None):
+                 router_kwargs: Optional[dict] = None,
+                 membership: Optional[object] = None):
         if not (1 <= min_replicas <= max_replicas):
             raise ValueError(
                 f"need 1 <= min_replicas <= max_replicas, got "
@@ -325,6 +326,17 @@ class FleetSupervisor:
         self.flight_dir = flight_dir
         self._spawn_fn = spawn
         self._router_kwargs = dict(router_kwargs or {})
+        # membership mode (`cluster.membership` service/client duck
+        # type): the fleet roster is RESOLVED from the membership
+        # view — replicas live on per-host agents, host death
+        # arrives as a view change, and autoscaling is the agents'
+        # business, not ours. `None` = classic single-host mode
+        # (supervisor owns the processes), untouched.
+        self.membership = membership
+        self._mem_epoch = 0
+        #: (host_id, (addr, port)) -> rid, the roster the view diffs
+        #: against
+        self._known_eps: Dict[Tuple[str, Tuple[str, int]], int] = {}
         self.router: Optional[ServingRouter] = None
         self.procs: Dict[int, Optional[ReplicaProcess]] = {}
         self._retiring: set = set()
@@ -332,7 +344,8 @@ class FleetSupervisor:
         self._since_scale = 0
         self.stats: Dict[str, int] = {
             "spawned": 0, "reaped": 0, "scale_out_events": 0,
-            "scale_in_events": 0, "upgrades": 0}
+            "scale_in_events": 0, "upgrades": 0, "view_changes": 0,
+            "hosts_lost": 0, "replicas_joined": 0}
         self.registry = (registry if registry is not None
                          else MetricsRegistry(clock=clock))
         # completion latency (ms) for requests routed through
@@ -353,7 +366,19 @@ class FleetSupervisor:
         them all, then collect handshakes) and build the router."""
         assert self.router is None, "start() is once"
         members: List[Tuple[object, Optional[ReplicaProcess]]] = []
-        if self._spawn_fn is not None:
+        mem_eps: List[Tuple[str, Tuple[str, int]]] = []
+        if self.membership is not None:
+            view = self.membership.view()
+            self._mem_epoch = view.epoch
+            mem_eps = view.endpoints("replicas")
+            if not mem_eps:
+                raise RuntimeError(
+                    "membership view (epoch "
+                    f"{view.epoch}) carries no replica endpoints — "
+                    "are the host agents registered?")
+            for _, addr in mem_eps:
+                members.append((self._wrap_addr(addr), None))
+        elif self._spawn_fn is not None:
             for _ in range(self.min_replicas):
                 members.append((self._spawn_fn(self.spec), None))
         else:
@@ -368,6 +393,8 @@ class FleetSupervisor:
             flight_dir=self.flight_dir, **self._router_kwargs)
         for rid, (_, proc) in enumerate(members):
             self.procs[rid] = proc
+        for rid, (host_id, addr) in enumerate(mem_eps):
+            self._known_eps[(host_id, addr)] = rid
         self.stats["spawned"] += len(members)
         self.router.bind_metrics(self.registry)
         self.registry.register_source("fleet_sup", self.counters)
@@ -387,6 +414,17 @@ class FleetSupervisor:
             io_timeout=self.spec.io_timeout,
             retries=self.spec.retries)
         return ProcessReplica(client, proc=proc, clock=self.clock)
+
+    def _wrap_addr(self, addr: Tuple[str, int]) -> ProcessReplica:
+        """An agent-owned replica: we hold its SOCKET, never its
+        process (proc=None — fencing degrades to transport-only; the
+        owning agent, or its death, is what actually stops it)."""
+        client = ReplicaClient(
+            (addr[0], int(addr[1])),
+            connect_timeout=self.spec.connect_timeout,
+            io_timeout=self.spec.io_timeout,
+            retries=self.spec.retries)
+        return ProcessReplica(client, proc=None, clock=self.clock)
 
     def _spawn_member(self, spec: ReplicaSpec) -> int:
         """Spawn one replica (process or seam) and add it to the
@@ -420,12 +458,60 @@ class FleetSupervisor:
 
     def sweep(self) -> bool:
         """One supervisor turn: drive the fleet, feed the latency
-        histogram, tick the autoscaler, reap empty retirees."""
+        histogram, tick the autoscaler, reap empty retirees. In
+        membership mode the VIEW ticks first — a host the membership
+        evicted is fenced before this sweep would step its replicas
+        (redistribution from the view change, not from a socket
+        error) — and the autoscale tick is skipped: capacity belongs
+        to the per-host agents."""
+        if self.membership is not None:
+            self._membership_tick()
         busy = self.router.sweep()
         self._observe_latency()
-        self._autoscale_tick()
+        if self.membership is None:
+            self._autoscale_tick()
         self._reap_retired()
         return busy
+
+    def _membership_tick(self) -> None:
+        """Fold the current membership view into the fleet roster:
+        endpoints that LEFT (host eviction, inventory shrink) run
+        the router's crash path; endpoints that JOINED are added to
+        the next sweep. A membership outage is tolerated — the fleet
+        keeps serving the last view it saw."""
+        try:
+            self.membership.tick()
+            view = self.membership.view()
+        except (OSError, ConnectionError, RuntimeError):
+            return
+        if view.epoch == self._mem_epoch:
+            return
+        self._mem_epoch = view.epoch
+        self.stats["view_changes"] += 1
+        current = set()
+        for host_id, addr in view.endpoints("replicas"):
+            key = (host_id, addr)
+            current.add(key)
+            if key not in self._known_eps:
+                rid = self.router.add_replica(self._wrap_addr(addr))
+                self.procs[rid] = None
+                self._known_eps[key] = rid
+                self.stats["replicas_joined"] += 1
+                self._note("replica-join", rid=rid, host=host_id,
+                           epoch=view.epoch)
+        lost_hosts = set()
+        for key in [k for k in self._known_eps if k not in current]:
+            host_id, _ = key
+            rid = self._known_eps.pop(key)
+            lost_hosts.add(host_id)
+            self.router.declare_dead(
+                rid, f"host {host_id} left the membership view "
+                     f"(epoch {view.epoch})")
+            self._note("replica-left", rid=rid, host=host_id,
+                       epoch=view.epoch)
+        self.stats["hosts_lost"] += sum(
+            1 for h in lost_hosts
+            if not any(k[0] == h for k in self._known_eps))
 
     def run(self):
         """Serve until the fleet is idle (the router contract);
@@ -449,6 +535,9 @@ class FleetSupervisor:
         for rid, proc in self.procs.items():
             if proc is not None:
                 out[f"proc_r{rid}_alive"] = int(proc.alive())
+        if self.membership is not None:
+            out["membership_epoch"] = self._mem_epoch
+            out["hosts_live"] = len({h for h, _ in self._known_eps})
         return out
 
     def reconcile(self) -> None:
@@ -487,6 +576,10 @@ class FleetSupervisor:
     def scale_out(self) -> int:
         """Add one replica NOW (autoscaler verdict or operator
         call). Resets the cooldown clock."""
+        if self.membership is not None:
+            raise RuntimeError(
+                "capacity is agent-owned in membership mode — "
+                "add a host (or grow an agent's inventory) instead")
         rid = self._spawn_member(self.spec)
         self.stats["scale_out_events"] += 1
         self._since_scale = 0
@@ -500,6 +593,10 @@ class FleetSupervisor:
         in-flight work finish; the reap pass shuts the process down
         only once it is EMPTY — zero dropped outcomes by
         construction."""
+        if self.membership is not None:
+            raise RuntimeError(
+                "capacity is agent-owned in membership mode — "
+                "deregister the host instead")
         routable = self._routable()
         if len(routable) <= self.min_replicas:
             return None

@@ -599,6 +599,21 @@ class ServingRouter:
 
     # -- elastic membership (the fleet supervisor's surface) ---------------
 
+    def declare_dead(self, rid: int, reason: str) -> None:
+        """Externally-sourced death: a membership view change says
+        this replica's HOST is gone (lease expiry — `cluster.
+        membership`), before any socket on it has had to fail. Runs
+        the exact crash path `_on_replica_death` takes for a
+        transport-detected death: pending work is harvested from the
+        mirror ledger and redistributed with retry budgets and
+        deadlines intact. Idempotent — a replica the sweep already
+        buried is a no-op, so the socket path and the view-change
+        path can both fire in either order."""
+        rep = self.replicas[rid]
+        if not rep.alive:
+            return
+        self._on_replica_death(rep, ReplicaDeadError(reason))
+
     def add_replica(self, server) -> int:
         """Join a new replica to the fleet mid-flight (scale-out,
         rolling-upgrade replacement). It gets the same breaker

@@ -79,6 +79,18 @@ model") prove the multi-replica story:
   exactly-once outcomes, and reconcile counters across the process
   boundary (docs/RELIABILITY.md "Process-fleet fault model").
 
+Cluster faults (cluster.agent + cluster.membership, docs/
+RELIABILITY.md "Host fault model") prove the multi-host control
+plane:
+- a per-host AGENT SIGKILLed mid-burst (`wrap_cluster`,
+  `cluster_sigkill_at` + `cluster_sigkill_host`): the host's replica
+  grandchildren die on the watchdog chain, nothing deregisters, and
+  the membership service's injected ManualClock advances past the
+  victim's TTL in two half-steps while the survivors provably renew
+  across each — the next supervisor sweep must evict exactly one
+  host from the VIEW (one epoch bump) and redistribute with
+  exactly-once outcomes.
+
 Parameter-server faults (native.pserver + parallel.pserver_client,
 docs/RELIABILITY.md "Parameter-server fault model") use the shard's
 `fault_hook` seam (`wrap_pserver_shard`):
@@ -102,6 +114,7 @@ import dataclasses
 import os
 import random
 import signal
+import time
 from typing import Any, Callable, List, Optional
 
 
@@ -140,6 +153,9 @@ class FaultPlan:
     # -- fleet process faults (serve.fleet, via wrap_fleet) --
     fleet_sigkill_at: Optional[int] = None        # nth supervisor sweep
     fleet_sigkill_replica: int = 0                # rid of the victim child
+    # -- cluster faults (cluster.agent + membership, via wrap_cluster) --
+    cluster_sigkill_at: Optional[int] = None      # nth supervisor sweep
+    cluster_sigkill_host: str = ""                # host_id of the victim
     # -- training gang faults (parallel.launch, via wrap_gang) --
     gang_kill_step_at: Optional[int] = None       # victim heartbeat step
     gang_kill_rank: int = 1                       # rank of the victim
@@ -168,6 +184,7 @@ class FaultPlan:
         self._router_import_counter = 0
         self._router_probe_counter = 0
         self._fleet_sweep_counter = 0
+        self._cluster_sweep_counter = 0
         self._pserver_push_counter = 0
         self._pserver_ack_counter = 0
         self._pserver_repl_counter = 0
@@ -377,6 +394,67 @@ class FaultPlan:
                     plan._note("fleetkill", idx)
                     os.kill(proc.pid, signal.SIGKILL)
                     proc.proc.join(10.0)
+            return inner_sweep()
+
+        supervisor.sweep = sweep
+        return supervisor
+
+    def wrap_cluster(self, supervisor, agents, *, clock, service,
+                     settle_timeout_s: float = 30.0):
+        """Install a REAL host death on a membership-mode
+        `FleetSupervisor`: right before the `cluster_sigkill_at`-th
+        sweep, the agent of host `cluster_sigkill_host` (from
+        `agents`, a host_id -> AgentProcess map) gets SIGKILL — its
+        replica grandchildren die with it on the watchdog chain, and
+        nothing deregisters. The membership service's injected
+        `ManualClock` then advances in TWO half-TTL steps: on a
+        frozen clock every renewal re-arms to the same deadline, so
+        one jump past the TTL would strand the SURVIVORS too (a
+        renewal at or past the deadline is refused by design — ties
+        break toward eviction). Instead the wrap jumps half a TTL,
+        BLOCKS (bounded, real time) until every surviving host has
+        renewed past that jump (its `service.lease_margins()` entry
+        exceeds the remaining half — the agents' renew loops run on
+        wall clock), then jumps the rest: only the victim's deadline
+        is now behind the clock. The sweep that follows therefore
+        evicts EXACTLY the victim: one lease expiry, one epoch bump,
+        one view change — the supervisor must learn of the death
+        from the VIEW, fence the dead endpoints before any socket
+        error, and redistribute with exactly-once outcomes. Needs
+        `ttl_s > 2` so survivors keep positive margin after the
+        second jump."""
+        plan = self
+
+        inner_sweep = supervisor.sweep
+
+        def sweep():
+            idx = plan._cluster_sweep_counter
+            plan._cluster_sweep_counter += 1
+            if (idx == plan.cluster_sigkill_at
+                    and not plan._spent("agentkill")):
+                victim = agents[plan.cluster_sigkill_host]
+                ttl = victim.spec.ttl_s
+                half = ttl / 2.0
+                plan._note("agentkill", idx)
+                victim.kill()
+                victim.proc.join(10.0)
+                clock.advance(half)
+                survivors = [h for h in agents
+                             if h != plan.cluster_sigkill_host]
+                deadline = time.monotonic() + settle_timeout_s
+                while True:
+                    margins = service.lease_margins()
+                    # a pre-jump lease has at most `ttl - half` left;
+                    # more proves a renewal AFTER the jump
+                    if all(margins.get(h, -1.0) > ttl - half
+                           for h in survivors):
+                        break
+                    if time.monotonic() > deadline:
+                        raise FaultError(
+                            f"surviving agents never renewed past "
+                            f"the clock jump: {margins}")
+                    time.sleep(0.02)
+                clock.advance(half + 1.0)
             return inner_sweep()
 
         supervisor.sweep = sweep

@@ -137,6 +137,32 @@ def idle_server() -> _IdleServer:
     return _IdleServer()
 
 
+def orphan_cluster_main(conn) -> None:
+    """Subprocess driver for the cluster orphan-CHAIN test: become
+    the supervisor of two leaseless `cluster.agent` children, each
+    owning one idle replica GRANDCHILD; report every pid up the pipe
+    (agents first, then grandchildren), then park until SIGKILLed.
+    The test asserts the whole three-deep tree exits on the watchdog
+    chain alone: supervisor dies -> the agents' pipes EOF -> agents
+    fence their replicas and exit -> the replicas' pipes EOF too.
+    No drain, no atexit, no layer survives its parent."""
+    from paddle_tpu.cluster.agent import AgentProcess, AgentSpec
+    from paddle_tpu.serve.fleet import ReplicaSpec
+
+    spec = ReplicaSpec(builder="paddle_tpu.testing.fleet:idle_server")
+    agents = [AgentProcess(AgentSpec(host_id=f"host-{i}",
+                                     replica_spec=spec)).start()
+              for i in range(2)]
+    agent_pids, replica_pids = [], []
+    for a in agents:
+        info = a.wait_ready()
+        agent_pids.append(a.pid)
+        replica_pids.extend(info["pids"])
+    conn.send(agent_pids + replica_pids)
+    while True:
+        time.sleep(3600)        # waiting for SIGKILL
+
+
 def orphan_fleet_main(conn) -> None:
     """Subprocess driver for the orphan-leak test: become a
     supervisor of idle replica PROCESSES, report their pids, then

@@ -17,6 +17,12 @@
 #     scripts/fault_smoke.sh fleet      # just the cross-process fleet
 #                                       #   lane (socket replicas, real
 #                                       #   SIGKILL, orphan watchdog)
+#     scripts/fault_smoke.sh cluster    # just the multi-host control-
+#                                       #   plane lane (lease/epoch
+#                                       #   fencing, agents, standby
+#                                       #   failover, the agent-SIGKILL
+#                                       #   reform chaos case, then
+#                                       #   bench.py --cluster-only)
 #     scripts/fault_smoke.sh elastic    # just the elastic gang-training
 #                                       #   lane (ZeRO parity, reshard
 #                                       #   restore, gang SIGKILL/wedge
@@ -39,6 +45,14 @@ elif [ "$1" = "disagg" ]; then
 elif [ "$1" = "fleet" ]; then
     marker="fleet and faults"
     shift
+elif [ "$1" = "cluster" ]; then
+    # the whole multi-host lane, INCLUDING the heavyweight reform
+    # chaos case, then the control-plane latency stage (view
+    # propagation + kill->first recovered completion)
+    shift
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m "cluster and faults" -p no:cacheprovider "$@"
+    exec env JAX_PLATFORMS=cpu python bench.py --cluster-only
 elif [ "$1" = "elastic" ]; then
     # the whole elastic lane, INCLUDING the slow wedge-fencing case
     # tier-1 excludes, then the perf stage (memory win, sharded-update

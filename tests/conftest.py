@@ -123,6 +123,13 @@ def pytest_configure(config):
         "cache-warm restart) — fast, runs IN tier-1; `-m aot` (or "
         "`scripts/perf_smoke.sh aot`) runs it alone")
     config.addinivalue_line(
+        "markers", "cluster: multi-host control-plane suite "
+        "(cluster.membership lease/epoch fencing, per-host agents, "
+        "standby failover, membership-resolved topology) — fast "
+        "cases run IN tier-1, the real-process chaos case is "
+        "heavyweight/slow; `-m cluster` (or `scripts/fault_smoke.sh "
+        "cluster`) runs the lane alone")
+    config.addinivalue_line(
         "markers", "elastic: elastic gang-training suite (ZeRO-"
         "sharded optimizer state, reshard-on-restore checkpoints, "
         "gang supervision chaos) — fast cases run IN tier-1, the "

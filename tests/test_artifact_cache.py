@@ -117,6 +117,9 @@ def test_roundtrip_greedy_parity(params, bundle, eng_art):
     assert c["artifact_fallbacks"] == 0
 
 
+@pytest.mark.slow
+
+
 def test_roundtrip_speculative_parity(params, bundle, eng_art):
     """Speculative serving (draft + one-launch verify via the
     exported spec program) stays greedy-bit-identical to the plain
@@ -275,6 +278,9 @@ def _run_warm_child(cache_dir):
     line = [l for l in out.stdout.splitlines()
             if l.strip().startswith("{")][-1]
     return json.loads(line)
+
+
+@pytest.mark.slow
 
 
 def test_subprocess_cache_warm_zero_recompiles(tmp_path):

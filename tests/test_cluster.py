@@ -1,0 +1,660 @@
+"""Multi-host control-plane suite (cluster.lease / cluster.membership
+/ cluster.agent + the membership-resolved topology paths).
+
+Fast cases drive the in-process `MembershipService` state machine
+under a ManualClock — lease-vs-renew races, epoch fencing, batch
+eviction, watch semantics, standby failover — plus the topology
+resolvers (`shard_specs_from_view`, `FleetSupervisor` membership
+mode, the gang supervisor's membership mirror) with no processes and
+no jax. The real-process cases boot per-host agents (idle replicas,
+millisecond boots) for the lifecycle and orphan-CHAIN tests, and the
+one `heavyweight` chaos test is the acceptance bar: 3 agents with
+distinct fake host-ids serving a mid-flight burst, one SIGKILLed —
+lease expiry on the injectable clock, one epoch bump, reform at 2
+hosts, exactly-once outcomes, counters reconciling, zero orphans,
+and the resurrected agent's stale-epoch writes refused. Like the
+elastic suite's real-process chaos cases it is heavyweight AND slow
+(three replica-process boots don't fit the tier-1 wall clock);
+`-m cluster` / `scripts/fault_smoke.sh cluster` runs it.
+"""
+
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from paddle_tpu.cluster.agent import (EXIT_EVICTED, AgentProcess,
+                                      AgentSpec)
+from paddle_tpu.cluster.lease import LeaseTable
+from paddle_tpu.cluster.membership import (ClusterView,
+                                           MembershipClient,
+                                           MembershipServer,
+                                           MembershipService,
+                                           StandbyLink)
+from paddle_tpu.models import transformer as T
+from paddle_tpu.parallel.pserver_client import (PServerClient,
+                                                shard_specs_from_view)
+from paddle_tpu.serve.fleet import FleetSupervisor, ReplicaSpec
+from paddle_tpu.testing.faults import FaultPlan, ManualClock
+from paddle_tpu.testing.fleet import TINY, _IdleServer, save_tiny_artifact
+
+pytestmark = [pytest.mark.cluster, pytest.mark.faults]
+
+CFG = T.TransformerConfig(**TINY)
+
+CHILD_ENV = {"JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}
+
+IDLE_SPEC = ReplicaSpec(builder="paddle_tpu.testing.fleet:idle_server")
+
+
+def _proc_gone(pid):
+    """True when `pid` is dead (missing or a zombie awaiting reap)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            state = f.read().rsplit(")", 1)[1].split()[0]
+    except (FileNotFoundError, ProcessLookupError):
+        return True
+    return state == "Z"
+
+
+def _await(cond, timeout_s=20.0, poll_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(poll_s)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# the shared lease table
+
+
+def test_lease_renew_honors_registered_ttl():
+    """The consumer contract satellite 1 unified: a renewal re-arms
+    with the ttl the holder REGISTERED with, not the table default —
+    a short-lease holder dies with its short lease."""
+    clock = ManualClock()
+    table = LeaseTable(default_ttl_s=30.0, clock=clock)
+    lease = table.grant("short", ttl_s=5.0)
+    clock.advance(3.0)
+    assert table.renew("short", lease.token)
+    assert table.remaining("short") == pytest.approx(5.0)
+    # an explicit ttl override re-arms with the NEW interval from now on
+    assert table.renew("short", lease.token, ttl_s=2.0)
+    assert table.remaining("short") == pytest.approx(2.0)
+    clock.advance(1.0)
+    assert table.renew("short")
+    assert table.remaining("short") == pytest.approx(2.0)
+
+
+def test_lease_expiry_vs_renew_race_breaks_toward_eviction():
+    """`now >= deadline` refuses the renewal: a holder renewing
+    exactly AT its deadline had zero margin, and zero margin is one
+    scheduler hiccup from split-brain. Just-in-time (any positive
+    margin) still wins."""
+    clock = ManualClock()
+    table = LeaseTable(default_ttl_s=10.0, clock=clock)
+    lease = table.grant("h")
+    clock.advance(9.999)
+    assert table.renew("h", lease.token)          # margin > 0: lives
+    clock.advance(10.0)                           # exactly at deadline
+    assert not table.renew("h", lease.token)
+    assert table.stats["refused_renewals"] == 1
+    assert table.expire() == ["h"]
+    assert not table.renew("h", lease.token)      # gone is gone
+
+
+def test_lease_tokens_are_incarnations():
+    """A re-grant is a NEW incarnation: fresh (strictly larger)
+    token, and the old token stops renewing immediately — a zombie
+    can never pass for its replacement. `install` keeps the local
+    counter ahead of replicated tokens."""
+    clock = ManualClock()
+    table = LeaseTable(default_ttl_s=10.0, clock=clock)
+    first = table.grant("h")
+    second = table.grant("h")
+    assert second.token > first.token
+    assert not table.renew("h", first.token)
+    assert table.renew("h", second.token)
+    table.install("repl", token=100, ttl_s=5.0)
+    assert table.grant("later").token > 100
+    assert table.alive("repl", 100) and not table.alive("repl", 99)
+
+
+# ---------------------------------------------------------------------------
+# membership: epochs, fencing, eviction, watch
+
+
+def _svc(ttl=10.0):
+    clock = ManualClock()
+    return MembershipService(default_ttl_s=ttl, clock=clock), clock
+
+
+def test_epoch_bumps_on_every_view_change_and_only_those():
+    svc, _ = _svc()
+    a = svc.register("host-a", {"replicas": [["127.0.0.1", 1]]})
+    assert (a["status"], a["epoch"]) == ("ok", 1)
+    b = svc.register("host-b")
+    assert b["epoch"] == 2
+    # a renew is NOT a view change
+    assert svc.renew("host-a", a["token"], a["epoch"])["status"] == "ok"
+    assert svc.epoch == 2
+    # an inventory report IS (consumers resolve endpoints from it)
+    r = svc.report("host-a", a["token"], 2,
+                   {"replicas": [["127.0.0.1", 9]]})
+    assert (r["status"], r["epoch"]) == ("ok", 3)
+    assert svc.view().hosts["host-a"]["replicas"] == [["127.0.0.1", 9]]
+    # so is a graceful leave
+    assert svc.deregister("host-b", b["token"], 3)["epoch"] == 4
+    assert "host-b" not in svc.view().hosts
+
+
+def test_batch_eviction_is_one_view_change():
+    """Three hosts expiring together are ONE epoch bump: survivors
+    see one new world, not N intermediate ones."""
+    svc, clock = _svc(ttl=5.0)
+    for i in range(3):
+        svc.register(f"host-{i}")
+    epoch = svc.epoch
+    clock.advance(6.0)
+    assert sorted(svc.tick()) == ["host-0", "host-1", "host-2"]
+    assert svc.epoch == epoch + 1
+    assert svc.view().hosts == {}
+    assert svc.tick() == []                       # idempotent
+    assert svc.counters()["evictions"] == 3
+
+
+def test_stale_epoch_fence_refuses_a_resurrected_agent():
+    """The acceptance fence: creds from before an eviction are
+    refused with `stale_epoch` — before AND after the host
+    re-registers — and `register` is the one unfenced way back in."""
+    svc, clock = _svc(ttl=5.0)
+    reg = svc.register("host-a", {"replicas": [["127.0.0.1", 1]]})
+    token, epoch = reg["token"], reg["epoch"]
+    svc.register("host-b")                        # the world moves on
+    clock.advance(6.0)
+    assert svc.tick() == ["host-a", "host-b"]
+    # the paused agent wakes up and replays its old stamps
+    assert svc.renew("host-a", token, epoch)["status"] == "stale_epoch"
+    assert svc.report("host-a", token, epoch,
+                      {"replicas": []})["status"] == "stale_epoch"
+    # a write stamped with a FUTURE epoch is equally stale
+    assert svc.renew("host-a", token,
+                     svc.epoch + 7)["status"] == "stale_epoch"
+    # re-entry is a visible join: new token, new epoch, view change
+    reg2 = svc.register("host-a")
+    assert reg2["token"] > token and reg2["epoch"] > epoch
+    # ... and the OLD incarnation still cannot write to the new world
+    assert svc.report("host-a", token, epoch,
+                      {"replicas": []})["status"] == "stale_epoch"
+    assert svc.renew("host-a", reg2["token"],
+                     reg2["epoch"])["status"] == "ok"
+    assert svc.counters()["refused_stale_epoch"] >= 4
+    # an unknown host (never evicted) is `expired`, not stale: it
+    # simply needs to register first
+    assert svc.renew("host-zz", 1, svc.epoch)["status"] == "expired"
+
+
+def test_wait_view_delivers_exactly_one_view_per_epoch():
+    svc, _ = _svc()
+    svc.register("host-a")
+    svc.register("host-b")
+    svc.register("host-c")
+    seen = []
+    cursor = 0
+    while True:
+        v = svc.wait_view(cursor, timeout_s=0.05)
+        if v is None:
+            break
+        seen.append((v.epoch, sorted(v.hosts)))
+        cursor = v.epoch
+    assert seen == [(1, ["host-a"]),
+                    (2, ["host-a", "host-b"]),
+                    (3, ["host-a", "host-b", "host-c"])]
+    # a change arriving while parked wakes the watcher with that view
+    import threading
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(svc.wait_view(3, timeout_s=10.0)))
+    t.start()
+    svc.register("host-d")
+    t.join(10.0)
+    assert got and got[0].epoch == 4 and "host-d" in got[0].hosts
+
+
+def test_lease_margins_track_the_manual_clock():
+    svc, clock = _svc(ttl=10.0)
+    reg = svc.register("host-a")
+    svc.register("host-b")
+    clock.advance(8.0)
+    assert svc.renew("host-a", reg["token"],
+                     reg["epoch"])["status"] == "ok"
+    margins = svc.lease_margins()
+    assert margins["host-a"] == pytest.approx(10.0)
+    assert margins["host-b"] == pytest.approx(2.0)
+    clock.advance(4.0)
+    assert svc.lease_margins()["host-b"] == pytest.approx(-2.0)
+    assert svc.tick() == ["host-b"]
+
+
+# ---------------------------------------------------------------------------
+# replication: log shipping + explicit failover
+
+
+def test_standby_failover_resumes_the_epoch_sequence():
+    """The pserver chain idiom on the control plane: every view
+    change ships to the warm standby; promote() is the explicit
+    failover — it resumes the epoch sequence past the primary's
+    last, and hosts keep their tokens (one renew against the new
+    primary and they are current again)."""
+    clock = ManualClock()
+    primary = MembershipService(default_ttl_s=10.0, clock=clock)
+    standby = MembershipService(default_ttl_s=10.0, clock=clock,
+                                primary=False)
+    sserver = MembershipServer(standby).start()
+    try:
+        primary.attach_standby(StandbyLink(sserver.addr, clock=clock))
+        reg_a = primary.register("host-a",
+                                 {"replicas": [["127.0.0.1", 1]]})
+        reg_b = primary.register("host-b")
+        primary.report("host-a", reg_a["token"], primary.epoch,
+                       {"replicas": [["127.0.0.1", 2]]})
+        clock.advance(6.0)
+        primary.renew("host-b", reg_b["token"], primary.epoch)
+        # the standby mirrors state AND epoch through the log alone
+        assert standby.epoch == primary.epoch == 3
+        assert standby.hosts["host-a"]["token"] == reg_a["token"]
+        assert (standby.view().hosts["host-a"]["replicas"]
+                == [["127.0.0.1", 2]])
+        # primary dies; failover is explicit and IS a view change
+        promoted = standby.promote()
+        assert promoted["epoch"] == 4 and standby.is_primary
+        # host-b renewed at t=6 on the primary; the standby re-armed
+        # every lease at promote, so its OLD token renews fine here
+        assert standby.renew("host-b", reg_b["token"],
+                             4)["status"] == "ok"
+        # and the sequence continues past the old primary's epochs
+        assert standby.register("host-c")["epoch"] == 5
+        assert standby.counters()["failovers"] == 1
+    finally:
+        sserver.shutdown()
+
+
+def test_standby_refuses_a_seq_gap_and_primary_survives_link_loss():
+    standby = MembershipService(default_ttl_s=10.0, primary=False)
+    assert standby.apply_entry(
+        {"seq": 1, "kind": "register", "epoch": 1,
+         "args": {"host_id": "h", "token": 1, "ttl_s": 5.0,
+                  "inventory": {}, "joined_epoch": 1}})["status"] == "ok"
+    # seq 3 over a missing 2: refuse, never apply over the hole
+    assert standby.apply_entry(
+        {"seq": 3, "kind": "evict", "epoch": 2,
+         "args": {"hosts": ["h"]}})["status"] == "need_resync"
+    assert "h" in standby.hosts                   # nothing applied
+    # a dup of an old record is acknowledged and ignored
+    assert standby.apply_entry(
+        {"seq": 1, "kind": "register", "epoch": 1,
+         "args": {"host_id": "h", "token": 9, "ttl_s": 5.0,
+                  "inventory": {}, "joined_epoch": 1}})["status"] == "ok"
+    assert standby.hosts["h"]["token"] == 1
+    # primary side: a dead standby link NEVER blocks mutations
+    clock = ManualClock()
+    primary = MembershipService(default_ttl_s=10.0, clock=clock)
+    dead = MembershipServer(MembershipService(primary=False))
+    addr = dead.addr
+    dead.shutdown()                               # nothing listens
+    primary.attach_standby(StandbyLink(addr, clock=clock, timeout=0.5))
+    assert primary.register("host-a")["status"] == "ok"
+    assert primary.epoch == 1
+    assert primary.counters()["ship_failures"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# the socket layer
+
+
+def test_membership_server_roundtrip_and_fence_over_the_wire():
+    clock = ManualClock()
+    svc = MembershipService(default_ttl_s=10.0, clock=clock)
+    server = MembershipServer(svc).start()
+    try:
+        client = MembershipClient(server.addr)
+        assert client.ping()["is_primary"] == 1
+        reg = client.register("host-a",
+                              {"replicas": [["127.0.0.1", 7070]]},
+                              ttl_s=5.0)
+        assert reg["status"] == "ok" and reg["ttl_s"] == 5.0
+        view = client.view()
+        assert view.endpoints("replicas") == [
+            ("host-a", ("127.0.0.1", 7070))]
+        assert client.renew("host-a", reg["token"],
+                            reg["epoch"])["status"] == "ok"
+        got = client.wait_view(0, timeout_s=1.0)
+        assert got is not None and got.epoch == 1
+        assert client.wait_view(view.epoch, timeout_s=0.05) is None
+        clock.advance(6.0)
+        assert client.lease_margins()["host-a"] < 0
+        assert client.tick() == ["host-a"]
+        # the fence refuses the evicted creds through the same wire
+        assert client.renew("host-a", reg["token"],
+                            reg["epoch"])["status"] == "stale_epoch"
+        assert client.counters()["refused_stale_epoch"] == 1
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# topology resolution: pserver client + fleet supervisor + gang mirror
+
+
+class _FakeMembership:
+    def __init__(self, view):
+        self.v = view
+
+    def view(self):
+        return self.v
+
+
+def test_shard_specs_from_view_merges_roles_and_rejects_stale_rows():
+    view = ClusterView(epoch=3, hosts={
+        "h0": {"shards": [{"shard_id": 0, "row_lo": 0, "row_hi": 8,
+                           "endpoints": [["127.0.0.1", 9001]],
+                           "role": "primary"}]},
+        "h1": {"shards": [{"shard_id": 0, "row_lo": 0, "row_hi": 8,
+                           "endpoints": [["127.0.0.1", 9002]],
+                           "role": "backup"},
+                          {"shard_id": 1, "row_lo": 8, "row_hi": 16,
+                           "endpoints": [["127.0.0.1", 9003]]}]},
+    })
+    specs = shard_specs_from_view(view)
+    assert [(s.shard_id, s.row_lo, s.row_hi) for s in specs] == [
+        (0, 0, 8), (1, 8, 16)]
+    # primaries head the failover chain, backups follow
+    assert specs[0].endpoints == [("127.0.0.1", 9001),
+                                  ("127.0.0.1", 9002)]
+    view.hosts["h1"]["shards"][0]["row_hi"] = 12    # stale inventory
+    with pytest.raises(ValueError, match="stale"):
+        shard_specs_from_view(view)
+
+
+def test_pserver_client_resolves_and_refreshes_from_membership():
+    """The multi-host pserver path: no hardcoded endpoint list — the
+    client builds from the view and re-points failover chains on a
+    view change; a changed shard LAYOUT demands a rebuild."""
+    v1 = ClusterView(epoch=1, hosts={
+        "h0": {"shards": [{"shard_id": 0, "row_lo": 0, "row_hi": 8,
+                           "endpoints": [["127.0.0.1", 9001]]}]}})
+    mem = _FakeMembership(v1)
+    client = PServerClient.from_membership(mem, dim=4)
+    assert client.num_rows == 8
+    assert client.refresh_topology() is False     # same view: no-op
+    mem.v = ClusterView(epoch=2, hosts={
+        "h1": {"shards": [{"shard_id": 0, "row_lo": 0, "row_hi": 8,
+                           "endpoints": [["127.0.0.1", 9002]]}]}})
+    assert client.refresh_topology() is True
+    assert client._conns[0].endpoints == [("127.0.0.1", 9002)]
+    mem.v = ClusterView(epoch=3, hosts={
+        "h1": {"shards": [{"shard_id": 0, "row_lo": 0, "row_hi": 16,
+                           "endpoints": [["127.0.0.1", 9002]]}]}})
+    with pytest.raises(ValueError, match="layout"):
+        client.refresh_topology()
+    with pytest.raises(RuntimeError, match="from_membership"):
+        PServerClient(shard_specs_from_view(v1), dim=4).refresh_topology()
+
+
+def test_fleet_supervisor_resolves_roster_from_membership_view():
+    """FleetSupervisor membership mode, no processes: the roster
+    comes from the view; a host joining is a replica add on the next
+    sweep, a lease expiry is `declare_dead` (the router's crash path,
+    exactly-once machinery intact) BEFORE any socket error could
+    fire; local autoscaling is disabled (capacity is agent-owned)."""
+    clock = ManualClock()
+    svc = MembershipService(default_ttl_s=10.0, clock=clock)
+    reg_a = svc.register("host-a", {"replicas": [["127.0.0.1", 1111]]})
+    svc.register("host-b", {"replicas": [["127.0.0.1", 2222]]})
+    sup = FleetSupervisor(IDLE_SPEC, min_replicas=1, max_replicas=4,
+                          membership=svc, clock=clock)
+    sup._wrap_addr = lambda addr: _IdleServer()   # no sockets in-proc
+    sup.start()
+    assert len(sup.router.replicas) == 2
+    assert sup.counters()["hosts_live"] == 2
+    assert sup.counters()["membership_epoch"] == 2
+    # capacity is the agents' business now
+    with pytest.raises(RuntimeError, match="agent-owned"):
+        sup.scale_out()
+    with pytest.raises(RuntimeError, match="agent-owned"):
+        sup.scale_in()
+    # a host joins: the very next sweep folds it in
+    reg_c = svc.register("host-c", {"replicas": [["127.0.0.1", 3333]]})
+    sup.sweep()
+    assert sup.stats["replicas_joined"] == 1
+    assert len(sup.router.replicas) == 3
+    assert sup.procs[2] is None                   # agent-owned: no proc
+    # host-b goes silent; a+c keep renewing across the jump
+    clock.advance(6.0)
+    svc.renew("host-a", reg_a["token"], svc.epoch)
+    svc.renew("host-c", reg_c["token"], svc.epoch)
+    clock.advance(5.0)                            # b past deadline
+    sup.sweep()
+    assert sup.stats["hosts_lost"] == 1
+    assert sup.stats["view_changes"] == 2         # join + eviction
+    assert sup.router.counters()["replicas_lost"] == 1
+    assert sup.counters()["hosts_live"] == 2
+    assert sup.counters()["replicas_routable"] == 2
+    # an empty view refuses to start a fleet at all
+    empty = MembershipService(default_ttl_s=10.0, clock=clock)
+    with pytest.raises(RuntimeError, match="no replica endpoints"):
+        FleetSupervisor(IDLE_SPEC, membership=empty).start()
+
+
+def test_gang_supervisor_membership_mirror(tmp_path):
+    """The gang's fake hosts `{prefix}-{rank}`: registration carries
+    rank inventory, observed progress renews, an eviction surfaces as
+    a LOST member from the view, and teardown deregisters."""
+    from paddle_tpu.parallel.launch import GangSupervisor
+
+    clock = ManualClock()
+    svc = MembershipService(default_ttl_s=5.0, clock=clock)
+    sup = GangSupervisor(
+        "paddle_tpu.testing.fleet:idle_server",
+        workdir=str(tmp_path / "w"), checkpoint_dir=str(tmp_path / "c"),
+        num_processes=2, total_steps=1, heartbeat_timeout_s=5.0,
+        membership=svc, host_prefix="gang")
+    sup._membership_register(2, "file:///unused")
+    assert sorted(svc.view().hosts) == ["gang-0", "gang-1"]
+    assert svc.view().hosts["gang-0"]["rank"] == 0
+    assert sup._membership_lost([0, 1]) == []
+    # rank 0 progresses (renews); rank 1 goes silent past the ttl
+    clock.advance(3.0)
+    sup._membership_renew(0)
+    clock.advance(3.0)
+    assert sup._membership_lost([0, 1]) == [1]
+    assert sorted(svc.view().hosts) == ["gang-0"]
+    # the mirror's whole point: the eviction becomes a reform trigger
+    sup.membership_evictions += 1
+    assert sup.counters()["membership_evictions"] == 1
+    sup._membership_deregister()
+    assert svc.view().hosts == {} and sup._member_creds == {}
+
+
+# ---------------------------------------------------------------------------
+# real processes: agent lifecycle, fencing, the orphan chain
+
+
+def test_agent_registers_renews_and_fences_on_eviction():
+    """One real agent (idle replica, millisecond boot) against a
+    real-clock membership server: it registers its inventory, its
+    renew loop keeps the lease margin positive, and when a SECOND
+    incarnation of its host registers (killing its token), the next
+    renew comes back refused and the agent executes fenced teardown:
+    replicas SIGKILLed, exit code EXIT_EVICTED."""
+    svc = MembershipService(default_ttl_s=30.0)
+    server = MembershipServer(svc).start()
+    agent = AgentProcess(AgentSpec(
+        host_id="host-0", replica_spec=IDLE_SPEC,
+        membership_addr=server.addr, ttl_s=2.0,
+        renew_interval_s=0.05))
+    try:
+        info = agent.start().wait_ready(60.0)
+        assert info["host_id"] == "host-0" and info["token"] is not None
+        assert len(info["replicas"]) == 1 and len(info["pids"]) == 1
+        view = svc.view()
+        assert view.hosts["host-0"]["replicas"] == info["replicas"]
+        # the renew loop holds the margin up (real clocks here)
+        assert _await(lambda: svc.counters()["renews"] >= 2, 10.0)
+        assert svc.lease_margins()["host-0"] > 0
+        # a new incarnation registers: the old agent is now a zombie
+        svc.register("host-0", {"replicas": []})
+        agent.proc.join(15.0)
+        assert agent.exitcode() == EXIT_EVICTED
+        assert _await(lambda: all(_proc_gone(p) for p in info["pids"]))
+    finally:
+        agent.reap()
+        server.shutdown()
+
+
+def test_supervisor_sigkill_takes_down_the_whole_agent_tree():
+    """The orphan-CHAIN regression (satellite: the PR14 watchdog,
+    chained through the agent tier): SIGKILL the SUPERVISOR — no
+    drain, no atexit — and the agents exit on their pipe EOF, then
+    the replica GRANDCHILDREN exit on theirs. Three levels deep,
+    zero survivors."""
+    import multiprocessing
+    from paddle_tpu.testing.fleet import orphan_cluster_main
+
+    ctx = multiprocessing.get_context("spawn")
+    parent_conn, child_conn = ctx.Pipe()
+    sup_proc = ctx.Process(target=orphan_cluster_main,
+                           args=(child_conn,))
+    sup_proc.start()
+    child_conn.close()
+    assert parent_conn.poll(60.0), "supervisor never reported pids"
+    pids = parent_conn.recv()
+    assert len(pids) == 4                   # 2 agents + 2 grandchildren
+    assert all(not _proc_gone(pid) for pid in pids)
+    os.kill(sup_proc.pid, signal.SIGKILL)   # no cleanup runs
+    sup_proc.join(10.0)
+    assert _await(lambda: all(_proc_gone(p) for p in pids)), \
+        f"agent-tree processes survive their supervisor: {pids}"
+    parent_conn.close()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance chaos test
+
+
+def _ref_tokens(params, prompt, max_new):
+    out = T.generate(params, CFG, jax.numpy.asarray(prompt)[None, :],
+                     steps=max_new)
+    return [int(t) for t in np.asarray(out[0, len(prompt):])]
+
+
+@pytest.mark.heavyweight
+@pytest.mark.slow
+def test_agent_sigkill_mid_burst_reforms_at_two_hosts(tmp_path):
+    """The multi-host acceptance bar: 3 agent processes with distinct
+    fake host-ids, each owning one real replica child, topology
+    resolved through membership (no endpoint list touches the
+    supervisor). One agent is SIGKILLed mid-burst; its lease expires
+    on the injectable clock, the epoch bumps ONCE, and the fleet
+    reforms at 2 hosts from the VIEW CHANGE — exactly-once outcomes,
+    greedy parity, counters reconciling across both process
+    boundaries, zero orphans, and the dead host's resurrected
+    credentials refused with `stale_epoch`."""
+    art = str(tmp_path / "engine.tar")
+    save_tiny_artifact(art, buckets=(16,))
+    rspec = ReplicaSpec(
+        builder="paddle_tpu.testing.fleet:build_tiny_server",
+        kwargs=dict(artifact=art, buckets=(16,), max_retries=1),
+        env=dict(CHILD_ENV))
+    clock = ManualClock()
+    svc = MembershipService(default_ttl_s=30.0, clock=clock)
+    server = MembershipServer(svc).start()
+    agents = {}
+    infos = {}
+    sup = None
+    try:
+        for i in range(3):
+            host = f"host-{i}"
+            agents[host] = AgentProcess(AgentSpec(
+                host_id=host, replica_spec=rspec,
+                membership_addr=server.addr, ttl_s=5.0,
+                renew_interval_s=0.05)).start()
+        infos = {h: a.wait_ready(180.0) for h, a in agents.items()}
+        assert svc.epoch == 3 and len(svc.view().hosts) == 3
+        sup = FleetSupervisor(
+            rspec, min_replicas=1, max_replicas=3,
+            membership=MembershipClient(server.addr))
+        sup.start()
+        assert len(sup.router.replicas) == 3
+        plan = FaultPlan(cluster_sigkill_at=6,
+                         cluster_sigkill_host="host-1")
+        plan.wrap_cluster(sup, agents, clock=clock, service=svc)
+
+        params = T.init_params(jax.random.key(0), CFG)
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, CFG.vocab, (4 + i % 5,))
+                   .astype(np.int32) for i in range(9)]
+        rids = [sup.submit(p, max_new=4) for p in prompts]
+        res = sup.run()
+        sup.reconcile()                       # the exactly-once audit
+
+        assert plan.count("agentkill") == 1
+        c = sup.router.counters()
+        # the death arrived as a VIEW CHANGE: one host evicted, its
+        # replica declared dead, work redistributed
+        assert c["replicas_lost"] == 1
+        assert c["redistributed"] >= 1
+        # exactly one terminal outcome per request, all completed
+        assert sorted(res) == sorted(rids)
+        assert all(res[i].outcome == "completed" for i in rids)
+        assert all(r.retries == 0 for r in res.values())
+        assert c["completed"] == len(rids) == c["fleet_completed"]
+        assert c["fleet_shed"] == 0 and c["fleet_failed"] == 0
+        # bit-exact greedy parity with the solo decode
+        for p, rid in zip(prompts, rids):
+            assert res[rid].tokens == _ref_tokens(params, p, 4)
+        # the fleet reformed at the surviving-host count
+        assert sup.counters()["hosts_live"] == 2
+        assert sup.stats["hosts_lost"] == 1
+        assert sup.counters()["replicas_routable"] == 2
+        # membership counters reconcile: one eviction, one epoch bump
+        # for it, survivors' leases healthy
+        mc = svc.counters()
+        assert mc["evictions"] == 1 and mc["hosts_live"] == 2
+        # the supervisor folded at least the eviction's view change
+        # (agents keep REPORTING after the burst, so the service
+        # epoch may run ahead of the last sweep's)
+        assert (mc["epoch"] >= sup.counters()["membership_epoch"]
+                >= svc.evicted_at["host-1"])
+        margins = svc.lease_margins()
+        assert all(margins[h] > 0 for h in ("host-0", "host-2"))
+        # zero orphans: the dead agent AND its replica children are
+        # gone (watchdog chain, nothing graceful ran)
+        victim = infos["host-1"]
+        assert _await(lambda: _proc_gone(agents["host-1"].pid))
+        assert _await(lambda: all(_proc_gone(p)
+                                  for p in victim["pids"]))
+        # the resurrected agent's stamps are REFUSED: its world ended
+        client = MembershipClient(server.addr)
+        replay = client.report("host-1", victim["token"],
+                               victim["epoch"],
+                               {"replicas": victim["replicas"]})
+        assert replay["status"] == "stale_epoch"
+        assert "host-1" not in client.view().hosts
+    finally:
+        if sup is not None:
+            sup.shutdown(drain=False)
+        for a in agents.values():
+            a.stop()
+        server.shutdown()
+    leaked = [p for h, info in infos.items()
+              for p in info["pids"] + [agents[h].pid]
+              if not _proc_gone(p)]
+    assert not leaked, f"cluster processes outlived shutdown: {leaked}"

@@ -182,6 +182,8 @@ class TestRollingSWACache:
         assert not any("[1,80," in l for l in wl), (
             "decode loop still carries a full-length (80-slot) buffer")
 
+    @pytest.mark.slow
+
     def test_rolling_matches_full_buffer_band_mask(self):
         """The ring layout must not change math: same tokens as the
         band-masked full buffer, which still serves beam_decode (its

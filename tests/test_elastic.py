@@ -315,6 +315,7 @@ def _reference_losses(total_steps):
     return losses
 
 
+@pytest.mark.slow
 @pytest.mark.heavyweight
 def test_gang_sigkill_midstep_reforms_and_converges(tmp_path):
     """THE chaos proof (the suite's one sanctioned heavyweight): a

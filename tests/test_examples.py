@@ -31,7 +31,8 @@ CASES = {
 #: cases whose smoke run exceeds the tier-1 duration budget (10s —
 #: conftest budget guard): they run in the slow lane instead
 _SLOW_CASES = {"serving.py", "serving.py --no-quant", "mnist_train.py",
-               "transformer_lm.py", "transformer_lm.py --moe"}
+               "transformer_lm.py", "transformer_lm.py --moe",
+               "seq2seq_nmt.py"}
 
 
 @pytest.mark.parametrize(

@@ -467,6 +467,9 @@ def test_submit_fails_over_when_the_picked_replica_is_dead():
     assert rr in router.replicas[1].pending.values()
 
 
+@pytest.mark.slow
+
+
 def test_rolling_upgrade_zero_sheds(engines, params):
     built = []
 
@@ -543,6 +546,7 @@ def test_supervisor_sigkill_leaves_no_orphan_children():
     parent_conn.close()
 
 
+@pytest.mark.slow
 @pytest.mark.heavyweight
 def test_sigkill_replica_mid_burst_exactly_once(tmp_path, params):
     """THE chaos acceptance bar, on real OS processes: 3 replica

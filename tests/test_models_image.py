@@ -81,6 +81,7 @@ def test_resnet_s2d_stem_equivalent(rng):
         np.testing.assert_allclose(a, b, rtol=1e-2, atol=2e-2)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("policy", ["conv_out", "full"])
 def test_resnet_remat_equivalent(rng, policy):
     """resnet(remat=...) is the SAME function with the SAME params —

@@ -201,6 +201,8 @@ class TestPrefixReuseParity:
         pool = eng.pool
         pool.reconcile()
 
+    @pytest.mark.slow
+
     def test_prefix_cache_off(self, params):
         eng = DecodeEngine(params, CFG, slots=2, max_len=48,
                            page_size=8, prefix_cache=False)
@@ -253,6 +255,8 @@ class TestChunkedPrefill:
             "step" in events[a + 1:b]
             for a, b in zip(chunk_idx, chunk_idx[1:]))
         assert between, events
+
+    @pytest.mark.slow
 
     def test_chunked_plus_prefix_hit(self, params):
         """A prefix hit under chunked prefill starts chunking at the

@@ -480,6 +480,7 @@ class TestRetire:
 
 
 class TestCliFleet:
+    @pytest.mark.slow
     def test_cli_serve_replicas(self, params, tmp_path):
         """`serve --replicas 2` routes through ServingRouter: ordered
         per-request output lines plus the fleet outcomes trailer.

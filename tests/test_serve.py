@@ -281,6 +281,9 @@ def test_artifact_carries_raw_mlir(tmp_path):
     assert meta["name"] == "mlp"
 
 
+@pytest.mark.slow
+
+
 def test_pjrt_serve_library_builds():
     """The PJRT-C serving library must compile and expose its ABI.
     (Running it needs a PJRT plugin device — covered by the gated test

@@ -169,6 +169,7 @@ def test_int8_weights_pool(params):
 
 
 class TestEngineSampling:
+    @pytest.mark.slow
     def test_temperature_zero_equals_greedy(self, params):
         ps = prompts_rng(3, [5, 7, 4], seed=21)
         greedy = DecodeEngine(params, CFG, slots=2, max_len=24) \
@@ -191,6 +192,7 @@ class TestEngineSampling:
 
 
 class TestPerRequestSampling:
+    @pytest.mark.slow
     def test_greedy_contract_survives_sampled_cotenants(self, params):
         """Per-request sampling: greedy requests must still match their
         solo generate() exactly while sampled requests share the
@@ -293,6 +295,8 @@ class TestSlidingWindowPool:
             out = T.generate(p, cfg, jnp.asarray(pr)[None, :], steps=10)
             assert g == [int(t) for t in np.asarray(out[0, len(pr):])], pr
 
+    @pytest.mark.slow
+
     def test_bucketed_window_matches_unpadded(self):
         """Bucket padding + window: the ring takes REAL positions only,
         so the decode matches generate() on the unpadded prompt (a
@@ -307,6 +311,8 @@ class TestSlidingWindowPool:
             out = T.generate(p, cfg, jnp.asarray(pr)[None, :], steps=8)
             assert g == [int(t) for t in np.asarray(out[0, len(pr):])], pr
 
+    @pytest.mark.slow
+
     def test_int8_ring_pool(self):
         cfg = self._cfg(kv_cache_dtype="int8")
         p = T.init_params(jax.random.key(6), cfg)
@@ -319,6 +325,8 @@ class TestSlidingWindowPool:
             ref = [int(t) for t in np.asarray(out[0, len(pr):])]
             agree += sum(a == b for a, b in zip(g, ref)); n += len(ref)
         assert agree / n >= 0.9, (agree, n)
+
+    @pytest.mark.slow
 
     def test_window_requests_unbounded_by_max_len(self):
         """The ring has no physical capacity bound: a windowed request
@@ -529,6 +537,9 @@ def test_pool_stats(params):
     assert st.tokens == sum(len(g) for g in got)
     assert st.steps >= max(len(g) for g in got)
     assert 0 < st.utilization(2) <= 1
+
+
+@pytest.mark.slow
 
 
 def test_edge_empty_and_single_token(params):

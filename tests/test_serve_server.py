@@ -353,6 +353,7 @@ class TestCircuitBreaker:
 
 
 class TestChaos:
+    @pytest.mark.slow
     def test_mixed_burst_reconciles_and_keeps_serving(self, params, eng2):
         """The acceptance-criteria chaos run: one burst mixing queue
         overflow, a deadline storm (injected slot stall burning the

@@ -78,6 +78,9 @@ def test_sharded_lookup_gradient_matches_dense(mesh):
         np.asarray(g_sharded), np.asarray(g_dense), rtol=1e-6)
 
 
+@pytest.mark.slow
+
+
 def test_sharded_bag_combiners(mesh):
     table = _table()
     sharded = shard_rows(table, mesh)

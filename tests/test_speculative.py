@@ -234,6 +234,8 @@ class TestSpeculativeServe:
         for a, b in zip(got_lp, want_lp):
             np.testing.assert_allclose(a, b, rtol=0, atol=2e-5)
 
+    @pytest.mark.slow
+
     def test_chaos_transfer_guard_parity(self, eng):
         """THE chaos gate: the full speculative loop — host drafting,
         page reserve, the jitted verify round, commit/rollback
@@ -265,6 +267,8 @@ class TestSpeculativeServe:
         want = eng.serve([p.copy() for p in ps], max_new=9)
         for i in (1, 3):                         # the greedy rows
             assert runs[0][i] == want[i]
+
+    @pytest.mark.slow
 
     def test_oversubscribed_pool_preempts_and_recovers(self, params):
         """Commit's boundary alloc can exhaust an over-subscribed

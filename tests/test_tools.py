@@ -78,6 +78,9 @@ def test_transformed_reader_multiproc():
 # ---- torch import ----------------------------------------------------
 
 
+@pytest.mark.slow
+
+
 def test_torch_import_lenet_forward_agrees():
     torch = pytest.importorskip("torch")
     import torch.nn as tnn

@@ -266,6 +266,8 @@ class TestSampling:
     CFG = T.TransformerConfig(vocab=32, dim=16, n_layers=2, n_heads=2,
                               mlp_ratio=2, attn_impl="dense")
 
+    @pytest.mark.slow
+
     def test_temperature_zero_is_greedy(self):
         params = T.init_params(jax.random.key(0), self.CFG)
         prompt = jnp.asarray(
@@ -334,6 +336,8 @@ class TestSampling:
         draws = {int(sel(logits, jax.random.key(i))[0]) for i in range(96)}
         assert draws == {0, 1, 2, 3}, draws
 
+    @pytest.mark.slow
+
     def test_eos_stops_generation(self):
         """After a row emits eos, every later position is pad."""
         params = T.init_params(jax.random.key(0), self.CFG)
@@ -400,6 +404,8 @@ class TestVariableLengthPrompts:
                                    temperature=0.0))
         np.testing.assert_array_equal(out[1, 7:], solo[0, 4:9])
 
+    @pytest.mark.slow
+
     def test_flash_prefill_matches_dense_prefill(self):
         """attn_impl='flash' + prompt_lens: the prefill rides the Pallas
         kernel's per-row key-length bound and must reproduce the dense
@@ -453,6 +459,8 @@ class TestBeamDecode:
         seqs, scores = T.beam_decode(params, self.CFG, prompt, steps=5,
                                      beam_size=1)
         np.testing.assert_array_equal(np.asarray(seqs[:, 0]), greedy)
+
+    @pytest.mark.slow
 
     def test_beam1_int8_equals_greedy_int8(self):
         """Quantized params stream s8 through the beam loop (r5 shared
@@ -663,6 +671,8 @@ class TestSpeculativeDecode:
         with pytest.raises(ValueError, match="prompt"):
             T.speculative_generate(target, self.CFG, draft, draft_cfg,
                                    jnp.zeros((1, 1), jnp.int32), steps=3)
+
+    @pytest.mark.slow
 
     def test_int8_target_matches_int8_greedy(self):
         """A quantized TARGET must still decode exactly its own int8
@@ -936,6 +946,7 @@ class TestSlidingWindowAttention:
         win = np.asarray(T.apply(params, self._cfg(window=1000), toks))
         np.testing.assert_allclose(win, full, rtol=1e-6)
 
+    @pytest.mark.slow
     def test_decode_matches_teacher_forcing(self):
         cfg = self._cfg(window=4)
         params = T.init_params(jax.random.key(2), cfg)
@@ -1119,6 +1130,8 @@ class TestFusedCEComposition:
     delegates to loss(), so the chunked scan runs over the
     sequence-sharded hidden)."""
 
+    @pytest.mark.slow
+
     def test_score_matches_plain(self, params):
         import dataclasses
         fcfg = dataclasses.replace(CFG, fused_ce_chunk=8)
@@ -1131,6 +1144,8 @@ class TestFusedCEComposition:
                                    atol=5e-6)
         np.testing.assert_allclose(np.asarray(na), np.asarray(nb),
                                    atol=5e-6)
+
+    @pytest.mark.slow
 
     def test_cp_fused_matches_dense_plain(self):
         import dataclasses
@@ -1180,6 +1195,8 @@ class TestInt8KVCache:
         assert a.shape == b.shape
         agree = float(jnp.mean((a == b).astype(jnp.float32)))
         assert agree >= 0.95, agree
+
+    @pytest.mark.slow
 
     def test_composes_with_gqa_and_window(self):
         import dataclasses

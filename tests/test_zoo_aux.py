@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from paddle_tpu import nn, optim
 from paddle_tpu.data import dataset_zoo as Z
@@ -349,6 +350,9 @@ def test_priorbox_layer_matches_op():
     out, _ = layer.apply(params, state, jnp.zeros((2, 8, 8, 16)))
     want = D.prior_boxes((8, 8), (64, 64), (0.2,), (0.4,))
     np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
+
+
+@pytest.mark.slow
 
 
 def test_multibox_loss_layer_batches():
