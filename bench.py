@@ -829,6 +829,186 @@ def bench_fleet() -> None:
     sup.shutdown(drain=False)
 
 
+def bench_edge() -> None:
+    """HTTP front-door stage (ISSUE 17): the SLO numbers that make
+    "heavy traffic" a measured claim — sustained QPS with p99
+    time-to-first-token and p99 inter-token gap, measured CLIENT-side
+    through real sockets by the traffic harness (closed-loop users
+    for honest latency, an open-loop ramp for autoscale pressure),
+    plus the two edge chaos economics: what a mid-stream client
+    disconnect costs (freed slots, zero leaked pages) and what an
+    overload burst sheds at the edge while admitted requests hold
+    their SLO. `scripts/fault_smoke.sh edge` drives it as `bench.py
+    --edge-only`."""
+    import tempfile
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.obs import MetricsRegistry
+    from paddle_tpu.serve.engine import DecodeEngine
+    from paddle_tpu.serve.fleet import (AutoscalePolicy,
+                                        FleetSupervisor, ReplicaSpec)
+    from paddle_tpu.serve.http_edge import HttpEdge
+    from paddle_tpu.serve.router import ServingRouter
+    from paddle_tpu.serve.server import ServingServer
+    from paddle_tpu.testing.fleet import save_tiny_artifact
+    from paddle_tpu.testing.traffic import (TrafficShape, closed_loop,
+                                            open_loop, slo_report,
+                                            stream_generate)
+
+    shape = TrafficShape(family_len=8, tail_len=3, out_base=3,
+                         out_cap=12)
+    cfg = T.TransformerConfig(vocab=61, dim=32, n_layers=2,
+                              n_heads=4, attn_impl="dense")
+    params = T.init_params(jax.random.key(0), cfg)
+
+    def tiny_router(max_queue):
+        eng = DecodeEngine(params, cfg, slots=2, max_len=32,
+                           page_size=4)
+        srv = ServingServer(eng, max_queue=max_queue, buckets=(16,))
+        return ServingRouter([srv]), srv
+
+    # -- stage A: SLO over an autoscaling PROCESS fleet ------------------
+    log("edge: SLO stage (HTTP over an autoscaling process fleet)")
+    tmp = tempfile.mkdtemp(prefix="edge_bench_")
+    art = os.path.join(tmp, "engine.tar")
+    save_tiny_artifact(art, buckets=(16,))
+    spec = ReplicaSpec(
+        builder="paddle_tpu.testing.fleet:build_tiny_server",
+        kwargs=dict(artifact=art, buckets=(16,), max_retries=1),
+        env={"JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1"})
+    registry = MetricsRegistry()
+    sup = FleetSupervisor(
+        spec, min_replicas=1, max_replicas=3,
+        policy=AutoscalePolicy(queue_high=1.0, cooldown_sweeps=2,
+                               idle_sweeps=8),
+        registry=registry)
+    sup.start()
+    edge = HttpEdge(sup.router, sweep_fn=sup.sweep,
+                    submit_fn=sup.submit,
+                    drain_fn=lambda why: sup.drain(reason=why),
+                    registry=registry).start()
+    # warm the child's serving path before the timed window
+    stream_generate(edge.addr,
+                    shape.sample(np.random.RandomState(0))[0], 2)
+    t0 = time.monotonic()
+    results = closed_loop(edge.addr, shape, users=4,
+                          requests_per_user=3, seed=1)
+    # the RAMP: arrival rate steps up until the queue-depth policy
+    # must scale out
+    results += open_loop(edge.addr, shape,
+                         phases=((4.0, 8), (12.0, 12), (30.0, 15)),
+                         seed=2)
+    wall = time.monotonic() - t0
+    rep = slo_report(results, wall)
+    edge.drain(reason="bench stage A done")
+    drained = edge.wait_drained(timeout_s=30.0)
+    c = sup.router.counters()
+    emit("edge_sustained_qps", round(rep["sustained_qps"], 2),
+         "completed streams/sec (closed users + open-loop ramp)",
+         None,
+         requests=rep["requests"], completed=rep["completed"],
+         shed_429=rep["shed_429"],
+         p99_ttft_s=rep["p99_ttft_s"], p99_itg_s=rep["p99_itg_s"],
+         p50_ttft_s=rep["p50_ttft_s"], p50_itg_s=rep["p50_itg_s"],
+         tokens_streamed=rep["tokens_streamed"],
+         scale_out_events=sup.stats["scale_out_events"],
+         drained_clean=bool(drained),
+         exactly_once=bool(
+             c["completed"] + c["expired"] + c["shed"] + c["failed"]
+             == c["requests"]),
+         obs_snapshot=registry.snapshot()["series"])
+    emit("edge_p99_ttft_s", rep["p99_ttft_s"],
+         "seconds to first streamed token, p99 client-side", None,
+         p50=rep["p50_ttft_s"],
+         server_side_p99=edge._ttft_hist.quantile(0.99)
+         if edge._ttft_hist is not None else None)
+    emit("edge_p99_itg_s", rep["p99_itg_s"],
+         "seconds between streamed tokens, p99 client-side", None,
+         p50=rep["p50_itg_s"],
+         server_side_p99=edge._itg_hist.quantile(0.99)
+         if edge._itg_hist is not None else None)
+    edge.close()
+    sup.shutdown(drain=False)
+
+    # -- stage B: disconnect chaos economics -----------------------------
+    log("edge: disconnect stage (clients vanish mid-stream)")
+    registry = MetricsRegistry()
+    router, srv = tiny_router(max_queue=16)
+    edge = HttpEdge(router, registry=registry).start()
+    stream_generate(edge.addr,
+                    shape.sample(np.random.RandomState(3))[0], 2)
+    aborted = full = 0
+    for i in range(8):
+        rng = np.random.RandomState(100 + i)
+        prompt, _ = shape.sample(rng)
+        if i % 2 == 0:
+            r = stream_generate(edge.addr, prompt, 12,
+                                abort_after_tokens=2)
+            aborted += int(r.aborted)
+        else:
+            r = stream_generate(edge.addr, prompt, 6)
+            full += int(r.outcome == "completed")
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        if (edge.counters()["active_streams"] == 0
+                and not router.sweep()):
+            break
+        time.sleep(0.02)
+    router.run()
+    router.reconcile()
+    srv.reconcile()
+    # pages still referenced by anything OTHER than the prefix cache
+    # (cache-only pages are refcount 1 and evictable on demand — by
+    # design they stay resident after release; a pinned page that is
+    # NOT evictable is the actual leak)
+    pool = srv.engine.pool
+    pages_leaked = (0 if pool is None
+                    else pool.pages_in_use - pool.evictable())
+    emit("edge_disconnect_cancels", edge.counters()
+         ["disconnect_cancels"],
+         "mid-stream disconnects cancelled (slot+pages freed)", None,
+         aborted_clients=aborted, completed_streams=full,
+         pages_leaked=int(pages_leaked),
+         pages_cached=int(0 if pool is None else pool.evictable()),
+         reconcile_clean=True,
+         obs_snapshot=registry.snapshot()["series"])
+    edge.close()
+
+    # -- stage C: overload burst sheds at the edge -----------------------
+    log("edge: overload stage (open-loop burst beyond capacity)")
+    registry = MetricsRegistry()
+    router, srv = tiny_router(max_queue=4)
+    depth = [0]
+
+    def sweep_recording_depth():
+        depth[0] = max(depth[0], len(srv.queue))
+        return router.sweep()
+
+    edge = HttpEdge(router, sweep_fn=sweep_recording_depth,
+                    registry=registry).start()
+    stream_generate(edge.addr,
+                    shape.sample(np.random.RandomState(4))[0], 2)
+    t0 = time.monotonic()
+    burst = open_loop(edge.addr, shape, phases=((250.0, 50),), seed=5)
+    wall = time.monotonic() - t0
+    rep = slo_report(burst, wall)
+    router.run()
+    router.reconcile()
+    srv.reconcile()
+    emit("edge_overload_shed_429", rep["shed_429"],
+         "requests shed at the edge during a 250qps burst", None,
+         admitted_completed=rep["completed"],
+         admitted_p99_ttft_s=rep["p99_ttft_s"],
+         max_queue=4, max_queue_depth_observed=depth[0],
+         queue_bounded=bool(depth[0] <= 4),
+         obs_snapshot=registry.snapshot()["series"])
+    edge.close()
+
+
 def bench_cluster() -> None:
     """Multi-host control-plane stage (ISSUE 16): the two latencies
     that price lease-based membership — how fast a host death
@@ -1596,6 +1776,8 @@ if __name__ == "__main__":
         bench_fleet()
     elif len(sys.argv) > 1 and sys.argv[1] == "--cluster-only":
         bench_cluster()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--edge-only":
+        bench_edge()
     elif len(sys.argv) > 1 and sys.argv[1] == "--elastic-only":
         bench_elastic()
     elif len(sys.argv) > 1 and sys.argv[1] == "--cold-start-only":

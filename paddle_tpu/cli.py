@@ -537,6 +537,17 @@ def cmd_serve(args) -> int:
         raise SystemExit(
             "--fleet-procs and --replicas are mutually exclusive: "
             "one fleet of threads OR one fleet of processes")
+    buckets = (tuple(int(b) for b in args.buckets.split(","))
+               if args.buckets else None)
+    if args.http is not None:
+        # network mode: the HTTP front door replaces the prompts
+        # batch — clients drive the fleet over sockets until SIGTERM
+        # (or --http-max-requests) drains it
+        with _transfer_guard(args.transfer_guard):
+            return _serve_http(args, make_engine, buckets)
+    if args.prompts is None:
+        raise SystemExit("--prompts is required (unless --http PORT "
+                         "serves over the network instead)")
     # --fleet-procs replicas build their engines IN THE CHILD
     # processes (serve.fleet builder); the parent never compiles a
     # pool of its own
@@ -551,8 +562,6 @@ def cmd_serve(args) -> int:
                              ("top_k", args.top_k),
                              ("top_p", args.top_p)) if v is not None}
     sampling = [dict(one) for _ in prompts] if one else None
-    buckets = (tuple(int(b) for b in args.buckets.split(","))
-               if args.buckets else None)
     # open the sink BEFORE the (possibly long) serve run: an
     # unwritable --output must fail fast, not discard the decode work
     sink = open(args.output, "w") if args.output else sys.stdout
@@ -602,6 +611,118 @@ def cmd_serve(args) -> int:
         if sink is not sys.stdout:
             sink.close()
     return 0
+
+
+def _serve_http(args, make_engine, buckets):
+    """`serve --http PORT`: the streaming HTTP front door
+    (docs/SERVING.md "HTTP front door"). Composes with the fleet
+    flags — bare = one reliability server behind a 1-replica router,
+    `--replicas N` = the thread fleet, `--fleet-procs N` = the
+    process fleet with elastic autoscaling — and serves until SIGTERM
+    (edge drain → fleet drain → drain report) or until
+    `--http-max-requests` requests have finished (the deterministic
+    test/CI stop). `--http-addr-file` publishes the bound address
+    (written atomically AFTER the listener is up), so port 0 works
+    for parallel test runs."""
+    from paddle_tpu.serve.http_edge import HttpEdge
+    from paddle_tpu.serve.router import ServingRouter
+    from paddle_tpu.serve.server import ServingServer
+
+    registry, tracer, flight = _obs_stack(args.metrics_out,
+                                          args.flight_dir)
+    if registry is None:
+        # the edge serves GET /metrics: a live scrape target must not
+        # depend on --metrics-out (that flag means "snapshot a file at
+        # exit"). The no-registry fast path exists for uninstrumented
+        # in-process serving; a network edge IS the instrumented mode.
+        from paddle_tpu.obs import MetricsRegistry
+
+        registry = MetricsRegistry()
+    max_queue = args.max_queue if args.max_queue is not None else 64
+    sup = None
+    if args.fleet_procs:
+        from paddle_tpu.serve.fleet import FleetSupervisor, ReplicaSpec
+
+        env = {k: v for k, v in ((n, os.environ.get(n))
+                                 for n in ("JAX_PLATFORMS",
+                                           "XLA_FLAGS"))
+               if v is not None}
+        spec = ReplicaSpec(
+            builder="paddle_tpu.serve.fleet:build_server_from_config",
+            kwargs=dict(
+                config=os.path.abspath(args.config),
+                slots=args.slots, max_len=args.max_len,
+                seed=args.seed, max_queue=max_queue,
+                default_deadline_ms=args.default_deadline_ms,
+                max_retries=args.max_retries, buckets=buckets,
+                drain_grace_s=args.drain_grace,
+                artifact=args.engine_artifact),
+            env=env)
+        sup = FleetSupervisor(
+            spec, min_replicas=args.fleet_procs,
+            max_replicas=max(args.fleet_procs,
+                             args.fleet_max or args.fleet_procs),
+            registry=registry, flight=flight,
+            flight_dir=args.flight_dir)
+        sup.start()
+        # the supervisor's sweep drives autoscale/reap on the edge's
+        # drive thread; its submit routes through admission control
+        edge = HttpEdge(sup.router, host=args.http_host,
+                        port=args.http,
+                        sweep_fn=sup.sweep, submit_fn=sup.submit,
+                        drain_fn=lambda why: sup.drain(reason=why),
+                        registry=registry,
+                        drain_report_path=args.drain_report)
+    else:
+        n = args.replicas or 1
+        engines = [make_engine() for _ in range(n)]
+        servers = [
+            ServingServer(
+                e, max_queue=max_queue,
+                default_deadline_ms=args.default_deadline_ms,
+                max_retries=args.max_retries, buckets=buckets,
+                drain_grace_s=args.drain_grace,
+                tracer=tracer, flight=flight,
+                artifact_path=args.engine_artifact)
+            for e in engines]
+        router = ServingRouter(servers, tracer=tracer, flight=flight,
+                               flight_dir=args.flight_dir)
+        if registry is not None:
+            router.bind_metrics(registry)
+        edge = HttpEdge(router, host=args.http_host, port=args.http,
+                        registry=registry, tracer=tracer,
+                        drain_report_path=args.drain_report)
+    edge.start()
+    edge.install_signals()
+    if args.http_addr_file:
+        tmp = f"{args.http_addr_file}.tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{edge.addr[0]} {edge.addr[1]}\n")
+        os.replace(tmp, args.http_addr_file)
+    print(f"# serving HTTP on {edge.addr[0]}:{edge.addr[1]}",
+          flush=True)
+    limit = args.http_max_requests
+    drained = False
+    try:
+        while not edge.draining:
+            if limit is not None:
+                c = edge.counters()
+                if (c["requests"] >= limit
+                        and c["active_streams"] == 0):
+                    edge.drain(reason=f"served {limit} requests "
+                                      "(--http-max-requests)")
+                    break
+            time.sleep(0.05)
+        drained = edge.wait_drained(timeout_s=args.drain_grace)
+    finally:
+        edge.close()
+        if sup is not None:
+            sup.shutdown(drain=False)
+    c = edge.counters()
+    print("# outcomes " + " ".join(f"{k}={v}" for k, v in c.items()),
+          flush=True)
+    _write_metrics(registry, args.metrics_out)
+    return 0 if drained else 1
 
 
 def _serve_reliable(args, eng, prompts, sampling, buckets, sink):
@@ -1143,10 +1264,29 @@ def build_parser() -> argparse.ArgumentParser:
         "token ids out; see cmd_serve)")
     sv.add_argument("--config", required=True,
                     help="script defining get_serve_config()")
-    sv.add_argument("--prompts", required=True,
+    sv.add_argument("--prompts", default=None,
                     help="file: one whitespace-separated id sequence "
-                    "per line")
+                    "per line (required unless --http)")
     sv.add_argument("--max-new", type=int, default=128)
+    sv.add_argument("--http", type=int, default=None, metavar="PORT",
+                    help="serve the streaming HTTP front door on this "
+                         "port instead of a prompts batch (0 = "
+                         "ephemeral; docs/SERVING.md \"HTTP front "
+                         "door\"): POST /v1/generate streams tokens "
+                         "via chunked transfer, client disconnect "
+                         "cancels the request, overload sheds 429 at "
+                         "the edge, SIGTERM drains edge then fleet")
+    sv.add_argument("--http-host", default="127.0.0.1",
+                    help="bind address for --http")
+    sv.add_argument("--http-addr-file", default=None, metavar="PATH",
+                    help="write 'host port' here once the --http "
+                         "listener is bound (atomic; pairs with "
+                         "--http 0 for test runs)")
+    sv.add_argument("--http-max-requests", type=int, default=None,
+                    metavar="N",
+                    help="drain and exit after N HTTP requests have "
+                         "finished (deterministic stop for tests/CI; "
+                         "default: serve until SIGTERM)")
     sv.add_argument("--replicas", type=int, default=None,
                     help="serve through an N-replica fleet behind the "
                          "prefix-affinity router (serve.router): one "

@@ -15,6 +15,7 @@ from paddle_tpu.serve.engine import (DecodeEngine, EngineState,
                                      PoolStats, PrefillTicket)
 from paddle_tpu.serve.fleet import (AutoscalePolicy, FleetSupervisor,
                                     ReplicaProcess, ReplicaSpec)
+from paddle_tpu.serve.http_edge import HttpEdge
 from paddle_tpu.serve.paged import (PagePool, PoolExhaustedError,
                                     chain_keys)
 from paddle_tpu.serve.policy import RandomRoutingPolicy, SchedulerPolicy

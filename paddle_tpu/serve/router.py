@@ -199,6 +199,10 @@ class ServingRouter:
         # rr_id -> redistribution hops so far, for requests currently
         # living on their second-or-later replica
         self._moved: Dict[int, int] = {}
+        # rr_id -> reason for cancels that must survive a failover
+        # race: re-applied after redistribution, cleared at the
+        # terminal outcome
+        self._cancel_wanted: Dict[int, str] = {}
         # fleet ledger counters (requests is submissions; the outcome
         # keys tally self.results exactly — reconcile() asserts it)
         self.stats: Dict[str, int] = {
@@ -334,6 +338,73 @@ class ServingRouter:
         self._note_affinity(chain, rep)
         return rr_id
 
+    def _holder(self, rr_id: int):
+        """Which live replica currently owns `rr_id`, and under which
+        rep-local id — the reverse of the `pending` maps. None/None
+        once terminal (or mid-failover, between harvest and
+        redistribution)."""
+        for rep in self.replicas:
+            if not rep.alive:
+                continue
+            for rep_id, rid in rep.pending.items():
+                if rid == rr_id:
+                    return rep, rep_id
+        return None, None
+
+    def cancel(self, rr_id: int, *,
+               reason: str = "client cancelled") -> bool:
+        """Cancel one router-submitted request (the HTTP edge's
+        client-disconnect path): force-expires it on whichever
+        replica holds it NOW, and — because a replica crash can race
+        the cancel — remembers the intent so `_redistribute` re-
+        applies it on the survivor. The request still ends in exactly
+        one terminal outcome (EXPIRED), mirrored on the next sweep.
+        Returns False once `rr_id` is already terminal (or was never
+        submitted — an id this router hasn't minted must not park a
+        wanted-cancel forever)."""
+        if rr_id in self.results:
+            return False
+        if not (0 <= rr_id < self._next_id):
+            return False
+        self._cancel_wanted[rr_id] = reason
+        if self.tracer is not None:
+            self.tracer.event(self.trace_id(rr_id), "cancel",
+                              reason=reason)
+        rep, rep_id = self._holder(rr_id)
+        if rep is None:
+            return True         # queued for redistribution: re-applied there
+        try:
+            rep.server.cancel(rep_id, reason=reason)
+        except Exception as e:
+            if not getattr(e, "replica_fatal", False):
+                raise
+            # the replica died answering the cancel: normal failover
+            # (the wanted-cancel re-applies on the survivor)
+            self._on_replica_death(rep, e)
+        return True
+
+    def partial_tokens(self, rr_id: int) -> List[int]:
+        """Streaming read: the tokens emitted so far for `rr_id`,
+        wherever it lives — the owning replica's accumulation buffer
+        while decoding, the fleet ledger once terminal. After a
+        replica loss the count can step BACKWARD while the survivor
+        regenerates (greedy decode regenerates the identical prefix),
+        so a streaming caller must send only beyond its own
+        high-water mark."""
+        res = self.results.get(rr_id)
+        if res is not None:
+            return list(res.tokens)
+        rep, rep_id = self._holder(rr_id)
+        if rep is None:
+            return []
+        try:
+            return list(rep.server.partial_tokens(rep_id))
+        except Exception as e:
+            if not getattr(e, "replica_fatal", False):
+                raise
+            self._on_replica_death(rep, e)
+            return []
+
     # -- the ledger --------------------------------------------------------
 
     @staticmethod
@@ -349,6 +420,7 @@ class ServingRouter:
             f"{self.results[res.rr_id].outcome}, refusing a second")
         self.results[res.rr_id] = res
         self.stats[res.outcome] += 1
+        self._cancel_wanted.pop(res.rr_id, None)
         if self.tracer is not None:
             # the serving replica normally ended the span at its
             # terminal outcome; a tracer-less replica (or a router-
@@ -472,6 +544,17 @@ class ServingRouter:
             return
         rep.pending[rep_id] = rr_id
         self._note_affinity(chain, rep)
+        if rr_id in self._cancel_wanted:
+            # a client disconnect raced the replica loss: re-apply the
+            # cancel on the survivor. Best-effort — if THIS replica is
+            # also dying, the next probe/sweep finds the corpse and
+            # the wanted-cancel re-applies on the hop after.
+            try:
+                rep.server.cancel(rep_id,
+                                  reason=self._cancel_wanted[rr_id])
+            except Exception as e:
+                if not getattr(e, "replica_fatal", False):
+                    raise
 
     # -- KV-block migration (disaggregated mode) ---------------------------
 

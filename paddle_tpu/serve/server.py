@@ -635,6 +635,40 @@ class ServingServer:
                 return req
         return None
 
+    def cancel(self, req_id: int, *,
+               reason: str = "client cancelled") -> bool:
+        """Force-expire one request NOW — the network edge's
+        client-disconnect path (docs/RELIABILITY.md "Network-edge
+        fault model"). The request's deadline is pulled to the
+        current clock, so the next `step()`'s PROVEN expiry machinery
+        (`_expire_queued` / `_expire_in_flight` → `_retire_slot`)
+        frees the slot, its pages, and any parked handoff pins with
+        exactly the cleanup a naturally-lapsed deadline gets: one
+        terminal outcome (EXPIRED), ledgers balanced, `reconcile()`
+        clean. Idempotent — returns False when `req_id` is already
+        terminal or unknown."""
+        now = self.clock()
+        for req in list(self.queue) + [r for r in self._slot_req
+                                       if r is not None]:
+            if req.req_id == req_id:
+                req.deadline = now
+                self._trace_event(req_id, "cancel", reason=reason)
+                return True
+        return False
+
+    def partial_tokens(self, req_id: int) -> List[int]:
+        """Snapshot of the tokens emitted SO FAR for one request —
+        the streaming read the HTTP edge polls between steps. A live
+        request answers from the decode-step accumulation buffer
+        (copied, never aliasing scheduler state); a terminal one
+        answers from its result's final token list, so a poller that
+        follows a request through completion sees one monotone
+        prefix chain with no gap between "decoding" and "done"."""
+        res = self.results.get(req_id)
+        if res is not None:
+            return list(res.tokens)
+        return list(self._emitted.get(req_id, []))
+
     # -- disaggregated prefill/decode handoff ------------------------------
     #
     # The migration protocol (docs/SERVING.md "Disaggregated

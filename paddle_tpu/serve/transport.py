@@ -308,6 +308,15 @@ class ReplicaTransportServer:
         self._withdrawn.add(req_id)
         return True
 
+    def _op_cancel(self, req_id: int, reason: str) -> bool:
+        # naturally idempotent (a terminal request answers False), so
+        # no tag ledger: a replayed cancel re-expires nothing
+        return bool(self.server.cancel(req_id, reason=reason))
+
+    def _op_partial(self, req_id: int) -> list:
+        # read-only streaming poll — the HTTP edge's chunk source
+        return list(self.server.partial_tokens(req_id))
+
     def _op_drain(self, grace_s, reason: str) -> None:
         self.server.drain(grace_s=grace_s, reason=reason)
 
@@ -632,6 +641,17 @@ class ProcessReplica:
         if self._rpc("withdraw_queued", dict(req_id=req_id)):
             return self._mirror.pop(req_id, None)
         return None
+
+    def cancel(self, req_id: int, *,
+               reason: str = "client cancelled") -> bool:
+        return bool(self._rpc("cancel",
+                              dict(req_id=req_id, reason=reason)))
+
+    def partial_tokens(self, req_id: int) -> List[int]:
+        res = self.results.get(req_id)
+        if res is not None:
+            return list(res.tokens)
+        return list(self._rpc("partial", dict(req_id=req_id)))
 
     def sync(self) -> None:
         """Refresh the cached state block (and deliver ACKs) with no

@@ -28,6 +28,12 @@
 #                                       #   restore, gang SIGKILL/wedge
 #                                       #   chaos incl. the slow cases,
 #                                       #   then bench.py --elastic-only)
+#     scripts/fault_smoke.sh edge       # just the HTTP front-door lane
+#                                       #   (disconnect cancellation,
+#                                       #   overload 429, slow-loris,
+#                                       #   drain, the SIGKILL-under-
+#                                       #   live-HTTP-load chaos case,
+#                                       #   then bench.py --edge-only)
 #     scripts/fault_smoke.sh -k serve   # just the serving chaos suite
 #
 # CPU-only and deterministic (testing.faults FaultPlan + ManualClock;
@@ -53,6 +59,15 @@ elif [ "$1" = "cluster" ]; then
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m "cluster and faults" -p no:cacheprovider "$@"
     exec env JAX_PLATFORMS=cpu python bench.py --cluster-only
+elif [ "$1" = "edge" ]; then
+    # the whole network-edge lane, INCLUDING the heavyweight
+    # SIGKILL-under-live-HTTP-load chaos case tier-1 excludes, then
+    # the SLO stage (sustained QPS, p99 TTFT/ITG, disconnect and
+    # overload economics)
+    shift
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m "edge and faults" -p no:cacheprovider "$@"
+    exec env JAX_PLATFORMS=cpu python bench.py --edge-only
 elif [ "$1" = "elastic" ]; then
     # the whole elastic lane, INCLUDING the slow wedge-fencing case
     # tier-1 excludes, then the perf stage (memory win, sharded-update

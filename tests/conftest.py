@@ -112,6 +112,14 @@ def pytest_configure(config):
         "`scripts/fault_smoke.sh fleet`, which runs "
         "-m 'fleet and faults') runs it alone")
     config.addinivalue_line(
+        "markers", "edge: HTTP front-door suite (serve.http_edge + "
+        "testing.traffic: chunked streaming, disconnect cancellation, "
+        "overload backpressure, slow-loris hardening, graceful drain) "
+        "— fast cases run IN tier-1, the live-load SIGKILL chaos case "
+        "is heavyweight/slow; `-m edge` (or `scripts/fault_smoke.sh "
+        "edge`, which runs -m 'edge and faults' plus `bench.py "
+        "--edge-only`) runs the lane alone")
+    config.addinivalue_line(
         "markers", "heavyweight: the ONE deliberate chaos heavyweight "
         "a suite may carry — exempt from the tier-1 budget guard "
         "(real process boots + a mid-burst SIGKILL cannot fit the "

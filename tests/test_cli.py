@@ -294,3 +294,121 @@ def get_serve_config():
                  "--prompts", str(prompts), "--max-new", "6",
                  "--transfer-guard", "--output", str(out2)]) == 0
     assert out2.read_text().strip().splitlines() == lines[::2]
+
+
+SERVE_CFG = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+
+def get_serve_config():
+    from paddle_tpu.models import transformer as T
+    cfg = T.TransformerConfig(vocab=61, dim=32, n_layers=2, n_heads=4,
+                              attn_impl="dense")
+    return {"cfg": cfg,
+            "params": T.init_params(jax.random.key(0), cfg),
+            "slots": 2, "max_len": 24}
+"""
+
+
+def _wait_addr(addr_file, alive, timeout_s=120.0):
+    import time
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(addr_file):
+            host, port = open(addr_file).read().split()
+            return host, int(port)
+        assert alive(), "serve --http exited before binding"
+        time.sleep(0.1)
+    raise AssertionError("serve --http never published its address")
+
+
+@pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
+@pytest.mark.edge
+def test_serve_http_verb(tmp_path):
+    """`serve --http 0`: the network mode — main() drives the edge
+    while a raw-socket client streams completions matching the solo
+    greedy decode; --http-max-requests drains the run to rc 0."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.testing.traffic import stream_generate
+
+    cfg_file = tmp_path / "serve_cfg.py"
+    cfg_file.write_text(SERVE_CFG)
+    addr_file = tmp_path / "addr.txt"
+    rc = {}
+
+    def run():
+        rc["v"] = main(["serve", "--config", str(cfg_file),
+                        "--http", "0",
+                        "--http-addr-file", str(addr_file),
+                        "--http-max-requests", "2",
+                        "--max-queue", "8", "--buckets", "16"])
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    addr = _wait_addr(str(addr_file), t.is_alive)
+    cfg = T.TransformerConfig(vocab=61, dim=32, n_layers=2, n_heads=4,
+                              attn_impl="dense")
+    params = T.init_params(jax.random.key(0), cfg)
+    for prompt in ([1, 2, 3, 4, 5], [7, 8, 9]):
+        r = stream_generate(addr, prompt, 6)
+        assert r.status == 200 and r.outcome == "completed"
+        ref = T.generate(params, cfg,
+                         jnp.asarray(prompt, jnp.int32)[None, :],
+                         steps=6)
+        assert r.tokens == [int(x) for x in
+                            np.asarray(ref[0, len(prompt):])]
+    t.join(timeout=60.0)
+    assert rc.get("v") == 0
+
+
+@pytest.mark.slow  # real process boot + SIGTERM, slow lane
+@pytest.mark.edge
+def test_serve_http_sigterm_drains_fleet(tmp_path):
+    """The SIGTERM sequence on a real process, composed with
+    --replicas: edge drain (newcomers shed 503) -> fleet drain ->
+    the drain report and metrics snapshot land, exit code 0."""
+    import signal
+    import time
+
+    from paddle_tpu.testing.traffic import stream_generate
+
+    cfg_file = tmp_path / "serve_cfg.py"
+    cfg_file.write_text(SERVE_CFG)
+    addr_file = tmp_path / "addr.txt"
+    report = tmp_path / "drain.json"
+    metrics = tmp_path / "metrics.prom"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.cli", "serve",
+         "--config", str(cfg_file), "--http", "0",
+         "--http-addr-file", str(addr_file), "--replicas", "2",
+         "--max-queue", "8", "--buckets", "16",
+         "--drain-report", str(report),
+         "--metrics-out", str(metrics)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        addr = _wait_addr(str(addr_file),
+                          lambda: proc.poll() is None)
+        r = stream_generate(addr, [1, 2, 3], 4)
+        assert r.status == 200 and r.outcome == "completed"
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60.0)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            out, _ = proc.communicate(timeout=10.0)
+    assert proc.returncode == 0, out
+    payload = json.loads(report.read_text())
+    assert payload["kind"] == "edge_drain_report"
+    assert payload["reason"].startswith("signal")
+    assert payload["edge"]["requests"] == 1
+    assert payload["fleet"]["completed"] >= 1
+    assert "edge_requests" in metrics.read_text()

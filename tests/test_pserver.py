@@ -359,8 +359,11 @@ def test_finish_pass_barrier_survives_primary_death():
     TimeoutError against a dead server's pass counter."""
     vocab = 8
     primary, backup, spec = start_shard_pair(0, 0, vocab, DIM)
-    a = _client([spec], trainer_id=1, lease_ttl_s=0.5)
-    b = _client([spec], trainer_id=2, lease_ttl_s=0.5)
+    # real wall-clock leases: 2.0s (renewed every ttl/3 by the barrier
+    # poll) rides out scheduler stalls on a loaded 1-vCPU runner that
+    # expired a 0.5s lease mid-barrier and released the vote early
+    a = _client([spec], trainer_id=1, lease_ttl_s=2.0)
+    b = _client([spec], trainer_id=2, lease_ttl_s=2.0)
     try:
         a.register()
         b.register()

@@ -249,6 +249,8 @@ class TestSpeculativeServe:
                             speculative=True)
         assert got == want
 
+    @pytest.mark.slow  # tier-1 budget guard: drifted past 10s on the
+    # 1-vCPU runner; the spec lane still runs it
     def test_sampled_requests_reproducible_and_bounded(self, eng):
         """Sampled speculative serving: draws differ from the plain
         loop's per-token stream (documented round-stream boundary)
