@@ -703,6 +703,159 @@ def bench_disagg() -> None:
          obs_snapshot=registry.snapshot()["series"])
 
 
+def bench_data() -> None:
+    """Zero-copy data-plane stage (ISSUE 18): the same disaggregated
+    migration traffic driven twice over REAL socket transport —
+    once with KV payloads pickled onto the control frame (the
+    PR13/PR14 path), once with payloads scattered into the
+    shared-memory arena so the frame carries only a ticket. The
+    questions this answers: how many bytes stop crossing the wire
+    per migration, how much of the KV still gets memcpy'd at all
+    (spanning-part assembly only — adopted pages are zero-copy
+    views), what that does to the export+import transfer time, and
+    how many per-sweep control RPCs the batched frame absorbs.
+    Acceptance (ISSUE 18): wire bytes per migration reduced vs the
+    pickle arm, zero data-plane fallbacks, coalesced frame count
+    reported, bit-identical greedy outputs across arms. Forces the
+    CPU backend; `scripts/fault_smoke.sh data` drives it as
+    `bench.py --data-only`."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+
+    from paddle_tpu.models import transformer as T
+    from paddle_tpu.obs import MetricsRegistry
+    from paddle_tpu.serve.engine import DecodeEngine
+    from paddle_tpu.serve.router import ServingRouter
+    from paddle_tpu.serve.server import ServingServer
+    from paddle_tpu.serve.shm_arena import ShmArena
+    from paddle_tpu.serve.transport import (ProcessReplica,
+                                            ReplicaClient,
+                                            ReplicaTransportServer)
+
+    cfg = T.TransformerConfig(vocab=256, dim=64, n_layers=2,
+                              n_heads=4, attn_impl="dense")
+    params = T.init_params(jax.random.key(0), cfg)
+    max_len, page, chunk, max_new, n_req = 128, 16, 16, 12, 12
+    bucket = 96
+    r = np.random.RandomState(7)
+    prompts = [r.randint(0, 256, (64 + 16 * (i % 3),)).astype(np.int32)
+               for i in range(n_req)]
+
+    def mk_arm(label, arena):
+        # 1 prefill + 1 decode, each a real ServingServer behind a
+        # socket transport in its own thread, spoken to through
+        # ProcessReplica — the exact stack the cross-process fleet
+        # runs, minus fork cost. Both arms share the geometry; only
+        # `data_plane` differs.
+        log(f"data: building {label} arm (1 prefill + 1 decode)")
+        reps, transports = [], []
+        warm = np.arange(40, dtype=np.int32)
+        for role, slots in (("prefill", 4), ("decode", 8)):
+            e = DecodeEngine(params, cfg, slots=slots,
+                             max_len=max_len, page_size=page,
+                             prefill_chunk=chunk,
+                             num_pages=slots * (max_len // page))
+            e.serve([warm], max_new=2, buckets=(bucket,))  # compile
+            srv = ServingServer(e, role=role, max_queue=2 * n_req,
+                                buckets=(bucket,), max_retries=2,
+                                data_plane=arena)
+            ts = ReplicaTransportServer(srv).start()
+            transports.append(ts)
+            client = ReplicaClient(ts.addr, connect_timeout=2.0,
+                                   io_timeout=60.0)
+            reps.append(ProcessReplica(client))
+        return (ServingRouter(reps, probe_interval_s=1e9), reps,
+                transports)
+
+    def instrument(reps, acc):
+        # time + wire-byte cost of each migration's export/import
+        # pair, measured around the actual RPCs: the router runs in
+        # this one thread, so the client byte deltas bracket exactly
+        # the payload-bearing frames.
+        for rep in reps:
+            client = rep._client
+            for name in ("export_request", "import_request"):
+                orig = getattr(rep, name)
+
+                def wrapped(*a, __orig=orig, __c=client, **k):
+                    t0 = time.perf_counter()
+                    b0 = __c.bytes_sent + __c.bytes_recv
+                    try:
+                        return __orig(*a, **k)
+                    finally:
+                        acc["s"] += time.perf_counter() - t0
+                        acc["bytes"] += (__c.bytes_sent
+                                         + __c.bytes_recv - b0)
+                setattr(rep, name, wrapped)
+
+    def drive(router, reps):
+        acc = {"s": 0.0, "bytes": 0}
+        # one routed warm request compiles the migration bodies; its
+        # transfer cost is excluded from the measured window
+        router.submit(np.arange(50, dtype=np.int32), max_new=4)
+        router.run()
+        instrument(reps, acc)
+        rids = [router.submit(p, max_new=max_new) for p in prompts]
+        t0 = time.perf_counter()
+        res = router.run()
+        dt = time.perf_counter() - t0
+        router.reconcile()
+        toks = {i: tuple(res[i].tokens) for i in rids}
+        assert all(res[i].outcome == "completed" for i in rids)
+        return toks, acc, dt
+
+    # -- arm A: pickle-over-socket (no arena) ------------------------
+    pk_router, pk_reps, pk_ts = mk_arm("pickle", None)
+    pk_toks, pk_acc, pk_dt = drive(pk_router, pk_reps)
+    pk_mig = pk_router.counters()["migrations"]
+    for ts in pk_ts:
+        ts.shutdown()
+
+    # -- arm B: shared-memory arena, same traffic --------------------
+    arena = ShmArena(seg_size=64 * 1024, n_segs=64)
+    registry = MetricsRegistry()
+    arena.bind_metrics(registry)
+    ar_router, ar_reps, ar_ts = mk_arm("arena", arena)
+    ar_router.bind_metrics(registry)
+    ar_toks, ar_acc, ar_dt = drive(ar_router, ar_reps)
+    c = ar_router.counters()
+    ar_mig = c["migrations"]
+    a = arena.counters()
+    coalesced = sum(rep.rpc_frames_coalesced for rep in ar_reps)
+    arena.reconcile()
+    assert a["arena_segments_live"] == 0, a
+    for ts in ar_ts:
+        ts.shutdown()
+
+    pk_per = pk_acc["bytes"] / max(pk_mig, 1)
+    ar_per = ar_acc["bytes"] / max(ar_mig, 1)
+    reduction = (round(pk_per / ar_per, 2) if ar_per else None)
+    emit("serve_data_plane_wire_bytes_per_migration_reduction",
+         reduction, "x (pickle wire bytes / arena wire bytes, per "
+         "migration export+import pair)", None,
+         pickle_wire_bytes_per_migration=int(pk_per),
+         arena_wire_bytes_per_migration=int(ar_per),
+         pickle_transfer_ms_mean=round(
+             pk_acc["s"] / max(pk_mig, 1) * 1e3, 2),
+         arena_transfer_ms_mean=round(
+             ar_acc["s"] / max(ar_mig, 1) * 1e3, 2),
+         arena_bytes_scattered=a["arena_bytes_scattered"],
+         arena_bytes_gathered=a["arena_bytes_gathered"],
+         arena_bytes_gather_copied=a["arena_bytes_gather_copied"],
+         zero_copy_fraction=round(
+             1.0 - a["arena_bytes_gather_copied"]
+             / max(a["arena_bytes_gathered"], 1), 4),
+         rpc_frames_coalesced=coalesced,
+         data_plane_fallbacks=c.get("fleet_data_plane_fallbacks", 0),
+         greedy_bit_identical=bool(pk_toks == ar_toks),
+         migrations=ar_mig, migrations_pickle_arm=pk_mig,
+         pickle_wall_s=round(pk_dt, 2),
+         arena_wall_s=round(ar_dt, 2),
+         requests=n_req, max_new=max_new,
+         obs_snapshot=registry.snapshot()["series"])
+    arena.close(destroy=True)
+
+
 def bench_fleet() -> None:
     """Cross-process fleet stage (ISSUE 14): the two latencies that
     decide whether elastic process replicas are worth running — how
@@ -1772,6 +1925,8 @@ if __name__ == "__main__":
         bench_kernels()
     elif len(sys.argv) > 1 and sys.argv[1] == "--disagg-only":
         bench_disagg()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--data-only":
+        bench_data()
     elif len(sys.argv) > 1 and sys.argv[1] == "--fleet-only":
         bench_fleet()
     elif len(sys.argv) > 1 and sys.argv[1] == "--cluster-only":

@@ -24,6 +24,8 @@ from paddle_tpu.serve.router import (Replica, ReplicaDeadError,
 from paddle_tpu.serve.server import (CircuitBreaker, QueueFullError,
                                      Request, RequestResult,
                                      ServingServer)
+from paddle_tpu.serve.shm_arena import (ArenaError, ArenaFull,
+                                        ArenaUnavailable, ShmArena)
 from paddle_tpu.serve.transport import (ProcessReplica, ReplicaClient,
                                         ReplicaTransportServer,
                                         TransportCallError,

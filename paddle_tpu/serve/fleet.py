@@ -107,13 +107,19 @@ def build_server_from_config(*, config: str, slots=None, max_len=None,
                              default_deadline_ms=None,
                              max_retries: int = 1, buckets=None,
                              drain_grace_s: float = 30.0,
-                             artifact: Optional[str] = None):
+                             artifact: Optional[str] = None,
+                             role: str = "unified",
+                             page_size=None, prefill_chunk=None,
+                             data_plane: Optional[str] = None):
     """The `cli serve --fleet-procs` replica builder: run the user's
     serve-config script IN THE CHILD (each process owns its engine
     pool; nothing jax-shaped crosses the spawn boundary) and wrap the
     engine in the reliability server, optionally booted from a PR9
     artifact. Kwargs mirror the `serve` CLI knobs — all plain data,
-    as `ReplicaSpec` requires."""
+    as `ReplicaSpec` requires: `data_plane` is the NAME of the
+    supervisor's shared-memory arena (the child attaches; an attach
+    failure degrades to the pickle path inside `ServingServer`),
+    `role` makes disaggregated prefill/decode tiers spawnable."""
     import runpy
 
     from paddle_tpu.serve.engine import DecodeEngine
@@ -129,13 +135,18 @@ def build_server_from_config(*, config: str, slots=None, max_len=None,
         slots=(sc.get("slots", 8) if slots is None else slots),
         max_len=(sc.get("max_len", 2048) if max_len is None
                  else max_len),
+        page_size=(sc.get("page_size", 16) if page_size is None
+                   else page_size),
+        prefill_chunk=(sc.get("prefill_chunk") if prefill_chunk
+                       is None else prefill_chunk),
         eos_id=sc.get("eos_id"), seed=seed)
     return ServingServer(
         engine, max_queue=max_queue,
         default_deadline_ms=default_deadline_ms,
         max_retries=max_retries,
         buckets=tuple(buckets) if buckets else None,
-        drain_grace_s=drain_grace_s, artifact_path=artifact)
+        drain_grace_s=drain_grace_s, artifact_path=artifact,
+        role=role, data_plane=data_plane)
 
 
 def _replica_main(spec: ReplicaSpec, conn) -> None:
@@ -311,7 +322,9 @@ class FleetSupervisor:
                  flight: Optional[FlightRecorder] = None,
                  flight_dir: Optional[str] = None,
                  router_kwargs: Optional[dict] = None,
-                 membership: Optional[object] = None):
+                 membership: Optional[object] = None,
+                 data_plane_segs: int = 0,
+                 data_plane_seg_kb: int = 256):
         if not (1 <= min_replicas <= max_replicas):
             raise ValueError(
                 f"need 1 <= min_replicas <= max_replicas, got "
@@ -358,6 +371,27 @@ class FleetSupervisor:
         self._latency_seen: set = set()
         self._closed = False
         self._atexit_registered = False
+        # zero-copy data plane (serve.shm_arena): the supervisor
+        # CREATES the fleet-shared arena and injects its NAME into
+        # the spec's builder kwargs — children attach by name and
+        # migrations move KV bytes through shared memory instead of
+        # pickling them through the control socket. Opt-in
+        # (data_plane_segs > 0); a create failure (no /dev/shm)
+        # degrades to the pickle path fleetwide with a flight event.
+        self.arena = None
+        if data_plane_segs > 0:
+            from paddle_tpu.serve.shm_arena import (ArenaError,
+                                                    ShmArena)
+            try:
+                self.arena = ShmArena(
+                    seg_size=data_plane_seg_kb * 1024,
+                    n_segs=data_plane_segs)
+                self.spec = dataclasses.replace(
+                    self.spec,
+                    kwargs={**self.spec.kwargs,
+                            "data_plane": self.arena.name})
+            except ArenaError as e:
+                self._note("data-plane-unavailable", error=repr(e))
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -398,6 +432,8 @@ class FleetSupervisor:
         self.stats["spawned"] += len(members)
         self.router.bind_metrics(self.registry)
         self.registry.register_source("fleet_sup", self.counters)
+        if self.arena is not None:
+            self.arena.bind_metrics(self.registry)
         if not self._atexit_registered:
             # a supervisor that exits WITHOUT shutdown() still reaps:
             # children also carry their own watchdog for the SIGKILL
@@ -471,6 +507,13 @@ class FleetSupervisor:
         if self.membership is None:
             self._autoscale_tick()
         self._reap_retired()
+        if self.arena is not None:
+            # orphan-reclaim ride-along: a SIGKILLed child's in-
+            # flight segments free here, on the same tick that fences
+            # and redistributes its requests
+            n = self.arena.reclaim_orphans()
+            if n:
+                self._note("data-plane-reclaim", segments=n)
         return busy
 
     def _membership_tick(self) -> None:
@@ -538,10 +581,19 @@ class FleetSupervisor:
         if self.membership is not None:
             out["membership_epoch"] = self._mem_epoch
             out["hosts_live"] = len({h for h, _ in self._known_eps})
+        if self.arena is not None:
+            out.update(self.arena.counters())
         return out
 
     def reconcile(self) -> None:
         self.router.reconcile()
+        if self.arena is not None:
+            # the fleet is quiescent (the router's books just
+            # balanced): after reclaiming any dead owners' segments,
+            # the arena must hold NOTHING — every ticket was freed on
+            # ACK/cancel or reclaimed with its owner
+            self.arena.reclaim_orphans()
+            self.arena.reconcile()
 
     # -- autoscaling -------------------------------------------------------
 
@@ -697,6 +749,8 @@ class FleetSupervisor:
                     pass        # shutdown continues regardless
             for rid in list(self.procs):
                 self._shutdown_member(rid)
+        if self.arena is not None:
+            self.arena.close(destroy=True)
         if self._atexit_registered:
             atexit.unregister(self._atexit_shutdown)
             self._atexit_registered = False

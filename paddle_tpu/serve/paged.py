@@ -509,6 +509,13 @@ class PagePool:
     def exports_outstanding(self) -> int:
         return len(self._exports)
 
+    def export_ids(self) -> List[int]:
+        """The outstanding export pins' ids — the cross-ledger seam
+        `ServingServer.reconcile` joins against its parked handoffs
+        (and, through them, the shared-memory arena's live tickets):
+        every pin must belong to a parked transfer, on all ledgers."""
+        return list(self._exports)
+
     def import_blocks(self, slot: int, tokens, true_len: int
                       ) -> Tuple[List[int], int]:
         """Map a slot for a MIGRATED finished prefill. Identical

@@ -466,7 +466,9 @@ class ServingRouter:
                      "draft_accepted", "spec_reserved",
                      "spec_rolled_back", "migrated_in", "migrated_out",
                      "migrated_out_pages", "migrated_in_pages",
-                     "handoffs_cancelled"):
+                     "handoffs_cancelled", "data_plane_fallbacks",
+                     "rpc_frames_coalesced", "rpc_client_frames",
+                     "rpc_client_bytes_sent", "rpc_client_bytes_recv"):
                 self._dead_base[k] = self._dead_base.get(k, 0) + v
 
     def _on_replica_death(self, rep: Replica, exc: Exception) -> None:

@@ -89,6 +89,7 @@ from paddle_tpu.obs.trace import Tracer
 from paddle_tpu.serve.engine import PoolStats, pad_to_bucket
 from paddle_tpu.serve.paged import PoolExhaustedError, blocks_for
 from paddle_tpu.serve.policy import SchedulerPolicy
+from paddle_tpu.serve.shm_arena import ArenaError, attach_cached
 from paddle_tpu.serve.speculative import NGramProposer
 
 log = logging.getLogger(__name__)
@@ -99,6 +100,46 @@ _POOL_COUNTER_KEYS = ("prefix_hits", "prefix_misses",
                       "prefix_rejected", "prefill_chunks",
                       "spec_reserved", "spec_rolled_back",
                       "migrated_out_pages", "migrated_in_pages")
+
+def _flatten_kv(kv):
+    """Flatten an exported KV payload (per-layer tuples whose
+    elements are ndarrays OR `(data, scale)` ndarray tuples — the
+    int8 shape) into a flat list of contiguous buffers plus the spec
+    that rebuilds the nesting. The data plane moves BUFFERS; the
+    control frame carries the spec."""
+    arrays, spec = [], []
+    for layer in kv:
+        lspec = []
+        for p in layer:
+            if isinstance(p, tuple):
+                sub = []
+                for q in p:
+                    a = np.ascontiguousarray(np.asarray(q))
+                    arrays.append(a)
+                    sub.append((a.dtype.str, a.shape))
+                lspec.append(("t", sub))
+            else:
+                a = np.ascontiguousarray(np.asarray(p))
+                arrays.append(a)
+                lspec.append(("a", (a.dtype.str, a.shape)))
+        spec.append(lspec)
+    return arrays, spec
+
+
+def _unflatten_kv(bufs, spec):
+    """Rebuild the KV nesting from gathered buffers — zero-copy views
+    over the arena where the buffer wasn't segment-spanning."""
+    it = iter(bufs)
+
+    def mk(ds):
+        dtype, shape = ds
+        return np.frombuffer(next(it), dtype=np.dtype(dtype)) \
+            .reshape(shape)
+
+    return [tuple(tuple(mk(d) for d in ds) if kind == "t" else mk(ds)
+                  for kind, ds in lspec)
+            for lspec in spec]
+
 
 #: terminal request outcomes — exactly one per submitted request
 COMPLETED = "completed"
@@ -256,7 +297,8 @@ class ServingServer:
                  speculative: bool = False,
                  proposer=None,
                  artifact_path: Optional[str] = None,
-                 role: str = "unified"):
+                 role: str = "unified",
+                 data_plane=None):
         if role not in ("unified", "prefill", "decode"):
             raise ValueError(
                 f"role must be 'unified', 'prefill' or 'decode', "
@@ -381,6 +423,29 @@ class ServingServer:
         self.migrated_in = 0
         self.migrated_out = 0
         self.handoffs_cancelled = 0
+
+        # zero-copy data plane (serve.shm_arena): exported KV pages
+        # scatter into the shared arena and the control frame carries
+        # only the ticket. `data_plane` is a ShmArena, an arena NAME
+        # to attach (the fleet injects the supervisor's arena into
+        # spawned replicas this way), or None (inline pickle path).
+        # EVERY data-plane failure — attach here, scatter/gather
+        # later — degrades to the inline path with a counter + flight
+        # event: never a wrong answer, never a failed boot.
+        self.data_plane_fallbacks = 0
+        if isinstance(data_plane, str):
+            try:
+                data_plane = attach_cached(data_plane)
+            except ArenaError as e:
+                self._data_plane_fallback("attach", repr(e))
+                data_plane = None
+        self.data_plane = data_plane
+
+    def _data_plane_fallback(self, where: str, error: str) -> None:
+        self.data_plane_fallbacks += 1
+        if self.flight is not None:
+            self.flight.record("data_plane", "fallback", where=where,
+                               error=error)
 
     def _load_artifact(self, path: str) -> None:
         """Boot-time artifact adoption: verify the bundle's manifest
@@ -718,7 +783,7 @@ class ServingServer:
         span = self._trace_ids.get(req_id)
         self._trace_event(req_id, "handoff_export",
                           pages=len(h["pages"]))
-        return {
+        payload = {
             "prompt": req.prompt,
             "true_len": req.true_len,
             "max_new": req.max_new,
@@ -726,11 +791,34 @@ class ServingServer:
             "retries_left": req.retries_left,
             "remaining_ms": remaining_ms,
             "seed": h["seed"],
-            "kv": self.engine.export_slot_kv(self._state, h["pages"]),
+            "kv": None,
             "n_pages": len(h["pages"]),
             "geometry": self.engine.kv_geometry(),
             "trace_id": getattr(span, "trace_id", None),
         }
+        if self.data_plane is not None:
+            try:
+                if "ticket" not in h:
+                    # first export: scatter the page bytes into the
+                    # arena ONCE and park the ticket on the handoff —
+                    # an RPC retry (or a retargeted destination after
+                    # a dst death) re-exports the SAME ticket instead
+                    # of leaking a second scatter
+                    arrays, spec = _flatten_kv(
+                        self.engine.export_slot_kv(self._state,
+                                                   h["pages"]))
+                    h["ticket"] = self.data_plane.scatter(arrays)
+                    h["kv_spec"] = spec
+                payload["kv_ref"] = {"ticket": h["ticket"],
+                                     "spec": h["kv_spec"]}
+                return payload
+            except ArenaError as e:
+                # size cap / arena gone: inline pickle path below —
+                # slower, never a wrong answer
+                self._data_plane_fallback("scatter", repr(e))
+        payload["kv"] = self.engine.export_slot_kv(self._state,
+                                                   h["pages"])
+        return payload
 
     def handoff_complete(self, req_id: int) -> None:
         """Destination ACK: release the source copy (export pin +
@@ -741,6 +829,7 @@ class ServingServer:
         h = self._handoff.pop(req_id)
         slot = h["slot"]
         self._active_pool.release_export(h["export_id"])
+        self._free_ticket(h)
         self._retire_slot(slot)
         self._emitted.pop(req_id, None)
         self._lps.pop(req_id, None)
@@ -759,10 +848,20 @@ class ServingServer:
         ordinary decode path on this server."""
         h = self._handoff.pop(req_id)
         self._active_pool.release_export(h["export_id"])
+        self._free_ticket(h)
         self._state = self.engine.resume_slot(
             self._state, h["slot"], h["seed"])
         self.handoffs_cancelled += 1
         self._trace_event(req_id, "handoff_cancelled", slot=h["slot"])
+
+    def _free_ticket(self, h: dict) -> None:
+        """Release a handoff's arena segments with its export pin —
+        the pins-release-on-ACK contract extended to the data plane.
+        Idempotent like the pin release (the arena skips segments
+        already freed or reowned)."""
+        ticket = h.pop("ticket", None)
+        if ticket is not None and self.data_plane is not None:
+            self.data_plane.free(ticket)
 
     def import_request(self, payload: dict) -> int:
         """Decode-tier intake for a migrated finished prefill. Gates
@@ -800,6 +899,30 @@ class ServingServer:
             raise MigrationRefusedError(
                 "import refused: page pool cannot map the migrated "
                 "blocks right now")
+        kv = payload.get("kv")
+        adopt = None
+        if kv is None:
+            # zero-copy arm: the frame carried a ticket, the bytes
+            # are in the shared arena. ANY gather failure (arena
+            # unattachable, ticket gone stale under an orphan
+            # reclaim) refuses the migration — transient from the
+            # router's view (the source copy is still pinned), so it
+            # retargets or cancels; never a wrong answer.
+            ref = payload["kv_ref"]
+            try:
+                arena = (self.data_plane
+                         if self.data_plane is not None
+                         and self.data_plane.name
+                         == ref["ticket"]["arena"]
+                         else attach_cached(ref["ticket"]["arena"]))
+                kv = _unflatten_kv(arena.gather(ref["ticket"]),
+                                   ref["spec"])
+            except ArenaError as e:
+                self._data_plane_fallback("gather", repr(e))
+                raise MigrationRefusedError(
+                    f"import refused: data-plane gather failed: "
+                    f"{e}") from e
+            adopt = (arena, ref["ticket"])
         try:
             pages, shared_blocks = pool.import_blocks(
                 slot, prompt, true_len)
@@ -808,8 +931,7 @@ class ServingServer:
                 f"import refused: {e}") from None
         try:
             self._state = self.engine.import_slot_kv(
-                self._state, slot, pages, shared_blocks,
-                payload["kv"])
+                self._state, slot, pages, shared_blocks, kv)
             self._state = self.engine.resume_slot(
                 self._state, slot, payload["seed"])
         except Exception:
@@ -846,6 +968,18 @@ class ServingServer:
             self._trace_event(req_id, "migrated_in", slot=slot,
                               pages=len(pages),
                               shared_blocks=shared_blocks)
+        if adopt is not None:
+            # stamp the adoption LAST: the bytes are already copied
+            # into this pool, so the stamp is pure ledger evidence
+            # ('delivered' vs 'died unread' for the orphan sweep).
+            # A stale ticket here (source died + reclaimed between
+            # gather and now) must not un-admit the request — the
+            # import committed; record the miss and move on.
+            arena, ticket = adopt
+            try:
+                arena.adopt(ticket)
+            except ArenaError as e:
+                self._data_plane_fallback("adopt", repr(e))
         return req_id
 
     # -- drain -------------------------------------------------------------
@@ -1012,8 +1146,10 @@ class ServingServer:
 
     def _drop_handoff_pin(self, req_id: int) -> None:
         h = self._handoff.pop(req_id, None)
-        if h is not None and self._active_pool is not None:
-            self._active_pool.release_export(h["export_id"])
+        if h is not None:
+            if self._active_pool is not None:
+                self._active_pool.release_export(h["export_id"])
+            self._free_ticket(h)
 
     # -- the drive loop ----------------------------------------------------
 
@@ -1510,6 +1646,13 @@ class ServingServer:
             "migrated_out": self.migrated_out,
             "handoffs_ready": len(self._handoff),
             "handoffs_cancelled": self.handoffs_cancelled,
+            # data-plane degrades (arena attach/scatter/gather
+            # failures that fell back to the inline pickle path).
+            # The arena's OWN gauges are deliberately not summed
+            # here — the arena is fleet-shared, and per-replica sums
+            # would multiply-count it; it binds to the registry once
+            # via ShmArena.bind_metrics.
+            "data_plane_fallbacks": self.data_plane_fallbacks,
         }
         out.update(self._pool_base)
         out.setdefault("pages_in_use", 0)
@@ -1548,3 +1691,17 @@ class ServingServer:
             # an idle server holds no pages outside the prefix cache
             pool = self._active_pool
             assert all(not p for p in pool.slot_pages), pool.slot_pages
+            # cross-ledger: every outstanding export pin belongs to a
+            # parked handoff and vice versa — a dropped ACK can leak
+            # on either side, and each side's books must name it
+            assert sorted(h["export_id"]
+                          for h in self._handoff.values()) \
+                == sorted(pool.export_ids()), (
+                self._handoff, pool.export_ids())
+        if self.data_plane is not None:
+            # the arena's live tickets FOR THIS PROCESS are exactly
+            # the parked handoffs' tickets (the third ledger)
+            mine = {int(h["ticket"]["tag"])
+                    for h in self._handoff.values() if "ticket" in h}
+            live = self.data_plane.live_tags(os.getpid())
+            assert live == mine, (live, mine)

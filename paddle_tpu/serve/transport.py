@@ -65,10 +65,27 @@ failures are gentler: while the process is visibly alive they are
 transient (the breaker's job); only a dead process turns a probe into
 a death verdict.
 
+Two data-plane economies ride the same frames (PR18):
+
+- **Out-of-band buffers.** The client speaks pickle protocol 5 over
+  `wire.send_frames` multi-part frames: ndarray payloads (prompts,
+  inline KV on the fallback path) travel as raw buffer parts instead
+  of being copied into the pickle stream — one serialization, no
+  sender-side concatenation. A new-protocol request is marked by a
+  4-tuple `(op, kwargs, acks, proto)`; legacy 3-tuple single-frame
+  clients get legacy single-frame replies, byte-compatible with PR14.
+- **Batched sweeps.** `_op_sweep` dispatches a LIST of ops from one
+  frame under one lock grab — `ProcessReplica` defers ACK-class ops
+  (handoff_complete / cancel_handoff) and folds them into the next
+  step/sync frame, and every reply's state block carries a `partials`
+  map so streaming polls are answered router-side with ZERO RPCs.
+  Control-plane syscall count stops scaling with request count; the
+  `rpc_frames_coalesced` counter proves it.
+
 The link is pickle over a loopback/private socket between same-uid
 processes the supervisor itself spawned — a trusted link, same as the
 pserver tier. Frames are bounded by `wire.MAX_FRAME` before
-allocation either way.
+allocation either way (summed across parts for multi-part frames).
 """
 
 from __future__ import annotations
@@ -83,12 +100,30 @@ import numpy as np
 
 from paddle_tpu.serve.router import ReplicaDeadError
 from paddle_tpu.serve.server import Request
-from paddle_tpu.wire import MAX_FRAME, recv_frame, send_frame
+from paddle_tpu.wire import (MAX_FRAME, recv_frames, send_frame,
+                             send_frames)
 
 __all__ = [
     "ProcessReplica", "ReplicaClient", "ReplicaTransportServer",
     "TransportCallError", "TransportConnectError", "TransportError",
 ]
+
+
+def _dumps(obj) -> List[bytes]:
+    """Serialize with protocol-5 out-of-band buffers: part 0 is the
+    pickle head, the rest are raw buffer views (ndarrays cross the
+    socket without entering the pickle stream)."""
+    bufs: List[pickle.PickleBuffer] = []
+    head = pickle.dumps(obj, protocol=5,
+                        buffer_callback=bufs.append)
+    return [head] + [b.raw() for b in bufs]
+
+
+def _loads(parts: List[bytes]):
+    """Inverse of `_dumps`; a legacy single-frame pickle is just the
+    zero-buffer case."""
+    return pickle.loads(parts[0], buffers=[memoryview(p)
+                                           for p in parts[1:]])
 
 
 class TransportError(ConnectionError):
@@ -189,34 +224,50 @@ class ReplicaTransportServer:
         try:
             while not self._stop.is_set():
                 try:
-                    frame = recv_frame(conn, max_frame=self.max_frame)
+                    parts = recv_frames(conn,
+                                        max_frame=self.max_frame)
                 except (ConnectionError, OSError):
                     return              # peer gone / desynced stream
+                multi = False
                 try:
-                    op, kwargs, acks = pickle.loads(frame)
+                    req = _loads(parts)
+                    if len(req) == 4:
+                        # protocol-5 client: reply in kind (multi-
+                        # part, buffers out-of-band)
+                        op, kwargs, acks, _proto = req
+                        multi = True
+                    else:
+                        op, kwargs, acks = req
                 except Exception as e:
                     # garbage that FRAMED correctly: answer in-band
                     # (the client sees a protocol error, not a hang)
                     # and drop the connection — the stream's framing
                     # survived but its content is untrusted now
                     self._reply(conn, ("err", ConnectionError(
-                        f"undecodable request frame: {e!r}"), None))
+                        f"undecodable request frame: {e!r}"), None),
+                        multi=False)
                     return
-                self._reply(conn, self._dispatch(op, kwargs, acks))
+                self._reply(conn, self._dispatch(op, kwargs, acks),
+                            multi=multi)
         finally:
             conn.close()
 
-    def _reply(self, conn: socket.socket, reply: tuple) -> None:
+    def _reply(self, conn: socket.socket, reply: tuple, *,
+               multi: bool) -> None:
         try:
-            blob = pickle.dumps(reply)
+            blobs = (_dumps(reply) if multi
+                     else [pickle.dumps(reply)])
         except Exception as e:
             # an unpicklable exception payload must not silence the
             # reply — degrade to its repr
             status, payload, state = reply
-            blob = pickle.dumps(
-                (status, RuntimeError(repr(payload)), state))
+            blobs = [pickle.dumps(
+                (status, RuntimeError(repr(payload)), state))]
         try:
-            send_frame(conn, blob, max_frame=self.max_frame)
+            if multi:
+                send_frames(conn, blobs, max_frame=self.max_frame)
+            else:
+                send_frame(conn, blobs[0], max_frame=self.max_frame)
         except (ConnectionError, OSError):
             pass        # client gone; redelivery covers the loss
 
@@ -251,6 +302,11 @@ class ReplicaTransportServer:
             "budgets": [(r.req_id, r.retries_left) for r in pending],
             "queued": [r.req_id for r in srv.queue],
             "handoffs": list(srv.ready_handoffs()),
+            # one partials block per reply: the edge's per-stream
+            # polling reads THIS off the router-side cache instead of
+            # issuing one RPC per stream per poll (PR17 follow-up)
+            "partials": {r.req_id: list(srv.partial_tokens(r.req_id))
+                         for r in pending},
         }
 
     # -- ops ---------------------------------------------------------------
@@ -276,6 +332,28 @@ class ReplicaTransportServer:
 
     def _op_step(self) -> bool:
         return bool(self.server.step())
+
+    def _op_sweep(self, ops: list) -> list:
+        """Batched dispatch: a LIST of `(op, kwargs)` pairs executed
+        in order under the one lock grab the frame already holds —
+        the router folds its per-sweep ACKs (handoff releases) and
+        the sweep's step into ONE frame per replica. Each sub-op
+        answers `("ok", ret)` or `("err", e)` individually; the state
+        block on the enclosing reply reflects the ledger AFTER the
+        whole batch."""
+        out = []
+        for op, kwargs in ops:
+            handler = (None if op == "sweep"
+                       else getattr(self, f"_op_{op}", None))
+            if handler is None:
+                out.append(("err",
+                            ConnectionError(f"unknown op {op!r}")))
+                continue
+            try:
+                out.append(("ok", handler(**(kwargs or {}))))
+            except Exception as e:
+                out.append(("err", e))
+        return out
 
     def _op_submit(self, tag: str, prompt, max_new: int,
                    deadline_ms, sampling, retries_left,
@@ -329,11 +407,14 @@ class ReplicaTransportServer:
         # device-flavored so the payload pickles without a jax import
         # on the router side
         payload["prompt"] = np.asarray(payload["prompt"])
-        payload["kv"] = [
-            tuple(np.asarray(p) if not isinstance(p, tuple)
-                  else tuple(np.asarray(q) for q in p)
-                  for p in layer)
-            for layer in payload["kv"]]
+        if payload.get("kv") is not None:
+            payload["kv"] = [
+                tuple(np.asarray(p) if not isinstance(p, tuple)
+                      else tuple(np.asarray(q) for q in p)
+                      for p in layer)
+                for layer in payload["kv"]]
+        # else: the KV bytes live in the shared-memory arena and the
+        # frame carries only the ticket (payload["kv_ref"])
         return payload
 
     def _op_handoff_complete(self, req_id: int) -> None:
@@ -405,6 +486,11 @@ class ReplicaClient:
         self._sleep = sleep
         import random
         self._rng = random.Random(seed)
+        # io accounting for the data-plane A/B bench: frames that
+        # completed, and payload bytes either way (headers excluded)
+        self.frames = 0
+        self.bytes_sent = 0
+        self.bytes_recv = 0
 
     def _backoff(self, attempt: int) -> None:
         ceiling = min(self.backoff_max,
@@ -420,7 +506,10 @@ class ReplicaClient:
         idempotent — tags replay verdicts, results redeliver until
         ACKed."""
         budget = self.retries if retries is None else retries
-        frame = pickle.dumps((op, dict(kwargs or {}), list(acks)))
+        # protocol-5 multi-part: the 4th tuple element marks a new-
+        # protocol client, buffers (ndarrays) ride out-of-band parts
+        parts = _dumps((op, dict(kwargs or {}), list(acks), 5))
+        sent = sum(len(p) for p in parts)
         last: Optional[Exception] = None
         connected_once = False
         for attempt in range(budget):
@@ -435,18 +524,22 @@ class ReplicaClient:
             connected_once = True
             try:
                 sock.settimeout(self.io_timeout)
-                send_frame(sock, frame, max_frame=self.max_frame)
-                reply = recv_frame(sock, max_frame=self.max_frame)
+                send_frames(sock, parts, max_frame=self.max_frame)
+                reply = recv_frames(sock, max_frame=self.max_frame)
             except (ConnectionError, OSError) as e:
                 last = e
                 continue
             finally:
                 sock.close()
             try:
-                return pickle.loads(reply)
+                obj = _loads(reply)
             except Exception as e:
                 last = ConnectionError(f"undecodable reply: {e!r}")
                 continue
+            self.frames += 1
+            self.bytes_sent += sent
+            self.bytes_recv += sum(len(p) for p in reply)
+            return obj
         cls = (TransportCallError if connected_once
                else TransportConnectError)
         raise cls(f"rpc {op!r} to {self.addr} failed after "
@@ -501,6 +594,14 @@ class ProcessReplica:
         self._load = 0
         self._queued_ids: List[int] = []
         self._handoff_ids: List[int] = []
+        # batched control plane (PR18): partials cache off the last
+        # state block (streaming polls answered with ZERO RPCs),
+        # deferred ACK-class ops folded into the next sweep frame
+        self._partials: Dict[int, List[int]] = {}
+        self._deferred: List[Tuple[str, dict]] = []
+        self._deferred_released: set = set()
+        self.rpc_frames_coalesced = 0
+        self.rpc_deferred_errors = 0
         info = self._rpc("info")
         self.role = info["role"]
         self.engine = _EngineInfo(info["paged"], info["prefix_cache"],
@@ -521,6 +622,7 @@ class ProcessReplica:
         self._load = state["load"]
         self._queued_ids = state["queued"]
         self._handoff_ids = state["handoffs"]
+        self._partials = state.get("partials", {})
         for rid, res in state["results"].items():
             if rid not in self.results:
                 self.results[rid] = res
@@ -560,6 +662,34 @@ class ProcessReplica:
         # double-serve what the router is about to redistribute.
         self._fatal(e)
 
+    def _flush(self, final_op: str,
+               final_kwargs: Optional[dict] = None):
+        """Fold every deferred ACK-class op plus `final_op` into ONE
+        sweep frame. Deferred-op errors can't reach their original
+        callers (those calls already returned) — a replica-fatal one
+        still fences + raises; the rest are counted and dropped,
+        which is safe because every deferred op is an idempotent
+        release (the request's outcome was already recorded before
+        the op was enqueued). The final op's verdict is returned or
+        re-raised exactly like a direct RPC."""
+        ops = self._deferred + [(final_op, dict(final_kwargs or {}))]
+        self._deferred = []
+        results = self._rpc("sweep", dict(ops=ops))
+        # N ops, 1 frame: N-1 frames that never hit the wire
+        self.rpc_frames_coalesced += len(ops) - 1
+        for kind, value in results[:-1]:
+            if kind == "err":
+                if getattr(value, "replica_fatal", False):
+                    self._fence()
+                    raise value
+                self.rpc_deferred_errors += 1
+        kind, value = results[-1]
+        if kind == "err":
+            if getattr(value, "replica_fatal", False):
+                self._fence()
+            raise value
+        return value
+
     def _fence(self) -> None:
         if self._proc is not None:
             self._proc.kill()
@@ -598,6 +728,8 @@ class ProcessReplica:
         return req_id
 
     def step(self) -> bool:
+        if self._deferred:
+            return bool(self._flush("step"))
         return bool(self._rpc("step"))
 
     def ping(self) -> None:
@@ -628,10 +760,21 @@ class ProcessReplica:
                 if rid not in self.results]
 
     def counters(self) -> Dict[str, int]:
-        return dict(self._counters)
+        c = dict(self._counters)
+        # merge the router-side control-plane economics so the fleet
+        # aggregation (and banked-at-death sums) pick them up
+        c["rpc_frames_coalesced"] = self.rpc_frames_coalesced
+        c["rpc_deferred_errors"] = self.rpc_deferred_errors
+        c["rpc_client_frames"] = self._client.frames
+        c["rpc_client_bytes_sent"] = self._client.bytes_sent
+        c["rpc_client_bytes_recv"] = self._client.bytes_recv
+        return c
 
     def reconcile(self) -> None:
-        self._rpc("reconcile")
+        if self._deferred:
+            self._flush("reconcile")
+        else:
+            self._rpc("reconcile")
 
     def drain(self, *, grace_s: Optional[float] = None,
               reason: str = "drain requested") -> None:
@@ -651,27 +794,53 @@ class ProcessReplica:
         res = self.results.get(req_id)
         if res is not None:
             return list(res.tokens)
+        if req_id in self._partials:
+            # push-style delivery: the last reply's partials block
+            # already carries this stream's tokens — no RPC. Fresh by
+            # construction: tokens only advance via step RPCs, and
+            # every step refreshes the block.
+            self.rpc_frames_coalesced += 1
+            return list(self._partials[req_id])
         return list(self._rpc("partial", dict(req_id=req_id)))
 
     def sync(self) -> None:
         """Refresh the cached state block (and deliver ACKs) with no
         side effects — the supervisor's idle-watch uses this."""
-        self._rpc("sync")
+        if self._deferred:
+            self._flush("sync")
+        else:
+            self._rpc("sync")
 
     # -- disaggregated handoff surface -------------------------------------
 
     def ready_handoffs(self) -> List[int]:
-        return list(self._handoff_ids)
+        # a handoff whose release is deferred (queued for the next
+        # sweep frame) must not be harvested again in between
+        return [rid for rid in self._handoff_ids
+                if rid not in self._deferred_released]
 
     def export_request(self, req_id: int) -> dict:
         return self._rpc("export_request", dict(req_id=req_id))
 
     def handoff_complete(self, req_id: int) -> None:
-        self._rpc("handoff_complete", dict(req_id=req_id))
+        # deferred ACK: the destination already owns the request (its
+        # import committed), so the source's pin release is pure
+        # bookkeeping — it folds into the next sweep frame instead of
+        # costing one RPC per migration. A crash before the flush is
+        # covered by the same machinery as a crash before this call:
+        # the pin is abandoned and dropped/reclaimed.
+        self._deferred.append(("handoff_complete",
+                               dict(req_id=req_id)))
+        self._deferred_released.add(req_id)
         self._mirror.pop(req_id, None)      # the destination owns it
 
     def cancel_handoff(self, req_id: int) -> None:
-        self._rpc("cancel_handoff", dict(req_id=req_id))
+        # cancel resumes the request SOURCE-side: flush immediately
+        # (deferring would leave the request frozen for a sweep)
+        if req_id in self._deferred_released:
+            return
+        self._flush("cancel_handoff", dict(req_id=req_id))
+        self._deferred_released.add(req_id)
 
     def import_request(self, payload: dict) -> int:
         now = self.clock()

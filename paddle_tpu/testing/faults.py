@@ -167,6 +167,10 @@ class FaultPlan:
     pserver_replica_delay_at: Optional[int] = None  # nth repl record
     pserver_replica_delay_s: float = 0.0          # stall per delayed record
     pserver_snapshot_error_at: Optional[int] = None  # nth snapshot write
+    # -- data-plane faults (serve.shm_arena, via wrap_arena) --
+    arena_kill_scatter_at: Optional[int] = None   # nth segment written
+    arena_kill_adopt_at: Optional[int] = None     # nth segment adopted
+    arena_error_at: Optional[int] = None          # nth scatter() call
     once: bool = True
     fired: List[str] = dataclasses.field(default_factory=list)
 
@@ -189,6 +193,9 @@ class FaultPlan:
         self._pserver_ack_counter = 0
         self._pserver_repl_counter = 0
         self._pserver_snap_counter = 0
+        self._arena_scatter_counter = 0
+        self._arena_adopt_counter = 0
+        self._arena_begin_counter = 0
 
     # -- bookkeeping ------------------------------------------------------
 
@@ -398,6 +405,53 @@ class FaultPlan:
 
         supervisor.sweep = sweep
         return supervisor
+
+    def wrap_arena(self, arena):
+        """Install data-plane faults on a `serve.shm_arena.ShmArena`
+        through its `fault_hook` seam (same idiom as the page pool's
+        hook). Three schedules, all 0-based:
+
+        - `arena_kill_scatter_at`: SIGKILL THIS process right after
+          the nth segment's bytes are written (and before the ticket
+          exists anywhere) — the source dying mid-scatter. The
+          segments are left SCATTER-state with a dead owner pid: only
+          the orphan-reclaim sweep can free them.
+        - `arena_kill_adopt_at`: SIGKILL right before the nth
+          adoption stamp — the destination dying mid-adopt, AFTER the
+          bytes were gathered. The source still owns the segments.
+        - `arena_error_at`: the nth `scatter()` call raises
+          FaultError-shaped `ArenaError` BEFORE claiming anything —
+          the deterministic trigger for the pickle-fallback parity
+          tests (never a half-claimed ticket)."""
+        from paddle_tpu.serve.shm_arena import ArenaError
+        plan = self
+
+        def hook(event: str, ctx: dict) -> None:
+            if event == "scatter_begin":
+                idx = plan._arena_begin_counter
+                plan._arena_begin_counter += 1
+                if (idx == plan.arena_error_at
+                        and not plan._spent("arenaerr")):
+                    plan._note("arenaerr", idx)
+                    raise ArenaError(
+                        f"injected arena fault at scatter {idx}")
+            elif event == "scatter":
+                idx = plan._arena_scatter_counter
+                plan._arena_scatter_counter += 1
+                if (idx == plan.arena_kill_scatter_at
+                        and not plan._spent("arenakillsc")):
+                    plan._note("arenakillsc", idx)
+                    os.kill(os.getpid(), signal.SIGKILL)
+            elif event == "adopt":
+                idx = plan._arena_adopt_counter
+                plan._arena_adopt_counter += 1
+                if (idx == plan.arena_kill_adopt_at
+                        and not plan._spent("arenakillad")):
+                    plan._note("arenakillad", idx)
+                    os.kill(os.getpid(), signal.SIGKILL)
+
+        arena.fault_hook = hook
+        return arena
 
     def wrap_cluster(self, supervisor, agents, *, clock, service,
                      settle_timeout_s: float = 30.0):
@@ -864,3 +918,19 @@ class _FlakyCheckpoints:
 
     def __getattr__(self, name):
         return getattr(self._manager, name)
+
+
+def build_chaos_replica(fault_plan: Optional[dict] = None, **kwargs):
+    """Spawn-importable `ReplicaSpec` builder for data-plane chaos:
+    `serve.fleet.build_server_from_config` plus a `FaultPlan` armed
+    on the replica's OWN arena handle (`fault_plan` is the plan's
+    kwargs — plain data, as the spawn boundary requires). The chaos
+    suite points prefill/decode children here to die by SIGKILL
+    mid-scatter or mid-adopt inside a REAL process, then proves the
+    supervisor's orphan-reclaim sweep frees every segment."""
+    from paddle_tpu.serve.fleet import build_server_from_config
+
+    server = build_server_from_config(**kwargs)
+    if fault_plan and server.data_plane is not None:
+        FaultPlan(**fault_plan).wrap_arena(server.data_plane)
+    return server

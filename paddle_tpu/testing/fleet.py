@@ -133,7 +133,11 @@ class _IdleServer:
         raise ValueError("idle test replica accepts no traffic")
 
 
-def idle_server() -> _IdleServer:
+def idle_server(*, data_plane: Optional[str] = None) -> _IdleServer:
+    # `data_plane` arrives when the supervisor owns an arena (the
+    # name is injected into every replica's kwargs); an idle replica
+    # serves no KV so it simply declines to attach.
+    del data_plane
     return _IdleServer()
 
 
@@ -159,6 +163,31 @@ def orphan_cluster_main(conn) -> None:
         agent_pids.append(a.pid)
         replica_pids.extend(info["pids"])
     conn.send(agent_pids + replica_pids)
+    while True:
+        time.sleep(3600)        # waiting for SIGKILL
+
+
+def orphan_data_fleet_main(conn) -> None:
+    """Subprocess driver for the DATA-PLANE orphan test: become a
+    supervisor that owns the fleet's shared-memory arena, scatter a
+    payload into it (this process is the segments' owner), report
+    {arena name, ticket, replica pids} up the pipe, then park until
+    SIGKILLed. The test asserts the whole tree dies on the watchdog
+    chain (no drain, no atexit — the arena's unlink never ran) and
+    that attaching to the orphaned arena BY NAME still reclaims every
+    dead-owner segment: shared memory has no kernel-mediated cleanup,
+    so the reclaim sweep is the only thing standing between a
+    supervisor SIGKILL and a permanent /dev/shm leak."""
+    from paddle_tpu.serve.fleet import FleetSupervisor, ReplicaSpec
+
+    spec = ReplicaSpec(builder="paddle_tpu.testing.fleet:idle_server")
+    sup = FleetSupervisor(spec, min_replicas=2, max_replicas=2,
+                          data_plane_segs=8, data_plane_seg_kb=1)
+    sup.start()
+    ticket = sup.arena.scatter([b"orphaned kv bytes " * 64])
+    conn.send({"arena": sup.arena.name, "ticket": ticket,
+               "pids": [p.pid for p in sup.procs.values()
+                        if p is not None]})
     while True:
         time.sleep(3600)        # waiting for SIGKILL
 

@@ -34,6 +34,13 @@
 #                                       #   drain, the SIGKILL-under-
 #                                       #   live-HTTP-load chaos case,
 #                                       #   then bench.py --edge-only)
+#     scripts/fault_smoke.sh data       # just the zero-copy data-
+#                                       #   plane lane (shm arena
+#                                       #   SIGKILL source/dst chaos,
+#                                       #   orphan reclaim after
+#                                       #   supervisor death, fallback
+#                                       #   parity, then bench.py
+#                                       #   --data-only)
 #     scripts/fault_smoke.sh -k serve   # just the serving chaos suite
 #
 # CPU-only and deterministic (testing.faults FaultPlan + ManualClock;
@@ -68,6 +75,15 @@ elif [ "$1" = "edge" ]; then
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m "edge and faults" -p no:cacheprovider "$@"
     exec env JAX_PLATFORMS=cpu python bench.py --edge-only
+elif [ "$1" = "data" ]; then
+    # the whole zero-copy data-plane lane, INCLUDING the heavyweight
+    # real-process SIGKILL chaos cases tier-1 excludes, then the A/B
+    # stage (bytes-copied + migration latency vs the pickle path,
+    # coalesced per-sweep frame count)
+    shift
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m "data and faults" -p no:cacheprovider "$@"
+    exec env JAX_PLATFORMS=cpu python bench.py --data-only
 elif [ "$1" = "elastic" ]; then
     # the whole elastic lane, INCLUDING the slow wedge-fencing case
     # tier-1 excludes, then the perf stage (memory win, sharded-update

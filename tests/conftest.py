@@ -143,6 +143,15 @@ def pytest_configure(config):
         "gang supervision chaos) — fast cases run IN tier-1, the "
         "real-process chaos cases are heavyweight/slow; `-m elastic` "
         "(or `scripts/fault_smoke.sh elastic`) runs the lane alone")
+    config.addinivalue_line(
+        "markers", "data: zero-copy data-plane suite "
+        "(serve.shm_arena: shared-memory KV arena, orphan "
+        "reclamation, stale-ticket refusal, pickle-fallback parity, "
+        "batched control RPC) — fast cases run IN tier-1, the "
+        "real-process SIGKILL chaos cases are heavyweight/slow; "
+        "`-m data` (or `scripts/fault_smoke.sh data`, which runs "
+        "-m 'data and faults' plus `bench.py --data-only`) runs the "
+        "lane alone")
 
 
 def pytest_runtest_logreport(report):
