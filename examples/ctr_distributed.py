@@ -73,6 +73,71 @@ def run_pserver_demo(args):
                   f"{client.stats}")
 
 
+def run_online_demo(args):
+    """The full production loop in one process: a `TaskQueue` streams
+    training tasks into a `StreamingTrainer` (no pass barrier — tasks
+    flow continuously, pushes numbered by the exactly-once epoch
+    watermark), the pushed rows land on `native.pserver` shards, and a
+    `TieredEmbedCache` + `CtrServer` serve scores concurrently — the
+    cache hears every push ACK through `bind_push_feed` and never
+    serves a row staler than `max_staleness` pushes
+    (docs/SERVING.md "Tiered embedding serving")."""
+    import json
+
+    from paddle_tpu.native.pserver import PServerGroup
+    from paddle_tpu.native.taskqueue import TaskQueue
+    from paddle_tpu.parallel.pserver_client import (PServerClient,
+                                                    PServerEmbedding)
+    from paddle_tpu.serve.ctr import CtrServer, init_tower
+    from paddle_tpu.serve.embed_cache import TieredEmbedCache
+    from paddle_tpu.train.online import StreamingTrainer
+
+    vocab = (args.vocab // 4) * 4
+    with PServerGroup(vocab, args.dim, n_shards=4) as group:
+        push = PServerClient(group.specs, args.dim, trainer_id=0)
+        push.register()
+        emb = PServerEmbedding(push)
+        table = emb.init(jax.random.key(0))
+
+        queue = TaskQueue(timeout_ms=2000, max_retries=3)
+        for i in range(args.steps):
+            queue.add_task(json.dumps(
+                {"seed": i, "batch": 8, "slots": 4,
+                 "vocab": vocab}).encode())
+        trainer = StreamingTrainer(queue, emb, table, lr=0.05)
+
+        read = PServerClient(group.specs, args.dim, trainer_id=1)
+        read.register()
+        cache = TieredEmbedCache(PServerEmbedding(read), table,
+                                 hot_rows=1024, host_rows=4096,
+                                 max_staleness=4)
+        cache.bind_push_feed(push)
+        server = CtrServer(cache, init_tower(jax.random.key(1),
+                                             args.dim),
+                           slots=args.slots, max_batch=8)
+
+        rs = np.random.RandomState(7)
+        served = 0
+        while trainer.stats["tasks_done"] < args.steps:
+            trainer.step()               # streams: no pass barrier
+            ids = rs.randint(0, vocab, (4, args.slots))
+            scores = server.score(ids.astype(np.int64))
+            served += len(scores)
+            cache.refresh_stale()        # maintenance tick, off path
+            if trainer.stats["tasks_done"] % 10 == 0:
+                c = cache.counters()
+                print(f"streamed {trainer.stats['tasks_done']:3d} "
+                      f"tasks | served {served:4d} scores | cache "
+                      f"hits {c['hits_device']} misses {c['misses']} "
+                      f"stale-refills {c['stale_refills']}")
+        rec = cache.reconcile([p.stats() for p in group.primaries])
+        print(f"stream drained: trainer {trainer.stats} | "
+              f"reconcile ok={rec['ok']} watermarks_match="
+              f"{rec.get('watermarks_match_push_ledger')}")
+        push.close()
+        read.close()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=60)
@@ -84,8 +149,16 @@ def main():
                     help="train the sparse tail against a local "
                          "fault-tolerant parameter-server tier (and "
                          "kill a primary midway to show failover)")
+    ap.add_argument("--online", action="store_true",
+                    help="stream tasks through a StreamingTrainer into "
+                         "the pserver tier while a TieredEmbedCache + "
+                         "CtrServer serve scores concurrently — the "
+                         "production online-learning loop")
     args = ap.parse_args()
 
+    if args.online:
+        run_online_demo(args)
+        return
     if args.pserver:
         run_pserver_demo(args)
         return

@@ -65,7 +65,7 @@ log = logging.getLogger(__name__)
 
 OP_REGISTER = 1      # <q trainer_id, <d ttl_s
 OP_HEARTBEAT = 2     # <q trainer_id, <Q token
-OP_GET_ROWS = 3      # <I n, n * <q global row ids
+OP_GET_ROWS = 3      # <I n, n * <q global row ids -> <IQ n wm, f32 rows
 OP_PUSH = 4          # <q trainer, <Q epoch, <d lr, <I n, ids, f32 grads
 OP_FINISH_PASS = 5   # <q trainer, <Q token
 OP_PASS_STATE = 6    # -> <q pass_num, <B all_finished
@@ -73,6 +73,7 @@ OP_STATS = 7         # -> json
 OP_LOAD = 8          # <q row_lo, <I n, f32 rows (SET — idempotent init)
 OP_REPL = 9          # primary->backup: <B kind, <Q version, kind body
 OP_SYNC = 10         # -> full shard state (restart catch-up)
+OP_WATERMARK = 11    # -> <Q version (cheap staleness probe, no payload)
 
 ST_OK = 0
 ST_DUP = 1           # push epoch already applied — ACK without applying
@@ -332,8 +333,8 @@ class PServerShard:
         self._pass_num = 0
         self._pass_finished: set = set()
         self._stats = {"pushes": 0, "duplicates": 0, "gets": 0,
-                       "lease_expirations": 0, "repl_records": 0,
-                       "repl_resyncs": 0}
+                       "probes": 0, "lease_expirations": 0,
+                       "repl_records": 0, "repl_resyncs": 0}
         if snapshot_dir:
             os.makedirs(snapshot_dir, exist_ok=True)
             snap = self.snapshot_path
@@ -490,6 +491,8 @@ class PServerShard:
 
     # -- leases ----------------------------------------------------------
 
+    # locklint: holds-lock(called from _dispatch inside its
+    # `with self._lock:` block)
     def _expire_leases(self) -> None:
         for t in self._leases.expire():
             # an expired lease releases the trainer's in-flight
@@ -506,6 +509,8 @@ class PServerShard:
         lease = self._leases.get(trainer)
         return lease is not None and lease.token == token
 
+    # locklint: holds-lock(both callers — _expire_leases and
+    # _h_finish_pass — run inside _dispatch's `with self._lock:` block)
     def _check_pass_done(self) -> None:
         if self._leases and self._pass_finished >= set(self._leases):
             self._pass_num += 1
@@ -589,8 +594,17 @@ class PServerShard:
                 return self._h_repl(body)
             if op == OP_SYNC:
                 return self._h_sync()
+            if op == OP_WATERMARK:
+                # the cheap invalidation probe: a caching reader pays
+                # 9 bytes, not a row payload, to learn whether pushes
+                # landed since it last filled
+                self._stats["probes"] += 1
+                return (bytes([ST_OK])
+                        + struct.pack("<Q", self.state.version))
         return bytes([ST_ERR]) + f"unknown op {op}".encode()
 
+    # locklint: holds-lock(every handler runs inside _dispatch's
+    # `with self._lock:` block)
     def _h_register(self, body: bytes) -> bytes:
         trainer, ttl = struct.unpack_from("<qd", body)
         token = self._leases.grant(trainer,
@@ -606,20 +620,31 @@ class PServerShard:
                 + struct.pack("<QqQ", token, self._pass_num,
                               self.state.epochs.get(trainer, 0)))
 
+    # locklint: holds-lock(every handler runs inside _dispatch's
+    # `with self._lock:` block)
     def _h_heartbeat(self, body: bytes) -> bytes:
         trainer, token = struct.unpack_from("<qQ", body)
         if not self._leases.renew(trainer, token):
             return bytes([ST_LEASE_EXPIRED])
         return bytes([ST_OK])
 
+    # locklint: holds-lock(every handler runs inside _dispatch's
+    # `with self._lock:` block)
     def _h_get_rows(self, body: bytes) -> bytes:
         (n,) = struct.unpack_from("<I", body)
         ids = np.frombuffer(body, np.int64, n, offset=4)
+        self._fault("get_recv")
         self._stats["gets"] += 1
         rows = self.state.take_rows(ids)
-        return (bytes([ST_OK]) + struct.pack("<I", n)
+        # the reply carries the shard's applied-update watermark next to
+        # the rows: both are read under the dispatch lock, so a caching
+        # reader can stamp every filled row with the exact version it
+        # reflects (the push-watermark invalidation protocol)
+        return (bytes([ST_OK]) + struct.pack("<IQ", n, self.state.version)
                 + np.ascontiguousarray(rows, np.float32).tobytes())
 
+    # locklint: holds-lock(every handler runs inside _dispatch's
+    # `with self._lock:` block)
     def _h_push(self, body: bytes) -> bytes:
         trainer, epoch, lr, n = struct.unpack_from("<qQdI", body)
         off = struct.calcsize("<qQdI")
@@ -642,8 +667,15 @@ class PServerShard:
         else:
             self._stats["duplicates"] += 1
         self._fault("push_pre_ack")
-        return bytes([ST_OK if applied else ST_DUP])
+        # every push ACK rides the post-apply watermark, so the pushing
+        # trainer (and anything sharing its client's on_watermark seam,
+        # e.g. a co-resident read cache) learns the shard moved without
+        # a second RPC
+        return (bytes([ST_OK if applied else ST_DUP])
+                + struct.pack("<Q", self.state.version))
 
+    # locklint: holds-lock(every handler runs inside _dispatch's
+    # `with self._lock:` block)
     def _h_finish_pass(self, body: bytes) -> bytes:
         trainer, token = struct.unpack_from("<qQ", body)
         if not self._lease_ok(trainer, token):
@@ -653,6 +685,8 @@ class PServerShard:
         return (bytes([ST_OK]) + struct.pack("<q", self._pass_num)
                 + struct.pack("<B", not self._pass_finished))
 
+    # locklint: holds-lock(every handler runs inside _dispatch's
+    # `with self._lock:` block)
     def _h_load(self, body: bytes) -> bytes:
         row_lo, n = struct.unpack_from("<qI", body)
         vals = np.frombuffer(
@@ -690,6 +724,8 @@ class PServerShard:
         self._repl.send(bytes([OP_REPL])
                         + struct.pack("<Q", self.state.version) + record)
 
+    # locklint: holds-lock(every handler runs inside _dispatch's
+    # `with self._lock:` block)
     def _h_repl(self, body: bytes) -> bytes:
         (version,) = struct.unpack_from("<Q", body)
         kind = body[8]

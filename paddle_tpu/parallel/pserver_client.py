@@ -49,6 +49,7 @@ from paddle_tpu.native.pserver import (
     OP_PUSH,
     OP_REGISTER,
     OP_STATS,
+    OP_WATERMARK,
     ST_DUP,
     ST_LEASE_EXPIRED,
     ST_OK,
@@ -98,6 +99,14 @@ class ShardConn:
         self._active = 0
         self._sock: Optional[socket.socket] = None
         self._closed = False
+        # failover ledger: bumped every time the chain advances to a
+        # different endpoint. A caching reader snapshots this to learn
+        # "the answering server may have changed" — the conservative
+        # re-validate trigger (chain replication keeps a backup a
+        # PREFIX of its primary, so a failover can legally rewind the
+        # watermark; rows filled from the old primary must not be
+        # trusted against the new authority).
+        self.failovers = 0
 
     @property
     def active_endpoint(self) -> Tuple[str, int]:
@@ -105,6 +114,7 @@ class ShardConn:
 
     def _advance(self) -> None:
         self._active = (self._active + 1) % len(self.endpoints)
+        self.failovers += 1
 
     def _connect(self) -> None:
         sock = socket.create_connection(self.active_endpoint,
@@ -182,6 +192,7 @@ class ShardConn:
         self.endpoints = [tuple(e) for e in endpoints]
         self._active = 0
         self._drop()
+        self.failovers += 1    # new chain == possibly-new authority
 
     def close(self) -> None:
         self._closed = True
@@ -260,6 +271,17 @@ class PServerClient:
                        for i, s in enumerate(specs)]
         self._tokens: List[Optional[int]] = [None] * len(specs)
         self._epochs = [0] * len(specs)
+        # last applied-update watermark each shard reported on ANY reply
+        # (get_rows, push ACK, explicit probe) — the freshness ledger the
+        # embed-cache invalidation protocol reads. A value can REGRESS
+        # after failover (a backup is a prefix of its primary); consumers
+        # of on_watermark must treat a rewind as "re-validate everything".
+        self.watermarks = [0] * len(specs)
+        # seam: fires as (shard, new_wm, prev_wm) after any reply carries
+        # a watermark, inside the client lock — the subscriber (the
+        # tiered cache) must only touch its own state, never call back
+        # into this client
+        self.on_watermark: Optional[Callable[[int, int, int], None]] = None
         # REENTRANT: every public RPC entry point takes it (the
         # heartbeat thread shares the per-shard sockets with the caller
         # — an unlocked send/recv pair would desync the framing), and
@@ -270,7 +292,8 @@ class PServerClient:
         self._hb_stop = threading.Event()
         self._hb_thread: Optional[threading.Thread] = None
         self.stats = {"pushes": 0, "duplicate_acks": 0,
-                      "reregistrations": 0, "pulls": 0}
+                      "reregistrations": 0, "pulls": 0,
+                      "watermark_polls": 0}
         # observability seam (the PagePool.obs_hook idiom): fires AFTER
         # an RPC settles, exceptions swallowed — ResilientTrainer points
         # this at the live step span so push/pull land on its trail.
@@ -400,6 +423,49 @@ class PServerClient:
         owner[(ids < 0) | (ids >= self.num_rows)] = -1
         return owner
 
+    def owner_of(self, ids) -> np.ndarray:
+        """Public routing map (the cache's shard-stamping entry point):
+        [K] global ids -> [K] owning shard index, -1 for out-of-range."""
+        return self._owner_of(
+            np.ascontiguousarray(np.asarray(ids).reshape(-1), np.int64))
+
+    @property
+    def n_shards(self) -> int:
+        return len(self.specs)
+
+    # locklint: holds-lock(called from get_rows/_push_shard/
+    # poll_watermarks under the reentrant self._lock)
+    def _note_watermark(self, s: int, wm: int) -> None:
+        prev = self.watermarks[s]
+        self.watermarks[s] = wm
+        hook = self.on_watermark
+        if hook is not None and wm != prev:
+            try:
+                hook(s, wm, prev)
+            except Exception:
+                pass    # observability seam, never the data plane
+
+    def shard_failovers(self) -> List[int]:
+        """Per-shard count of chain advances (endpoint changes) so far —
+        a caching reader diffs consecutive snapshots to detect "a
+        different server may be answering now" and re-validates."""
+        with self._lock:
+            return [c.failovers for c in self._conns]
+
+    def poll_watermarks(self) -> List[int]:
+        """One OP_WATERMARK probe per shard: refresh the freshness
+        ledger without moving any row bytes. This is the bounded-
+        staleness heartbeat for an all-hit cache (misses and pushes
+        refresh the ledger for free on their own replies)."""
+        with self._lock:
+            for s in range(len(self.specs)):
+                resp = self._conns[s].call(bytes([OP_WATERMARK]))
+                self._check(resp, "watermark")
+                (wm,) = struct.unpack_from("<Q", resp, 1)
+                self._note_watermark(s, int(wm))
+            self.stats["watermark_polls"] += 1
+            return list(self.watermarks)
+
     # -- data plane ------------------------------------------------------
 
     def get_rows(self, ids) -> np.ndarray:
@@ -419,10 +485,11 @@ class PServerClient:
                     bytes([OP_GET_ROWS]) + struct.pack("<I", sub.size)
                     + sub.tobytes())
                 self._check(resp, "get_rows")
-                (n,) = struct.unpack_from("<I", resp, 1)
+                n, wm = struct.unpack_from("<IQ", resp, 1)
                 rows = np.frombuffer(resp, np.float32, n * dim,
-                                     offset=5).reshape(n, dim)
+                                     offset=13).reshape(n, dim)
                 out[sel] = rows
+                self._note_watermark(s, int(wm))
             self.stats["pulls"] += 1
         self._obs("pserver_pull", rows=int(ids.shape[0]))
         return out
@@ -461,6 +528,11 @@ class PServerClient:
             if self._tokens[s] is None:
                 self._register_shard(s)
             resp = self._conns[s].call(payload)
+            if resp[0] in (ST_OK, ST_DUP) and len(resp) >= 9:
+                # push ACKs carry the post-apply shard watermark — the
+                # pushing process's cache invalidates without a probe
+                (wm,) = struct.unpack_from("<Q", resp, 1)
+                self._note_watermark(s, int(wm))
             if resp[0] == ST_OK:
                 self.stats["pushes"] += 1
                 self._obs("pserver_push", shard=s, epoch=epoch,
@@ -700,3 +772,26 @@ class PServerEmbedding:
     def alltoall_push_row_grads(self, table, ids, row_grads, lr, *,
                                 capacity=None):
         return self.apply_row_grads(table, ids, row_grads, lr)
+
+    # -- cache-backing surface (parallel.sparse.LookupSurface) ---------
+
+    def pull_rows(self, table, ids):
+        """Host-side read-through entry point for the tiered cache:
+        [K] ids -> ([K, D] float32 host rows, per-shard watermark list
+        as of each shard's reply). One RPC per owning shard per call —
+        the batched miss-fill contract."""
+        rows = self.client.get_rows(np.asarray(ids))
+        return rows, list(self.client.watermarks)
+
+    def owner_of(self, ids) -> np.ndarray:
+        return self.client.owner_of(ids)
+
+    @property
+    def n_shards(self) -> int:
+        return self.client.n_shards
+
+    def poll_watermarks(self, table):
+        return self.client.poll_watermarks()
+
+    def shard_failovers(self):
+        return self.client.shard_failovers()

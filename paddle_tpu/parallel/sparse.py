@@ -21,7 +21,7 @@ parameter/FirstOrderOptimizer.h SparseMomentum analog).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Protocol, runtime_checkable
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +33,44 @@ from paddle_tpu.parallel import compat
 
 from paddle_tpu.core.mesh import MODEL_AXIS
 from paddle_tpu.ops.embedding import combine_bags
+
+
+@runtime_checkable
+class LookupSurface(Protocol):
+    """The ONE shared lookup surface every embedding backing exposes —
+    `ShardedEmbedding`, `HostOffloadEmbedding` and the pserver-backed
+    `PServerEmbedding` all satisfy it structurally, so call sites (the
+    CTR models, the tiered embed cache, the streaming trainer) swap
+    backings without a single isinstance check.
+
+    Contract highlights shared by every implementation:
+      - `lookup(table, ids)`: [K] ids -> [K, D] rows ON DEVICE;
+        out-of-range ids (e.g. -1 padding) give ZERO vectors;
+      - `apply_row_grads(table, ids, row_grads, lr)`: row-sparse SGD,
+        padding ids dropped (`masked_row_delta` is the one home of that
+        rule), returns the updated table handle;
+      - `alltoall_lookup` / `alltoall_push_row_grads`: the capacity-
+        bounded aliases the distributed CTR call sites use — single-
+        process backings honor `return_overflow` with a zero counter.
+
+    Backings that can serve a read-through cache additionally expose
+    the `pull_rows`/`owner_of`/`n_shards`/`poll_watermarks`/
+    `shard_failovers` surface (see serve.embed_cache.CacheBacking)."""
+
+    vocab: int
+    dim: int
+
+    def init(self, rng): ...
+
+    def lookup(self, table, ids): ...
+
+    def apply_row_grads(self, table, ids, row_grads, lr): ...
+
+    def alltoall_lookup(self, table, ids, *, capacity=None,
+                        return_overflow: bool = False): ...
+
+    def alltoall_push_row_grads(self, table, ids, row_grads, lr, *,
+                                capacity=None): ...
 
 
 def shard_rows(table, mesh: Mesh, axis: str = MODEL_AXIS):
@@ -379,9 +417,14 @@ class HostOffloadEmbedding:
     touched rows cross PCIe — the HBM never sees the [V, D] table. The
     row-sparse SGD update scatters back on the host the same way.
 
-    Same call surface as ShardedEmbedding's local path (init / lookup /
-    apply_row_grads), single-process; combine with ShardedEmbedding when
-    the table also spans hosts.
+    Same call surface as ShardedEmbedding/PServerEmbedding (the
+    `LookupSurface` protocol: init / lookup / apply_row_grads + the
+    alltoall_* aliases), single-process; combine with ShardedEmbedding
+    when the table also spans hosts. Also exposes the cache-backing
+    quintet (pull_rows/owner_of/n_shards/poll_watermarks/
+    shard_failovers) in its degenerate single-authority form, so the
+    tiered embed cache slots in front of it exactly as it does in
+    front of the pserver tier — no isinstance checks anywhere.
     """
 
     def __init__(self, vocab: int, dim: int, *, init_scale: float = 0.01,
@@ -480,6 +523,46 @@ class HostOffloadEmbedding:
         # annotate_device_placement inside the host region has no
         # registered lowering on some backends.)
         return new_table
+
+    # aliases matching the ShardedEmbedding/PServerEmbedding call
+    # sites (the signature drift the lookup-surface unification fixed:
+    # this backing was the only one missing them, so swapping it into
+    # a distributed CTR call site used to AttributeError)
+    def alltoall_lookup(self, table, ids, *, capacity=None,
+                        return_overflow: bool = False):
+        out = self.lookup(table, ids)
+        if return_overflow:
+            return out, jnp.zeros((), jnp.int32)
+        return out
+
+    def alltoall_push_row_grads(self, table, ids, row_grads, lr, *,
+                                capacity=None):
+        return self.apply_row_grads(table, ids, row_grads, lr)
+
+    # -- cache-backing surface (degenerate single-authority forms) -----
+
+    def pull_rows(self, table, ids):
+        """[K] ids -> ([K, D] float32 host rows, watermarks=None).
+        A host-offload table has no push ledger — None tells the cache
+        to run in static-source mode (entries never go stale; explicit
+        invalidate_all() is the only eviction besides capacity)."""
+        return np.asarray(self.lookup(table, ids), np.float32), None
+
+    def owner_of(self, ids) -> np.ndarray:
+        ids = np.asarray(ids).reshape(-1)
+        owner = np.zeros(ids.shape[0], np.int64)
+        owner[(ids < 0) | (ids >= self.vocab)] = -1
+        return owner
+
+    @property
+    def n_shards(self) -> int:
+        return 1
+
+    def poll_watermarks(self, table):
+        return None
+
+    def shard_failovers(self):
+        return [0]
 
     def update(self, table, ids, row_grads, lr):
         """Jitted row-sparse update whose output table STAYS pinned in

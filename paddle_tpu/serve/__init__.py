@@ -11,6 +11,8 @@ from paddle_tpu.serve.artifact import (
     save_engine_artifact,
 )
 from paddle_tpu.serve import quant
+from paddle_tpu.serve.ctr import CtrServer, init_tower
+from paddle_tpu.serve.embed_cache import CacheBacking, TieredEmbedCache
 from paddle_tpu.serve.engine import (DecodeEngine, EngineState,
                                      PoolStats, PrefillTicket)
 from paddle_tpu.serve.fleet import (AutoscalePolicy, FleetSupervisor,

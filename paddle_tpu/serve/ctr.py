@@ -1,0 +1,163 @@
+"""CTR serving: feature ids -> cached gather -> dense tower forward.
+
+The second first-class serving scenario next to LLM decode (ROADMAP
+item 5; reference: the Paddle heritage's production workload). A
+request is a batch of examples, each a fixed number of feature-id
+slots (-1 pads empty slots); scoring is
+
+    rows   = TieredEmbedCache.lookup(ids)        # the hot-row tier
+    pooled = mean over valid slots               # per example
+    score  = sigmoid(relu(pooled @ w1 + b1) @ w2 + b2)
+
+The tower runs as ONE jitted program over fixed [max_batch, slots]
+shapes (requests pad up), so steady-state serving is zero-recompile
+end to end: the cache's gather and the tower forward both reuse their
+first-trace executables. `CtrServer` slots behind the HTTP edge via
+`HttpEdge(router, ctr=server)` — CTR traffic enters the same front
+door as generation traffic and answers on POST /v1/ctr/score.
+
+Observability: per-request spans on the shared tracer (gather/forward
+events ride the request's trail), a request-latency histogram, and the
+request ledger as a read-through registry source; the cache exports
+its own hit/miss/stale/invalidation ledger next to it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 1.0)
+
+
+def init_tower(rng, dim: int, hidden: int = 16) -> dict:
+    """Dense tower params (host-seeded, tiny — the sparse table is the
+    big state and it lives behind the cache's backing)."""
+    import jax
+
+    seed = np.asarray(jax.random.key_data(rng)).ravel()
+    host = np.random.default_rng([int(s) for s in seed])
+    return {
+        "w1": np.asarray(host.standard_normal((dim, hidden)) * 0.1,
+                         np.float32),
+        "b1": np.zeros(hidden, np.float32),
+        "w2": np.asarray(host.standard_normal(hidden) * 0.1, np.float32),
+        "b2": np.zeros((), np.float32),
+    }
+
+
+class CtrServer:
+    """The CTR request path over one `TieredEmbedCache` + dense tower.
+
+    `score(ids)` takes [b, s] int feature ids (-1 pads), b <=
+    `max_batch`, s <= `slots`, and returns [b] float32 click
+    probabilities (numpy, host-side — the response is JSON anyway).
+    `score_request(payload)` is the HTTP-edge entry point."""
+
+    def __init__(self, cache, tower: dict, *, slots: int = 16,
+                 max_batch: int = 8, registry=None, tracer=None,
+                 name: str = "ctr",
+                 clock: Callable[[], float] = time.monotonic):
+        import jax
+        import jax.numpy as jnp
+
+        self.cache = cache
+        self.slots = int(slots)
+        self.max_batch = int(max_batch)
+        self.name = name
+        self.clock = clock
+        self.tracer = tracer
+        self._jax = jax
+        self._tower = jax.device_put(
+            {k: jnp.asarray(v) for k, v in tower.items()})
+        self._next_rid = 0
+        self._stats: Dict[str, int] = {
+            "requests": 0, "examples": 0, "rejected": 0,
+        }
+        self._lat_hist = None
+        if registry is not None:
+            registry.register_source(name, self.counters)
+            self._lat_hist = registry.histogram(
+                f"{name}_request_seconds",
+                "CTR scoring latency per request (gather + tower)",
+                buckets=_LATENCY_BUCKETS)
+
+        b, s = self.max_batch, self.slots
+
+        def _forward(tw, vecs, mask):
+            # vecs: [B*S, D] from the cache gather; padding slots are
+            # already zero rows, so the masked mean only needs counts
+            d = vecs.shape[-1]
+            v = vecs.reshape(b, s, d)
+            cnt = jnp.maximum(mask.sum(axis=1, keepdims=True), 1.0)
+            pooled = v.sum(axis=1) / cnt
+            h = jnp.maximum(pooled @ tw["w1"] + tw["b1"], 0.0)
+            logit = h @ tw["w2"] + tw["b2"]
+            return jax.nn.sigmoid(logit)
+
+        self._forward = jax.jit(_forward)
+
+    def counters(self) -> Dict[str, int]:
+        return dict(self._stats)
+
+    def score(self, ids) -> np.ndarray:
+        """[b, s] feature ids (-1 pads) -> [b] click probabilities."""
+        jax = self._jax
+        ids = np.asarray(ids, np.int64)
+        if ids.ndim != 2:
+            raise ValueError(f"ids must be [batch, slots], got shape "
+                             f"{ids.shape}")
+        b, s = ids.shape
+        if b > self.max_batch or s > self.slots:
+            self._stats["rejected"] += 1
+            raise ValueError(
+                f"request [{b}, {s}] exceeds the server's fixed "
+                f"[{self.max_batch}, {self.slots}] bucket")
+        t0 = self.clock()
+        rid = self._next_rid
+        self._next_rid += 1
+        span = None
+        if self.tracer is not None:
+            span = self.tracer.start(f"{self.name}{rid}", "ctr.request",
+                                     batch=b)
+        try:
+            padded = np.full((self.max_batch, self.slots), -1, np.int64)
+            padded[:b, :s] = ids
+            rows = self.cache.lookup(padded.reshape(-1))
+            if span is not None:
+                span.event("gather",
+                           rows=int(np.count_nonzero(padded >= 0)))
+            mask = jax.device_put(
+                (padded >= 0).astype(np.float32))
+            scores = self._forward(self._tower, rows, mask)
+            out = np.asarray(scores, np.float32)[:b]
+            if span is not None:
+                span.event("forward")
+        except BaseException:
+            if span is not None:
+                self.tracer.end(span, "error")
+            raise
+        self._stats["requests"] += 1
+        self._stats["examples"] += b
+        if self._lat_hist is not None:
+            self._lat_hist.observe(self.clock() - t0)
+        if span is not None:
+            self.tracer.end(span, "ok")
+        return out
+
+    def score_request(self, payload: dict) -> dict:
+        """The HTTP front-door entry: ``{"ids": [[...], ...]}`` ->
+        ``{"scores": [...], "batch": b}``. Malformed payloads raise
+        ValueError (the edge maps it to 400); oversize batches too."""
+        if not isinstance(payload, dict):
+            raise ValueError("body must be a JSON object")
+        try:
+            ids = np.asarray(payload["ids"], np.int64)
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"ids malformed: {e}")
+        scores = self.score(ids)
+        return {"scores": [float(x) for x in scores],
+                "batch": int(ids.shape[0])}

@@ -131,8 +131,13 @@ class HttpEdge:
                  poll_s: float = 0.05,
                  stream_poll_s: float = 0.002,
                  retry_after_s: float = 1.0,
-                 drain_report_path: Optional[str] = None):
+                 drain_report_path: Optional[str] = None,
+                 ctr=None):
         self.router = router
+        # optional CTR scoring backend (serve.ctr.CtrServer): mounts
+        # POST /v1/ctr/score so recommender traffic enters the same
+        # front door as generation traffic
+        self.ctr = ctr
         self._sweep_fn = sweep_fn if sweep_fn is not None else router.sweep
         self._submit_fn = (submit_fn if submit_fn is not None
                            else router.submit)
@@ -163,6 +168,7 @@ class HttpEdge:
             "connections": 0, "requests": 0, "completed": 0,
             "disconnect_cancels": 0, "shed_429": 0, "shed_503": 0,
             "malformed_400": 0, "hangups": 0, "active_streams": 0,
+            "ctr_requests": 0,
         }
         self._ttft_hist = None
         self._itg_hist = None
@@ -522,6 +528,22 @@ class HttpEdge:
                     + b"Connection: close\r\n\r\n" + text)
             except (ConnectionError, OSError):
                 pass
+            return
+        if target == "/v1/ctr/score":
+            if method != "POST":
+                raise _HttpReject(405, f"{method} on /v1/ctr/score")
+            if self.ctr is None:
+                raise _HttpReject(404, "no CTR backend bound")
+            try:
+                payload = json.loads(body.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError) as e:
+                raise _HttpReject(400, f"body is not JSON: {e}")
+            try:
+                result = self.ctr.score_request(payload)
+            except ValueError as e:
+                raise _HttpReject(400, str(e))
+            self._count("ctr_requests")
+            self._respond(conn, 200, result)
             return
         if target != "/v1/generate":
             raise _HttpReject(404, f"unknown target {target!r}")

@@ -163,6 +163,7 @@ class FaultPlan:
     gang_wedge_rank: int = 1                      # rank of the victim
     # -- parameter-server faults (native.pserver, via wrap_pserver_shard) --
     pserver_kill_push_at: Optional[int] = None    # nth push received
+    pserver_kill_get_at: Optional[int] = None     # nth get_rows received
     pserver_lost_ack_at: Optional[int] = None     # nth push ACK dropped
     pserver_replica_delay_at: Optional[int] = None  # nth repl record
     pserver_replica_delay_s: float = 0.0          # stall per delayed record
@@ -171,6 +172,8 @@ class FaultPlan:
     arena_kill_scatter_at: Optional[int] = None   # nth segment written
     arena_kill_adopt_at: Optional[int] = None     # nth segment adopted
     arena_error_at: Optional[int] = None          # nth scatter() call
+    # -- online-learning faults (train.online, via wrap_online_trainer) --
+    online_kill_step_at: Optional[int] = None     # nth streaming step
     once: bool = True
     fired: List[str] = dataclasses.field(default_factory=list)
 
@@ -190,12 +193,14 @@ class FaultPlan:
         self._fleet_sweep_counter = 0
         self._cluster_sweep_counter = 0
         self._pserver_push_counter = 0
+        self._pserver_get_counter = 0
         self._pserver_ack_counter = 0
         self._pserver_repl_counter = 0
         self._pserver_snap_counter = 0
         self._arena_scatter_counter = 0
         self._arena_adopt_counter = 0
         self._arena_begin_counter = 0
+        self._online_step_counter = 0
 
     # -- bookkeeping ------------------------------------------------------
 
@@ -571,6 +576,12 @@ class FaultPlan:
         - "push_pre_ack" (applied + replicated, reply unsent): the
           `pserver_lost_ack_at`-th push drops the connection — the
           lost-ACK shape whose retry the epoch watermark must DUP;
+        - "get_recv" (before rows are read): the
+          `pserver_kill_get_at`-th get_rows KILLS the shard — the
+          serving read path must fail over to the backup, and any
+          cache above it must notice the new authority (failover
+          counter) and re-validate, since a backup is a PREFIX of its
+          primary and the watermark may legally rewind;
         - "repl_apply" (backup side): the `pserver_replica_delay_at`-th
           replicated record sleeps `pserver_replica_delay_s` — a slow
           replica stretches the chain without breaking it;
@@ -590,6 +601,14 @@ class FaultPlan:
                     plan._note("pskill", idx)
                     raise _ps.KillShard(f"injected shard kill on push "
                                         f"#{idx}")
+            elif event == "get_recv":
+                idx = plan._pserver_get_counter
+                plan._pserver_get_counter += 1
+                if (idx == plan.pserver_kill_get_at
+                        and not plan._spent("psgetkill")):
+                    plan._note("psgetkill", idx)
+                    raise _ps.KillShard(f"injected shard kill on "
+                                        f"get_rows #{idx}")
             elif event == "push_pre_ack":
                 idx = plan._pserver_ack_counter
                 plan._pserver_ack_counter += 1
@@ -618,6 +637,31 @@ class FaultPlan:
 
         shard.fault_hook = hook
         return shard
+
+    # -- online-learning faults -------------------------------------------
+
+    def wrap_online_trainer(self, trainer):
+        """Install this plan on a `train.online.StreamingTrainer` via
+        its `fault_hook` seam: the `online_kill_step_at`-th streaming
+        step raises FaultError BEFORE the task is fetched — the
+        mid-stream death shape. The task's lease expires back to todo,
+        and a reformed trainer (same trainer_id, fresh client) must
+        resume with its push numbering adopted from the shard's applied
+        epochs, so the replayed task's pushes stay exactly-once."""
+        plan = self
+
+        def hook(event: str) -> None:
+            if event == "step":
+                idx = plan._online_step_counter
+                plan._online_step_counter += 1
+                if (idx == plan.online_kill_step_at
+                        and not plan._spent("onlinekill")):
+                    plan._note("onlinekill", idx)
+                    raise FaultError(f"injected online-trainer death "
+                                     f"at step #{idx}")
+
+        trainer.fault_hook = hook
+        return trainer
 
     # -- master-connection faults -----------------------------------------
 

@@ -41,6 +41,13 @@
 #                                       #   supervisor death, fallback
 #                                       #   parity, then bench.py
 #                                       #   --data-only)
+#     scripts/fault_smoke.sh ctr        # just the embedding-cache
+#                                       #   chaos lane (shard failover
+#                                       #   mid-traffic with the
+#                                       #   staleness bound held,
+#                                       #   reform-mid-stream exactly-
+#                                       #   once, then bench.py
+#                                       #   --ctr-only)
 #     scripts/fault_smoke.sh -k serve   # just the serving chaos suite
 #
 # CPU-only and deterministic (testing.faults FaultPlan + ManualClock;
@@ -75,6 +82,14 @@ elif [ "$1" = "edge" ]; then
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
         -m "edge and faults" -p no:cacheprovider "$@"
     exec env JAX_PLATFORMS=cpu python bench.py --edge-only
+elif [ "$1" = "ctr" ]; then
+    # the embedding-cache chaos lane (shard-failover-mid-traffic,
+    # reform-mid-stream), then the cached-vs-uncached lookup stage
+    # with its >=3x p99 gate and push-ledger reconciliation
+    shift
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+        -m "ctr and faults" -p no:cacheprovider "$@"
+    exec env JAX_PLATFORMS=cpu python bench.py --ctr-only
 elif [ "$1" = "data" ]; then
     # the whole zero-copy data-plane lane, INCLUDING the heavyweight
     # real-process SIGKILL chaos cases tier-1 excludes, then the A/B

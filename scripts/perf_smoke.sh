@@ -34,8 +34,23 @@
 #                                      # the disagg bench stage (p99
 #                                      # inter-token decode gap,
 #                                      # disaggregated vs unified A/B)
+#     scripts/perf_smoke.sh ctr        # embedding-cache lane only: the
+#                                      # tiered-cache + CTR serving +
+#                                      # streaming-online suite (-m ctr)
+#                                      # + the ctr bench stage (cached vs
+#                                      # uncached p99 lookup on Zipf hot
+#                                      # traffic, >=3x gate, counters
+#                                      # reconciled against the pserver
+#                                      # push ledger)
 set -e
 cd "$(dirname "$0")/.."
+if [ "$1" = "ctr" ]; then
+    shift
+    env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m ctr \
+        -p no:cacheprovider "$@"
+    env JAX_PLATFORMS=cpu python bench.py --ctr-only
+    exit 0
+fi
 if [ "$1" = "disagg" ]; then
     shift
     env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m disagg \

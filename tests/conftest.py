@@ -152,6 +152,14 @@ def pytest_configure(config):
         "`-m data` (or `scripts/fault_smoke.sh data`, which runs "
         "-m 'data and faults' plus `bench.py --data-only`) runs the "
         "lane alone")
+    config.addinivalue_line(
+        "markers", "ctr: tiered embedding-cache + CTR serving suite "
+        "(serve.embed_cache staleness bounds / batched miss-fill / "
+        "zero-recompile gather, train.online streaming exactly-once, "
+        "shard-failover + reform-mid-stream chaos) — fast cases run "
+        "IN tier-1; `-m ctr` (or `scripts/perf_smoke.sh ctr` / "
+        "`scripts/fault_smoke.sh ctr`, which add `bench.py "
+        "--ctr-only`) runs the lane alone")
 
 
 def pytest_runtest_logreport(report):
