@@ -10,12 +10,16 @@ recompiles and host round-trips. This package is the enforcement:
 - `graftlint`   — AST linter for trace-safety and recompile discipline
                   (rules GL001-GL006, per-line disable comments,
                   committed baseline allowlist).
-- `locklint`    — lock-discipline checker for the threaded native
-                  runtimes (rule LK001: an attribute mutated both
-                  under a held lock and outside one).
+- `locklint`    — concurrency linter for the threaded native runtimes
+                  (LK001 half-locked attrs, LK002 lock-order cycles
+                  over the cross-module acquisition graph, LK003
+                  blocking-call-under-lock, LK004 thread lifecycle,
+                  LK005 signal-handler safety).
 - `guards`      — runtime enforcement: `RecompileGuard` (a region
-                  must not compile) and `no_implicit_transfers`
-                  (a region must not implicitly cross host<->device).
+                  must not compile), `no_implicit_transfers`
+                  (a region must not implicitly cross host<->device),
+                  and `LockOrderGuard` (lockdep-style runtime
+                  lock-order sanitizer for the chaos suites).
 
 CLI: `python -m paddle_tpu.analysis --check` lints the package against
 `analysis/baseline.json` and exits non-zero on any unbaselined
@@ -24,13 +28,17 @@ finding (docs/ANALYSIS.md).
 
 from paddle_tpu.analysis.graftlint import (Finding, RULES, lint_file,
                                            lint_source)
-from paddle_tpu.analysis.locklint import lint_locks
-from paddle_tpu.analysis.guards import (RecompileError, RecompileGuard,
+from paddle_tpu.analysis.locklint import (lint_lock_graph, lint_locks,
+                                          lint_locks_source)
+from paddle_tpu.analysis.guards import (LockOrderError, LockOrderGuard,
+                                        RecompileError, RecompileGuard,
                                         TransferError,
                                         no_implicit_transfers)
 
 __all__ = [
     "Finding", "RULES", "lint_file", "lint_source", "lint_locks",
+    "lint_locks_source", "lint_lock_graph",
+    "LockOrderError", "LockOrderGuard",
     "RecompileError", "RecompileGuard", "TransferError",
     "no_implicit_transfers",
 ]

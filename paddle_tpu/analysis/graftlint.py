@@ -64,6 +64,62 @@ RULES: Dict[str, str] = {
     "GL006": "module-import-time jnp computation",
     "GL007": "bare time.time()/print() in an instrumented module",
     "LK001": "attribute mutated both under a held lock and outside one",
+    "LK002": "lock-order cycle in the acquisition graph",
+    "LK003": "blocking call while a lock is held",
+    "LK004": "thread neither daemon nor joined / target expects a lock",
+    "LK005": "signal handler acquires locks or does non-reentrant I/O",
+}
+
+#: `--explain ID` text for the GL rules: one bad/good pair each (the
+#: LK rules' catalog lives in locklint.CATALOG; run.py merges both).
+#: docs/ANALYSIS.md carries the long-form prose — keep these short
+#: enough to read in a terminal.
+CATALOG: Dict[str, str] = {
+    "GL001": """host sync inside a traced function
+A `.item()`, `float()`, `np.asarray()` or `.block_until_ready()` on a
+traced value forces a device round-trip per step.
+  bad:   @jax.jit
+         def step(x):
+             if float(x.sum()) > 0: ...   # host sync under trace
+  good:  @jax.jit
+         def step(x):
+             return jnp.where(x.sum() > 0, ..., ...)""",
+    "GL002": """Python control flow on a traced value
+`if`/`while` on a tracer raises ConcretizationTypeError or silently
+specializes on the trace-time value.
+  bad:   if x > 0: y = x * 2          # x is a tracer
+  good:  y = jnp.where(x > 0, x * 2, x)
+         # or lax.cond for side-effecting branches""",
+    "GL003": """weak-dtype constructor (implicit 64-bit under x64)
+`jnp.array(1.0)` picks float64 when x64 is enabled — a silent dtype
+split between test (x64) and prod (x32) builds.
+  bad:   scale = jnp.array(1.0)
+  good:  scale = jnp.array(1.0, dtype=jnp.float32)""",
+    "GL004": """recompile hazard
+Building a jit inside a loop/method body, or closing a jit over a
+changing Python value, recompiles every call.
+  bad:   def step(self, n):
+             return jax.jit(lambda x: x * n)(self.x)
+  good:  self._step = jax.jit(lambda x, n: x * n)  # build once
+         self._step(self.x, n)""",
+    "GL005": """tracer leak out of the traced scope
+Appending a traced value to an outer list/dict escapes the trace and
+dies later with an opaque UnexpectedTracerError.
+  bad:   @jax.jit
+         def f(x):
+             debug_vals.append(x)      # leaks the tracer
+  good:  return the value, or jax.debug.callback(record, x)""",
+    "GL006": """module-import-time jnp computation
+A `jnp.*` call at module scope runs at import — it initializes the
+backend early, breaks device selection, and hides compile cost.
+  bad:   TABLE = jnp.arange(1024)      # at module top level
+  good:  @functools.lru_cache
+         def table(): return jnp.arange(1024)""",
+    "GL007": """bare time.time()/print() in an instrumented module
+serve/ and train/ route timing through the injectable clock and
+output through span events so tests and the flight recorder see them.
+  bad:   t0 = time.time(); print("step", i)
+  good:  t0 = self.clock(); span.event("step", i=i)""",
 }
 
 #: path fragments marking modules under the obs instrumentation
@@ -95,9 +151,12 @@ _WEAK_CTORS = {"array": 0, "asarray": 0, "full": 1}
 
 # the reason must START on the disable line (non-empty — a bare
 # disable does not suppress); it may run onto the next comment line
-# before its closing paren
+# before its closing paren. `locklint:` is an accepted alias so LK
+# disables can name the linter that owns the rule — one suppression
+# grammar, two linters (the rule ID, not the prefix, selects what is
+# suppressed).
 _DISABLE_RE = re.compile(
-    r"graftlint:\s*disable=([A-Z]{2}\d{3})\s*"
+    r"(?:graftlint|locklint):\s*disable=([A-Z]{2}\d{3})\s*"
     r"(?:\((\s*[^)\s][^)]*)\)?)?")
 
 
@@ -127,6 +186,10 @@ def _suppressions(source: str) -> Dict[int, List[Tuple[str, str]]]:
     comments. Tokenize (not a line regex) so a '#' inside a string
     can't fake a directive."""
     out: Dict[int, List[Tuple[str, str]]] = {}
+    if "disable=" not in source:
+        # tokenizing costs as much as parsing; most modules carry no
+        # directives, so gate on the substring before paying it
+        return out
     try:
         toks = tokenize.generate_tokens(io.StringIO(source).readline)
         for tok in toks:

@@ -1,32 +1,62 @@
-"""locklint: lock-discipline checker for the threaded native runtimes.
+"""locklint: concurrency static analysis for the multi-process fleet.
 
-The race-detector shape for our socket servers (`native/pserver.py`,
-`native/taskqueue.py`, `serve/server.py`): a class that guards state
-with `with self._lock:` must guard it EVERYWHERE — an attribute
-mutated both under a held lock and outside one is either a data race
-or an undocumented invariant. locklint flags exactly that (rule
-LK001, reported through the same Finding/baseline machinery as
-graftlint).
+PR5 shipped one rule (LK001) when the threaded surface was two socket
+servers; PRs 14-19 grew per-connection edge threads, pserver dispatch
+locks, the membership service, the shm-arena ledger and three
+supervisor watchdog chains. graftlock extends locklint into the
+lockdep-style pass that surface needs (PAPERS.md: dynamic
+race/deadlock detection — here the STATIC half; `guards.py
+LockOrderGuard` is the runtime half).
 
-Mechanics, per class:
+Rules (docs/ANALYSIS.md has one bad/good example per rule):
 
-- lock attributes = `self.X = threading.Lock()/RLock()/Condition()`
-  (or `Event` is NOT a lock) assignments anywhere in the class;
-- a mutation is `self.attr = ...` / `self.attr += ...` /
-  `self.attr[k] = ...` / `self.attr.append/add/update/...(...)`;
-- a mutation is LOCKED when it sits lexically inside
-  `with self.<lock>:`, or inside a method annotated
-  `# locklint: holds-lock(reason)` on its `def` line — the
-  annotation is for helpers the class only ever calls with the lock
-  already held (e.g. the pserver request handlers dispatched under
-  `_dispatch`'s lock);
-- `__init__` never counts (construction happens-before publication);
-- LK001 fires on each UNLOCKED mutation site of an attribute that
-  also has LOCKED mutation sites. Suppress per line with
-  `# graftlint: disable=LK001(reason)`.
+  LK001  attribute mutated both under a held `with self._lock:` and
+         outside one — a data race or an undocumented invariant.
+  LK002  lock-order cycle: the per-class and cross-module lock
+         acquisition graph (nested `with self.<lock>` blocks, lock
+         acquisitions reached through same-class method calls and
+         through attributes whose class is known, plus `holds-lock`
+         annotated helpers) contains a cycle — two threads taking the
+         same pair of locks in opposite orders is a deadlock waiting
+         for load. A single non-reentrant Lock re-acquired on the
+         same path (self-cycle) is flagged too; an RLock self-cycle
+         is reentrancy and is not.
+  LK003  blocking call while a lock is held: socket
+         `send`/`recv`/`accept`/`connect`, wire framing helpers,
+         `pickle.loads` of wire bytes, `time.sleep`, `subprocess.*`,
+         `os.wait*`, `Queue.get()`/`Event.wait()`/`Thread.join()`
+         WITHOUT a timeout, and jit-compiled callables — each one
+         turns the lock into a convoy while the caller waits on the
+         network/kernel/compiler. Snapshot under the lock, block
+         outside it. (A `.wait()` on the lock/Condition itself is the
+         condition-variable idiom and is not flagged — wait releases
+         the lock.)
+  LK004  thread-lifecycle hygiene: a `threading.Thread` that is
+         neither `daemon=True` nor `.join()`ed anywhere in the file
+         outlives its owner silently; a `Thread(target=...)` whose
+         target is a `holds-lock` annotated method starts a thread
+         that does NOT hold the lock the annotation promises.
+  LK005  signal-handler safety: a handler registered via
+         `signal.signal` that acquires locks, logs, or performs
+         blocking I/O (directly or via methods it calls) can deadlock
+         the main thread — CPython runs handlers between bytecodes of
+         whatever the main thread was doing, including inside the
+         very `with self._lock:` region the handler then re-enters.
+         Handlers must only set flags / write plain attributes.
 
-A class with no lock attribute is never flagged — locklint checks
-discipline against the lock the author chose, it does not demand one.
+Mechanics shared with graftlint: findings flow through the same
+Finding/baseline machinery; suppress per line with
+`# locklint: disable=ID(reason)` (the historical
+`# graftlint: disable=ID(reason)` spelling is accepted too — one
+suppression grammar, two linters). Lock-held helper methods are
+annotated `# locklint: holds-lock(reason)` on/above the `def`.
+
+LK002 runs as a PROJECT pass (`lint_lock_graph`) so an acquisition
+chain crossing modules — a serve-side class holding its lock while
+calling into a cluster-side class that locks back — still closes the
+cycle; per-file `lint_locks` covers LK001/LK003/LK004/LK005 with
+intra-module resolution (same-class calls, `self.x = ClassName(...)`
+attribute types).
 """
 
 from __future__ import annotations
@@ -36,7 +66,7 @@ import dataclasses
 import io
 import re
 import tokenize
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from paddle_tpu.analysis.graftlint import (Finding, _dotted,
                                            _is_suppressed,
@@ -44,13 +74,36 @@ from paddle_tpu.analysis.graftlint import (Finding, _dotted,
 
 _LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
                "BoundedSemaphore"}
+_REENTRANT_CTORS = {"RLock"}
 _MUTATORS = {"append", "extend", "insert", "add", "discard", "remove",
              "pop", "popleft", "appendleft", "clear", "update",
              "setdefault", "__setitem__"}
+#: method names that are socket syscalls (or the repo's wire framing
+#: helpers built directly on them) — blocking by construction
+_BLOCKING_SOCKET = {"accept", "recv", "recvfrom", "recv_into",
+                    "sendall", "sendto", "connect", "send"}
+_BLOCKING_WIRE = {"send_frame", "send_frames", "recv_frame",
+                  "recv_frames"}
+#: subprocess entry points that wait on a child
+_BLOCKING_SUBPROCESS = {"run", "call", "check_call", "check_output",
+                        "communicate", "wait"}
+#: jit-constructing callables (a call to their RESULT under a lock
+#: serializes every co-tenant behind device execution)
+_JIT_CTORS = {"jit", "pjit"}
+#: logging emitters (LK005: the logging module takes module/handler
+#: locks — re-entering it from a signal handler can deadlock)
+_LOG_METHODS = {"debug", "info", "warning", "error", "exception",
+                "critical", "log"}
+_LOG_ROOTS = {"log", "logger", "logging"}
+
 # the reason must START on the annotation line (non-empty); it may
 # run onto the next comment line before its closing paren
 _HOLDS_RE = re.compile(
     r"locklint:\s*holds-lock\s*(?:\((\s*[^)\s][^)]*)\)?)?")
+
+
+# ---------------------------------------------------------------------------
+# shared per-file model
 
 
 @dataclasses.dataclass
@@ -63,10 +116,50 @@ class _Site:
     node: ast.AST
 
 
+@dataclasses.dataclass
+class _Event:
+    """One interesting action inside a method body, with the lexical
+    lock-held stack at that point (innermost last)."""
+
+    kind: str                   # acquire | call_self | call_attr |
+                                # call_other | call_name
+    name: str                   # lock attr / method / func name
+    node: ast.AST
+    held: Tuple[str, ...]
+    attr: str = ""              # call_attr: the self attribute
+    dotted: str = ""            # full dotted callee when resolvable
+    args_n: int = 0
+    kwargs: Tuple[str, ...] = ()
+
+
+@dataclasses.dataclass
+class _MethodRec:
+    name: str
+    holds_lock: bool
+    events: List[_Event]
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class _ClassRec:
+    name: str
+    path: str
+    lock_names: Set[str]
+    lock_kinds: Dict[str, str]          # attr -> ctor name
+    methods: Dict[str, _MethodRec]
+    attr_types: Dict[str, Set[str]]     # self.attr -> candidate classes
+    jit_attrs: Set[str]                 # self.attr = jax.jit(...)
+    node: ast.ClassDef
+
+
 def _holds_lock_lines(source: str) -> Set[int]:
     """Lines carrying a `# locklint: holds-lock(reason)` comment (the
     reason is required, same contract as disable comments)."""
     out: Set[int] = set()
+    if "holds-lock" not in source:
+        # tokenizing every module costs as much as parsing it; the
+        # substring gate keeps the repo-wide pass off that cliff
+        return out
     try:
         for tok in tokenize.generate_tokens(
                 io.StringIO(source).readline):
@@ -81,8 +174,8 @@ def _holds_lock_lines(source: str) -> Set[int]:
 
 
 class _MethodScanner(ast.NodeVisitor):
-    """Collect mutation sites of self-attributes inside one method,
-    tracking lexical `with self.<lock>` nesting."""
+    """LK001: collect mutation sites of self-attributes inside one
+    method, tracking lexical `with self.<lock>` nesting."""
 
     def __init__(self, lock_names: Set[str], method: str,
                  holds_lock: bool):
@@ -160,34 +253,144 @@ class _MethodScanner(ast.NodeVisitor):
     visit_Lambda = visit_FunctionDef
 
 
-def _class_lock_names(cls: ast.ClassDef) -> Set[str]:
-    names: Set[str] = set()
+#: `call_other` leaf names that can EVER classify as directly
+#: blocking in `_direct_blocking`. Anything else is dropped at scan
+#: time: on a repo-wide pass the event volume (every call in every
+#: method) dominates the scan cost, and only these names matter.
+_OTHER_RELEVANT = (_BLOCKING_SOCKET | _BLOCKING_SUBPROCESS
+                   | {"loads", "load", "get", "wait", "join", "sleep",
+                      "waitpid", "waitid", "wait3", "wait4"})
+
+
+class _EventScanner(ast.NodeVisitor):
+    """LK002/LK003: record lock acquisitions and call sites with the
+    lexical held-lock stack live at each one."""
+
+    def __init__(self, lock_names: Set[str],
+                 held0: Sequence[str] = (),
+                 jit_names: Set[str] = frozenset()):
+        self.lock_names = lock_names
+        self.held: List[str] = list(held0)
+        self.jit_names = jit_names
+        self.events: List[_Event] = []
+
+    def _self_attr(self, node: ast.AST) -> Optional[str]:
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return node.attr
+        return None
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            # the context expression evaluates BEFORE the lock is
+            # held — visit it under the current stack
+            self.visit(item.context_expr)
+            ctx = item.context_expr
+            attr = self._self_attr(ctx)
+            if attr is None and isinstance(ctx, ast.Call):
+                attr = self._self_attr(ctx.func)
+            if attr in self.lock_names:
+                self.events.append(_Event(
+                    "acquire", attr, node=ctx,
+                    held=tuple(self.held)))
+                self.held.append(attr)
+                acquired.append(attr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        rec = None                  # (kind, name, attr)
+        if isinstance(f, ast.Attribute):
+            base = f.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                rec = ("call_self", f.attr, None)
+            elif (isinstance(base, ast.Attribute)
+                  and isinstance(base.value, ast.Name)
+                  and base.value.id == "self"):
+                rec = ("call_attr", f.attr, base.attr)
+            elif f.attr in _OTHER_RELEVANT:
+                rec = ("call_other", f.attr, None)
+        elif isinstance(f, ast.Name):
+            if f.id in _BLOCKING_WIRE or f.id in self.jit_names:
+                rec = ("call_name", f.id, None)
+        if rec is not None:
+            kind, name, attr = rec
+            self.events.append(_Event(
+                kind, name, attr=attr or "", node=node,
+                held=tuple(self.held), args_n=len(node.args),
+                kwargs=tuple(kw.arg for kw in node.keywords
+                             if kw.arg),
+                dotted=_dotted(f) or ""))
+        for a in node.args:
+            self.visit(a)
+        for kw in node.keywords:
+            self.visit(kw.value)
+
+    # nested defs run on other stacks/contexts; scanned separately
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    dn = _dotted(node.func) or ""
+    return dn.split(".")[-1] in _JIT_CTORS
+
+
+def _ctor_class_names(value: ast.AST) -> Set[str]:
+    """Candidate class names a `self.x = <value>` assignment binds:
+    direct `ClassName(...)` calls, both arms of a ternary. Only
+    CapWords callees count (functions returning instances are out of
+    scope for the heuristic)."""
+    out: Set[str] = set()
+    cands = [value]
+    if isinstance(value, ast.IfExp):
+        cands = [value.body, value.orelse]
+    for v in cands:
+        if isinstance(v, ast.Call):
+            dn = _dotted(v.func) or ""
+            leaf = dn.split(".")[-1]
+            if leaf[:1].isupper():
+                out.add(leaf)
+    return out
+
+
+def _scan_class(cls: ast.ClassDef, path: str, source: str,
+                holds_lines: Set[int],
+                src_lines: List[str],
+                jit_names: Set[str] = frozenset()) -> _ClassRec:
+    lock_names: Set[str] = set()
+    lock_kinds: Dict[str, str] = {}
+    attr_types: Dict[str, Set[str]] = {}
+    jit_attrs: Set[str] = set()
     for node in ast.walk(cls):
         if not isinstance(node, ast.Assign):
             continue
-        if not isinstance(node.value, ast.Call):
-            continue
-        dn = _dotted(node.value.func) or ""
-        if dn.split(".")[-1] not in _LOCK_CTORS:
-            continue
         for t in node.targets:
-            if (isinstance(t, ast.Attribute)
+            if not (isinstance(t, ast.Attribute)
                     and isinstance(t.value, ast.Name)
                     and t.value.id == "self"):
-                names.add(t.attr)
-    return names
-
-
-def lint_locks_source(source: str, path: str = "<string>"
-                      ) -> List[Finding]:
-    """LK001 findings for one file (unsuppressed only)."""
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError:
-        return []
-    supp = _suppressions(source)
-    holds_lines = _holds_lock_lines(source)
-    src_lines = source.splitlines()
+                continue
+            if isinstance(node.value, ast.Call):
+                dn = _dotted(node.value.func) or ""
+                leaf = dn.split(".")[-1]
+                if leaf in _LOCK_CTORS:
+                    lock_names.add(t.attr)
+                    lock_kinds[t.attr] = leaf
+            if _is_jit_call(node.value):
+                jit_attrs.add(t.attr)
+            types = _ctor_class_names(node.value)
+            if types:
+                attr_types.setdefault(t.attr, set()).update(types)
 
     def _annotated(meth: ast.FunctionDef) -> bool:
         """holds-lock applies on the def line, between the def line
@@ -203,46 +406,832 @@ def lint_locks_source(source: str, path: str = "<string>"
             ln -= 1
         return False
 
-    findings: List[Finding] = []
-    for cls in [n for n in ast.walk(tree)
-                if isinstance(n, ast.ClassDef)]:
-        lock_names = _class_lock_names(cls)
-        if not lock_names:
-            continue
-        sites: List[_Site] = []
-        for meth in [n for n in cls.body
-                     if isinstance(n, ast.FunctionDef)]:
-            if meth.name == "__init__":
-                continue
-            sc = _MethodScanner(lock_names, meth.name,
-                                _annotated(meth))
+    methods: Dict[str, _MethodRec] = {}
+    for meth in [n for n in cls.body
+                 if isinstance(n, ast.FunctionDef)]:
+        holds = _annotated(meth)
+        # an annotated helper of a single-lock class is entered with
+        # THAT lock held; with several locks the annotation is
+        # ambiguous, so the event scanner starts with an empty stack
+        # (LK001 still honors the boolean)
+        held0 = (tuple(lock_names) if holds and len(lock_names) == 1
+                 else ())
+        sc = _EventScanner(lock_names, held0=held0,
+                           jit_names=jit_names)
+        if meth.name != "__init__":
             for stmt in meth.body:
                 sc.visit(stmt)
-            sites.extend(sc.sites)
-        by_attr: Dict[str, List[_Site]] = {}
-        for s in sites:
-            by_attr.setdefault(s.attr, []).append(s)
-        for attr, ss in sorted(by_attr.items()):
-            locked = [s for s in ss if s.locked]
-            unlocked = [s for s in ss if not s.locked]
-            if not locked or not unlocked:
-                continue
-            lock_desc = "/".join(sorted(lock_names))
-            for s in unlocked:
-                f = Finding(
-                    "LK001", path, s.line, s.col,
-                    f"{cls.name}.{s.method}",
-                    f"`self.{attr}` mutated WITHOUT `self."
-                    f"{lock_desc}` held, but also mutated under it "
-                    f"(e.g. {cls.name}.{locked[0].method}:"
-                    f"{locked[0].line}) — lock it, or annotate the "
-                    f"method `# locklint: holds-lock(reason)`")
-                if _is_suppressed(f, s.node, supp, src_lines):
+        methods[meth.name] = _MethodRec(meth.name, holds, sc.events,
+                                        meth)
+    return _ClassRec(cls.name, path, lock_names, lock_kinds, methods,
+                     attr_types, jit_attrs, cls)
+
+
+def _module_jit_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+@dataclasses.dataclass
+class ModuleScan:
+    """One module parsed and class-scanned exactly once, reusable by
+    both the per-file rules (`lint_locks_source`) and the project-wide
+    LK002 graph pass (`lint_lock_graph`). The repo gate hands the same
+    scan to both so no file is parsed twice. `tree is None` means the
+    file failed to parse — every consumer returns no findings."""
+
+    path: str
+    source: str
+    tree: Optional[ast.Module]
+    classes: List[_ClassRec]
+    supp: Dict[int, List[Tuple[str, str]]]
+    src_lines: List[str]
+    jit_names: Set[str]
+
+
+def scan_module(source: str, path: str = "<string>") -> ModuleScan:
+    """Parse + scan one module into the form every LK rule consumes."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return ModuleScan(path, source, None, [], {}, [], set())
+    holds = _holds_lock_lines(source)
+    src_lines = source.splitlines()
+    jit_names = (_module_jit_names(tree) if "jit" in source
+                 else set())
+    classes = [_scan_class(c, path, source, holds, src_lines,
+                           jit_names=jit_names)
+               for c in ast.walk(tree)
+               if isinstance(c, ast.ClassDef)]
+    return ModuleScan(path, source, tree, classes,
+                      _suppressions(source), src_lines, jit_names)
+
+
+# ---------------------------------------------------------------------------
+# LK003: blocking calls (direct classification + intra-module
+# transitive closure over same-class / typed-attribute calls)
+
+
+def _direct_blocking(ev: _Event, cls: _ClassRec,
+                     jit_names: Set[str]) -> Optional[str]:
+    """A short description when this call event blocks by itself, or
+    None. `call_self` is never classified here — same-class calls
+    resolve transitively."""
+    name = ev.name
+    dn = ev.dotted
+    if ev.kind in ("call_attr", "call_other"):
+        if name in _BLOCKING_SOCKET:
+            return f"socket `.{name}()`"
+        if name in ("loads", "load") and dn.startswith("pickle."):
+            return f"`{dn}` of wire bytes"
+        if dn.startswith("subprocess.") \
+                and name in _BLOCKING_SUBPROCESS:
+            return f"`{dn}`"
+        if dn == "time.sleep":
+            return "`time.sleep`"
+        if dn.startswith("os.wait"):
+            return f"`{dn}`"
+        if name == "get" and ev.args_n == 0 \
+                and "timeout" not in ev.kwargs:
+            return "`.get()` without timeout"
+        if name in ("wait", "join") and ev.args_n == 0 \
+                and "timeout" not in ev.kwargs \
+                and ev.attr not in cls.lock_names:
+            return f"`.{name}()` without timeout"
+        if ev.kind == "call_attr" and ev.attr in cls.jit_attrs:
+            return f"jit-compiled `self.{ev.attr}(...)`"
+    elif ev.kind == "call_self":
+        # `self._step(x)` where `self._step = jax.jit(...)`: lexically
+        # a self-call, semantically a compiled-executable dispatch
+        if name in cls.jit_attrs:
+            return f"jit-compiled `self.{name}(...)`"
+    elif ev.kind == "call_name":
+        if name in _BLOCKING_WIRE:
+            return f"wire framing `{name}()`"
+        if name in jit_names:
+            return f"jit-compiled `{name}(...)`"
+    return None
+
+
+def _fix_blocking(classes: List[_ClassRec], jit_names: Set[str]
+                  ) -> Dict[Tuple[str, str], List[Tuple[str, int]]]:
+    """(class, method) -> [(description, line)] including blocking
+    reached through same-class and typed-attribute calls (fixpoint
+    over the module)."""
+    by_name = {c.name: c for c in classes}
+    block: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+    for c in classes:
+        for m in c.methods.values():
+            ds = []
+            for ev in m.events:
+                d = _direct_blocking(ev, c, jit_names)
+                if d:
+                    ds.append((d, ev.node.lineno))
+            block[(c.name, m.name)] = ds
+    changed = True
+    while changed:
+        changed = False
+        for c in classes:
+            for m in c.methods.values():
+                cur = block[(c.name, m.name)]
+                have = {d for d, _ in cur}
+                for ev in m.events:
+                    targets: List[Tuple[str, str]] = []
+                    if ev.kind == "call_self":
+                        targets = [(c.name, ev.name)]
+                    elif ev.kind == "call_attr":
+                        targets = [(t, ev.name) for t in
+                                   c.attr_types.get(ev.attr, ())
+                                   if t in by_name]
+                    for key in targets:
+                        for d, ln in block.get(key, ()):
+                            via = (f"{d} (via "
+                                   f"`{key[0]}.{key[1]}`:{ln})")
+                            if d not in have and via not in have:
+                                cur.append((via, ev.node.lineno))
+                                have.add(via)
+                                have.add(d)
+                                changed = True
+    return block
+
+
+# ---------------------------------------------------------------------------
+# LK002: the lock acquisition graph
+
+
+@dataclasses.dataclass
+class _EdgeSite:
+    path: str
+    line: int
+    func: str
+    node: ast.AST
+
+
+@dataclasses.dataclass
+class _Edge:
+    src: str                    # "Class.attr"
+    dst: str
+    site: _EdgeSite             # where dst is taken while src is held
+
+
+def _fix_acquires(classes: List[_ClassRec]
+                  ) -> Dict[Tuple[str, str],
+                            List[Tuple[str, str, int]]]:
+    """(class, method) -> [(node, kind, line)] of locks the method
+    acquires directly or transitively (same-class + typed-attribute
+    calls), where node is 'Class.attr'."""
+    by_name = {c.name: c for c in classes}
+    acq: Dict[Tuple[str, str], List[Tuple[str, str, int]]] = {}
+    for c in classes:
+        for m in c.methods.values():
+            ds = []
+            for ev in m.events:
+                if ev.kind == "acquire":
+                    ds.append((f"{c.name}.{ev.name}",
+                               c.lock_kinds.get(ev.name, "Lock"),
+                               ev.node.lineno))
+            acq[(c.name, m.name)] = ds
+    changed = True
+    while changed:
+        changed = False
+        for c in classes:
+            for m in c.methods.values():
+                cur = acq[(c.name, m.name)]
+                have = {n for n, _, _ in cur}
+                for ev in m.events:
+                    targets: List[Tuple[str, str]] = []
+                    if ev.kind == "call_self":
+                        targets = [(c.name, ev.name)]
+                    elif ev.kind == "call_attr":
+                        targets = [(t, ev.name) for t in
+                                   c.attr_types.get(ev.attr, ())
+                                   if t in by_name]
+                    for key in targets:
+                        for n, k, ln in acq.get(key, ()):
+                            if n not in have:
+                                cur.append((n, k, ev.node.lineno))
+                                have.add(n)
+                                changed = True
+    return acq
+
+
+def _class_edges(classes: List[_ClassRec]
+                 ) -> Tuple[List[_Edge], Dict[str, str]]:
+    """Held-then-acquired edges over a set of classes (possibly from
+    several modules — attr types resolve across the whole set, which
+    is what closes cross-module cycles), plus node->ctor-kind (for
+    the reentrancy exemption)."""
+    by_name = {c.name: c for c in classes}
+    acq = _fix_acquires(classes)
+    edges: List[_Edge] = []
+    kinds: Dict[str, str] = {}
+    for c in classes:
+        for a, k in c.lock_kinds.items():
+            kinds[f"{c.name}.{a}"] = k
+        for m in c.methods.values():
+            func = f"{c.name}.{m.name}"
+            for ev in m.events:
+                if not ev.held:
                     continue
-                findings.append(f)
+                site = _EdgeSite(c.path, ev.node.lineno, func,
+                                 ev.node)
+                dsts: List[str] = []
+                if ev.kind == "acquire":
+                    dsts = [f"{c.name}.{ev.name}"]
+                elif ev.kind == "call_self":
+                    dsts = [n for n, _, _
+                            in acq.get((c.name, ev.name), ())]
+                elif ev.kind == "call_attr":
+                    for t in c.attr_types.get(ev.attr, ()):
+                        if t in by_name:
+                            dsts.extend(
+                                n for n, _, _
+                                in acq.get((t, ev.name), ()))
+                for h in ev.held:
+                    src = f"{c.name}.{h}"
+                    for dst in dsts:
+                        edges.append(_Edge(src, dst, site))
+    return edges, kinds
+
+
+def _find_cycles(edges: List[_Edge], kinds: Dict[str, str]
+                 ) -> List[List[_Edge]]:
+    """Minimal cycles in the order graph, one per distinct node set.
+    A self-edge on a reentrant lock (RLock) is the sanctioned
+    reentrancy pattern and is skipped."""
+    adj: Dict[str, Dict[str, _Edge]] = {}
+    for e in edges:
+        if e.src == e.dst \
+                and kinds.get(e.src) in _REENTRANT_CTORS:
+            continue
+        adj.setdefault(e.src, {}).setdefault(e.dst, e)
+    cycles: List[List[_Edge]] = []
+    seen: Set[Tuple[str, ...]] = set()
+    for start in sorted(adj):
+        # BFS from each successor of `start` back to it: shortest
+        # cycle through `start`
+        for first_dst, first_edge in sorted(adj[start].items()):
+            if first_dst == start:
+                key = (start,)
+                if key not in seen:
+                    seen.add(key)
+                    cycles.append([first_edge])
+                continue
+            prev: Dict[str, Tuple[str, _Edge]] = {first_dst:
+                                                  (start, first_edge)}
+            frontier = [first_dst]
+            found = False
+            while frontier and not found:
+                nxt = []
+                for n in frontier:
+                    for d, e in sorted(adj.get(n, {}).items()):
+                        if d == start:
+                            chain = [e]
+                            cur = n
+                            while cur != start:
+                                p, pe = prev[cur]
+                                chain.append(pe)
+                                cur = p
+                            chain.reverse()
+                            chain = [first_edge] + chain[1:] \
+                                if chain and chain[0] is first_edge \
+                                else chain
+                            key = tuple(sorted(
+                                {x.src for x in chain}))
+                            if key not in seen:
+                                seen.add(key)
+                                cycles.append(chain)
+                            found = True
+                            break
+                        if d not in prev:
+                            prev[d] = (n, e)
+                            nxt.append(d)
+                    if found:
+                        break
+                frontier = nxt
+    return cycles
+
+
+def lint_lock_graph(sources: Optional[Dict[str, str]] = None,
+                    scans: Optional[Sequence[ModuleScan]] = None
+                    ) -> List[Finding]:
+    """LK002 over a set of files: merge every module's acquisition
+    edges into one graph and flag each cycle once, at the edge that
+    closes it. Takes either raw `sources` (path -> source) or
+    precomputed `scans` — the repo gate passes the scans it already
+    built for the per-file rules so nothing is parsed twice."""
+    if scans is None:
+        scans = [scan_module(src, path)
+                 for path, src in (sources or {}).items()]
+    all_classes: List[_ClassRec] = []
+    supp_by_path: Dict[str, dict] = {}
+    lines_by_path: Dict[str, List[str]] = {}
+    for scan in scans:
+        # keep lockless classes too: a cross-module chain may pass
+        # THROUGH a class that holds no lock of its own
+        if scan.tree is None or not scan.classes:
+            continue
+        all_classes.extend(scan.classes)
+        supp_by_path[scan.path] = scan.supp
+        lines_by_path[scan.path] = scan.src_lines
+    # ONE edge computation over every scanned class: attr types
+    # (`self.x = ClassName(...)`) resolve across module boundaries,
+    # which is exactly where the dangerous cycles close
+    all_edges, kinds = _class_edges(all_classes)
+    findings: List[Finding] = []
+    for cycle in _find_cycles(all_edges, kinds):
+        order = " -> ".join([cycle[0].src]
+                            + [e.dst for e in cycle])
+        closing = cycle[-1]
+        if len(cycle) == 1:
+            msg = (f"non-reentrant lock `{closing.src}` re-acquired "
+                   f"on a path that already holds it "
+                   f"({closing.site.path}:{closing.site.line}) — "
+                   f"self-deadlock; use an RLock or split the method")
+        else:
+            first = cycle[0]
+            msg = (f"lock-order cycle {order}: `{closing.dst}` is "
+                   f"taken while `{closing.src}` is held at "
+                   f"{closing.site.path}:{closing.site.line}, but "
+                   f"the opposite order is established at "
+                   f"{first.site.path}:{first.site.line} — pick ONE "
+                   f"order (docs/RELIABILITY.md 'Lock discipline') "
+                   f"and annotate the sanctioned one")
+        f = Finding("LK002", closing.site.path, closing.site.line,
+                    getattr(closing.site.node, "col_offset", 0),
+                    closing.site.func, msg)
+        # a disable on ANY edge of the cycle suppresses it — the
+        # annotator shouldn't have to guess which edge the cycle
+        # search happens to attribute the finding to
+        if any(_is_suppressed(
+                Finding("LK002", e.site.path, e.site.line, 0,
+                        e.site.func, msg),
+                e.site.node,
+                supp_by_path.get(e.site.path, {}),
+                lines_by_path.get(e.site.path))
+               for e in cycle):
+            continue
+        findings.append(f)
     return findings
 
 
-def lint_locks(path: str) -> List[Finding]:
+# ---------------------------------------------------------------------------
+# LK004: thread lifecycle
+
+
+def _thread_ctor(node: ast.Call) -> bool:
+    dn = _dotted(node.func) or ""
+    return dn in ("threading.Thread", "Thread") \
+        or dn.endswith(".Thread")
+
+
+def _kw(node: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in node.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _joined_names(tree: ast.Module) -> Set[str]:
+    """Every `<name>.join(...)` / `self.<attr>.join(...)` receiver in
+    the file ('joined on every exit path' is approximated file-wide:
+    an owner that joins SOMEWHERE has a lifecycle story; one that
+    never joins anywhere has none). A collection iterated with
+    `for t in threads: t.join()` marks `threads` joined too — the
+    idiomatic fan-out/join shape."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join":
+            base = node.func.value
+            if isinstance(base, ast.Name):
+                out.add(base.id)
+            elif (isinstance(base, ast.Attribute)
+                  and isinstance(base.value, ast.Name)
+                  and base.value.id == "self"):
+                out.add(f"self.{base.attr}")
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.For)
+                and isinstance(node.target, ast.Name)
+                and node.target.id in out):
+            continue
+        it = node.iter
+        if isinstance(it, ast.Name):
+            out.add(it.id)
+        elif (isinstance(it, ast.Attribute)
+              and isinstance(it.value, ast.Name)
+              and it.value.id == "self"):
+            out.add(f"self.{it.attr}")
+    return out
+
+
+def _lint_threads(tree: ast.Module, path: str,
+                  holds_annotated: Dict[str, Set[str]],
+                  supp, src_lines) -> List[Finding]:
+    # one cheap pass up front: no Thread ctors means none of the
+    # scope-marking / join-collection walks below have work to do
+    # (the common case for most modules in a repo-wide run)
+    ctor_nodes = [n for n in ast.walk(tree)
+                  if isinstance(n, ast.Call) and _thread_ctor(n)]
+    if not ctor_nodes:
+        return []
+    joined = _joined_names(tree)
+    findings: List[Finding] = []
+
+    def scope_of(node: ast.AST) -> str:
+        return getattr(node, "_ll_scope", "<module>")
+
+    # annotate scopes (dotted lexical func names, like graftlint)
+    def mark(node: ast.AST, scope: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            s = scope
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                s = f"{scope}.{child.name}" if scope != "<module>" \
+                    else child.name
+            child._ll_scope = s
+            mark(child, s)
+    mark(tree, "<module>")
+
+    # ctor call -> binding name, from enclosing assignments; a ctor
+    # inside a list/set comprehension binds to the comprehension's
+    # target (`threads = [Thread(...) for ...]`)
+    bound: Dict[int, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        ctors: List[ast.Call] = []
+        if isinstance(node.value, ast.Call) \
+                and _thread_ctor(node.value):
+            ctors = [node.value]
+        elif isinstance(node.value, (ast.ListComp, ast.SetComp)):
+            ctors = [n for n in ast.walk(node.value.elt)
+                     if isinstance(n, ast.Call) and _thread_ctor(n)]
+        for c in ctors:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    bound[id(c)] = t.id
+                elif (isinstance(t, ast.Attribute)
+                      and isinstance(t.value, ast.Name)
+                      and t.value.id == "self"):
+                    bound[id(c)] = f"self.{t.attr}"
+
+    for node in ctor_nodes:
+        func = scope_of(node)
+        # target = a holds-lock annotated method: the fresh thread
+        # does NOT hold the lock the annotation promises
+        tgt = _kw(node, "target")
+        if (isinstance(tgt, ast.Attribute)
+                and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            cls = func.split(".")[0]
+            if tgt.attr in holds_annotated.get(cls, set()):
+                f = Finding(
+                    "LK004", path, node.lineno, node.col_offset,
+                    func,
+                    f"thread target `self.{tgt.attr}` is annotated "
+                    f"`holds-lock` — a fresh thread holds nothing; "
+                    f"the annotation (or the spawn) is wrong")
+                if not _is_suppressed(f, node, supp, src_lines):
+                    findings.append(f)
+        daemon = _kw(node, "daemon")
+        if isinstance(daemon, ast.Constant) and daemon.value is True:
+            continue
+        name = bound.get(id(node))
+        if name is not None and name in joined:
+            continue
+        where = (f"bound to `{name}` but never `.join()`ed"
+                 if name is not None
+                 else "never bound, so it can never be joined")
+        f = Finding(
+            "LK004", path, node.lineno, node.col_offset, func,
+            f"`threading.Thread` that is neither `daemon=True` nor "
+            f"joined ({where}) — it outlives its owner silently; "
+            f"mark it daemon or join it on every exit path")
+        if not _is_suppressed(f, node, supp, src_lines):
+            findings.append(f)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# LK005: signal-handler safety
+
+
+def _handler_hazard(fn: ast.FunctionDef,
+                    classes: Dict[str, _ClassRec],
+                    cls_name: Optional[str],
+                    module_funcs: Dict[str, ast.FunctionDef],
+                    depth: int = 0) -> Optional[str]:
+    """First hazard reachable from a signal handler: a lock
+    acquisition, a logging call, or a blocking call — searched
+    through same-class methods and local/module functions, bounded
+    depth."""
+    if depth > 3:
+        return None
+    cls = classes.get(cls_name) if cls_name else None
+    lock_names = cls.lock_names if cls else set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                ctx = item.context_expr
+                tgt = ctx.func if isinstance(ctx, ast.Call) else ctx
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and tgt.attr in lock_names):
+                    return (f"acquires `self.{tgt.attr}` "
+                            f"(line {node.lineno})")
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        dn = _dotted(f) or ""
+        leaf = dn.split(".")[-1]
+        root = dn.split(".")[0]
+        if leaf == "acquire" and isinstance(f, ast.Attribute):
+            base = f.value
+            if (isinstance(base, ast.Attribute)
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id == "self"
+                    and base.attr in lock_names):
+                return (f"acquires `self.{base.attr}` "
+                        f"(line {node.lineno})")
+        if leaf in _LOG_METHODS and root in _LOG_ROOTS:
+            return (f"calls `{dn}` (line {node.lineno}) — the "
+                    f"logging module takes non-reentrant locks")
+        if isinstance(f, ast.Attribute) \
+                and f.attr in _BLOCKING_SOCKET \
+                and not (isinstance(f.value, ast.Name)
+                         and f.value.id == "self"):
+            return f"does socket I/O `.{f.attr}()` (line {node.lineno})"
+        if dn == "time.sleep":
+            return f"calls `time.sleep` (line {node.lineno})"
+        # one hop through self.<method>() / local helper()
+        callee: Optional[ast.FunctionDef] = None
+        nxt_cls = cls_name
+        if (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "self" and cls is not None):
+            rec = cls.methods.get(f.attr)
+            callee = rec.node if rec else None
+        elif isinstance(f, ast.Name) and f.id in module_funcs:
+            callee = module_funcs[f.id]
+            nxt_cls = None
+        if callee is not None:
+            hz = _handler_hazard(callee, classes, nxt_cls,
+                                 module_funcs, depth + 1)
+            if hz:
+                return (f"reaches a hazard via `{dn}()` "
+                        f"(line {node.lineno}): {hz}")
+    return None
+
+
+def _lint_signals(tree: ast.Module, path: str,
+                  classes: Dict[str, _ClassRec],
+                  supp, src_lines) -> List[Finding]:
+    module_funcs = {n.name: n for n in tree.body
+                    if isinstance(n, ast.FunctionDef)}
+    findings: List[Finding] = []
+
+    def walk_scope(node: ast.AST, scope: str,
+                   cls_name: Optional[str],
+                   local_defs: Dict[str, ast.FunctionDef]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk_scope(child, child.name, child.name, {})
+                continue
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                inner = {n.name: n for n in child.body
+                         if isinstance(n, ast.FunctionDef)}
+                sub = (f"{scope}.{child.name}"
+                       if scope != "<module>" else child.name)
+                walk_scope(child, sub, cls_name,
+                           {**local_defs, **inner})
+                continue
+            for call in [n for n in ast.walk(child)
+                         if isinstance(n, ast.Call)]:
+                if (_dotted(call.func) or "") != "signal.signal" \
+                        or len(call.args) < 2:
+                    continue
+                h = call.args[1]
+                target: Optional[ast.FunctionDef] = None
+                t_cls = cls_name
+                if isinstance(h, ast.Name):
+                    target = local_defs.get(h.id) \
+                        or module_funcs.get(h.id)
+                    if target in module_funcs.values():
+                        t_cls = None
+                elif (isinstance(h, ast.Attribute)
+                      and isinstance(h.value, ast.Name)
+                      and h.value.id == "self" and cls_name
+                      and cls_name in classes):
+                    rec = classes[cls_name].methods.get(h.attr)
+                    target = rec.node if rec else None
+                if target is None:
+                    continue
+                hz = _handler_hazard(target, classes, t_cls,
+                                     module_funcs)
+                if hz is None:
+                    continue
+                f = Finding(
+                    "LK005", path, call.lineno, call.col_offset,
+                    scope,
+                    f"signal handler `{target.name}` {hz} — "
+                    f"handlers run between bytecodes of whatever "
+                    f"the main thread holds; set a flag and act on "
+                    f"it from the owning loop instead")
+                if not _is_suppressed(f, call, supp, src_lines):
+                    findings.append(f)
+
+    walk_scope(tree, "<module>", None, {})
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# per-file entry
+
+
+def lint_locks_source(source: str, path: str = "<string>",
+                      rules: Optional[Sequence[str]] = None,
+                      scan: Optional[ModuleScan] = None
+                      ) -> List[Finding]:
+    """LK001/LK003/LK004/LK005 findings for one file (unsuppressed
+    only). LK002 needs the project graph — see `lint_lock_graph`.
+    Pass a precomputed `scan` (from `scan_module`) to share the parse
+    with the graph pass; the repo gate does."""
+    want = (lambda r: rules is None or r in rules)
+    if scan is None:
+        scan = scan_module(source, path)
+    tree = scan.tree
+    if tree is None:
+        return []
+    supp = scan.supp
+    src_lines = scan.src_lines
+    jit_names = scan.jit_names
+
+    class_recs = scan.classes
+    lockful = [c for c in class_recs if c.lock_names]
+    by_name = {c.name: c for c in class_recs}
+
+    findings: List[Finding] = []
+
+    # -- LK001 ------------------------------------------------------------
+    if want("LK001"):
+        for crec in lockful:
+            cls = crec.node
+            sites: List[_Site] = []
+            for meth in [n for n in cls.body
+                         if isinstance(n, ast.FunctionDef)]:
+                if meth.name == "__init__":
+                    continue
+                sc = _MethodScanner(crec.lock_names, meth.name,
+                                    crec.methods[meth.name].holds_lock)
+                for stmt in meth.body:
+                    sc.visit(stmt)
+                sites.extend(sc.sites)
+            by_attr: Dict[str, List[_Site]] = {}
+            for s in sites:
+                by_attr.setdefault(s.attr, []).append(s)
+            for attr, ss in sorted(by_attr.items()):
+                locked = [s for s in ss if s.locked]
+                unlocked = [s for s in ss if not s.locked]
+                if not locked or not unlocked:
+                    continue
+                lock_desc = "/".join(sorted(crec.lock_names))
+                for s in unlocked:
+                    f = Finding(
+                        "LK001", path, s.line, s.col,
+                        f"{cls.name}.{s.method}",
+                        f"`self.{attr}` mutated WITHOUT `self."
+                        f"{lock_desc}` held, but also mutated under "
+                        f"it (e.g. {cls.name}.{locked[0].method}:"
+                        f"{locked[0].line}) — lock it, or annotate "
+                        f"the method `# locklint: "
+                        f"holds-lock(reason)`")
+                    if _is_suppressed(f, s.node, supp, src_lines):
+                        continue
+                    findings.append(f)
+
+    # -- LK003 ------------------------------------------------------------
+    if want("LK003") and lockful:
+        block = _fix_blocking(lockful, jit_names)
+        for crec in lockful:
+            for m in crec.methods.values():
+                func = f"{crec.name}.{m.name}"
+                for ev in m.events:
+                    if not ev.held:
+                        continue
+                    held_desc = "/".join(
+                        f"self.{h}" for h in ev.held)
+                    descs: List[str] = []
+                    d = _direct_blocking(ev, crec, jit_names)
+                    if d:
+                        descs = [d]
+                    elif ev.kind == "call_self":
+                        sub = block.get((crec.name, ev.name), ())
+                        if sub:
+                            descs = [f"`self.{ev.name}()` which "
+                                     f"blocks on {sub[0][0]}"]
+                    elif ev.kind == "call_attr":
+                        for t in crec.attr_types.get(ev.attr, ()):
+                            sub = block.get((t, ev.name), ())
+                            if sub:
+                                descs = [
+                                    f"`self.{ev.attr}.{ev.name}()` "
+                                    f"({t}) which blocks on "
+                                    f"{sub[0][0]}"]
+                                break
+                    for desc in descs:
+                        f = Finding(
+                            "LK003", path, ev.node.lineno,
+                            ev.node.col_offset, func,
+                            f"blocking call {desc} while holding "
+                            f"`{held_desc}` — every co-tenant of the "
+                            f"lock convoys behind this wait; "
+                            f"snapshot under the lock, block outside "
+                            f"it")
+                        if _is_suppressed(f, ev.node, supp,
+                                          src_lines):
+                            continue
+                        findings.append(f)
+
+    # -- LK004 ------------------------------------------------------------
+    # substring gates: a Thread ctor needs "Thread" in the text and a
+    # handler registration needs "signal"; most modules have neither,
+    # and skipping the walks is most of the repo-wide pass's budget
+    if want("LK004") and "Thread" in source:
+        holds_annot = {c.name: {m.name for m in c.methods.values()
+                                if m.holds_lock}
+                       for c in class_recs}
+        findings.extend(_lint_threads(tree, path, holds_annot,
+                                      supp, src_lines))
+
+    # -- LK005 ------------------------------------------------------------
+    if want("LK005") and "signal" in source:
+        findings.extend(_lint_signals(tree, path, by_name, supp,
+                                      src_lines))
+
+    findings.sort(key=lambda x: (x.line, x.col, x.rule))
+    return findings
+
+
+def lint_locks(path: str,
+               rules: Optional[Sequence[str]] = None
+               ) -> List[Finding]:
     with open(path, encoding="utf-8") as f:
-        return lint_locks_source(f.read(), path)
+        return lint_locks_source(f.read(), path, rules=rules)
+
+
+#: `--explain ID` text for the LK rules (graftlint.CATALOG holds the
+#: GL side; run.py merges both). One bad/good pair each; the long-
+#: form prose lives in docs/ANALYSIS.md.
+CATALOG: Dict[str, str] = {
+    "LK001": """attribute mutated both under a held lock and outside one
+Half-locked state is a data race (or an invariant nobody wrote down).
+  bad:   with self._lock: self._n += 1     # one site locks...
+         ...
+         self._n = 0                       # ...another doesn't
+  good:  lock every mutation site, or annotate the caller-holds-it
+         helper `# locklint: holds-lock(reason)`""",
+    "LK002": """lock-order cycle in the acquisition graph
+Two code paths taking the same pair of locks in opposite orders
+deadlock the first time both run concurrently.
+  bad:   def a(self):                      # A then B
+             with self._router:
+                 with self._pool: ...
+         def b(self):                      # B then A  -> cycle
+             with self._pool:
+                 with self._router: ...
+  good:  pick ONE order (docs/RELIABILITY.md 'Lock discipline') and
+         restructure the minority path to follow it""",
+    "LK003": """blocking call while a lock is held
+Socket I/O, sleeps, waits-without-timeout and jit execution under a
+lock convoy every co-tenant behind one slow peer.
+  bad:   with self._lock:
+             self._sock.sendall(frame)     # peer-paced write
+  good:  with self._lock:
+             frame = self._snapshot()      # snapshot under the lock
+         self._sock.sendall(frame)         # block outside it""",
+    "LK004": """thread neither daemon nor joined / target expects a lock
+An unjoined non-daemon thread outlives its owner silently; a fresh
+thread does not hold the lock a `holds-lock` target promises.
+  bad:   threading.Thread(target=self._loop).start()
+  good:  self._t = threading.Thread(target=self._loop, daemon=True)
+         self._t.start() ... self._t.join(timeout=...)  # on close""",
+    "LK005": """signal handler acquires locks or does non-reentrant I/O
+Handlers run between bytecodes of whatever the main thread was doing
+— including inside the very `with self._lock:` they then re-enter.
+  bad:   def _on_term(sig, frm):
+             self.drain()                  # takes self._lock, logs
+         signal.signal(SIGTERM, _on_term)
+  good:  def _on_term(sig, frm):
+             self._pending_drain = "SIGTERM"   # flag only
+         # the owning loop notices the flag and drains""",
+}

@@ -26,8 +26,15 @@ import os
 import sys
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from paddle_tpu.analysis.graftlint import Finding, RULES, lint_file
-from paddle_tpu.analysis.locklint import lint_locks
+from paddle_tpu.analysis import graftlint, locklint
+from paddle_tpu.analysis.graftlint import Finding, RULES, lint_source
+from paddle_tpu.analysis.locklint import (lint_lock_graph,
+                                          lint_locks_source,
+                                          scan_module)
+
+#: rules owned by the locklint pass (LK002 additionally needs the
+#: cross-module graph — see collect_findings)
+_LK_RULES = tuple(r for r in RULES if r.startswith("LK"))
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _REPO_ROOT = os.path.dirname(_PKG_ROOT)
@@ -80,15 +87,29 @@ def collect_findings(paths: Sequence[str],
     """graftlint + locklint over every .py under `paths`, with
     repo-relative paths (baseline-key form)."""
     findings: List[Finding] = []
+    lk_on = locklint and (
+        rules is None or any(r in rules for r in _LK_RULES))
+    lk_scans = []
     for f in _iter_py_files(paths):
         rel = _rel(f)
-        for fd in lint_file(f, rules=rules):
-            findings.append(Finding(fd.rule, rel, fd.line, fd.col,
-                                    fd.func, fd.message))
-        if locklint and (rules is None or "LK001" in rules):
-            for fd in lint_locks(f):
-                findings.append(Finding(fd.rule, rel, fd.line, fd.col,
-                                        fd.func, fd.message))
+        try:
+            with open(f, encoding="utf-8") as fh:
+                src = fh.read()
+        except OSError:
+            continue
+        findings.extend(lint_source(src, rel, rules=rules))
+        if lk_on:
+            # ONE parse+scan per file, shared by the per-file LK
+            # rules and the project-wide LK002 graph pass
+            scan = scan_module(src, rel)
+            findings.extend(lint_locks_source(src, rel, rules=rules,
+                                              scan=scan))
+            if rules is None or "LK002" in rules:
+                lk_scans.append(scan)
+    # LK002 runs over ALL scanned files at once: a lock-order cycle
+    # closing across modules only exists in the merged graph
+    if lk_on and lk_scans:
+        findings.extend(lint_lock_graph(scans=lk_scans))
     findings.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
     return findings
 
@@ -204,10 +225,23 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
                    help="comma-separated rule ids to run "
                         f"(default: all of {', '.join(RULES)})")
     p.add_argument("--no-locklint", action="store_true",
-                   help="skip the LK001 lock-discipline pass")
+                   help="skip the LK001-LK005 lock-discipline pass")
+    p.add_argument("--explain", default=None, metavar="ID",
+                   help="print the rule's catalog entry (bad/good "
+                        "example) and exit — so disables stop citing "
+                        "rules by number only")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     args = p.parse_args(argv)
+
+    if args.explain is not None:
+        rid = args.explain.upper()
+        catalog = {**graftlint.CATALOG, **locklint.CATALOG}
+        if rid not in catalog:
+            p.error(f"unknown rule {args.explain!r}; valid: "
+                    f"{', '.join(sorted(catalog))}")
+        print(f"{rid} — {catalog[rid]}")
+        return 0
 
     rules = args.rules.split(",") if args.rules else None
     if rules:
@@ -243,9 +277,21 @@ def run_cli(argv: Optional[Sequence[str]] = None) -> int:
         report = unbaselined if (args.check and baseline) else findings
         for fd in report:
             print(fd)
-        for k in stale:
-            print(f"warning: stale baseline entry {k} — the finding "
-                  f"is gone; run --update-baseline")
+        if stale:
+            # prune report, grouped per rule: stale entries are the
+            # baseline outliving the code — name what to delete
+            by_rule: Dict[str, List[Key]] = collections.defaultdict(
+                list)
+            for k in stale:
+                by_rule[k[0]].append(k)
+            print(f"stale baseline entries to prune ({len(stale)} — "
+                  f"the findings are gone; run --update-baseline):")
+            for rule in sorted(by_rule):
+                ks = by_rule[rule]
+                print(f"  {rule} ({RULES.get(rule, '?')}): "
+                      f"{len(ks)} entr{'y' if len(ks) == 1 else 'ies'}")
+                for k in ks:
+                    print(f"    - {k[1]} [{k[2]}]")
         n_base = len(findings) - len(unbaselined)
         print(f"graftlint: {len(findings)} finding(s), "
               f"{n_base} baselined, {len(unbaselined)} unbaselined"

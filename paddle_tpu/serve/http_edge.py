@@ -158,6 +158,9 @@ class HttpEdge:
         self._wake = threading.Event()
         self._draining = False
         self._drain_reason: Optional[str] = None
+        # set by the signal handler, applied by the drive loop: the
+        # handler itself must never take self._lock (locklint LK005)
+        self._pending_drain: Optional[str] = None
         self._drain_report: Optional[dict] = None
         self._next_cid = 0
         self._active_streams = 0
@@ -222,9 +225,15 @@ class HttpEdge:
         """SIGTERM/SIGINT → graceful drain (edge first, then the
         fleet via `drain_fn`). Survives non-main-thread callers the
         same way ServingServer does: signal handlers are a process-
-        level convenience, not a correctness dependency."""
+        level convenience, not a correctness dependency.
+
+        The handler only SETS A FLAG (locklint LK005): it runs
+        between bytecodes of whatever the main thread was doing —
+        possibly inside `self._lock` — so taking the lock (or
+        logging) from it can deadlock the process. The drive loop
+        applies the pending drain within one `poll_s` park."""
         def handler(signum, frame):
-            self.drain(reason=f"signal {signum}")
+            self._pending_drain = f"signal {signum}"
 
         try:
             signal.signal(signal.SIGTERM, handler)
@@ -312,6 +321,10 @@ class HttpEdge:
         lock, parked briefly when idle (handlers `_wake` it on every
         submit/cancel so admission latency is bounded by one park)."""
         while not self._stop.is_set():
+            pending = self._pending_drain
+            if pending is not None:
+                self._pending_drain = None
+                self.drain(reason=pending)
             with self._lock:
                 busy = self._sweep_fn()
             if not busy:
@@ -591,41 +604,50 @@ class HttpEdge:
                               target="/v1/generate")
         outcome = "error"
         try:
+            # admission VERDICT under the lock, rejection WRITE
+            # outside it (locklint LK003): _respond's sendall is
+            # peer-paced — a client that stops reading must stall
+            # only its own connection thread, never the router lock
+            # every stream's poll loop shares
+            reject = None
+            rr_id = None
+            t0 = 0.0
             with self._lock:
                 if self._draining or self.router.draining:
                     self._stats["shed_503"] += 1
                     outcome = "shed_503"
-                    self._respond(conn, 503, {
-                        "error": "draining",
-                        "reason": self._drain_reason}, extra=retry)
-                    return
+                    reject = (503, {"error": "draining",
+                                    "reason": self._drain_reason},
+                              retry)
                 # backpressure mapped onto the ADMISSION QUEUE: the
                 # edge never buffers what the fleet has no room for
-                if self.router.queue_space() <= 0:
+                elif self.router.queue_space() <= 0:
                     self._stats["shed_429"] += 1
                     outcome = "shed_429"
-                    self._respond(conn, 429,
-                                  {"error": "queue full"}, extra=retry)
-                    return
-                t0 = self.clock()
-                try:
-                    rr_id = self._submit_fn(
-                        prompt, max_new=max_new,
-                        deadline_ms=deadline_ms, sampling=sampling)
-                except ValueError as e:
-                    outcome = "rejected"
-                    self._respond(conn, 400, {"error": str(e)})
-                    return
-                except QueueFullError as e:
-                    # raced the gate (or a router-level shed): same
-                    # 429 the gate would have given
-                    self._stats["shed_429"] += 1
-                    outcome = "shed_429"
-                    self._respond(conn, 429, {"error": str(e)},
-                                  extra=retry)
-                    return
-                self._stats["requests"] += 1
-                self._active_streams += 1
+                    reject = (429, {"error": "queue full"}, retry)
+                else:
+                    t0 = self.clock()
+                    try:
+                        rr_id = self._submit_fn(
+                            prompt, max_new=max_new,
+                            deadline_ms=deadline_ms,
+                            sampling=sampling)
+                    except ValueError as e:
+                        outcome = "rejected"
+                        reject = (400, {"error": str(e)}, None)
+                    except QueueFullError as e:
+                        # raced the gate (or a router-level shed):
+                        # same 429 the gate would have given
+                        self._stats["shed_429"] += 1
+                        outcome = "shed_429"
+                        reject = (429, {"error": str(e)}, retry)
+                    else:
+                        self._stats["requests"] += 1
+                        self._active_streams += 1
+            if reject is not None:
+                status, payload, extra = reject
+                self._respond(conn, status, payload, extra=extra)
+                return
             self._wake.set()
             if self.tracer is not None:
                 self.tracer.event(tid, "submitted", rr_id=rr_id)

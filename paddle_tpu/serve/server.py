@@ -381,6 +381,9 @@ class ServingServer:
         self._draining = False
         self._drain_deadline: Optional[float] = None
         self._drain_reason: Optional[str] = None
+        # set by the signal handler, consumed at the top of step():
+        # the handler itself must not log, dump, or drain (LK005)
+        self._pending_signal: Optional[int] = None
         self.drain_report: Optional[dict] = None
 
         # AOT engine artifacts (serve.artifact, docs/SERVING.md "AOT
@@ -997,11 +1000,13 @@ class ServingServer:
                         self._drain_deadline - self.clock())
 
     def _install_signals(self):
+        # the handler only SETS A FLAG (locklint LK005): it runs
+        # between bytecodes of the drive loop itself — logging (the
+        # drain banner), the flight dump's file I/O, and the ledger
+        # walk all re-enter non-reentrant state if done here. step()
+        # consumes the flag at its next iteration.
         def handler(signum, frame):
-            if self.flight is not None:
-                self.flight.record("signal", f"signal-{signum}")
-                self._flight_dump(f"signal-{signum}")
-            self.drain(reason=f"signal {signum}")
+            self._pending_signal = signum
 
         try:
             return {s: signal.signal(s, handler)
@@ -1431,6 +1436,13 @@ class ServingServer:
 
         if self._state is None:
             self._reset_pool()
+        signum = self._pending_signal
+        if signum is not None:
+            self._pending_signal = None
+            if self.flight is not None:
+                self.flight.record("signal", f"signal-{signum}")
+                self._flight_dump(f"signal-{signum}")
+            self.drain(reason=f"signal {signum}")
         if self._draining:
             for req in list(self.queue):
                 self.queue.remove(req)

@@ -457,10 +457,11 @@ class ResilientTrainer:
         when not in the main thread (signal API restriction)."""
         self._preempt_signum = None
 
+        # flag only (locklint LK005): the handler interrupts the
+        # train loop between bytecodes — logging here re-enters the
+        # logging module's non-reentrant handler locks; the banner
+        # moves to _maybe_drain, the step-boundary consumer
         def handler(signum, frame):
-            log.warning("preemption signal %d received; draining one "
-                        "final checkpoint at the next step boundary",
-                        signum)
             self._preempt_signum = signum
 
         try:
@@ -516,6 +517,9 @@ class ResilientTrainer:
     def _maybe_drain(self, state: TrainState) -> None:
         if self._preempt_signum is None:
             return
+        log.warning("preemption signal %d received; draining one "
+                    "final checkpoint at step boundary %d",
+                    self._preempt_signum, int(state.step))
         if self.flight is not None:
             self.flight.record("signal", "preemption-drain",
                                signum=self._preempt_signum,

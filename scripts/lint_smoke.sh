@@ -1,18 +1,26 @@
 #!/usr/bin/env sh
-# Lint smoke lane: the static-analysis gate plus its test suite, one
+# Lint smoke lane: the static-analysis gate plus its test suites, one
 # command (docs/ANALYSIS.md):
 #
 #   1. `python -m paddle_tpu.analysis --check` — graftlint (GL001-
 #      GL006 trace-safety/recompile discipline, GL007 obs clock/
-#      logging discipline in serve/train) + locklint (LK001 lock
-#      discipline) over the whole repo against the committed
+#      logging discipline in serve/train) + locklint (LK001-LK005
+#      concurrency discipline, incl. the project-wide LK002
+#      lock-order graph) over the whole repo against the committed
 #      baseline (paddle_tpu/analysis/baseline.json); any unbaselined
 #      finding fails the lane.
 #   2. `pytest -m analysis` — per-rule must-flag/near-miss fixtures
 #      and the RecompileGuard steady-state regressions (decode loop
 #      and train step compile once, then zero recompiles / implicit
 #      transfers).
-#   3. `python -m paddle_tpu obs schema` — the metrics-exporter
+#   3. `pytest -m 'locks and not slow'` — the graftlock lane: LK002-
+#      LK005 rule fixtures, the LockOrderGuard unit suite, and the
+#      fast chaos re-runs under the guard (edge disconnect, pserver
+#      failover, bit-exact streaming).
+#   4. one fault-lane run under LockOrderGuard: the router-kill chaos
+#      acceptance test (slow lane) re-run with every lock its fleet
+#      creates order-checked — zero inversions required.
+#   5. `python -m paddle_tpu obs schema` — the metrics-exporter
 #      golden-schema gate (the full obs lane incl. the span-audit
 #      chaos tests is scripts/obs_smoke.sh; the schema check rides
 #      here because exporter drift is a lint-class regression).
@@ -38,4 +46,8 @@ if [ "$1" = "--check-only" ]; then
 fi
 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m analysis \
     -p no:cacheprovider "$@"
+env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'locks and not slow' -p no:cacheprovider "$@"
+env JAX_PLATFORMS=cpu python -m pytest -q -p no:cacheprovider \
+    'tests/test_router.py::TestChaosKill::test_kill_midburst_exactly_once_and_hit_rate_recovers'
 exec env JAX_PLATFORMS=cpu python -m paddle_tpu obs schema

@@ -153,6 +153,13 @@ def pytest_configure(config):
         "-m 'data and faults' plus `bench.py --data-only`) runs the "
         "lane alone")
     config.addinivalue_line(
+        "markers", "locks: graftlock concurrency suite (locklint "
+        "LK002-LK005 rule fixtures, the LockOrderGuard runtime "
+        "sanitizer, chaos lanes re-run under the guard) — fast and "
+        "CPU-only, runs IN tier-1; `-m locks` (or "
+        "`scripts/lint_smoke.sh`, which adds the `--check` gate and "
+        "one fault-lane run under the guard) runs it alone")
+    config.addinivalue_line(
         "markers", "ctr: tiered embedding-cache + CTR serving suite "
         "(serve.embed_cache staleness bounds / batched miss-fill / "
         "zero-recompile gather, train.online streaming exactly-once, "
@@ -232,6 +239,26 @@ def _hermetic_compile_cache(tmp_path_factory):
 
     cli.DEFAULT_COMPILE_CACHE = str(tmp_path_factory.mktemp("xla-cache"))
     yield
+
+
+@pytest.fixture
+def lock_order_guard():
+    """Run a chaos test under the graftlock runtime sanitizer: every
+    threading.Lock/RLock the test's stack creates is instrumented,
+    the process-global acquisition-order graph is checked on every
+    acquire, and the test FAILS (at teardown) if any order inversion
+    was observed. `raise_on_violation=False` so a violation does not
+    kill a worker thread mid-scenario and cascade into unrelated
+    assertion noise — the teardown assert reports every recorded
+    violation at once."""
+    from paddle_tpu.analysis.guards import LockOrderGuard
+
+    with LockOrderGuard(raise_on_violation=False,
+                        name="chaos-lane") as g:
+        yield g
+    assert g.violations == [], (
+        "lock-order violations under the chaos lane:\n  "
+        + "\n  ".join(g.violations))
 
 
 @pytest.fixture

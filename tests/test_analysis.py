@@ -16,6 +16,8 @@ Three layers, mirroring docs/ANALYSIS.md:
 """
 
 import textwrap
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -26,7 +28,8 @@ from paddle_tpu.analysis.graftlint import Finding, lint_source
 from paddle_tpu.analysis.guards import (RecompileError, RecompileGuard,
                                         no_implicit_transfers,
                                         steady_state)
-from paddle_tpu.analysis.locklint import lint_locks_source
+from paddle_tpu.analysis.locklint import (lint_lock_graph,
+                                          lint_locks_source)
 from paddle_tpu.analysis.run import (apply_baseline, collect_findings,
                                      run_cli)
 
@@ -512,6 +515,548 @@ class TestHAMasterSnapshotErrorRegression:
             ha.stop(final_snapshot=False)
 
 
+# -- graftlock: the LK002-LK005 concurrency rules -------------------------
+
+
+def lk(src, rules, path="t.py"):
+    return [f.rule for f in lint_locks_source(
+        textwrap.dedent(src), path, rules=rules)]
+
+
+class TestLK002LockOrderCycles:
+    CYCLE = """
+        import threading
+        class A:
+            def __init__(self):
+                self._router = threading.Lock()
+                self._pool = threading.Lock()
+            def fwd(self):
+                with self._router:
+                    with self._pool:
+                        pass
+            def rev(self):
+                with self._pool:
+                    with self._router:
+                        pass
+    """
+
+    def test_must_flag_inverted_order(self):
+        fs = lint_lock_graph(
+            {"a.py": textwrap.dedent(self.CYCLE)})
+        assert [f.rule for f in fs] == ["LK002"]
+        # the message names the full cycle and both sites
+        assert "A._router" in fs[0].message
+        assert "A._pool" in fs[0].message
+        assert "opposite order" in fs[0].message
+
+    def test_near_miss_same_order_twice(self):
+        src = self.CYCLE.replace(
+            """            def rev(self):
+                with self._pool:
+                    with self._router:""",
+            """            def rev(self):
+                with self._router:
+                    with self._pool:""")
+        assert src != self.CYCLE     # the replace must have landed
+        assert lint_lock_graph({"a.py": textwrap.dedent(src)}) == []
+
+    def test_cycle_via_method_call_chain(self):
+        # fwd holds router and CALLS a helper that takes pool; rev
+        # inverts — the edge comes from the call chain, not a
+        # lexical nested with
+        src = """
+            import threading
+            class A:
+                def __init__(self):
+                    self._router = threading.Lock()
+                    self._pool = threading.Lock()
+                def fwd(self):
+                    with self._router:
+                        self._grab()
+                def _grab(self):
+                    with self._pool:
+                        pass
+                def rev(self):
+                    with self._pool:
+                        with self._router:
+                            pass
+        """
+        fs = lint_lock_graph({"a.py": textwrap.dedent(src)})
+        assert [f.rule for f in fs] == ["LK002"]
+
+    def test_cross_module_cycle_via_typed_attr(self):
+        # serve-side class holds its lock and calls into a cluster-
+        # side class that locks; a back-path inverts — only the
+        # MERGED graph sees it
+        m1 = """
+            import threading
+            from m2 import Lease
+            class Member:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._lease = Lease()
+                def tick(self):
+                    with self._lock:
+                        self._lease.renew()
+                def poke(self):
+                    with self._lock:
+                        pass
+        """
+        m2 = """
+            import threading
+            from m1 import Member
+            class Lease:
+                def __init__(self):
+                    self._mu = threading.Lock()
+                    self._member = Member()
+                def renew(self):
+                    with self._mu:
+                        pass
+                def back(self):
+                    with self._mu:
+                        self._member.poke()
+        """
+        fs = lint_lock_graph({"m1.py": textwrap.dedent(m1),
+                              "m2.py": textwrap.dedent(m2)})
+        assert [f.rule for f in fs] == ["LK002"]
+        msg = fs[0].message
+        assert "Member._lock" in msg and "Lease._mu" in msg
+        # each module alone has no cycle
+        assert lint_lock_graph({"m1.py": textwrap.dedent(m1)}) == []
+        assert lint_lock_graph({"m2.py": textwrap.dedent(m2)}) == []
+
+    RE_SRC = """
+        import threading
+        class R:
+            def __init__(self):
+                self._mu = threading.{}()
+            def outer(self):
+                with self._mu:
+                    self.inner()
+            def inner(self):
+                with self._mu:
+                    pass
+    """
+
+    def test_plain_lock_self_cycle_is_deadlock(self):
+        fs = lint_lock_graph(
+            {"r.py": textwrap.dedent(self.RE_SRC.format("Lock"))})
+        assert [f.rule for f in fs] == ["LK002"]
+        assert "self-deadlock" in fs[0].message
+
+    def test_rlock_self_cycle_is_reentrancy_not_flagged(self):
+        assert lint_lock_graph(
+            {"r.py": textwrap.dedent(self.RE_SRC.format("RLock"))}
+        ) == []
+
+    def test_suppression_applies(self):
+        src = textwrap.dedent(self.CYCLE).replace(
+            "with self._pool:\n            with self._router:",
+            "with self._pool:\n            # locklint: disable="
+            "LK002(order probe fixture)\n            "
+            "with self._router:")
+        assert src != textwrap.dedent(self.CYCLE)
+        assert lint_lock_graph({"a.py": src}) == []
+
+    def test_repo_graph_has_no_cycles(self):
+        # the tentpole's standing guarantee: the sanctioned orders in
+        # docs/RELIABILITY.md are acyclic at HEAD
+        fs = [f for f in collect_findings(["paddle_tpu"],
+                                          rules=["LK002"])]
+        assert fs == [], [str(f) for f in fs]
+
+
+class TestLK003BlockingUnderLock:
+    def test_must_flag_socket_write_under_lock(self):
+        assert lk("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._sock = None
+                def bad(self):
+                    with self._lock:
+                        self._sock.sendall(b"x")
+        """, ["LK003"]) == ["LK003"]
+
+    def test_near_miss_snapshot_then_write_outside(self):
+        assert lk("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._sock = None
+                def good(self):
+                    with self._lock:
+                        data = b"x"
+                    self._sock.sendall(data)
+        """, ["LK003"]) == []
+
+    def test_wait_without_timeout_flagged_with_timeout_clean(self):
+        src = """
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._ev = threading.Event()
+                def f(self):
+                    with self._lock:
+                        self._ev.wait({})
+        """
+        assert lk(src.format(""), ["LK003"]) == ["LK003"]
+        assert lk(src.format("timeout=1.0"), ["LK003"]) == []
+
+    def test_condition_wait_on_own_lock_is_the_cv_idiom(self):
+        # Condition.wait RELEASES the lock — the one .wait() that is
+        # sanctioned under it
+        assert lk("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Condition()
+                def f(self):
+                    with self._lock:
+                        self._lock.wait()
+        """, ["LK003"]) == []
+
+    def test_jit_callable_under_lock(self):
+        src = """
+            import threading, jax
+            class J:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._step = jax.jit(lambda x: x)
+                def bad(self, x):
+                    with self._lock:
+                        return self._step(x)
+        """
+        assert lk(src, ["LK003"]) == ["LK003"]
+
+    def test_transitive_through_same_class_call(self):
+        fs = lint_locks_source(textwrap.dedent("""
+            import threading, time
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def outer(self):
+                    with self._lock:
+                        self._helper()
+                def _helper(self):
+                    time.sleep(0.1)
+        """), "t.py", rules=["LK003"])
+        assert [f.rule for f in fs] == ["LK003"]
+        assert "_helper" in fs[0].message
+
+    def test_suppression_applies(self):
+        assert lk("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._sock = None
+                def f(self):
+                    with self._lock:
+                        # locklint: disable=LK003(ACK-after-tail
+                        # ordering requires the send under the lock)
+                        self._sock.sendall(b"x")
+        """, ["LK003"]) == []
+
+
+class TestLK004ThreadLifecycle:
+    def test_must_flag_fire_and_forget(self):
+        assert lk("""
+            import threading
+            def spawn():
+                threading.Thread(target=print).start()
+        """, ["LK004"]) == ["LK004"]
+
+    def test_near_miss_daemon(self):
+        assert lk("""
+            import threading
+            def spawn():
+                threading.Thread(target=print, daemon=True).start()
+        """, ["LK004"]) == []
+
+    def test_near_miss_bound_and_joined(self):
+        assert lk("""
+            import threading
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=print)
+                    self._t.start()
+                def stop(self):
+                    self._t.join(timeout=1.0)
+        """, ["LK004"]) == []
+
+    def test_listcomp_fanout_join_loop_is_clean(self):
+        # the idiomatic shape test_native_runtime uses
+        assert lk("""
+            import threading
+            def fan():
+                ts = [threading.Thread(target=print)
+                      for _ in range(4)]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+        """, ["LK004"]) == []
+
+    def test_holds_lock_target_flagged(self):
+        # a FRESH thread holds nothing: a holds-lock annotated
+        # target run as a thread body is a contradiction
+        fs = lint_locks_source(textwrap.dedent("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def spawn(self):
+                    self._t = threading.Thread(target=self._body,
+                                               daemon=True)
+                # locklint: holds-lock(callers lock first)
+                def _body(self):
+                    pass
+        """), "t.py", rules=["LK004"])
+        assert [f.rule for f in fs] == ["LK004"]
+        assert "holds-lock" in fs[0].message
+
+
+class TestLK005SignalSafety:
+    def test_must_flag_handler_taking_lock(self):
+        fs = lint_locks_source(textwrap.dedent("""
+            import signal, threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def install(self):
+                    def handler(signum, frame):
+                        self.drain()
+                    signal.signal(signal.SIGTERM, handler)
+                def drain(self):
+                    with self._lock:
+                        pass
+        """), "t.py", rules=["LK005"])
+        assert [f.rule for f in fs] == ["LK005"]
+        assert "self._lock" in fs[0].message
+
+    def test_must_flag_handler_logging(self):
+        assert lk("""
+            import logging, signal
+            log = logging.getLogger(__name__)
+            def handler(signum, frame):
+                log.warning("got %d", signum)
+            def install():
+                signal.signal(signal.SIGTERM, handler)
+        """, ["LK005"]) == ["LK005"]
+
+    def test_near_miss_flag_only_handler(self):
+        assert lk("""
+            import signal
+            class S:
+                def install(self):
+                    def handler(signum, frame):
+                        self._pending = signum
+                    signal.signal(signal.SIGTERM, handler)
+        """, ["LK005"]) == []
+
+    def test_hardened_signal_surfaces_stay_clean(self):
+        # the PR's fix sweep: every signal handler in the package
+        # defers to a flag (http_edge, server, resilience)
+        fs = collect_findings(["paddle_tpu"], rules=["LK005"])
+        assert fs == [], [str(f) for f in fs]
+
+
+class TestLockSweptModulesStayClean:
+    def test_fix_sweep_holds(self):
+        # the ISSUE's fix-sweep targets, under every LK rule the
+        # per-file pass runs — anything new here must be fixed or
+        # land in the baseline with a written reason
+        fs = collect_findings([
+            "paddle_tpu/serve/http_edge.py",
+            "paddle_tpu/serve/transport.py",
+            "paddle_tpu/serve/router.py",
+            "paddle_tpu/cluster/membership.py",
+            "paddle_tpu/serve/shm_arena.py",
+        ], rules=["LK001", "LK003", "LK004", "LK005"])
+        assert fs == [], [str(f) for f in fs]
+
+
+# -- LockOrderGuard: the runtime half of graftlock ------------------------
+
+
+@pytest.mark.locks
+class TestLockOrderGuard:
+    def test_inversion_raises_naming_both_sites(self):
+        from paddle_tpu.analysis.guards import (LockOrderError,
+                                                LockOrderGuard)
+
+        with LockOrderGuard(raise_on_violation=False) as g:
+            a, b = threading.Lock(), threading.Lock()
+
+            def fwd():
+                with a:
+                    with b:
+                        pass
+
+            def rev():
+                with b:
+                    with a:
+                        pass
+
+            for fn in (fwd, rev):
+                t = threading.Thread(target=fn)
+                t.start()
+                t.join()
+        assert len(g.violations) == 1
+        msg = g.violations[0]
+        assert "lock order inverted" in msg
+        assert "test_analysis.py" in msg     # both sites named
+        # raise_on_violation=True surfaces it as LockOrderError from
+        # __exit__ even when a worker thread swallowed it
+        with pytest.raises(LockOrderError, match="inverted"):
+            with LockOrderGuard() as g2:
+                a, b = threading.Lock(), threading.Lock()
+                for first, second in ((a, b), (b, a)):
+                    def run(x=first, y=second):
+                        try:
+                            with x:
+                                with y:
+                                    pass
+                        except LockOrderError:
+                            pass        # swallowed in the worker
+                    t = threading.Thread(target=run)
+                    t.start()
+                    t.join()
+
+    def test_cycle_across_three_threads(self):
+        # no PAIR is ever inverted — only the 3-cycle A->B->C->A is
+        # wrong; DFS reachability must catch it
+        from paddle_tpu.analysis.guards import LockOrderGuard
+
+        with LockOrderGuard(raise_on_violation=False) as g:
+            a, b, c = (threading.Lock(), threading.Lock(),
+                       threading.Lock())
+
+            def run(x, y):
+                with x:
+                    with y:
+                        pass
+
+            for x, y in ((a, b), (b, c), (c, a)):
+                t = threading.Thread(target=run, args=(x, y))
+                t.start()
+                t.join()
+        assert len(g.violations) == 1
+        assert "established" in g.violations[0]
+
+    def test_rlock_reentrancy_not_flagged(self):
+        from paddle_tpu.analysis.guards import LockOrderGuard
+
+        with LockOrderGuard() as g:
+            r = threading.RLock()
+            with r:
+                with r:
+                    with r:
+                        pass
+        assert g.violations == []
+
+    def test_plain_lock_self_deadlock_raises_instead_of_hanging(self):
+        from paddle_tpu.analysis.guards import (LockOrderError,
+                                                LockOrderGuard)
+
+        try:
+            with LockOrderGuard() as g:
+                l = threading.Lock()
+                l.acquire()
+                try:
+                    with pytest.raises(LockOrderError,
+                                       match="self-deadlock"):
+                        l.acquire()
+                finally:
+                    l.release()
+        except LockOrderError:
+            pass                     # __exit__ re-raise, expected
+        assert len(g.violations) == 1
+
+    def test_held_while_blocking_report(self):
+        from paddle_tpu.analysis.guards import LockOrderGuard
+
+        with LockOrderGuard(max_held_s=0.05) as g:
+            l = threading.Lock()
+            with l:
+                time.sleep(0.12)
+        assert len(g.held_reports) == 1
+        rep = g.held_reports[0]
+        assert rep["held_s"] > 0.05 and rep["bound_s"] == 0.05
+        assert "test_analysis.py" in rep["acquired_at"]
+
+    def test_trylock_records_no_edge(self):
+        from paddle_tpu.analysis.guards import LockOrderGuard
+
+        with LockOrderGuard() as g:
+            a, b = threading.Lock(), threading.Lock()
+
+            def try_side():
+                with a:
+                    if b.acquire(blocking=False):
+                        b.release()
+
+            def rev():
+                with b:
+                    with a:
+                        pass
+
+            for fn in (try_side, rev):
+                t = threading.Thread(target=fn)
+                t.start()
+                t.join()
+        assert g.violations == []
+
+    def test_condition_event_queue_built_under_guard_work(self):
+        import queue
+
+        from paddle_tpu.analysis.guards import LockOrderGuard
+
+        with LockOrderGuard() as g:
+            cv = threading.Condition()
+            done = []
+
+            def waiter():
+                with cv:
+                    cv.wait(timeout=2.0)
+                    done.append(1)
+
+            t = threading.Thread(target=waiter)
+            t.start()
+            time.sleep(0.05)
+            with cv:
+                cv.notify_all()
+            t.join()
+            ev = threading.Event()
+            ev.set()
+            assert ev.wait(0.1)
+            q = queue.Queue()
+            q.put(1)
+            assert q.get() == 1
+        assert done == [1] and g.violations == []
+
+    def test_locks_survive_guard_exit(self):
+        from paddle_tpu.analysis.guards import LockOrderGuard
+
+        with LockOrderGuard():
+            l = threading.Lock()
+        with l:                      # tracking off, lock still works
+            pass
+        assert threading.Lock is not type(l)  # patch restored
+
+    def test_single_active_guard(self):
+        from paddle_tpu.analysis.guards import LockOrderGuard
+
+        with LockOrderGuard():
+            with pytest.raises(RuntimeError, match="already active"):
+                with LockOrderGuard():
+                    pass
+
+
 # -- baseline mechanics ---------------------------------------------------
 
 
@@ -540,6 +1085,54 @@ class TestBaseline:
         rc = run_cli(["--check"])
         out = capsys.readouterr().out
         assert rc == 0, out
+
+    def test_explain_prints_catalog_entry(self, capsys):
+        for rid in ("GL001", "LK002", "lk003"):  # case-insensitive
+            assert run_cli(["--explain", rid]) == 0
+            out = capsys.readouterr().out
+            assert rid.upper() in out
+            assert "bad:" in out and "good:" in out
+
+    def test_explain_unknown_rule_errors(self, capsys):
+        with pytest.raises(SystemExit):
+            run_cli(["--explain", "LK999"])
+
+    def test_stale_prune_report_grouped_per_rule(self, tmp_path,
+                                                 capsys):
+        import json as _json
+
+        # a file with one real LK003 finding, and a baseline holding
+        # that entry plus two stale ones under different rules
+        src = tmp_path / "mod.py"
+        src.write_text(textwrap.dedent("""
+            import threading
+            class S:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._sock = None
+                def f(self):
+                    with self._lock:
+                        self._sock.sendall(b"x")
+        """))
+        rel = str(src)
+        from paddle_tpu.analysis.run import _rel
+        rel = _rel(str(src))
+        base = tmp_path / "base.json"
+        base.write_text(_json.dumps({"version": 1, "entries": [
+            {"rule": "LK003", "path": rel, "func": "S.f",
+             "count": 1, "reason": "r", "message": "m"},
+            {"rule": "LK003", "path": rel, "func": "S.gone",
+             "count": 1, "reason": "r", "message": "m"},
+            {"rule": "LK001", "path": rel, "func": "S.old",
+             "count": 1, "reason": "r", "message": "m"},
+        ]}))
+        rc = run_cli(["--check", "--baseline", str(base), str(src)])
+        out = capsys.readouterr().out
+        assert rc == 0, out          # the live finding is covered
+        assert "stale baseline entries to prune (2" in out
+        # grouped per rule, each naming its keys
+        assert "LK001" in out and "S.old" in out
+        assert "S.gone" in out
 
 
 # -- runtime guards: the two hottest loops --------------------------------

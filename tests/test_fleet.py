@@ -548,7 +548,9 @@ def test_supervisor_sigkill_leaves_no_orphan_children():
 
 @pytest.mark.slow
 @pytest.mark.heavyweight
-def test_sigkill_replica_mid_burst_exactly_once(tmp_path, params):
+@pytest.mark.locks      # chaos lane re-run under LockOrderGuard
+def test_sigkill_replica_mid_burst_exactly_once(tmp_path, params,
+                                                lock_order_guard):
     """THE chaos acceptance bar, on real OS processes: 3 replica
     children booted from a PR9 artifact, one SIGKILLed mid-burst by
     `FaultPlan.wrap_fleet`. Every request must end in exactly one

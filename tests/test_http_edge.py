@@ -186,6 +186,35 @@ def test_stream_parity_and_nonstream(params):
         edge.close()
 
 
+@pytest.mark.locks      # rides with the LK003 hot-path fix
+def test_stream_bit_exact_across_many_chunks(params,
+                                             lock_order_guard):
+    """The LK003 hot-path contract: `_snapshot` reads the partial
+    tokens UNDER the router lock and the chunked socket write happens
+    OUTSIDE it. Throttled steps force the stream through many
+    snapshot/write cycles (one or two tokens per chunk), and the
+    concatenation of every chunk must still be bit-exact against the
+    solo greedy decode — proving the restructure drops the lock
+    without ever tearing or reordering the stream. Runs under
+    LockOrderGuard so a regression that re-nests the write under the
+    lock shows up as an order violation, not just a slow stream."""
+    edge, router, srv = mk_stack(params)
+    throttle_steps(srv, delay_s=0.03)
+    try:
+        prompt = [2, 4, 6]
+        want = ref_tokens(params, prompt, 8)
+        r = stream_generate(edge.addr, prompt, 8)
+        assert r.status == 200 and r.outcome == "completed"
+        assert r.tokens == want         # bit-exact, in order
+        # the throttle spread the stream over several chunks: some
+        # inter-token gap is nonzero, so parity was across REAL
+        # snapshot/write cycles, not one lucky final chunk
+        assert any(g > 0 for g in r.gaps_s)
+        assert wait_idle(edge, router)
+    finally:
+        edge.close()
+
+
 def test_healthz_and_metrics(params):
     from paddle_tpu.obs import MetricsRegistry
 
@@ -308,7 +337,9 @@ def throttle_steps(srv, delay_s=0.03):
     srv.step = slow_step
 
 
-def test_disconnect_mid_stream_frees_slot_and_pages(params):
+@pytest.mark.locks      # chaos lane re-run under LockOrderGuard
+def test_disconnect_mid_stream_frees_slot_and_pages(
+        params, lock_order_guard):
     """The tentpole invariant: a client vanishing mid-stream costs
     the fleet NOTHING durable — the in-flight request is force-
     expired through the deadline/retire path, its slot and pages

@@ -270,7 +270,9 @@ def test_backup_fast_restart_gap_refused_then_resynced():
 
 # ---- the acceptance chaos run ------------------------------------------
 
-def test_chaos_primary_kill_midpass_failover_bit_identical():
+@pytest.mark.locks      # chaos lane re-run under LockOrderGuard
+def test_chaos_primary_kill_midpass_failover_bit_identical(
+        lock_order_guard):
     """Kill the primary of shard 0 on its 3rd push, MID-PASS, while a
     lost ACK hits shard 1 — the client fails over to shard 0's chain
     replica, re-registers, retries the in-flight epoch, finishes the

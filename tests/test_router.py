@@ -199,8 +199,9 @@ class TestRouting:
 
 class TestChaosKill:
     @pytest.mark.slow  # tier-1 budget guard: >10s-class test, slow lane
+    @pytest.mark.locks  # chaos lane re-run under LockOrderGuard
     def test_kill_midburst_exactly_once_and_hit_rate_recovers(
-            self, params, engines):
+            self, params, engines, lock_order_guard):
         """THE acceptance chaos run (ISSUE 6): >= 3 replicas under a
         mixed burst (3 prefix families + garbage traffic), one
         replica killed at a decode step MID-burst (slots occupied,
